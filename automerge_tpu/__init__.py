@@ -1,0 +1,10 @@
+"""automerge_tpu -- a TPU-native CRDT document framework.
+
+A ground-up rebuild of the capabilities of unao/automerge (JSON-document
+CRDTs: maps, lists, text, tables, causal sync, undo/redo, save/load) designed
+for TPU execution: the causal-graph resolver runs as batched JAX/XLA kernels
+over columnar operation records, resolving thousands of documents in one
+vectorized pass, sharded over a device mesh.
+"""
+
+__version__ = '0.1.0'

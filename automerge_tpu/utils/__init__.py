@@ -1,0 +1,4 @@
+from .common import ROOT_ID, is_object, less_or_equal
+from .uuid import uuid, set_factory, reset
+
+__all__ = ['ROOT_ID', 'is_object', 'less_or_equal', 'uuid', 'set_factory', 'reset']

@@ -1,0 +1,17 @@
+"""Shared constants and helpers (reference: `/root/reference/src/common.js`)."""
+
+ROOT_ID = '00000000-0000-0000-0000-000000000000'
+
+
+def is_object(value):
+    """True for values that map to Automerge objects (dict/list/Text/Table)."""
+    return isinstance(value, (dict, list)) or hasattr(value, '_am_object')
+
+
+def less_or_equal(clock1, clock2):
+    """True if every component of vector clock `clock1` is <= the matching
+    component of `clock2` (reference: `/root/reference/src/common.js:14-18`)."""
+    for key in set(clock1) | set(clock2):
+        if clock1.get(key, 0) > clock2.get(key, 0):
+            return False
+    return True

@@ -1,0 +1,461 @@
+"""Backend unit tests: hand-written change JSON in, exact patch JSON out.
+
+Ported from `/root/reference/test/backend_test.js` -- these fixtures are the
+differential-testing seam: any backend implementation (oracle or TPU batch
+engine) must produce identical patches for these exact inputs.
+"""
+
+import pytest
+
+from automerge_tpu import backend as Backend
+from automerge_tpu.errors import RangeError
+from automerge_tpu.utils.uuid import uuid
+
+ROOT_ID = '00000000-0000-0000-0000-000000000000'
+
+
+class TestIncrementalDiffs:
+    def test_assign_to_a_key_in_a_map(self):
+        actor = uuid()
+        change1 = {'actor': actor, 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'bird', 'value': 'magpie'}
+        ]}
+        s0 = Backend.init()
+        s1, patch1 = Backend.apply_changes(s0, [change1])
+        assert patch1 == {
+            'canUndo': False, 'canRedo': False,
+            'clock': {actor: 1}, 'deps': {actor: 1},
+            'diffs': [{'action': 'set', 'obj': ROOT_ID, 'path': [],
+                       'type': 'map', 'key': 'bird', 'value': 'magpie'}]
+        }
+
+    def test_conflict_on_assignment_to_same_key(self):
+        change1 = {'actor': 'actor1', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'bird', 'value': 'magpie'}
+        ]}
+        change2 = {'actor': 'actor2', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'bird', 'value': 'blackbird'}
+        ]}
+        s0 = Backend.init()
+        s1, _ = Backend.apply_changes(s0, [change1])
+        s2, patch2 = Backend.apply_changes(s1, [change2])
+        assert patch2 == {
+            'canUndo': False, 'canRedo': False,
+            'clock': {'actor1': 1, 'actor2': 1},
+            'deps': {'actor1': 1, 'actor2': 1},
+            'diffs': [{'action': 'set', 'obj': ROOT_ID, 'path': [], 'type': 'map',
+                       'key': 'bird', 'value': 'blackbird',
+                       'conflicts': [{'actor': 'actor1', 'value': 'magpie'}]}]
+        }
+
+    def test_delete_key_from_map(self):
+        actor = uuid()
+        change1 = {'actor': actor, 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'bird', 'value': 'magpie'}
+        ]}
+        change2 = {'actor': actor, 'seq': 2, 'deps': {}, 'ops': [
+            {'action': 'del', 'obj': ROOT_ID, 'key': 'bird'}
+        ]}
+        s0 = Backend.init()
+        s1, _ = Backend.apply_changes(s0, [change1])
+        s2, patch2 = Backend.apply_changes(s1, [change2])
+        assert patch2 == {
+            'canUndo': False, 'canRedo': False,
+            'clock': {actor: 2}, 'deps': {actor: 2},
+            'diffs': [{'action': 'remove', 'obj': ROOT_ID, 'path': [],
+                       'type': 'map', 'key': 'bird'}]
+        }
+
+    def test_create_nested_maps(self):
+        birds, actor = uuid(), uuid()
+        change1 = {'actor': actor, 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'makeMap', 'obj': birds},
+            {'action': 'set', 'obj': birds, 'key': 'wrens', 'value': 3},
+            {'action': 'link', 'obj': ROOT_ID, 'key': 'birds', 'value': birds}
+        ]}
+        s0 = Backend.init()
+        s1, patch1 = Backend.apply_changes(s0, [change1])
+        assert patch1 == {
+            'canUndo': False, 'canRedo': False,
+            'clock': {actor: 1}, 'deps': {actor: 1},
+            'diffs': [
+                {'action': 'create', 'obj': birds, 'type': 'map'},
+                {'action': 'set', 'obj': birds, 'type': 'map', 'path': None,
+                 'key': 'wrens', 'value': 3},
+                {'action': 'set', 'obj': ROOT_ID, 'type': 'map', 'path': [],
+                 'key': 'birds', 'value': birds, 'link': True}
+            ]
+        }
+
+    def test_assign_to_keys_in_nested_maps(self):
+        birds, actor = uuid(), uuid()
+        change1 = {'actor': actor, 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'makeMap', 'obj': birds},
+            {'action': 'set', 'obj': birds, 'key': 'wrens', 'value': 3},
+            {'action': 'link', 'obj': ROOT_ID, 'key': 'birds', 'value': birds}
+        ]}
+        change2 = {'actor': actor, 'seq': 2, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': birds, 'key': 'sparrows', 'value': 15}
+        ]}
+        s0 = Backend.init()
+        s1, _ = Backend.apply_changes(s0, [change1])
+        s2, patch2 = Backend.apply_changes(s1, [change2])
+        assert patch2 == {
+            'canUndo': False, 'canRedo': False,
+            'clock': {actor: 2}, 'deps': {actor: 2},
+            'diffs': [{'action': 'set', 'obj': birds, 'type': 'map',
+                       'path': ['birds'], 'key': 'sparrows', 'value': 15}]
+        }
+
+    def test_create_lists(self):
+        birds, actor = uuid(), uuid()
+        change1 = {'actor': actor, 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'makeList', 'obj': birds},
+            {'action': 'ins', 'obj': birds, 'key': '_head', 'elem': 1},
+            {'action': 'set', 'obj': birds, 'key': '%s:1' % actor, 'value': 'chaffinch'},
+            {'action': 'link', 'obj': ROOT_ID, 'key': 'birds', 'value': birds}
+        ]}
+        s0 = Backend.init()
+        s1, patch1 = Backend.apply_changes(s0, [change1])
+        assert patch1 == {
+            'canUndo': False, 'canRedo': False,
+            'clock': {actor: 1}, 'deps': {actor: 1},
+            'diffs': [
+                {'action': 'create', 'obj': birds, 'type': 'list'},
+                {'action': 'insert', 'obj': birds, 'type': 'list', 'path': None,
+                 'index': 0, 'value': 'chaffinch', 'elemId': '%s:1' % actor},
+                {'action': 'set', 'obj': ROOT_ID, 'type': 'map', 'path': [],
+                 'key': 'birds', 'value': birds, 'link': True}
+            ]
+        }
+
+    def test_apply_updates_inside_lists(self):
+        birds, actor = uuid(), uuid()
+        change1 = {'actor': actor, 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'makeList', 'obj': birds},
+            {'action': 'ins', 'obj': birds, 'key': '_head', 'elem': 1},
+            {'action': 'set', 'obj': birds, 'key': '%s:1' % actor, 'value': 'chaffinch'},
+            {'action': 'link', 'obj': ROOT_ID, 'key': 'birds', 'value': birds}
+        ]}
+        change2 = {'actor': actor, 'seq': 2, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': birds, 'key': '%s:1' % actor, 'value': 'greenfinch'}
+        ]}
+        s0 = Backend.init()
+        s1, _ = Backend.apply_changes(s0, [change1])
+        s2, patch2 = Backend.apply_changes(s1, [change2])
+        assert patch2 == {
+            'canUndo': False, 'canRedo': False,
+            'clock': {actor: 2}, 'deps': {actor: 2},
+            'diffs': [{'action': 'set', 'obj': birds, 'type': 'list',
+                       'path': ['birds'], 'index': 0, 'value': 'greenfinch'}]
+        }
+
+    def test_delete_list_elements(self):
+        birds, actor = uuid(), uuid()
+        change1 = {'actor': actor, 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'makeList', 'obj': birds},
+            {'action': 'ins', 'obj': birds, 'key': '_head', 'elem': 1},
+            {'action': 'set', 'obj': birds, 'key': '%s:1' % actor, 'value': 'chaffinch'},
+            {'action': 'link', 'obj': ROOT_ID, 'key': 'birds', 'value': birds}
+        ]}
+        change2 = {'actor': actor, 'seq': 2, 'deps': {}, 'ops': [
+            {'action': 'del', 'obj': birds, 'key': '%s:1' % actor}
+        ]}
+        s0 = Backend.init()
+        s1, _ = Backend.apply_changes(s0, [change1])
+        s2, patch2 = Backend.apply_changes(s1, [change2])
+        assert patch2 == {
+            'canUndo': False, 'canRedo': False,
+            'clock': {actor: 2}, 'deps': {actor: 2},
+            'diffs': [{'action': 'remove', 'obj': birds, 'type': 'list',
+                       'path': ['birds'], 'index': 0}]
+        }
+
+    def test_timestamp_at_root(self):
+        now = 1234567890123
+        actor = uuid()
+        change = {'actor': actor, 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'now', 'value': now,
+             'datatype': 'timestamp'}
+        ]}
+        s0 = Backend.init()
+        s1, patch = Backend.apply_changes(s0, [change])
+        assert patch == {
+            'canUndo': False, 'canRedo': False,
+            'clock': {actor: 1}, 'deps': {actor: 1},
+            'diffs': [{'action': 'set', 'obj': ROOT_ID, 'type': 'map',
+                       'path': [], 'key': 'now', 'value': now,
+                       'datatype': 'timestamp'}]
+        }
+
+    def test_timestamp_in_list(self):
+        now, lst, actor = 1234567890123, uuid(), uuid()
+        change = {'actor': actor, 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'makeList', 'obj': lst},
+            {'action': 'ins', 'obj': lst, 'key': '_head', 'elem': 1},
+            {'action': 'set', 'obj': lst, 'key': '%s:1' % actor, 'value': now,
+             'datatype': 'timestamp'},
+            {'action': 'link', 'obj': ROOT_ID, 'key': 'list', 'value': lst}
+        ]}
+        s0 = Backend.init()
+        s1, patch = Backend.apply_changes(s0, [change])
+        assert patch == {
+            'canUndo': False, 'canRedo': False,
+            'clock': {actor: 1}, 'deps': {actor: 1},
+            'diffs': [
+                {'action': 'create', 'obj': lst, 'type': 'list'},
+                {'action': 'insert', 'obj': lst, 'type': 'list', 'path': None,
+                 'index': 0, 'value': now, 'elemId': '%s:1' % actor,
+                 'datatype': 'timestamp'},
+                {'action': 'set', 'obj': ROOT_ID, 'type': 'map', 'path': [],
+                 'key': 'list', 'value': lst, 'link': True}
+            ]
+        }
+
+
+class TestApplyLocalChange:
+    def test_apply_change_requests(self):
+        actor = uuid()
+        change1 = {'requestType': 'change', 'actor': actor, 'seq': 1, 'deps': {},
+                   'ops': [{'action': 'set', 'obj': ROOT_ID, 'key': 'bird',
+                            'value': 'magpie'}]}
+        s0 = Backend.init()
+        s1, patch1 = Backend.apply_local_change(s0, change1)
+        assert patch1 == {
+            'actor': actor, 'seq': 1, 'canUndo': True, 'canRedo': False,
+            'clock': {actor: 1}, 'deps': {actor: 1},
+            'diffs': [{'action': 'set', 'obj': ROOT_ID, 'path': [],
+                       'type': 'map', 'key': 'bird', 'value': 'magpie'}]
+        }
+
+    def test_throws_on_duplicate_requests(self):
+        actor = uuid()
+        change1 = {'requestType': 'change', 'actor': actor, 'seq': 1, 'deps': {},
+                   'ops': [{'action': 'set', 'obj': ROOT_ID, 'key': 'bird',
+                            'value': 'magpie'}]}
+        change2 = {'requestType': 'change', 'actor': actor, 'seq': 2, 'deps': {},
+                   'ops': [{'action': 'set', 'obj': ROOT_ID, 'key': 'bird',
+                            'value': 'jay'}]}
+        s0 = Backend.init()
+        s1, _ = Backend.apply_local_change(s0, change1)
+        s2, _ = Backend.apply_local_change(s1, change2)
+        with pytest.raises(RangeError, match='Change request has already been applied'):
+            Backend.apply_local_change(s2, change1)
+        with pytest.raises(RangeError, match='Change request has already been applied'):
+            Backend.apply_local_change(s2, change2)
+
+
+class TestGetPatch:
+    def test_most_recent_value_for_key(self):
+        actor = uuid()
+        change1 = {'actor': actor, 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'bird', 'value': 'magpie'}]}
+        change2 = {'actor': actor, 'seq': 2, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'bird', 'value': 'blackbird'}]}
+        s0 = Backend.init()
+        s1, _ = Backend.apply_changes(s0, [change1, change2])
+        assert Backend.get_patch(s1) == {
+            'canUndo': False, 'canRedo': False,
+            'clock': {actor: 2}, 'deps': {actor: 2},
+            'diffs': [{'action': 'set', 'obj': ROOT_ID, 'type': 'map',
+                       'key': 'bird', 'value': 'blackbird'}]
+        }
+
+    def test_conflicting_values_for_key(self):
+        change1 = {'actor': 'actor1', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'bird', 'value': 'magpie'}]}
+        change2 = {'actor': 'actor2', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'bird', 'value': 'blackbird'}]}
+        s0 = Backend.init()
+        s1, _ = Backend.apply_changes(s0, [change1, change2])
+        assert Backend.get_patch(s1) == {
+            'canUndo': False, 'canRedo': False,
+            'clock': {'actor1': 1, 'actor2': 1},
+            'deps': {'actor1': 1, 'actor2': 1},
+            'diffs': [{'action': 'set', 'obj': ROOT_ID, 'type': 'map',
+                       'key': 'bird', 'value': 'blackbird',
+                       'conflicts': [{'actor': 'actor1', 'value': 'magpie'}]}]
+        }
+
+    def test_nested_maps(self):
+        birds, actor = uuid(), uuid()
+        change1 = {'actor': actor, 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'makeMap', 'obj': birds},
+            {'action': 'set', 'obj': birds, 'key': 'wrens', 'value': 3},
+            {'action': 'link', 'obj': ROOT_ID, 'key': 'birds', 'value': birds}]}
+        change2 = {'actor': actor, 'seq': 2, 'deps': {}, 'ops': [
+            {'action': 'del', 'obj': birds, 'key': 'wrens'},
+            {'action': 'set', 'obj': birds, 'key': 'sparrows', 'value': 15}]}
+        s0 = Backend.init()
+        s1, _ = Backend.apply_changes(s0, [change1, change2])
+        assert Backend.get_patch(s1) == {
+            'canUndo': False, 'canRedo': False,
+            'clock': {actor: 2}, 'deps': {actor: 2},
+            'diffs': [
+                {'action': 'create', 'obj': birds, 'type': 'map'},
+                {'action': 'set', 'obj': birds, 'type': 'map',
+                 'key': 'sparrows', 'value': 15},
+                {'action': 'set', 'obj': ROOT_ID, 'type': 'map',
+                 'key': 'birds', 'value': birds, 'link': True}
+            ]
+        }
+
+    def test_create_lists(self):
+        birds, actor = uuid(), uuid()
+        change1 = {'actor': actor, 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'makeList', 'obj': birds},
+            {'action': 'ins', 'obj': birds, 'key': '_head', 'elem': 1},
+            {'action': 'set', 'obj': birds, 'key': '%s:1' % actor, 'value': 'chaffinch'},
+            {'action': 'link', 'obj': ROOT_ID, 'key': 'birds', 'value': birds}]}
+        s0 = Backend.init()
+        s1, _ = Backend.apply_changes(s0, [change1])
+        assert Backend.get_patch(s1) == {
+            'canUndo': False, 'canRedo': False,
+            'clock': {actor: 1}, 'deps': {actor: 1},
+            'diffs': [
+                {'action': 'create', 'obj': birds, 'type': 'list'},
+                {'action': 'insert', 'obj': birds, 'type': 'list', 'index': 0,
+                 'value': 'chaffinch', 'elemId': '%s:1' % actor},
+                {'action': 'set', 'obj': ROOT_ID, 'type': 'map',
+                 'key': 'birds', 'value': birds, 'link': True}
+            ]
+        }
+
+    def test_latest_state_of_list(self):
+        birds, actor = uuid(), uuid()
+        change1 = {'actor': actor, 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'makeList', 'obj': birds},
+            {'action': 'ins', 'obj': birds, 'key': '_head', 'elem': 1},
+            {'action': 'set', 'obj': birds, 'key': '%s:1' % actor, 'value': 'chaffinch'},
+            {'action': 'ins', 'obj': birds, 'key': '%s:1' % actor, 'elem': 2},
+            {'action': 'set', 'obj': birds, 'key': '%s:2' % actor, 'value': 'goldfinch'},
+            {'action': 'link', 'obj': ROOT_ID, 'key': 'birds', 'value': birds}]}
+        change2 = {'actor': actor, 'seq': 2, 'deps': {}, 'ops': [
+            {'action': 'del', 'obj': birds, 'key': '%s:1' % actor},
+            {'action': 'ins', 'obj': birds, 'key': '%s:1' % actor, 'elem': 3},
+            {'action': 'set', 'obj': birds, 'key': '%s:3' % actor, 'value': 'greenfinch'},
+            {'action': 'set', 'obj': birds, 'key': '%s:2' % actor, 'value': 'goldfinches!!'}]}
+        s0 = Backend.init()
+        s1, _ = Backend.apply_changes(s0, [change1, change2])
+        assert Backend.get_patch(s1) == {
+            'canUndo': False, 'canRedo': False,
+            'clock': {actor: 2}, 'deps': {actor: 2},
+            'diffs': [
+                {'action': 'create', 'obj': birds, 'type': 'list'},
+                {'action': 'insert', 'obj': birds, 'type': 'list', 'index': 0,
+                 'value': 'greenfinch', 'elemId': '%s:3' % actor},
+                {'action': 'insert', 'obj': birds, 'type': 'list', 'index': 1,
+                 'value': 'goldfinches!!', 'elemId': '%s:2' % actor},
+                {'action': 'set', 'obj': ROOT_ID, 'type': 'map',
+                 'key': 'birds', 'value': birds, 'link': True}
+            ]
+        }
+
+    def test_nested_maps_in_lists(self):
+        todos, item, actor = uuid(), uuid(), uuid()
+        change = {'actor': actor, 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'makeList', 'obj': todos},
+            {'action': 'ins', 'obj': todos, 'key': '_head', 'elem': 1},
+            {'action': 'makeMap', 'obj': item},
+            {'action': 'set', 'obj': item, 'key': 'title', 'value': 'water plants'},
+            {'action': 'set', 'obj': item, 'key': 'done', 'value': False},
+            {'action': 'link', 'obj': todos, 'key': '%s:1' % actor, 'value': item},
+            {'action': 'link', 'obj': ROOT_ID, 'key': 'todos', 'value': todos}]}
+        s0 = Backend.init()
+        s1, _ = Backend.apply_changes(s0, [change])
+        assert Backend.get_patch(s1) == {
+            'canUndo': False, 'canRedo': False,
+            'clock': {actor: 1}, 'deps': {actor: 1},
+            'diffs': [
+                {'action': 'create', 'obj': item, 'type': 'map'},
+                {'action': 'set', 'obj': item, 'type': 'map',
+                 'key': 'title', 'value': 'water plants'},
+                {'action': 'set', 'obj': item, 'type': 'map',
+                 'key': 'done', 'value': False},
+                {'action': 'create', 'obj': todos, 'type': 'list'},
+                {'action': 'insert', 'obj': todos, 'type': 'list', 'index': 0,
+                 'value': item, 'link': True, 'elemId': '%s:1' % actor},
+                {'action': 'set', 'obj': ROOT_ID, 'type': 'map',
+                 'key': 'todos', 'value': todos, 'link': True}
+            ]
+        }
+
+    def test_timestamps_at_root(self):
+        now = 1234567890123
+        actor = uuid()
+        change = {'actor': actor, 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'now', 'value': now,
+             'datatype': 'timestamp'}]}
+        s0 = Backend.init()
+        s1, _ = Backend.apply_changes(s0, [change])
+        assert Backend.get_patch(s1) == {
+            'canUndo': False, 'canRedo': False,
+            'clock': {actor: 1}, 'deps': {actor: 1},
+            'diffs': [{'action': 'set', 'obj': ROOT_ID, 'type': 'map',
+                       'key': 'now', 'value': now, 'datatype': 'timestamp'}]
+        }
+
+    def test_timestamps_in_list(self):
+        now, lst, actor = 1234567890123, uuid(), uuid()
+        change = {'actor': actor, 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'makeList', 'obj': lst},
+            {'action': 'ins', 'obj': lst, 'key': '_head', 'elem': 1},
+            {'action': 'set', 'obj': lst, 'key': '%s:1' % actor, 'value': now,
+             'datatype': 'timestamp'},
+            {'action': 'link', 'obj': ROOT_ID, 'key': 'list', 'value': lst}]}
+        s0 = Backend.init()
+        s1, _ = Backend.apply_changes(s0, [change])
+        assert Backend.get_patch(s1) == {
+            'canUndo': False, 'canRedo': False,
+            'clock': {actor: 1}, 'deps': {actor: 1},
+            'diffs': [
+                {'action': 'create', 'obj': lst, 'type': 'list'},
+                {'action': 'insert', 'obj': lst, 'type': 'list', 'index': 0,
+                 'value': now, 'elemId': '%s:1' % actor, 'datatype': 'timestamp'},
+                {'action': 'set', 'obj': ROOT_ID, 'type': 'map',
+                 'key': 'list', 'value': lst, 'link': True}
+            ]
+        }
+
+
+class TestStatePersistence:
+    """The COW fork must preserve old states (Immutable.js parity)."""
+
+    def test_old_state_remains_valid(self):
+        actor = uuid()
+        change1 = {'actor': actor, 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'bird', 'value': 'magpie'}]}
+        change2 = {'actor': actor, 'seq': 2, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'bird', 'value': 'jay'}]}
+        s0 = Backend.init()
+        s1, _ = Backend.apply_changes(s0, [change1])
+        s2, _ = Backend.apply_changes(s1, [change2])
+        # s1 must still materialize the old value even after s2 advanced
+        patch1 = Backend.get_patch(s1)
+        assert patch1['diffs'][-1]['value'] == 'magpie'
+        assert patch1['clock'] == {actor: 1}
+        patch2 = Backend.get_patch(s2)
+        assert patch2['diffs'][-1]['value'] == 'jay'
+        # and s0 is still empty
+        assert Backend.get_patch(s0) == {
+            'canUndo': False, 'canRedo': False, 'clock': {}, 'deps': {},
+            'diffs': []
+        }
+
+    def test_causally_buffered_changes(self):
+        """Changes with unmet deps sit in the queue until prerequisites
+        arrive (reference: op_set.js:279-295, test/test.js:1319-1344)."""
+        actor = uuid()
+        change1 = {'actor': actor, 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'a', 'value': 1}]}
+        change2 = {'actor': actor, 'seq': 2, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'b', 'value': 2}]}
+        s0 = Backend.init()
+        # deliver out of order: change2 first
+        s1, patch1 = Backend.apply_changes(s0, [change2])
+        assert patch1['diffs'] == []
+        assert Backend.get_missing_deps(s1) == {actor: 1}
+        s2, patch2 = Backend.apply_changes(s1, [change1])
+        # both changes apply once the dependency arrives
+        assert [d['key'] for d in patch2['diffs']] == ['a', 'b']
+        assert Backend.get_missing_deps(s2) == {}
