@@ -5,6 +5,41 @@ CRDTs: maps, lists, text, tables, causal sync, undo/redo, save/load) designed
 for TPU execution: the causal-graph resolver runs as batched JAX/XLA kernels
 over columnar operation records, resolving thousands of documents in one
 vectorized pass, sharded over a device mesh.
+
+Public API surface mirrors the reference (`/root/reference/src/automerge.js`):
+
+    import automerge_tpu as am
+    doc = am.init()
+    doc = am.change(doc, lambda d: d.update({'cards': []}))
+    doc2 = am.merge(am.init(), doc)
 """
 
+from .api import (HistoryEntry, apply_changes, applyChanges, can_redo,
+                  can_undo, canRedo, canUndo, change, diff, doc_from_changes,
+                  docFromChanges, empty_change, emptyChange, equals,
+                  get_actor_id, get_changes, get_conflicts, get_element_ids,
+                  get_history, get_missing_deps, get_object_id, getActorId,
+                  getChanges, getConflicts, getHistory, getMissingDeps,
+                  getObjectId, init, inspect, load, merge, redo, save,
+                  set_actor_id, setActorId, undo)
+from . import backend as Backend
+from . import frontend as Frontend
+from .errors import AutomergeError, RangeError
+from .models.table import Table
+from .models.text import Text
+from .sync.connection import Connection
+from .sync.doc_set import DocSet
+from .sync.watchable_doc import WatchableDoc
+from .utils.uuid import uuid
+
 __version__ = '0.1.0'
+
+__all__ = [
+    'init', 'change', 'empty_change', 'undo', 'redo', 'load', 'save', 'merge',
+    'diff', 'get_changes', 'apply_changes', 'get_missing_deps', 'equals',
+    'inspect', 'get_history', 'uuid', 'Frontend', 'Backend', 'DocSet',
+    'WatchableDoc', 'Connection', 'Text', 'Table', 'can_undo', 'can_redo',
+    'get_actor_id', 'set_actor_id', 'get_conflicts', 'get_object_id',
+    'get_element_ids', 'doc_from_changes', 'HistoryEntry', 'AutomergeError',
+    'RangeError',
+]

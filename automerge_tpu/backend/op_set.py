@@ -86,10 +86,13 @@ def transitive_deps(op_set, base_deps):
     for dep_actor, dep_seq in base_deps.items():
         if dep_seq <= 0:
             continue
-        transitive = states[dep_actor][dep_seq - 1]['allDeps']
-        for a, s in transitive.items():
-            if s > deps.get(a, 0):
-                deps[a] = s
+        # A state entry we don't have merges as an empty clock, matching the
+        # reference's getIn(...) -> undefined -> mergeWith no-op behavior
+        actor_states = states.get(dep_actor, ())
+        if dep_seq - 1 < len(actor_states):
+            for a, s in actor_states[dep_seq - 1]['allDeps'].items():
+                if s > deps.get(a, 0):
+                    deps[a] = s
         deps[dep_actor] = dep_seq
     return deps
 

@@ -1,0 +1,103 @@
+"""Connection -- per-peer, multi-document sync state machine
+(reference: `/root/reference/src/connection.js`, 111 LoC).
+
+Tracks `their_clock` (most recent vector clock we believe the peer has) and
+`our_clock` (what we've advertised); ships `{docId, clock, changes}` messages
+through a user-supplied `send_msg` callback, so any transport works.  The
+message schema is kept verbatim from the reference; within a TPU slice the
+same clock-union/missing-changes algebra runs as mesh collectives
+(`automerge_tpu/parallel/replica.py`).
+"""
+
+from .. import backend as Backend
+from .. import frontend as Frontend
+from ..utils.common import less_or_equal
+
+
+def clock_union(clock_map, doc_id, clock):
+    """Merges `clock` into clock_map[doc_id] componentwise-max
+    (reference: connection.js:9-12)."""
+    merged = dict(clock_map.get(doc_id, {}))
+    for actor, seq in clock.items():
+        if seq > merged.get(actor, 0):
+            merged[actor] = seq
+    new_map = dict(clock_map)
+    new_map[doc_id] = merged
+    return new_map
+
+
+class Connection:
+    def __init__(self, doc_set, send_msg):
+        self._doc_set = doc_set
+        self._send_msg = send_msg
+        self._their_clock = {}
+        self._our_clock = {}
+
+    def open(self):
+        """(reference: connection.js:42-45)"""
+        for doc_id in self._doc_set.doc_ids:
+            self.doc_changed(doc_id, self._doc_set.get_doc(doc_id))
+        self._doc_set.register_handler(self.doc_changed)
+
+    def close(self):
+        self._doc_set.unregister_handler(self.doc_changed)
+
+    def send_msg(self, doc_id, clock, changes=None):
+        """(reference: connection.js:51-56)"""
+        msg = {'docId': doc_id, 'clock': dict(clock)}
+        self._our_clock = clock_union(self._our_clock, doc_id, clock)
+        if changes is not None:
+            msg['changes'] = changes
+        self._send_msg(msg)
+
+    def maybe_send_changes(self, doc_id):
+        """Ships changes the peer is missing, or advertises our clock
+        (reference: connection.js:58-73)."""
+        doc = self._doc_set.get_doc(doc_id)
+        state = Frontend.get_backend_state(doc)
+        clock = state['opSet']['clock']
+
+        if doc_id in self._their_clock:
+            changes = Backend.get_missing_changes(
+                state, self._their_clock[doc_id])
+            if changes:
+                self._their_clock = clock_union(self._their_clock, doc_id, clock)
+                self.send_msg(doc_id, clock, changes)
+                return
+
+        if dict(clock) != self._our_clock.get(doc_id, {}):
+            self.send_msg(doc_id, clock)
+
+    def doc_changed(self, doc_id, doc):
+        """DocSet handler (reference: connection.js:76-89)."""
+        state = Frontend.get_backend_state(doc)
+        if state is None or 'opSet' not in state:
+            raise TypeError(
+                'This object cannot be used for network sync. '
+                'Are you trying to sync a snapshot from the history?')
+        clock = state['opSet']['clock']
+        if not less_or_equal(self._our_clock.get(doc_id, {}), clock):
+            raise AssertionError('Cannot pass an old state object to a connection')
+        self.maybe_send_changes(doc_id)
+
+    def receive_msg(self, msg):
+        """(reference: connection.js:91-108)"""
+        if 'clock' in msg and msg['clock'] is not None:
+            self._their_clock = clock_union(self._their_clock, msg['docId'],
+                                            msg['clock'])
+        if 'changes' in msg and msg['changes'] is not None:
+            return self._doc_set.apply_changes(msg['docId'], msg['changes'])
+
+        if self._doc_set.get_doc(msg['docId']) is not None:
+            self.maybe_send_changes(msg['docId'])
+        elif msg['docId'] not in self._our_clock:
+            # The remote has a document we don't: ask for it
+            self.send_msg(msg['docId'], {})
+
+        return self._doc_set.get_doc(msg['docId'])
+
+    # camelCase aliases (reference API surface)
+    sendMsg = send_msg
+    maybeSendChanges = maybe_send_changes
+    docChanged = doc_changed
+    receiveMsg = receive_msg

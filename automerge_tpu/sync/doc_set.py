@@ -1,0 +1,58 @@
+"""DocSet -- observable multi-document registry
+(reference: `/root/reference/src/doc_set.js`).
+
+Holds many independent documents; applying changes notifies registered
+handlers (typically Connections).  Document-level independence is the
+framework's data-parallel axis: `automerge_tpu.parallel.engine` batches the
+op streams of every doc in a DocSet into one TPU resolve pass.
+"""
+
+from .. import backend as Backend
+from .. import frontend as Frontend
+
+
+class DocSet:
+    def __init__(self):
+        self.docs = {}
+        self.handlers = []
+
+    @property
+    def doc_ids(self):
+        return list(self.docs.keys())
+
+    docIds = doc_ids
+
+    def get_doc(self, doc_id):
+        return self.docs.get(doc_id)
+
+    def set_doc(self, doc_id, doc):
+        self.docs[doc_id] = doc
+        for handler in list(self.handlers):
+            handler(doc_id, doc)
+
+    def apply_changes(self, doc_id, changes):
+        """(reference: doc_set.js:25-33)"""
+        doc = self.docs.get(doc_id)
+        if doc is None:
+            doc = Frontend.init({'backend': Backend})
+        old_state = Frontend.get_backend_state(doc)
+        new_state, patch = Backend.apply_changes(old_state, changes)
+        patch['state'] = new_state
+        doc = Frontend.apply_patch(doc, patch)
+        self.set_doc(doc_id, doc)
+        return doc
+
+    def register_handler(self, handler):
+        if handler not in self.handlers:
+            self.handlers.append(handler)
+
+    def unregister_handler(self, handler):
+        if handler in self.handlers:
+            self.handlers.remove(handler)
+
+    # camelCase aliases (reference API surface)
+    getDoc = get_doc
+    setDoc = set_doc
+    applyChanges = apply_changes
+    registerHandler = register_handler
+    unregisterHandler = unregister_handler

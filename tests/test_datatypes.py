@@ -1,0 +1,211 @@
+"""Datatype behavior tests: Text, Table, proxies, uuid factory override.
+
+Ported from `/root/reference/test/text_test.js`, `table_test.js`,
+`proxies_test.js` (core behaviors), `test_uuid.js`.
+"""
+
+import pytest
+
+import automerge_tpu as am
+from automerge_tpu.errors import AutomergeError, RangeError
+from automerge_tpu.utils import uuid as _uuid_pkg
+from automerge_tpu.utils.uuid import reset as uuid_reset, set_factory, uuid as make_uuid
+
+
+class TestText:
+    def make_text(self):
+        s1 = am.change(am.init(), lambda doc: doc.update({'text': am.Text()}))
+        return s1
+
+    def test_support_insertion_and_deletion(self):
+        s1 = self.make_text()
+        s1 = am.change(s1, lambda doc: doc['text'].insert_at(0, 'a'))
+        s1 = am.change(s1, lambda doc: doc['text'].insert_at(1, 'b', 'c'))
+        assert str(s1['text']) == 'abc'
+        s1 = am.change(s1, lambda doc: doc['text'].delete_at(1))
+        assert str(s1['text']) == 'ac'
+        assert len(s1['text']) == 2
+        assert s1['text'].get(0) == 'a'
+
+    def test_concurrent_text_insert(self):
+        """(reference: text_test.js:26)"""
+        s1 = am.change(am.init('A'), lambda doc: doc.update({'text': am.Text()}))
+        s2 = am.merge(am.init('B'), s1)
+        s1 = am.change(s1, lambda doc: doc['text'].insert_at(0, 'a', 'b'))
+        s2 = am.change(s2, lambda doc: doc['text'].insert_at(0, 'x', 'y'))
+        s3 = am.merge(s1, s2)
+        text = str(s3['text'])
+        assert text in ('abxy', 'xyab')
+        # both replicas converge to the same interleaving
+        s4 = am.merge(s2, s1)
+        assert str(s4['text']) == text
+
+    def test_elem_ids(self):
+        s1 = self.make_text()
+        s1 = am.change(s1, lambda doc: doc['text'].insert_at(0, 'h', 'i'))
+        actor = am.get_actor_id(s1)
+        assert s1['text'].get_elem_id(0) == '%s:1' % actor
+        assert s1['text'].get_elem_id(1) == '%s:2' % actor
+
+    def test_text_in_saved_doc(self):
+        s1 = self.make_text()
+        s1 = am.change(s1, lambda doc: doc['text'].insert_at(0, *'persist'))
+        s2 = am.load(am.save(s1))
+        assert str(s2['text']) == 'persist'
+
+
+class TestTable:
+    def make_table(self):
+        return am.change(am.init(), lambda doc: doc.update(
+            {'books': am.Table(['authors', 'title', 'isbn'])}))
+
+    def test_empty_table(self):
+        s1 = self.make_table()
+        assert s1['books'].count == 0
+        assert list(s1['books'].columns) == ['authors', 'title', 'isbn']
+
+    def test_add_row_as_dict(self):
+        s1 = self.make_table()
+        row_id = {}
+
+        def cb(doc):
+            row_id['id'] = doc['books'].add({
+                'authors': ['Kleppmann, Martin'],
+                'title': 'Designing Data-Intensive Applications',
+                'isbn': '1449373321'})
+        s1 = am.change(s1, cb)
+        row = s1['books'].by_id(row_id['id'])
+        assert row['title'] == 'Designing Data-Intensive Applications'
+        assert am.get_object_id(row) == row_id['id']
+
+    def test_add_row_as_list(self):
+        s1 = self.make_table()
+
+        def cb(doc):
+            doc['books'].add([['Kleppmann, Martin'], 'DDIA', '1449373321'])
+        s1 = am.change(s1, cb)
+        assert s1['books'].count == 1
+        assert s1['books'].rows[0]['title'] == 'DDIA'
+
+    def test_remove_row(self):
+        s1 = self.make_table()
+        row_id = {}
+
+        def add(doc):
+            row_id['id'] = doc['books'].add({'title': 'a', 'authors': [],
+                                             'isbn': ''})
+        s1 = am.change(s1, add)
+
+        def remove(doc):
+            doc['books'].remove(row_id['id'])
+        s2 = am.change(s1, remove)
+        assert s2['books'].count == 0
+        with pytest.raises(RangeError):
+            am.change(s2, remove)
+
+    def test_concurrent_row_insertion(self):
+        """(reference: table_test.js:159)"""
+        s1 = self.make_table()
+        s2 = am.merge(am.init(), s1)
+        s1 = am.change(s1, lambda doc: doc['books'].add(
+            {'title': 'one', 'authors': [], 'isbn': '1'}))
+        s2 = am.change(s2, lambda doc: doc['books'].add(
+            {'title': 'two', 'authors': [], 'isbn': '2'}))
+        s3 = am.merge(s1, s2)
+        assert s3['books'].count == 2
+        assert sorted(r['title'] for r in s3['books'].rows) == ['one', 'two']
+
+    def test_sort_and_filter(self):
+        s1 = self.make_table()
+
+        def cb(doc):
+            doc['books'].add({'title': 'c', 'authors': [], 'isbn': '3'})
+            doc['books'].add({'title': 'a', 'authors': [], 'isbn': '1'})
+            doc['books'].add({'title': 'b', 'authors': [], 'isbn': '2'})
+        s1 = am.change(s1, cb)
+        assert [r['title'] for r in s1['books'].sort('title')] == ['a', 'b', 'c']
+        assert sorted(r['title'] for r in s1['books'].filter(
+            lambda r: r['isbn'] > '1')) == ['b', 'c']
+        found = s1['books'].find(lambda r: r['isbn'] == '2')
+        assert found['title'] == 'b'
+
+    def test_rows_frozen_outside_change(self):
+        s1 = self.make_table()
+        with pytest.raises(AutomergeError):
+            s1['books'].set('x', 'y')
+
+
+class TestProxies:
+    def test_map_proxy_behaves_like_dict(self):
+        def cb(doc):
+            doc['key1'] = 'value1'
+            doc['key2'] = 'value2'
+            assert 'key1' in doc
+            assert 'absent' not in doc
+            assert sorted(doc.keys()) == ['key1', 'key2']
+            assert doc.get('key1') == 'value1'
+            assert doc.get('absent', 'fallback') == 'fallback'
+            assert len(doc) == 2
+        am.change(am.init(), cb)
+
+    def test_list_proxy_behaves_like_list(self):
+        def setup(doc):
+            doc['list'] = [1, 2, 3]
+        s1 = am.change(am.init(), setup)
+
+        def cb(doc):
+            lst = doc['list']
+            assert len(lst) == 3
+            assert list(lst) == [1, 2, 3]
+            assert lst[0] == 1
+            assert lst.index_of(2) == 1
+            assert lst.includes(3)
+            assert not lst.includes(99)
+            assert lst.slice(1) == [2, 3]
+            assert lst.map(lambda x: x * 2) == [2, 4, 6]
+            assert lst.filter(lambda x: x > 1) == [2, 3]
+            assert 2 in lst
+        am.change(s1, cb)
+
+    def test_proxy_object_id(self):
+        def cb(doc):
+            doc['nested'] = {}
+            assert doc._objectId == '00000000-0000-0000-0000-000000000000'
+            assert doc['nested']._objectId is not None
+            assert doc._type == 'map'
+            assert doc['nested']._type == 'map'
+        am.change(am.init(), cb)
+
+    def test_list_proxy_type_and_negative_index(self):
+        def setup(doc):
+            doc['list'] = ['a']
+        s1 = am.change(am.init(), setup)
+
+        def cb(doc):
+            assert doc['list']._type == 'list'
+            with pytest.raises(RangeError):
+                doc['list'][-1] = 'x'
+        am.change(s1, cb)
+
+
+class TestUuidFactory:
+    def test_factory_override(self):
+        """(reference: test_uuid.js:24)"""
+        try:
+            counter = [0]
+
+            def factory():
+                counter[0] += 1
+                return 'custom-uuid-%04d' % counter[0]
+            set_factory(factory)
+            assert make_uuid() == 'custom-uuid-0001'
+            assert make_uuid() == 'custom-uuid-0002'
+            doc = am.init()
+            assert am.get_actor_id(doc) == 'custom-uuid-0003'
+        finally:
+            uuid_reset()
+
+    def test_default_uuid_format(self):
+        import re
+        assert re.match(r'^[0-9a-f]{8}(-[0-9a-f]{4}){3}-[0-9a-f]{12}$',
+                        make_uuid())

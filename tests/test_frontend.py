@@ -1,0 +1,292 @@
+"""Frontend unit tests -- frontend driven WITHOUT a real backend: asserts the
+emitted change requests and applies hand-built patches, incl. seq/deps
+bookkeeping, queue handling, and the OT transform of pending requests.
+
+Ported from `/root/reference/test/frontend_test.js` (435 LoC).
+"""
+
+import pytest
+
+from automerge_tpu import backend as Backend
+from automerge_tpu import frontend as Frontend
+from automerge_tpu.errors import AutomergeError, RangeError
+from automerge_tpu.utils.uuid import uuid
+
+ROOT_ID = '00000000-0000-0000-0000-000000000000'
+
+
+def get_requests(doc):
+    return [{k: v for k, v in req.items() if k not in ('before', 'diffs')}
+            for req in doc._state['requests']]
+
+
+class TestFrontendBasics:
+    def test_empty_object_by_default(self):
+        doc = Frontend.init()
+        assert dict(doc) == {}
+        assert Frontend.get_actor_id(doc)
+
+    def test_deferred_actor_id(self):
+        doc0 = Frontend.init({'deferActorId': True})
+        assert Frontend.get_actor_id(doc0) is None
+        with pytest.raises(AutomergeError, match='Actor ID must be initialized'):
+            Frontend.change(doc0, lambda doc: doc.update({'foo': 'bar'}))
+        doc1 = Frontend.set_actor_id(doc0, uuid())
+        doc2, req = Frontend.change(doc1, lambda doc: doc.update({'foo': 'bar'}))
+        assert dict(doc2) == {'foo': 'bar'}
+
+
+class TestPerformingChanges:
+    def test_unmodified_doc_if_nothing_changed(self):
+        doc0 = Frontend.init()
+        doc1, req = Frontend.change(doc0, lambda doc: None)
+        assert doc1 is doc0
+
+    def test_set_root_object_properties(self):
+        actor = uuid()
+        doc, req = Frontend.change(Frontend.init(actor),
+                                   lambda doc: doc.update({'bird': 'magpie'}))
+        assert dict(doc) == {'bird': 'magpie'}
+        assert req == {'requestType': 'change', 'actor': actor, 'seq': 1,
+                       'deps': {}, 'ops': [
+                           {'obj': ROOT_ID, 'action': 'set', 'key': 'bird',
+                            'value': 'magpie'}]}
+
+    def test_create_nested_maps(self):
+        doc, req = Frontend.change(Frontend.init(),
+                                   lambda doc: doc.update({'birds': {'wrens': 3}}))
+        birds = Frontend.get_object_id(doc['birds'])
+        actor = Frontend.get_actor_id(doc)
+        assert dict(doc['birds']) == {'wrens': 3}
+        assert req == {'requestType': 'change', 'actor': actor, 'seq': 1,
+                       'deps': {}, 'ops': [
+                           {'obj': birds, 'action': 'makeMap'},
+                           {'obj': birds, 'action': 'set', 'key': 'wrens', 'value': 3},
+                           {'obj': ROOT_ID, 'action': 'link', 'key': 'birds',
+                            'value': birds}]}
+
+    def test_create_lists(self):
+        doc, req = Frontend.change(Frontend.init(),
+                                   lambda doc: doc.update({'birds': ['chaffinch']}))
+        birds = Frontend.get_object_id(doc['birds'])
+        actor = Frontend.get_actor_id(doc)
+        assert list(doc['birds']) == ['chaffinch']
+        assert req == {'requestType': 'change', 'actor': actor, 'seq': 1,
+                       'deps': {}, 'ops': [
+                           {'obj': birds, 'action': 'makeList'},
+                           {'obj': birds, 'action': 'ins', 'key': '_head', 'elem': 1},
+                           {'obj': birds, 'action': 'set', 'key': '%s:1' % actor,
+                            'value': 'chaffinch'},
+                           {'obj': ROOT_ID, 'action': 'link', 'key': 'birds',
+                            'value': birds}]}
+
+    def test_delete_list_elements(self):
+        doc1, _ = Frontend.change(Frontend.init(), lambda doc: doc.update(
+            {'birds': ['chaffinch', 'goldfinch']}))
+        doc2, req2 = Frontend.change(doc1, lambda doc: doc['birds'].delete_at(0))
+        birds = Frontend.get_object_id(doc2['birds'])
+        actor = Frontend.get_actor_id(doc2)
+        assert list(doc2['birds']) == ['goldfinch']
+        assert req2 == {'requestType': 'change', 'actor': actor, 'seq': 2,
+                        'deps': {}, 'ops': [
+                            {'obj': birds, 'action': 'del',
+                             'key': '%s:1' % actor}]}
+
+
+class TestBackendConcurrency:
+    def test_deps_and_seq_from_backend(self):
+        local, remote1, remote2 = uuid(), uuid(), uuid()
+        patch1 = {
+            'clock': {local: 4, remote1: 11, remote2: 41},
+            'deps': {local: 4, remote2: 41},
+            'diffs': [{'action': 'set', 'obj': ROOT_ID, 'type': 'map',
+                       'key': 'blackbirds', 'value': 24}],
+        }
+        doc1 = Frontend.apply_patch(Frontend.init(local), patch1)
+        doc2, req = Frontend.change(doc1, lambda doc: doc.update({'partridges': 1}))
+        assert get_requests(doc2) == [
+            {'requestType': 'change', 'actor': local, 'seq': 5,
+             'deps': {remote2: 41}, 'ops': [
+                 {'obj': ROOT_ID, 'action': 'set', 'key': 'partridges',
+                  'value': 1}]}]
+
+    def test_remove_pending_requests_once_handled(self):
+        actor = uuid()
+        doc1, _ = Frontend.change(Frontend.init(actor),
+                                  lambda doc: doc.update({'blackbirds': 24}))
+        doc2, _ = Frontend.change(doc1, lambda doc: doc.update({'partridges': 1}))
+        assert len(get_requests(doc2)) == 2
+
+        diffs1 = [{'obj': ROOT_ID, 'type': 'map', 'action': 'set',
+                   'key': 'blackbirds', 'value': 24}]
+        doc2 = Frontend.apply_patch(doc2, {'actor': actor, 'seq': 1,
+                                           'diffs': diffs1})
+        assert dict(doc2) == {'blackbirds': 24, 'partridges': 1}
+        assert len(get_requests(doc2)) == 1
+
+        diffs2 = [{'obj': ROOT_ID, 'type': 'map', 'action': 'set',
+                   'key': 'partridges', 'value': 1}]
+        doc2 = Frontend.apply_patch(doc2, {'actor': actor, 'seq': 2,
+                                           'diffs': diffs2})
+        assert dict(doc2) == {'blackbirds': 24, 'partridges': 1}
+        assert get_requests(doc2) == []
+
+    def test_remote_patches_leave_queue_unchanged(self):
+        actor, other = uuid(), uuid()
+        doc, _ = Frontend.change(Frontend.init(actor),
+                                 lambda d: d.update({'blackbirds': 24}))
+        assert len(get_requests(doc)) == 1
+        diffs1 = [{'obj': ROOT_ID, 'type': 'map', 'action': 'set',
+                   'key': 'pheasants', 'value': 2}]
+        doc = Frontend.apply_patch(doc, {'actor': other, 'seq': 1,
+                                         'diffs': diffs1})
+        assert dict(doc) == {'blackbirds': 24, 'pheasants': 2}
+        assert len(get_requests(doc)) == 1
+
+        diffs2 = [{'obj': ROOT_ID, 'type': 'map', 'action': 'set',
+                   'key': 'blackbirds', 'value': 24}]
+        doc = Frontend.apply_patch(doc, {'actor': actor, 'seq': 1,
+                                         'diffs': diffs2})
+        assert dict(doc) == {'blackbirds': 24, 'pheasants': 2}
+        assert get_requests(doc) == []
+
+    def test_out_of_order_patches_rejected(self):
+        doc1, _ = Frontend.change(Frontend.init(),
+                                  lambda doc: doc.update({'blackbirds': 24}))
+        doc2, _ = Frontend.change(doc1, lambda doc: doc.update({'partridges': 1}))
+        actor = Frontend.get_actor_id(doc2)
+        diffs = [{'obj': ROOT_ID, 'type': 'map', 'action': 'set',
+                  'key': 'partridges', 'value': 1}]
+        with pytest.raises(RangeError, match='Mismatched sequence number'):
+            Frontend.apply_patch(doc2, {'actor': actor, 'seq': 2, 'diffs': diffs})
+
+    def test_transform_concurrent_insertions(self):
+        doc1, _ = Frontend.change(Frontend.init(),
+                                  lambda doc: doc.update({'birds': ['goldfinch']}))
+        birds = Frontend.get_object_id(doc1['birds'])
+        actor = Frontend.get_actor_id(doc1)
+        diffs1 = [
+            {'obj': birds, 'type': 'list', 'action': 'create'},
+            {'obj': birds, 'type': 'list', 'action': 'insert', 'index': 0,
+             'value': 'goldfinch', 'elemId': '%s:1' % actor},
+            {'obj': ROOT_ID, 'type': 'map', 'action': 'set', 'key': 'birds',
+             'value': birds, 'link': True},
+        ]
+        doc1 = Frontend.apply_patch(doc1, {'actor': actor, 'seq': 1,
+                                           'diffs': diffs1})
+        assert list(doc1['birds']) == ['goldfinch']
+        assert get_requests(doc1) == []
+
+        def cb(doc):
+            doc['birds'].insert_at(0, 'chaffinch')
+            doc['birds'].insert_at(2, 'greenfinch')
+        doc2, _ = Frontend.change(doc1, cb)
+        assert list(doc2['birds']) == ['chaffinch', 'goldfinch', 'greenfinch']
+
+        remote = uuid()
+        diffs3 = [{'obj': birds, 'type': 'list', 'action': 'insert', 'index': 1,
+                   'value': 'bullfinch', 'elemId': '%s:2' % remote}]
+        doc3 = Frontend.apply_patch(doc2, {'actor': remote, 'seq': 1,
+                                           'diffs': diffs3})
+        assert list(doc3['birds']) == ['chaffinch', 'goldfinch', 'bullfinch',
+                                       'greenfinch']
+
+        diffs4 = [
+            {'obj': birds, 'type': 'list', 'action': 'insert', 'index': 0,
+             'value': 'chaffinch', 'elemId': '%s:2' % actor},
+            {'obj': birds, 'type': 'list', 'action': 'insert', 'index': 2,
+             'value': 'greenfinch', 'elemId': '%s:3' % actor},
+        ]
+        doc4 = Frontend.apply_patch(doc3, {'actor': actor, 'seq': 2,
+                                           'diffs': diffs4})
+        assert list(doc4['birds']) == ['chaffinch', 'goldfinch', 'greenfinch',
+                                       'bullfinch']
+        assert get_requests(doc4) == []
+
+    def test_interleaving_patches_and_changes(self):
+        actor = uuid()
+        doc1, req1 = Frontend.change(Frontend.init(actor),
+                                     lambda doc: doc.update({'number': 1}))
+        doc2, req2 = Frontend.change(doc1, lambda doc: doc.update({'number': 2}))
+        assert req1['seq'] == 1 and req2['seq'] == 2
+        state0 = Backend.init()
+        state1, patch1 = Backend.apply_local_change(state0, req1)
+        doc2a = Frontend.apply_patch(doc2, patch1)
+        doc3, req3 = Frontend.change(doc2a, lambda doc: doc.update({'number': 3}))
+        assert req3 == {'requestType': 'change', 'actor': actor, 'seq': 3,
+                        'deps': {}, 'ops': [
+                            {'obj': ROOT_ID, 'action': 'set', 'key': 'number',
+                             'value': 3}]}
+
+
+class TestApplyingPatches:
+    def test_set_root_properties(self):
+        diffs = [{'obj': ROOT_ID, 'type': 'map', 'action': 'set',
+                  'key': 'bird', 'value': 'magpie'}]
+        doc = Frontend.apply_patch(Frontend.init(), {'diffs': diffs})
+        assert dict(doc) == {'bird': 'magpie'}
+
+    def test_reveal_conflicts_on_root(self):
+        actor = uuid()
+        diffs = [{'obj': ROOT_ID, 'type': 'map', 'action': 'set',
+                  'key': 'favoriteBird', 'value': 'wagtail',
+                  'conflicts': [{'actor': actor, 'value': 'robin'}]}]
+        doc = Frontend.apply_patch(Frontend.init(), {'diffs': diffs})
+        assert dict(doc) == {'favoriteBird': 'wagtail'}
+        assert Frontend.get_conflicts(doc) == {'favoriteBird': {actor: 'robin'}}
+
+    def test_nested_maps_via_patch(self):
+        birds = uuid()
+        diffs = [
+            {'obj': birds, 'type': 'map', 'action': 'create'},
+            {'obj': birds, 'type': 'map', 'action': 'set', 'key': 'wrens',
+             'value': 3},
+            {'obj': ROOT_ID, 'type': 'map', 'action': 'set', 'key': 'birds',
+             'value': birds, 'link': True},
+        ]
+        doc = Frontend.apply_patch(Frontend.init(), {'diffs': diffs})
+        assert dict(doc['birds']) == {'wrens': 3}
+
+    def test_updates_inside_nested_maps(self):
+        birds = uuid()
+        diffs1 = [
+            {'obj': birds, 'type': 'map', 'action': 'create'},
+            {'obj': birds, 'type': 'map', 'action': 'set', 'key': 'wrens',
+             'value': 3},
+            {'obj': ROOT_ID, 'type': 'map', 'action': 'set', 'key': 'birds',
+             'value': birds, 'link': True},
+        ]
+        diffs2 = [{'obj': birds, 'type': 'map', 'action': 'set',
+                   'key': 'sparrows', 'value': 15}]
+        doc1 = Frontend.apply_patch(Frontend.init(), {'diffs': diffs1})
+        doc2 = Frontend.apply_patch(doc1, {'diffs': diffs2})
+        assert dict(doc1['birds']) == {'wrens': 3}
+        assert dict(doc2['birds']) == {'wrens': 3, 'sparrows': 15}
+
+    def test_list_elements_via_patch(self):
+        birds = uuid()
+        actor = uuid()
+        diffs = [
+            {'obj': birds, 'type': 'list', 'action': 'create'},
+            {'obj': birds, 'type': 'list', 'action': 'insert', 'index': 0,
+             'value': 'magpie', 'elemId': '%s:1' % actor},
+            {'obj': ROOT_ID, 'type': 'map', 'action': 'set', 'key': 'birds',
+             'value': birds, 'link': True},
+        ]
+        doc = Frontend.apply_patch(Frontend.init(), {'diffs': diffs})
+        assert list(doc['birds']) == ['magpie']
+
+    def test_text_via_patch(self):
+        text_id = uuid()
+        actor = uuid()
+        diffs = [
+            {'obj': text_id, 'type': 'text', 'action': 'create'},
+            {'obj': text_id, 'type': 'text', 'action': 'insert', 'index': 0,
+             'value': 'h', 'elemId': '%s:1' % actor},
+            {'obj': text_id, 'type': 'text', 'action': 'insert', 'index': 1,
+             'value': 'i', 'elemId': '%s:2' % actor},
+            {'obj': ROOT_ID, 'type': 'map', 'action': 'set', 'key': 'text',
+             'value': text_id, 'link': True},
+        ]
+        doc = Frontend.apply_patch(Frontend.init(), {'diffs': diffs})
+        assert str(doc['text']) == 'hi'
