@@ -146,6 +146,8 @@ def apply_local_change(state, change):
     """Applies one local change request, adding it to the undo history
     (reference: backend/index.js:175-197)."""
     if not isinstance(change.get('actor'), str) or not isinstance(change.get('seq'), int):
+        # 'requries' [sic]: byte-for-byte parity with the reference's own
+        # error text (backend/index.js:177)
         raise TypeError('Change request requries `actor` and `seq` properties')
     if change['seq'] <= state['opSet']['clock'].get(change['actor'], 0):
         raise RangeError('Change request has already been applied')

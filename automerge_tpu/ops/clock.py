@@ -1,0 +1,212 @@
+"""Batched causal scheduling kernels.
+
+The reference drains its causal-ready queue with a sequential fixpoint loop
+(`/root/reference/backend/op_set.js:279-295`): scan the queue in order, apply
+every change whose vector-clock deps are satisfied, repeat until no progress.
+
+Here the same fixpoint runs as a jitted multi-pass `lax.scan` inside a
+`lax.while_loop`, over *columnar* change records, and `vmap`s over a document
+batch: one device dispatch schedules the queues of thousands of docs.  The
+clock algebra (elementwise max / compare) is exactly the VPU-friendly shape
+the survey calls for (SURVEY.md section 2, "Batched scheduling kernel").
+
+Conventions:
+  - actors are dense int ranks whose order equals the lexicographic order of
+    the actor-ID strings (so LWW tie-breaks compare equal to the reference)
+  - a change record is (actor, seq, deps[A]); deps rows use 0 for "no dep"
+  - invalid/padding rows have actor == -1
+"""
+
+import jax
+import jax.numpy as jnp
+from functools import partial
+
+NOT_APPLIED = jnp.int32(2147483647)
+
+
+@partial(jax.jit, static_argnames=())
+def schedule_queue(clock, actor, seq, deps, valid):
+    """Schedules one doc's queued changes.
+
+    Args:
+      clock: [A] int32 -- applied seq per actor.
+      actor: [C] int32 -- authoring actor rank per change (-1 = padding).
+      seq:   [C] int32.
+      deps:  [C, A] int32 -- dependency clock per change.
+      valid: [C] bool.
+
+    Returns (order, new_clock):
+      order:  [C] int32 -- application position (0-based, queue order within
+              a pass, passes concatenated); NOT_APPLIED for changes whose
+              deps were never satisfied; -2 for duplicates (seq already
+              covered by the clock at their turn).
+      new_clock: [A] int32.
+    """
+    C = actor.shape[0]
+    A = clock.shape[0]
+
+    def one_pass(state):
+        clock, order, counter, _progress = state
+
+        def step(carry, i):
+            clock, order, counter = carry
+            a = actor[i]
+            s = seq[i]
+            dep_row = deps[i].at[jnp.maximum(a, 0)].set(s - 1)
+            ready = valid[i] & (a >= 0) & jnp.all(dep_row <= clock) \
+                & (order[i] == NOT_APPLIED)
+            duplicate = ready & (s <= clock[jnp.maximum(a, 0)])
+            fresh = ready & ~duplicate
+            clock = jax.lax.cond(
+                fresh,
+                lambda c: c.at[a].set(jnp.maximum(c[a], s)),
+                lambda c: c,
+                clock)
+            order = order.at[i].set(
+                jnp.where(fresh, counter, jnp.where(duplicate, -2, order[i])))
+            counter = counter + fresh.astype(jnp.int32)
+            return (clock, order, counter), ready
+
+        (clock, order, counter), readies = jax.lax.scan(
+            step, (clock, order, counter), jnp.arange(C))
+        return clock, order, counter, jnp.any(readies)
+
+    def cond(state):
+        return state[3]
+
+    init = (clock, jnp.full((C,), NOT_APPLIED, jnp.int32), jnp.int32(0),
+            jnp.bool_(True))
+    clock, order, counter, _ = jax.lax.while_loop(cond, one_pass, init)
+    return order, clock
+
+
+schedule_queue_batch = jax.jit(jax.vmap(schedule_queue, in_axes=(0, 0, 0, 0, 0)))
+"""vmapped scheduler: clock [D, A], actor/seq [D, C], deps [D, C, A],
+valid [D, C] -> (order [D, C], new_clock [D, A])."""
+
+
+@jax.jit
+def transitive_deps_batch(base_deps, state_all_deps, actor_offsets, actor_counts):
+    """Transitively closes dependency clocks for a batch of changes.
+
+    The reference folds each change's deps through the per-actor state log
+    (`op_set.js:29-37`): allDeps = elementwise-max over the allDeps rows of
+    every (actor, seq) the change depends on, with the declared dep seqs
+    pinned.  For the well-formed inputs the protocol produces (dep frontiers
+    and full clocks are self-consistent -- a declared dep is never below what
+    another dep transitively implies) pin-and-merge equals elementwise max.
+
+    Per-actor state rows are dense in seq, so row(actor, seq) =
+    actor_offsets[actor] + seq - 1.
+
+    Args:
+      base_deps: [C, A] int32 -- each change's declared deps (authoring actor
+                 pinned to seq-1 already folded in by the caller).
+      state_all_deps: [S, A] int32 -- allDeps rows of applied changes, grouped
+                 by actor, seq-ascending.
+      actor_offsets: [A] int32 -- start row per actor.
+      actor_counts:  [A] int32 -- applied changes per actor.
+
+    Returns closed [C, A].
+    """
+    C, A = base_deps.shape
+
+    def close_one(deps_row):
+        def fold(acc, a):
+            s = deps_row[a]
+            in_state = (s > 0) & (s <= actor_counts[a])
+            row_idx = actor_offsets[a] + jnp.maximum(s - 1, 0)
+            trans = jnp.where(
+                in_state,
+                state_all_deps[jnp.clip(row_idx, 0, state_all_deps.shape[0] - 1)],
+                jnp.zeros((A,), jnp.int32))
+            return jnp.maximum(acc, trans), None
+        acc, _ = jax.lax.scan(fold, jnp.zeros((A,), jnp.int32), jnp.arange(A))
+        return jnp.maximum(acc, jnp.maximum(deps_row, 0))
+
+    return jax.vmap(close_one)(base_deps)
+
+
+@jax.jit
+def is_concurrent_pairs(clock_a, actor_a, seq_a, clock_b, actor_b, seq_b):
+    """Vectorized pairwise concurrency test (reference: op_set.js:7-16):
+    two ops are concurrent iff neither one's change clock covers the other.
+
+    All args are [N] (actor ranks) or [N, A] (clocks); returns [N] bool."""
+    n = actor_a.shape[0]
+    idx = jnp.arange(n)
+    a_knows_b = clock_a[idx, actor_b] >= seq_b
+    b_knows_a = clock_b[idx, actor_a] >= seq_a
+    return ~a_knows_b & ~b_knows_a
+
+
+def clock_union(clock_a, clock_b):
+    """Vector-clock union = elementwise max.  Over a replica mesh axis this
+    is `jax.lax.pmax` (see automerge_tpu/parallel/replica.py)."""
+    return jnp.maximum(clock_a, clock_b)
+
+
+def close_batch_all_deps(batch_deps, batch_actor, batch_seq,
+                         state_all_deps, actor_offsets, actor_counts,
+                         batch_offsets, n_iters):
+    """Transitive closure of allDeps for a batch of *applied* changes that may
+    depend on each other, via iterative doubling over the dependency DAG
+    (log-depth, replacing the reference's sequential per-change fold).
+
+    Applied batch changes are seq-dense per actor: change (a, s) with
+    s > actor_counts[a] lives at batch row
+    batch_offsets[a] + (s - actor_counts[a] - 1).
+
+    Args:
+      batch_deps:  [C, A] declared deps with authoring actor pinned to seq-1.
+      batch_actor: [C] int32 (-1 padding).
+      batch_seq:   [C] int32.
+      state_all_deps: [S, A], actor_offsets/actor_counts: [A] (see
+          transitive_deps_batch).
+      batch_offsets: [A] int32 -- first batch row per actor (rows grouped by
+          actor, seq-ascending), -1 if none.
+      n_iters: static int -- ceil(log2(max chain depth)) + 1.
+
+    Returns allDeps [C, A] for every batch change.
+    """
+    import jax
+    import jax.numpy as jnp
+    C, A = batch_deps.shape
+    S = state_all_deps.shape[0]
+
+    base = jnp.maximum(batch_deps, 0)
+
+    def lookup(table, a, s):
+        """allDeps row for dep (a, s): state row, batch row, or zeros."""
+        in_state = (s > 0) & (s <= actor_counts[a])
+        srow = actor_offsets[a] + jnp.maximum(s - 1, 0)
+        state_row = jnp.where(
+            in_state,
+            state_all_deps[jnp.clip(srow, 0, max(S - 1, 0))],
+            jnp.zeros((A,), jnp.int32)) if S > 0 else jnp.zeros((A,), jnp.int32)
+        brow = batch_offsets[a] + (s - actor_counts[a] - 1)
+        in_batch = (s > actor_counts[a]) & (batch_offsets[a] >= 0) & \
+            (brow >= 0) & (brow < C)
+        batch_row = jnp.where(
+            in_batch, table[jnp.clip(brow, 0, C - 1)], jnp.zeros((A,), jnp.int32))
+        return jnp.maximum(state_row, batch_row)
+
+    def one_round(table):
+        def close_row(deps_row, table_row):
+            def fold(acc, a):
+                s = deps_row[a]
+                row = jnp.where(s > 0, lookup(table, a, s),
+                                jnp.zeros((A,), jnp.int32))
+                return jnp.maximum(acc, row), None
+            acc, _ = jax.lax.scan(fold, table_row, jnp.arange(A))
+            return acc
+        return jax.vmap(close_row)(base, table)
+
+    table = base
+    for _ in range(n_iters):
+        table = one_round(table)
+    return table
+
+
+close_batch_all_deps_jit = jax.jit(close_batch_all_deps,
+                                   static_argnames=('n_iters',))

@@ -1,0 +1,187 @@
+"""Parallel RGA list linearization.
+
+The reference walks an insertion tree sequentially: children of each parent
+sorted descending by (elem-counter, actor), DFS preorder gives the list
+order; a skip list maps visible elements to indexes
+(`/root/reference/backend/op_set.js:383-437`, `backend/skip_list.js`).
+
+Here the whole forest is linearized in O(log L) parallel steps:
+
+  1. sort elements by (object, parent, -counter, -actor) -> sibling groups
+     with first-child / next-sibling links,
+  2. resolve each node's DFS "escape" pointer (next sibling, else parent's
+     escape) by pointer doubling,
+  3. dfs_next = first child else escape; list-rank the dfs_next chain by
+     pointer doubling -> total-order rank per element.
+
+RGA guarantees existing elements never reorder when new ones insert, so
+ranks computed on the final forest are valid at every intermediate time
+step.  Per-op list indexes then become *dominance counts* -- "visible
+elements of the same object with smaller rank at time t" -- evaluated as
+chunked mask matmuls (MXU work), not sequential skip-list probes.
+
+All (doc, object) segments are flattened into one arena; `obj` ids are dense
+ints in [0, L), globally unique across docs, so a single dispatch linearizes
+every list of every doc.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def ceil_log2(n):
+    bits = 0
+    while (1 << bits) < max(n, 1):
+        bits += 1
+    return bits
+
+
+@partial(jax.jit, static_argnames=('n_iters',))
+def linearize(obj, parent, ctr, actor, valid, n_iters):
+    """Computes the total RGA order of every element of every list object.
+
+    Args:
+      obj:    [L] int32 -- list-object id per element (dense, < L).
+      parent: [L] int32 -- arena index of the insertion parent, -1 for head.
+      ctr:    [L] int32 -- elemId counter.
+      actor:  [L] int32 -- elemId actor rank (string-order preserving).
+      valid:  [L] bool.
+      n_iters: static int >= ceil(log2(L)) + 1 (pointer-doubling rounds).
+
+    Returns:
+      rank: [L] int32 -- position in the object's total element order
+            (counting all elements, visible or not); -1 for invalid rows.
+    """
+    L = obj.shape[0]
+    BIG = jnp.int32(2 ** 30)
+    rows = jnp.arange(L)
+
+    # --- 1. sibling sort: (obj, parent, -ctr, -actor); invalid rows last ---
+    skey_obj = jnp.where(valid, obj, BIG)
+    sort_idx = jnp.lexsort((-actor, -ctr, parent, skey_obj))
+    s_valid = valid[sort_idx]
+    s_obj = jnp.where(s_valid, obj[sort_idx], -2)
+    s_parent = jnp.where(s_valid, parent[sort_idx], -3)
+
+    prev_same = (rows > 0) & (jnp.roll(s_obj, 1) == s_obj) \
+        & (jnp.roll(s_parent, 1) == s_parent)
+    next_same = (rows < L - 1) & (jnp.roll(s_obj, -1) == s_obj) \
+        & (jnp.roll(s_parent, -1) == s_parent)
+
+    # next sibling (in descending sibling order): arena index, -1 if last
+    nxt_arena = jnp.where(next_same, sort_idx[jnp.clip(rows + 1, 0, L - 1)], -1)
+    sib_next = jnp.full((L,), -1, jnp.int32).at[sort_idx].set(nxt_arena)
+
+    # first child per parent element: first sorted row of each
+    # (obj, parent >= 0) group
+    is_first_nonhead = ~prev_same & (s_parent >= 0) & s_valid
+    scatter_tgt = jnp.where(is_first_nonhead, s_parent, L)   # L rows drop
+    first_child = jnp.full((L,), -1, jnp.int32).at[scatter_tgt].set(
+        jnp.where(is_first_nonhead, sort_idx, -1), mode='drop')
+
+    # --- 2. escape pointers: next sibling, else parent's escape ------------
+    # sentinel: -1 = unresolved, -2 = resolved "no escape" (end of object)
+    esc = jnp.where(sib_next >= 0, sib_next,
+                    jnp.where(parent == -1, -2, -1))
+    link = parent
+    for _ in range(n_iters + 1):
+        link_safe = jnp.clip(link, 0, L - 1)
+        consult = esc[link_safe]
+        unresolved = (esc == -1) & (link >= 0)
+        esc = jnp.where(unresolved & (consult != -1), consult, esc)
+        # shortcut the consult chain (doubling: link <- link's link)
+        link = jnp.where(unresolved, link[link_safe], link)
+    escape = jnp.where(esc == -2, -1, esc)
+
+    # --- 3. dfs_next + list ranking ---------------------------------------
+    dfs_next = jnp.where(first_child >= 0, first_child, escape)
+    dfs_next = jnp.where(valid, dfs_next, -1)
+
+    dist = jnp.where(dfs_next >= 0, 1, 0).astype(jnp.int32)
+    nxt = dfs_next
+    for _ in range(n_iters):
+        take = nxt >= 0
+        nxt_safe = jnp.clip(nxt, 0, L - 1)
+        dist = dist + jnp.where(take, dist[nxt_safe], 0)
+        nxt = jnp.where(take, nxt[nxt_safe], nxt)
+
+    # per-object element count -> rank = size - 1 - hops_to_end
+    obj_sizes = jax.ops.segment_sum(
+        valid.astype(jnp.int32), jnp.where(valid, obj, L),
+        num_segments=L + 1)
+    size_of_elem = obj_sizes[jnp.clip(obj, 0, L)]
+    rank = jnp.where(valid, size_of_elem - 1 - dist, -1)
+    return rank
+
+
+@partial(jax.jit, static_argnames=('chunk',))
+def dominance_indexes(elem_obj, elem_rank, vis0, op_elem, op_obj, op_rank,
+                      op_delta, op_valid, chunk=128):
+    """Per-op list indexes as time-windowed dominance counts.
+
+    index(op t on element e) = #{e' : obj(e') == obj(e), rank(e') < rank(e),
+                                 visible just before t}
+
+    Visibility evolves one element per op (op_delta in {-1, 0, +1}).  Ops are
+    processed in application order in chunks: each chunk queries the running
+    visibility vector with one [L] x [L, K] mask product (MXU work), then
+    applies within-chunk pairwise corrections (K x K) and updates the vector.
+
+    Args:
+      elem_obj: [L] int32, elem_rank: [L] int32, vis0: [L] float32 (0/1).
+      op_elem: [T] int32 -- arena element index each op touches (-1 = none).
+      op_obj:  [T] int32, op_rank: [T] int32 -- of the touched element.
+      op_delta:[T] int32 -- visibility change this op causes.
+      op_valid:[T] bool.
+      chunk: static int.
+
+    Returns: index [T] int32 -- visible-before-e count for each op.
+    """
+    L = elem_obj.shape[0]
+    T = op_elem.shape[0]
+    K = chunk
+    n_chunks = (T + K - 1) // K
+    Tp = n_chunks * K
+
+    def pad(x, fill):
+        return jnp.concatenate(
+            [x, jnp.full((Tp - T,) + x.shape[1:], fill, x.dtype)])
+
+    op_elem_p = pad(op_elem, -1)
+    op_obj_p = pad(op_obj, -2)
+    op_rank_p = pad(op_rank, -1)
+    op_delta_p = pad(op_delta, 0)
+    op_valid_p = pad(op_valid, False)
+
+    def body(vis, c):
+        sl = c * K
+        e = jax.lax.dynamic_slice(op_elem_p, (sl,), (K,))
+        o = jax.lax.dynamic_slice(op_obj_p, (sl,), (K,))
+        r = jax.lax.dynamic_slice(op_rank_p, (sl,), (K,))
+        d = jax.lax.dynamic_slice(op_delta_p, (sl,), (K,))
+        v = jax.lax.dynamic_slice(op_valid_p, (sl,), (K,))
+
+        # base counts against visibility at chunk start: [L, K] mask
+        mask = (elem_obj[:, None] == o[None, :]) \
+            & (elem_rank[:, None] < r[None, :])
+        base = vis @ mask.astype(jnp.float32)                      # [K]
+
+        # within-chunk corrections: op j before op k, same object, and the
+        # element op j touches ranks below op k's element
+        cross = (jnp.arange(K)[:, None] < jnp.arange(K)[None, :]) \
+            & (o[:, None] == o[None, :]) & (r[:, None] < r[None, :])
+        corr = jnp.sum(cross * d[:, None].astype(jnp.float32), axis=0)  # [K]
+
+        idx = (base + corr).astype(jnp.int32)
+
+        # visibility update: net delta per element this chunk
+        upd = jax.ops.segment_sum(
+            jnp.where(v, d, 0).astype(jnp.float32),
+            jnp.clip(jnp.where(v, e, L), 0, L), num_segments=L + 1)[:L]
+        vis = vis + upd
+        return vis, idx
+
+    _, idxs = jax.lax.scan(body, vis0, jnp.arange(n_chunks))
+    return idxs.reshape(-1)[:T]

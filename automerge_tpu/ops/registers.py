@@ -1,0 +1,145 @@
+"""Batched LWW register resolution.
+
+The reference resolves each assignment sequentially: partition the register's
+ops into overwritten (causally superseded) vs concurrent, append the new op,
+sort by actor descending; the first op is the winner, the rest are conflicts
+(`/root/reference/backend/op_set.js:188-231`).
+
+This kernel computes the same result for EVERY op of a whole multi-document
+batch in one dispatch.  Key idea: after sorting ops by (register-group,
+application-time), op `p` is alive at time `t` iff no later op `q` with
+time_q <= t at the same register causally supersedes it
+(supersedes = NOT concurrent, reference op_set.js:7-16).  Supersession is
+evaluated over a fixed window of W predecessors -- register survivor sets are
+concurrent antichains, which stay tiny in real workloads; a full window
+(possible overflow) is flagged so the host can fall back to the oracle for
+that register, keeping byte parity always.
+
+All ops across all docs are flattened into one array; groups are globally
+unique ids for (doc, obj, key), so no per-doc padding is needed.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+# Window of predecessors considered per op.  Conflict sets larger than this
+# overflow to the host oracle (rare: needs >W concurrent writers on one key).
+WINDOW = 8
+
+
+@partial(jax.jit, static_argnames=('window',))
+def resolve_registers(group, time, actor, seq, clock, is_del, alive_in,
+                      window=WINDOW):
+    """Resolves every register op of a batch.
+
+    Args:
+      group: [T] int32 -- register group id ((doc, obj, key) interned);
+             -1 for padding rows.
+      time:  [T] int32 -- application position (unique, total order; state
+             ops carry times below every batch op).
+      actor: [T] int32 -- actor rank of the op's change.
+      seq:   [T] int32 -- seq of the op's change.
+      clock: [T, A] int32 -- allDeps row of the op's change.
+      is_del:[T] bool -- 'del' ops overwrite but never join the register.
+      alive_in: [T] bool -- for pre-existing state ops: True; for batch ops:
+             True (they are considered at their own time).
+
+    Returns dict of [T]-shaped outputs (original op order):
+      alive_after: int32 -- register size right after this op.
+      winner:      int32 -- op index (into this batch array) of the register
+                   winner after this op, or -1 if the register is empty.
+      conflicts:   int32 [T, window] -- losing op indices, actor-descending,
+                   -1 padded.
+      visible_before: bool -- register non-empty just before this op.
+      overflow:    bool -- window saturated; host must re-resolve this group.
+    """
+    T = group.shape[0]
+    W = window
+
+    # sort by (group, time); padding (group == -1) sorts first and is inert
+    sort_idx = jnp.lexsort((time, group))
+    g_s = group[sort_idx]
+    t_s = time[sort_idx]
+    a_s = actor[sort_idx]
+    q_s = seq[sort_idx]
+    c_s = clock[sort_idx]
+    d_s = is_del[sort_idx]
+
+    pos = jnp.arange(T)
+    # window member w of op i lives at sorted position i - 1 - w
+    offs = jnp.arange(1, W + 1)
+    wpos = pos[:, None] - offs[None, :]                       # [T, W]
+    wvalid = (wpos >= 0) & (g_s[jnp.clip(wpos, 0, T - 1)] == g_s[:, None]) \
+        & (g_s[:, None] >= 0)
+    widx = jnp.clip(wpos, 0, T - 1)
+
+    # member arrays: slot 0 = self, slots 1..W = predecessors (recent first)
+    def gather_members(arr):
+        return jnp.concatenate([arr[:, None], arr[widx]], axis=1)   # [T, W+1]
+
+    m_actor = gather_members(a_s)
+    m_seq = gather_members(q_s)
+    m_del = gather_members(d_s)
+    m_valid = jnp.concatenate(
+        [(g_s >= 0)[:, None], wvalid], axis=1)                      # [T, W+1]
+    m_clock = jnp.concatenate([c_s[:, None, :], c_s[widx]], axis=1)  # [T,W+1,A]
+
+    # pairwise: does member u supersede member v?  (u applied later, and they
+    # are NOT concurrent).  Member order by slot: slot 0 is the latest op,
+    # larger slots are earlier.  u later than v  <=>  slot_u < slot_v.
+    bt = jnp.arange(T)[:, None, None]
+    u_actor = m_actor[:, :, None]          # [T, W+1, 1]
+    v_actor = m_actor[:, None, :]          # [T, 1, W+1]
+    u_seq = m_seq[:, :, None]
+    v_seq = m_seq[:, None, :]
+    u_clock_at_v = m_clock[bt, jnp.arange(W + 1)[None, :, None],
+                           jnp.clip(v_actor, 0, m_clock.shape[2] - 1)]
+    v_clock_at_u = m_clock[bt, jnp.arange(W + 1)[None, None, :],
+                           jnp.clip(u_actor, 0, m_clock.shape[2] - 1)]
+    concurrent = (u_clock_at_v < v_seq) & (v_clock_at_u < u_seq)    # [T,W+1,W+1]
+    later = (jnp.arange(W + 1)[:, None] < jnp.arange(W + 1)[None, :])  # u<v slot
+    supersedes = later[None, :, :] & ~concurrent \
+        & m_valid[:, :, None] & m_valid[:, None, :]
+
+    # alive after op i: member v is alive iff valid and no member u (at or
+    # before time_i, i.e. any slot) supersedes it, and v is not a del
+    superseded = jnp.any(supersedes, axis=1)                        # [T, W+1]
+    alive = m_valid & ~superseded & ~m_del                          # [T, W+1]
+
+    # visible before op i: drop self (slot 0), member alive considering only
+    # supersessions by predecessors (exclude slot-0 superseder)
+    superseded_wo_self = jnp.any(supersedes[:, 1:, :], axis=1)      # [T, W+1]
+    alive_before = m_valid & ~superseded_wo_self & ~m_del
+    visible_before = jnp.any(alive_before[:, 1:], axis=1)
+
+    alive_after = jnp.sum(alive, axis=1).astype(jnp.int32)
+
+    # winner: alive member with max actor rank; conflicts: remaining alive
+    # members, actor-descending (the reference's sortBy(actor).reverse())
+    actor_keyed = jnp.where(alive, m_actor, -1)
+    order = jnp.argsort(-actor_keyed, axis=1, stable=True)          # [T, W+1]
+    sorted_alive = jnp.take_along_axis(alive, order, axis=1)
+    member_src = jnp.concatenate(
+        [sort_idx[:, None], sort_idx[widx]], axis=1)                # [T, W+1]
+    sorted_src = jnp.take_along_axis(member_src, order, axis=1)
+    sorted_src = jnp.where(sorted_alive, sorted_src, -1)
+
+    winner = sorted_src[:, 0]
+    conflicts = sorted_src[:, 1:]
+
+    # overflow: the whole window is same-group valid AND the earliest window
+    # slot is still alive -- older ops beyond the window could matter
+    window_full = jnp.all(m_valid[:, 1:], axis=1)
+    overflow = window_full & (g_s >= 0)
+
+    # scatter back to original op order
+    out = {
+        'alive_after': jnp.zeros((T,), jnp.int32).at[sort_idx].set(alive_after),
+        'winner': jnp.full((T,), -1, jnp.int32).at[sort_idx].set(winner),
+        'conflicts': jnp.full((T, W), -1, jnp.int32).at[sort_idx].set(conflicts),
+        'visible_before': jnp.zeros((T,), jnp.bool_).at[sort_idx].set(visible_before),
+        'overflow': jnp.zeros((T,), jnp.bool_).at[sort_idx].set(overflow),
+    }
+    return out

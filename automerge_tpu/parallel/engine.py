@@ -1,0 +1,887 @@
+"""TPUDocPool -- the batched TPU execution backend.
+
+Resolves the op streams of MANY documents in one device pass, emitting
+patches byte-identical to the scalar oracle (`automerge_tpu/backend`).  This
+is the rebuild's answer to the reference's per-document sequential backend
+(`/root/reference/backend/op_set.js`): document-level independence becomes
+the data-parallel axis (SURVEY.md section 2 mapping table).
+
+Per batch:
+  1. schedule:   vmapped causal-ready fixpoint over per-doc queues
+                 (`ops/clock.schedule_queue_batch`)
+  2. resolve:    flat LWW register resolution across all docs' assign ops
+                 (`ops/registers.resolve_registers`)
+  3. linearize:  RGA list ranking over all touched list objects
+                 (`ops/list_rank.linearize`) and per-op dominance indexes
+                 (`ops/list_rank.dominance_indexes`)
+  4. emit:       host pass assembling the reference-format patches; host
+                 mirrors (registers, inbound links, visible sequences) are
+                 updated from the same outputs, so the expensive resolution
+                 work never runs in Python.
+
+Registers whose concurrency window overflows (more than WINDOW live writers
+on one key) are re-resolved host-side with oracle semantics -- parity always
+wins over speed.
+
+The pool exposes the reference Backend surface per document
+(`apply_changes`, `get_patch`, `get_missing_changes`, `get_missing_deps`,
+`get_changes_for_actor`) plus `apply_batch` for the many-docs fast path.
+"""
+
+import numpy as np
+
+from ..errors import AutomergeError, RangeError
+from ..ops import clock as clock_ops
+from ..ops import list_rank, registers as register_ops
+from ..utils.common import ROOT_ID
+from .columnar import Interner, actor_rank_table, densify_clock
+
+_MAKE_TYPES = {'makeMap': 'map', 'makeTable': 'table', 'makeList': 'list',
+               'makeText': 'text'}
+_LIST_TYPES = ('list', 'text')
+
+
+def _bucket(n, floor=16):
+    """Next power-of-two size >= n: shape bucketing so jit compiles cache
+    across batches (SURVEY.md hard part: dynamic shapes)."""
+    size = floor
+    while size < n:
+        size *= 2
+    return size
+
+
+class Arena:
+    """Element storage for one list/text object."""
+
+    __slots__ = ('ctr', 'actor_sid', 'parent', 'visible', 'index_of',
+                 'visible_order', 'max_elem')
+
+    def __init__(self):
+        self.ctr = []          # elemId counter per element
+        self.actor_sid = []    # stable actor id per element
+        self.parent = []       # arena index of insertion parent (-1 = head)
+        self.visible = []      # bool per element
+        self.index_of = {}     # elemId str -> arena index
+        self.visible_order = []  # arena indexes in list order (the mirror)
+        self.max_elem = 0
+
+
+class DocState:
+    """Host-resident mirror of one document's CRDT state."""
+
+    def __init__(self):
+        self.clock = {}
+        self.deps = {}
+        self.states = {}       # actor -> [ {'change':, 'allDeps':} ]
+        self.queue = []
+        self.objects = {ROOT_ID: {'type': 'map', 'inbound': []}}
+        self.registers = {}    # (obj, key) -> [op dicts], winner first
+        self.arenas = {}       # obj -> Arena
+
+
+class TPUDocPool:
+    def __init__(self):
+        self.docs = {}
+        self.actor_ids = Interner()
+
+    def doc(self, doc_id):
+        state = self.docs.get(doc_id)
+        if state is None:
+            state = DocState()
+            self.docs[doc_id] = state
+        return state
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+
+    def apply_changes(self, doc_id, changes):
+        """Single-doc convenience; returns the patch."""
+        return self.apply_batch({doc_id: changes})[doc_id]
+
+    def apply_batch(self, changes_by_doc):
+        """Applies a batch of changes across many docs in one device pass;
+        returns {doc_id: patch}."""
+        doc_ids = list(changes_by_doc.keys())
+        for doc_id in doc_ids:
+            self.doc(doc_id)
+
+        # ---- 1. schedule -------------------------------------------------
+        applied, dup_checks = self._schedule(doc_ids, changes_by_doc)
+
+        # ---- 2. transitive allDeps + state updates per applied change ----
+        for doc_id, change in applied:
+            state = self.docs[doc_id]
+            actor, seq = change['actor'], change['seq']
+            base = dict(change.get('deps', {}))
+            base[actor] = seq - 1
+            all_deps = {}
+            for da, ds in base.items():
+                if ds <= 0:
+                    continue
+                entries = state.states.get(da, [])
+                if ds - 1 < len(entries):
+                    for ta, ts in entries[ds - 1]['allDeps'].items():
+                        if ts > all_deps.get(ta, 0):
+                            all_deps[ta] = ts
+                all_deps[da] = max(all_deps.get(da, 0), ds)
+            state.states.setdefault(actor, []).append(
+                {'change': change, 'allDeps': all_deps})
+            state.clock[actor] = seq
+            remaining = {a: s for a, s in state.deps.items()
+                         if s > all_deps.get(a, 0)}
+            remaining[actor] = seq
+            state.deps = remaining
+
+        # duplicate consistency runs after state updates so that in-batch
+        # seq reuse is caught too (oracle parity: op_set.js:255-260)
+        self._check_duplicates(dup_checks)
+
+        # ---- 3. metadata pre-pass: object creation + arena appends ------
+        self._prepass(applied)
+
+        # ---- 4. encode applied ops --------------------------------------
+        enc = self._encode(applied)
+
+        # ---- 4. device kernels ------------------------------------------
+        outputs = self._run_kernels(enc)
+
+        # ---- 5. emission + mirror updates -------------------------------
+        diffs_by_doc = self._emit(enc, outputs)
+
+        # ---- 6. patches --------------------------------------------------
+        patches = {}
+        for doc_id in doc_ids:
+            state = self.docs[doc_id]
+            patches[doc_id] = {
+                'clock': dict(state.clock),
+                'deps': dict(state.deps),
+                'canUndo': False,
+                'canRedo': False,
+                'diffs': diffs_by_doc.get(doc_id, []),
+            }
+        return patches
+
+    def get_missing_deps(self, doc_id):
+        """(parity: op_set.js:359-370)"""
+        state = self.doc(doc_id)
+        missing = {}
+        for change in state.queue:
+            deps = dict(change.get('deps', {}))
+            deps[change['actor']] = change['seq'] - 1
+            for da, ds in deps.items():
+                if state.clock.get(da, 0) < ds:
+                    missing[da] = max(ds, missing.get(da, 0))
+        return missing
+
+    def get_missing_changes(self, doc_id, have_deps):
+        """(parity: op_set.js:339-346)"""
+        state = self.doc(doc_id)
+        all_deps = {}
+        for da, ds in have_deps.items():
+            if ds <= 0:
+                continue
+            entries = state.states.get(da, [])
+            if ds - 1 < len(entries):
+                for ta, ts in entries[ds - 1]['allDeps'].items():
+                    if ts > all_deps.get(ta, 0):
+                        all_deps[ta] = ts
+            all_deps[da] = max(all_deps.get(da, 0), ds)
+        from ..backend.op_set import copy_change
+        changes = []
+        for actor, entries in state.states.items():
+            for entry in entries[all_deps.get(actor, 0):]:
+                changes.append(copy_change(entry['change']))
+        return changes
+
+    def get_changes_for_actor(self, doc_id, actor, after_seq=0):
+        from ..backend.op_set import copy_change
+        state = self.doc(doc_id)
+        return [copy_change(e['change'])
+                for e in state.states.get(actor, [])[after_seq:]]
+
+    def get_patch(self, doc_id):
+        """Whole-doc materialization patch, child-first, byte-compatible
+        with the oracle's MaterializationContext
+        (parity: backend/index.js:5-119)."""
+        state = self.doc(doc_id)
+        diffs = []
+        self._materialize(state, ROOT_ID, diffs, set())
+        return {
+            'clock': dict(state.clock),
+            'deps': dict(state.deps),
+            'canUndo': False,
+            'canRedo': False,
+            'diffs': diffs,
+        }
+
+    # ------------------------------------------------------------------
+    # scheduling
+    # ------------------------------------------------------------------
+
+    def _schedule(self, doc_ids, changes_by_doc):
+        """Exact-order causal scheduling.
+
+        The application ORDER the reference produces is an artifact of its
+        ingestion loop: every ingested change triggers a full queue fixpoint
+        (`backend/index.js:144-151` -> `op_set.js:279-295`), so cascade
+        unlocks interleave per-ingestion, not per-batch.  Patch parity
+        requires reproducing that order exactly, and the readiness test is a
+        cheap clock-dict comparison, so the order is emulated host-side here;
+        the vmapped device scheduler (`ops/clock.schedule_queue_batch`)
+        serves the bulk/order-insensitive paths (replica catch-up, dryrun).
+
+        Returns ([(doc_id, change)] in application order, duplicates)."""
+        from ..backend.op_set import copy_change
+
+        applied = []
+        duplicates = []
+        for doc_id in doc_ids:
+            state = self.docs[doc_id]
+            clock = state.clock  # mutated by caller later; use a shadow
+            shadow = dict(clock)
+            queue = list(state.queue)
+            for incoming in changes_by_doc[doc_id]:
+                queue.append(copy_change(incoming))
+                while True:
+                    progress = False
+                    next_q = []
+                    for change in queue:
+                        actor, seq = change['actor'], change['seq']
+                        deps = change.get('deps', {})
+                        ready = shadow.get(actor, 0) >= seq - 1 and all(
+                            shadow.get(da, 0) >= ds
+                            for da, ds in deps.items())
+                        if ready:
+                            progress = True
+                            if seq <= shadow.get(actor, 0):
+                                duplicates.append((doc_id, change))
+                            else:
+                                shadow[actor] = seq
+                                applied.append((doc_id, change))
+                        else:
+                            next_q.append(change)
+                    queue = next_q
+                    if not progress:
+                        break
+            state.queue = queue
+        return applied, duplicates
+
+    def _check_duplicates(self, duplicates):
+        for doc_id, change in duplicates:
+            state = self.docs[doc_id]
+            entries = state.states.get(change['actor'], [])
+            seq = change['seq']
+            if seq - 1 < len(entries):
+                if entries[seq - 1]['change'] != change:
+                    raise AutomergeError(
+                        'Inconsistent reuse of sequence number %s by %s'
+                        % (seq, change['actor']))
+
+    def _prepass(self, applied):
+        """Walks applied ops in order registering objects (make*) and arena
+        elements (ins), with the oracle's error semantics
+        (parity: op_set.js:63-95)."""
+        for doc_id, change in applied:
+            state = self.docs[doc_id]
+            actor, seq = change['actor'], change['seq']
+            for raw_op in change['ops']:
+                action = raw_op['action']
+                if action in _MAKE_TYPES:
+                    obj = raw_op['obj']
+                    if obj in state.objects:
+                        raise AutomergeError(
+                            'Duplicate creation of object ' + obj)
+                    type_ = _MAKE_TYPES[action]
+                    state.objects[obj] = {'type': type_, 'inbound': []}
+                    if type_ in _LIST_TYPES:
+                        state.arenas.setdefault(obj, Arena())
+                elif action == 'ins':
+                    obj = raw_op['obj']
+                    if obj not in state.objects:
+                        raise AutomergeError(
+                            'Modification of unknown object ' + obj)
+                    arena = state.arenas.setdefault(obj, Arena())
+                    elem_id = '%s:%s' % (actor, raw_op['elem'])
+                    if elem_id in arena.index_of:
+                        raise AutomergeError(
+                            'Duplicate list element ID ' + elem_id)
+                    parent_key = raw_op['key']
+                    if parent_key == '_head':
+                        parent_idx = -1
+                    else:
+                        parent_idx = arena.index_of.get(parent_key)
+                        if parent_idx is None:
+                            raise AutomergeError(
+                                'Missing index entry for list element '
+                                + str(parent_key))
+                    arena.index_of[elem_id] = len(arena.ctr)
+                    arena.ctr.append(int(raw_op['elem']))
+                    arena.actor_sid.append(self.actor_ids.id_of(actor))
+                    arena.parent.append(parent_idx)
+                    arena.visible.append(False)
+                    arena.max_elem = max(arena.max_elem, int(raw_op['elem']))
+                elif action in ('set', 'del', 'link'):
+                    if raw_op['obj'] not in state.objects:
+                        raise AutomergeError(
+                            'Modification of unknown object ' + raw_op['obj'])
+                else:
+                    raise RangeError('Unknown operation type %s' % action)
+
+    # ------------------------------------------------------------------
+    # encoding
+    # ------------------------------------------------------------------
+
+    def _encode(self, applied):
+        """Flattens applied changes into per-op columns + register state rows.
+
+        Returns an `enc` dict consumed by _run_kernels/_emit."""
+        ops = []           # (doc_id, op dict)
+        group_ids = {}
+        arena_objs = {}    # (doc_id, obj) -> local dense id
+        involved_actor_sids = set()
+
+        for doc_id, change in applied:
+            actor, seq = change['actor'], change['seq']
+            involved_actor_sids.add(self.actor_ids.id_of(actor))
+            state = self.docs[doc_id]
+            all_deps = state.states[actor][seq - 1]['allDeps']
+            for da in all_deps:
+                involved_actor_sids.add(self.actor_ids.id_of(da))
+            for raw_op in change['ops']:
+                op = dict(raw_op, actor=actor, seq=seq)
+                ops.append((doc_id, op))
+
+        # actor ranks for this batch: batch actors + all actors appearing in
+        # register state rows of touched groups / arena elements
+        # (first pass to discover touched groups and arenas)
+        for doc_id, op in ops:
+            state = self.docs[doc_id]
+            action = op['action']
+            if action in ('set', 'del', 'link'):
+                gkey = (doc_id, op['obj'], op['key'])
+                if gkey not in group_ids:
+                    group_ids[gkey] = len(group_ids)
+                    for rec in state.registers.get((op['obj'], op['key']), []):
+                        involved_actor_sids.add(
+                            self.actor_ids.id_of(rec['actor']))
+                        rec_deps = self._all_deps_of(state, rec['actor'],
+                                                     rec['seq'])
+                        for da in rec_deps:
+                            involved_actor_sids.add(self.actor_ids.id_of(da))
+                obj_meta = state.objects.get(op['obj'])
+                if obj_meta and obj_meta['type'] in _LIST_TYPES:
+                    akey = (doc_id, op['obj'])
+                    if akey not in arena_objs:
+                        arena_objs[akey] = len(arena_objs)
+            elif action == 'ins':
+                akey = (doc_id, op['obj'])
+                if akey not in arena_objs:
+                    arena_objs[akey] = len(arena_objs)
+
+        # arena element actors join the rank table (lamport tie-breaks)
+        for (doc_id, obj) in arena_objs:
+            arena = self.docs[doc_id].arenas.get(obj)
+            if arena is not None:
+                involved_actor_sids.update(arena.actor_sid)
+
+        if not involved_actor_sids:
+            involved_actor_sids = {self.actor_ids.id_of('')}
+        rank_of, _ = actor_rank_table(self.actor_ids, involved_actor_sids)
+        A = max(int((rank_of >= 0).sum()), 1)
+
+        return {
+            'ops': ops,
+            'group_ids': group_ids,
+            'arena_objs': arena_objs,
+            'rank_of': rank_of,
+            'A': A,
+        }
+
+    def _all_deps_of(self, state, actor, seq):
+        entries = state.states.get(actor, [])
+        if 0 < seq <= len(entries):
+            return entries[seq - 1]['allDeps']
+        return {}
+
+    # ------------------------------------------------------------------
+    # kernels
+    # ------------------------------------------------------------------
+
+    def _run_kernels(self, enc):
+        ops = enc['ops']
+        group_ids = enc['group_ids']
+        rank_of = enc['rank_of']
+        A = enc['A']
+        aid = self.actor_ids.id_of
+
+        # ---- register rows: state rows first, then batch assign ops ------
+        g_col, t_col, a_col, s_col, d_col = [], [], [], [], []
+        clock_rows = []
+        src_records = []   # parallel: the op dict behind each row
+        row_doc = []
+
+        for (doc_id, obj, key), gid in group_ids.items():
+            state = self.docs[doc_id]
+            recs = state.registers.get((obj, key), [])
+            for i, rec in enumerate(recs):
+                g_col.append(gid)
+                t_col.append(-len(recs) + i)
+                a_col.append(int(rank_of[aid(rec['actor'])]))
+                s_col.append(rec['seq'])
+                d_col.append(False)
+                clock_rows.append(densify_clock(
+                    self._all_deps_of(state, rec['actor'], rec['seq']),
+                    rank_of, A, self.actor_ids))
+                src_records.append(rec)
+                row_doc.append(doc_id)
+
+        assign_row_of_op = {}
+        time = 0
+        for op_idx, (doc_id, op) in enumerate(ops):
+            if op['action'] not in ('set', 'del', 'link'):
+                time += 1
+                continue
+            state = self.docs[doc_id]
+            gid = group_ids[(doc_id, op['obj'], op['key'])]
+            assign_row_of_op[op_idx] = len(g_col)
+            g_col.append(gid)
+            t_col.append(time)
+            a_col.append(int(rank_of[aid(op['actor'])]))
+            s_col.append(op['seq'])
+            d_col.append(op['action'] == 'del')
+            clock_rows.append(densify_clock(
+                self._all_deps_of(state, op['actor'], op['seq']),
+                rank_of, A, self.actor_ids))
+            src_records.append(op)
+            row_doc.append(doc_id)
+            time += 1
+
+        T = len(g_col)
+        if T > 0:
+            Tp = _bucket(T)
+            Ap = _bucket(A, floor=4)
+            g_arr = np.full((Tp,), -1, np.int32)
+            g_arr[:T] = g_col
+            t_arr = np.zeros((Tp,), np.int32)
+            t_arr[:T] = t_col
+            a_arr = np.zeros((Tp,), np.int32)
+            a_arr[:T] = a_col
+            s_arr = np.zeros((Tp,), np.int32)
+            s_arr[:T] = s_col
+            c_arr = np.zeros((Tp, Ap), np.int32)
+            c_arr[:T, :A] = np.stack(clock_rows)
+            d_arr = np.zeros((Tp,), bool)
+            d_arr[:T] = d_col
+            reg_out = register_ops.resolve_registers(
+                g_arr, t_arr, a_arr, s_arr, c_arr, d_arr,
+                np.ones((Tp,), bool))
+            reg_out = {k: np.asarray(v)[:T] for k, v in reg_out.items()}
+        else:
+            reg_out = None
+
+        # ---- arenas (elements already appended by _prepass) ---------------
+        arena_objs = enc['arena_objs']
+
+        # build the flat arena arrays of all touched objects
+        base_of = {}
+        obj_l, par_l, ctr_l, act_l = [], [], [], []
+        for akey, local_obj in arena_objs.items():
+            doc_id, obj = akey
+            arena = self.docs[doc_id].arenas.get(obj)
+            if arena is None:
+                arena = self.docs[doc_id].arenas.setdefault(obj, Arena())
+            base = len(obj_l)
+            base_of[akey] = base
+            n = len(arena.ctr)
+            obj_l.extend([local_obj] * n)
+            par_l.extend(p + base if p >= 0 else -1 for p in arena.parent)
+            ctr_l.extend(arena.ctr)
+            act_l.extend(int(rank_of[sid]) for sid in arena.actor_sid)
+
+        L = len(obj_l)
+        if L > 0:
+            Lp = _bucket(L)
+            obj_arr = np.zeros((Lp,), np.int32)
+            obj_arr[:L] = obj_l
+            par_arr = np.full((Lp,), -1, np.int32)
+            par_arr[:L] = par_l
+            ctr_arr = np.zeros((Lp,), np.int32)
+            ctr_arr[:L] = ctr_l
+            act_arr = np.zeros((Lp,), np.int32)
+            act_arr[:L] = act_l
+            val_arr = np.zeros((Lp,), bool)
+            val_arr[:L] = True
+            rank = np.asarray(list_rank.linearize(
+                obj_arr, par_arr, ctr_arr, act_arr, val_arr,
+                n_iters=list_rank.ceil_log2(Lp) + 1))[:L]
+        else:
+            rank = np.zeros((0,), np.int32)
+
+        # ---- per-op dominance indexes for list assigns -------------------
+        # visibility timeline: each list assign op toggles its element
+        list_op_rows = []   # (op_idx, flat_elem, delta)
+        vis0 = np.zeros((L,), np.float32)
+        for akey, base in base_of.items():
+            doc_id, obj = akey
+            arena = self.docs[doc_id].arenas[obj]
+            for i, v in enumerate(arena.visible):
+                if v:
+                    vis0[base + i] = 1.0
+
+        # host fallback for overflowed register groups: replay that group's
+        # ops sequentially with oracle semantics so BOTH the emitted register
+        # and the visibility timeline stay byte-faithful (parity wins)
+        host_registers = {}
+        if reg_out is not None and reg_out['overflow'].any():
+            overflowed = set()
+            for op_idx, row in assign_row_of_op.items():
+                if reg_out['overflow'][row]:
+                    doc_id, op = ops[op_idx]
+                    overflowed.add((doc_id, op['obj'], op['key']))
+            scratch = {}
+            for op_idx, (doc_id, op) in enumerate(ops):
+                if op['action'] not in ('set', 'del', 'link'):
+                    continue
+                gkey = (doc_id, op['obj'], op['key'])
+                if gkey not in overflowed:
+                    continue
+                state = self.docs[doc_id]
+                if gkey not in scratch:
+                    scratch[gkey] = list(
+                        state.registers.get((op['obj'], op['key']), []))
+                scratch[gkey] = self._resolve_assign_host(
+                    state, scratch[gkey], op)
+                host_registers[op_idx] = list(scratch[gkey])
+
+        op_elem, op_delta, op_valid, op_src = [], [], [], []
+        if reg_out is not None:
+            vis_now = {}
+            for op_idx, (doc_id, op) in enumerate(ops):
+                row = assign_row_of_op.get(op_idx)
+                if row is None:
+                    continue
+                state = self.docs[doc_id]
+                obj_meta = state.objects.get(op['obj'])
+                if not obj_meta or obj_meta['type'] not in _LIST_TYPES:
+                    continue
+                akey = (doc_id, op['obj'])
+                arena = state.arenas[op['obj']]
+                eidx = arena.index_of.get(op['key'])
+                if op_idx in host_registers:
+                    alive_now = len(host_registers[op_idx]) > 0
+                else:
+                    alive_now = bool(reg_out['alive_after'][row] > 0)
+                if eidx is None:
+                    # assign to unknown element: visible only if it would
+                    # produce a diff -- the oracle raises when walking
+                    if alive_now:
+                        raise AutomergeError(
+                            'Missing index entry for list element '
+                            + str(op['key']))
+                    continue
+                flat = base_of[akey] + eidx
+                key = flat
+                before = vis_now.get(key, bool(vis0[flat] > 0))
+                after = alive_now
+                vis_now[key] = after
+                op_elem.append(flat)
+                op_delta.append(int(after) - int(before))
+                op_valid.append(True)
+                op_src.append((op_idx, row))
+
+        Tl = len(op_elem)
+        if Tl > 0 and L > 0:
+            Lp = _bucket(L)
+            Tlp = _bucket(Tl)
+            eo_arr = np.full((Lp,), -3, np.int32)
+            eo_arr[:L] = obj_l
+            er_arr = np.full((Lp,), -1, np.int32)
+            er_arr[:L] = rank
+            v0_arr = np.zeros((Lp,), np.float32)
+            v0_arr[:L] = vis0
+            oe_arr = np.full((Tlp,), -1, np.int32)
+            oe_arr[:Tl] = op_elem
+            oo_arr = np.full((Tlp,), -2, np.int32)
+            oo_arr[:Tl] = eo_arr[oe_arr[:Tl]]
+            or_arr = np.full((Tlp,), -1, np.int32)
+            or_arr[:Tl] = er_arr[oe_arr[:Tl]]
+            od_arr = np.zeros((Tlp,), np.int32)
+            od_arr[:Tl] = op_delta
+            ov_arr = np.zeros((Tlp,), bool)
+            ov_arr[:Tl] = True
+            indexes = np.asarray(list_rank.dominance_indexes(
+                eo_arr, er_arr, v0_arr, oe_arr, oo_arr, or_arr,
+                od_arr, ov_arr))[:Tl]
+        else:
+            indexes = np.zeros((0,), np.int32)
+
+        return {
+            'reg_out': reg_out,
+            'assign_row_of_op': assign_row_of_op,
+            'src_records': src_records,
+            'rank': rank,
+            'base_of': base_of,
+            'host_registers': host_registers,
+            'list_index_of_op': {src[0]: (int(indexes[i]), src[1])
+                                 for i, src in enumerate(op_src)},
+        }
+
+    # ------------------------------------------------------------------
+    # emission
+    # ------------------------------------------------------------------
+
+    def _emit(self, enc, outputs):
+        ops = enc['ops']
+        reg_out = outputs['reg_out']
+        src_records = outputs['src_records']
+        assign_row_of_op = outputs['assign_row_of_op']
+        list_index_of_op = outputs['list_index_of_op']
+
+        diffs_by_doc = {}
+        for op_idx, (doc_id, op) in enumerate(ops):
+            state = self.docs[doc_id]
+            diffs = diffs_by_doc.setdefault(doc_id, [])
+            action = op['action']
+
+            if action in _MAKE_TYPES:
+                diffs.append({'action': 'create', 'obj': op['obj'],
+                              'type': _MAKE_TYPES[action]})
+                continue
+
+            if action == 'ins':
+                continue  # arena updated during encoding; no diff
+
+            if action not in ('set', 'del', 'link'):
+                raise RangeError('Unknown operation type %s' % action)
+
+            if op['obj'] not in state.objects:
+                raise AutomergeError(
+                    'Modification of unknown object ' + op['obj'])
+
+            row = assign_row_of_op[op_idx]
+            host_reg = outputs['host_registers'].get(op_idx)
+            if host_reg is not None:
+                new_register = host_reg
+            else:
+                new_register = self._register_from_kernel(
+                    reg_out, row, src_records)
+
+            self._update_register_mirror(state, op, new_register)
+            obj_type = state.objects[op['obj']]['type']
+            if obj_type in _LIST_TYPES:
+                diff = self._emit_list_diff(
+                    state, op, new_register, op_idx, list_index_of_op,
+                    obj_type)
+            else:
+                diff = self._emit_map_diff(state, op, new_register, obj_type)
+            if diff is not None:
+                diffs.append(diff)
+        return diffs_by_doc
+
+    def _register_from_kernel(self, reg_out, row, src_records):
+        srcs = [int(reg_out['winner'][row])]
+        srcs.extend(int(c) for c in reg_out['conflicts'][row])
+        return [src_records[s] for s in srcs if s >= 0]
+
+    def _resolve_assign_host(self, state, priors, op):
+        """Oracle-rule fallback for overflowed registers
+        (parity: op_set.js:202-220)."""
+
+        def concurrent(o1, o2):
+            c1 = self._all_deps_of(state, o1['actor'], o1['seq'])
+            c2 = self._all_deps_of(state, o2['actor'], o2['seq'])
+            return (c1.get(o2['actor'], 0) < o2['seq']
+                    and c2.get(o1['actor'], 0) < o1['seq'])
+
+        remaining = [o for o in priors if concurrent(o, op)]
+        if op['action'] != 'del':
+            remaining.append(op)
+        remaining.sort(key=lambda o: o['actor'], reverse=True)
+        return remaining
+
+    def _update_register_mirror(self, state, op, new_register):
+        key = (op['obj'], op['key'])
+        old = state.registers.get(key, [])
+        old_links = [o for o in old if o['action'] == 'link']
+        new_set = [(o['actor'], o['seq'], o.get('value')) for o in new_register]
+        for o in old_links:
+            if (o['actor'], o['seq'], o.get('value')) not in new_set:
+                target = state.objects.get(o['value'])
+                if target is not None:
+                    target['inbound'] = [
+                        r for r in target['inbound']
+                        if not (r['actor'] == o['actor']
+                                and r['seq'] == o['seq']
+                                and r['key'] == o['key']
+                                and r['obj'] == o['obj'])]
+        if op['action'] == 'link':
+            target = state.objects.get(op['value'])
+            if target is not None:
+                ref = {'obj': op['obj'], 'key': op['key'],
+                       'actor': op['actor'], 'seq': op['seq'],
+                       'value': op['value']}
+                if not any(r == ref for r in target['inbound']):
+                    target['inbound'].append(ref)
+        if new_register:
+            state.registers[key] = new_register
+        else:
+            state.registers[key] = []
+
+    def _get_path(self, state, object_id):
+        """(parity: op_set.js:43-60)"""
+        path = []
+        while object_id != ROOT_ID:
+            meta = state.objects.get(object_id)
+            inbound = meta['inbound'] if meta else []
+            if not inbound:
+                return None
+            ref = inbound[0]
+            object_id = ref['obj']
+            parent_meta = state.objects.get(object_id, {})
+            if parent_meta.get('type') in _LIST_TYPES:
+                arena = state.arenas.get(object_id)
+                eidx = arena.index_of.get(ref['key']) if arena else None
+                if eidx is None:
+                    return None
+                try:
+                    path.insert(0, arena.visible_order.index(eidx))
+                except ValueError:
+                    return None
+            else:
+                path.insert(0, ref['key'])
+        return path
+
+    def _conflict_list(self, register):
+        conflicts = []
+        for o in register[1:]:
+            c = {'actor': o['actor'], 'value': o.get('value')}
+            if o['action'] == 'link':
+                c['link'] = True
+            conflicts.append(c)
+        return conflicts
+
+    def _emit_map_diff(self, state, op, register, obj_type):
+        """(parity: op_set.js:165-185)"""
+        type_ = 'map' if op['obj'] == ROOT_ID else obj_type
+        edit = {'action': '', 'type': type_, 'obj': op['obj'],
+                'key': op['key'], 'path': self._get_path(state, op['obj'])}
+        if not register:
+            edit['action'] = 'remove'
+        else:
+            first = register[0]
+            edit['action'] = 'set'
+            edit['value'] = first.get('value')
+            if first['action'] == 'link':
+                edit['link'] = True
+            if first.get('datatype'):
+                edit['datatype'] = first['datatype']
+            if len(register) > 1:
+                edit['conflicts'] = self._conflict_list(register)
+        return edit
+
+    def _emit_list_diff(self, state, op, register, op_idx, list_index_of_op,
+                        obj_type):
+        """(parity: op_set.js:107-163)"""
+        arena = state.arenas[op['obj']]
+        entry = list_index_of_op.get(op_idx)
+        eidx = arena.index_of.get(op['key'])
+        if entry is None or eidx is None:
+            # invisible before and after: no diff (delete of non-existent)
+            return None
+        index = entry[0]
+        visible_before = arena.visible[eidx]
+        alive = bool(register)
+
+        edit = {'action': '', 'type': obj_type, 'obj': op['obj'],
+                'index': index, 'path': self._get_path(state, op['obj'])}
+        if visible_before and alive:
+            edit['action'] = 'set'
+        elif visible_before and not alive:
+            edit['action'] = 'remove'
+            arena.visible_order.pop(index)
+            arena.visible[eidx] = False
+        elif not visible_before and alive:
+            edit['action'] = 'insert'
+            edit['elemId'] = op['key']
+            arena.visible_order.insert(index, eidx)
+            arena.visible[eidx] = True
+        else:
+            return None
+
+        if edit['action'] in ('set', 'insert'):
+            first = register[0]
+            edit['value'] = first.get('value')
+            if first['action'] == 'link':
+                edit['link'] = True
+            if first.get('datatype'):
+                edit['datatype'] = first['datatype']
+            if len(register) > 1:
+                edit['conflicts'] = self._conflict_list(register)
+        return edit
+
+    # ------------------------------------------------------------------
+    # materialization (getPatch parity)
+    # ------------------------------------------------------------------
+
+    def _materialize(self, state, object_id, diffs, seen):
+        """Child-first whole-object materialization."""
+        if object_id in seen:
+            return
+        seen.add(object_id)
+        meta = state.objects.get(object_id, {'type': 'map'})
+        type_ = meta['type']
+        own = []
+
+        if type_ in _LIST_TYPES:
+            own.append({'obj': object_id, 'type': type_, 'action': 'create'})
+            arena = state.arenas.get(object_id, Arena())
+            elem_ids = {v: k for k, v in arena.index_of.items()}
+            for index, eidx in enumerate(arena.visible_order):
+                key = elem_ids[eidx]
+                register = state.registers.get((object_id, key), [])
+                if not register:
+                    continue
+                diff = {'obj': object_id, 'type': type_, 'action': 'insert',
+                        'index': index, 'elemId': key}
+                self._materialize_value(state, register[0], diff, diffs, seen)
+                if len(register) > 1:
+                    diff['conflicts'] = self._materialize_conflicts(
+                        state, register, diffs, seen)
+                own.append(diff)
+        else:
+            if object_id != ROOT_ID:
+                own.append({'obj': object_id, 'type': type_,
+                            'action': 'create'})
+            for (obj, key), register in state.registers.items():
+                if obj != object_id or not register:
+                    continue
+                diff = {'obj': object_id, 'type': type_, 'action': 'set',
+                        'key': key}
+                self._materialize_value(state, register[0], diff, diffs, seen)
+                if len(register) > 1:
+                    diff['conflicts'] = self._materialize_conflicts(
+                        state, register, diffs, seen)
+                own.append(diff)
+        diffs.extend(own)
+
+    def _materialize_value(self, state, record, diff, diffs, seen):
+        if record['action'] == 'link':
+            child_diffs = []
+            self._materialize(state, record['value'], child_diffs, seen)
+            # child-first: children go before this object's diffs
+            diffs.extend(child_diffs)
+            diff['value'] = record['value']
+            diff['link'] = True
+        else:
+            diff['value'] = record.get('value')
+            if record.get('datatype'):
+                diff['datatype'] = record['datatype']
+
+    def _materialize_conflicts(self, state, register, diffs, seen):
+        conflicts = []
+        for record in register[1:]:
+            c = {'actor': record['actor']}
+            self._materialize_value(state, record, c, diffs, seen)
+            conflicts.append(c)
+        return conflicts

@@ -1,0 +1,308 @@
+"""Differential tests of the TPU kernels against the scalar oracle --
+the kernel analogue of the reference's shadow-oracle property tests
+(`/root/reference/test/skip_list_test.js:171-224`).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+import automerge_tpu.backend.op_set as OpSet
+from automerge_tpu.backend import init as backend_init
+from automerge_tpu.ops.clock import (NOT_APPLIED, schedule_queue,
+                                     schedule_queue_batch)
+from automerge_tpu.ops.list_rank import (ceil_log2, dominance_indexes,
+                                         linearize)
+
+ROOT_ID = '00000000-0000-0000-0000-000000000000'
+
+
+def oracle_schedule(clock, changes):
+    """Reference fixpoint loop (op_set.js:279-295) over (actor, seq, deps)."""
+    clock = dict(clock)
+    order = {}
+    counter = 0
+    queue = list(range(len(changes)))
+    while True:
+        next_queue = []
+        progress = False
+        for i in queue:
+            actor, seq, deps = changes[i]
+            deps = dict(deps)
+            deps[actor] = seq - 1
+            if all(clock.get(a, 0) >= s for a, s in deps.items()):
+                if seq <= clock.get(actor, 0):
+                    order[i] = -2  # duplicate
+                else:
+                    clock[actor] = seq
+                    order[i] = counter
+                    counter += 1
+                progress = True
+            else:
+                next_queue.append(i)
+        queue = next_queue
+        if not progress:
+            return order, clock
+
+
+class TestScheduler:
+    def run_case(self, n_actors, clock0, changes):
+        A = n_actors
+        C = len(changes)
+        actor = np.full((C,), -1, np.int32)
+        seq = np.zeros((C,), np.int32)
+        deps = np.zeros((C, A), np.int32)
+        for i, (a, s, d) in enumerate(changes):
+            actor[i] = a
+            seq[i] = s
+            for da, ds in d.items():
+                deps[i, da] = ds
+        clock = np.zeros((A,), np.int32)
+        for a, s in clock0.items():
+            clock[a] = s
+        order, new_clock = schedule_queue(
+            clock, actor, seq, deps, np.ones((C,), bool))
+        order = np.asarray(order)
+        new_clock = np.asarray(new_clock)
+
+        expect_order, expect_clock = oracle_schedule(clock0, changes)
+        for i in range(C):
+            exp = expect_order.get(i)
+            if exp is None:
+                assert order[i] == int(NOT_APPLIED), (i, order[i])
+            else:
+                assert order[i] == exp, (i, order[i], exp)
+        for a in range(A):
+            assert new_clock[a] == expect_clock.get(a, 0)
+
+    def test_in_order_single_actor(self):
+        self.run_case(2, {}, [(0, 1, {}), (0, 2, {}), (0, 3, {})])
+
+    def test_out_of_order_buffering(self):
+        # seq 3 and 2 arrive before seq 1: two fixpoint passes needed
+        self.run_case(2, {}, [(0, 3, {}), (0, 2, {}), (0, 1, {})])
+
+    def test_cross_actor_deps(self):
+        self.run_case(3, {}, [
+            (1, 1, {0: 1}),   # blocked until actor0 seq1
+            (0, 1, {}),
+            (2, 1, {0: 1, 1: 1}),
+        ])
+
+    def test_duplicates_and_unresolvable(self):
+        self.run_case(2, {0: 2}, [
+            (0, 1, {}),          # duplicate (already applied)
+            (0, 3, {}),          # fresh
+            (1, 5, {}),          # gap: never ready (seq 1..4 missing)
+        ])
+
+    def test_random_schedules(self):
+        rng = random.Random(7)
+        for trial in range(25):
+            A = rng.randint(1, 4)
+            # build a valid causal history, then deliver in random order
+            clocks = {a: 0 for a in range(A)}
+            changes = []
+            frontier = {}
+            for _ in range(rng.randint(1, 24)):
+                a = rng.randrange(A)
+                clocks[a] += 1
+                deps = {da: ds for da, ds in frontier.items() if da != a}
+                changes.append((a, clocks[a], deps))
+                frontier = {da: max(frontier.get(da, 0), ds)
+                            for da, ds in list(frontier.items())
+                            + [(a, clocks[a])]}
+            rng.shuffle(changes)
+            self.run_case(A, {}, changes)
+
+    def test_vmapped_batch(self):
+        A, C, D = 3, 4, 5
+        actor = np.zeros((D, C), np.int32)
+        seq = np.tile(np.arange(1, C + 1, dtype=np.int32), (D, 1))
+        deps = np.zeros((D, C, A), np.int32)
+        clock = np.zeros((D, A), np.int32)
+        valid = np.ones((D, C), bool)
+        order, new_clock = schedule_queue_batch(clock, actor, seq, deps, valid)
+        assert np.all(np.asarray(order) == np.arange(C))
+        assert np.all(np.asarray(new_clock)[:, 0] == C)
+
+
+def build_forest_via_oracle(rng, n_ops, n_actors=3):
+    """Random interleaved inserts through the oracle; returns the oracle's
+    linear order and the columnar forest encoding."""
+    state = backend_init()
+    opset = state['opSet']
+    opset = opset.copy_with_gen(1)
+
+    actors = ['actor%d' % i for i in range(n_actors)]
+    list_id = 'list-1'
+    OpSet.apply_make(opset, {'action': 'makeList', 'obj': list_id})
+
+    elems = []          # (elem_id, ctr, actor_rank, parent_elem_id)
+    max_elem = 0
+    for i in range(n_ops):
+        a = rng.randrange(n_actors)
+        max_elem += 1
+        parent = '_head' if not elems or rng.random() < 0.2 else \
+            rng.choice(elems)[0]
+        op = {'action': 'ins', 'obj': list_id, 'key': parent,
+              'elem': max_elem, 'actor': actors[a], 'seq': 1}
+        OpSet.apply_insert(opset, op)
+        elems.append(('%s:%d' % (actors[a], max_elem), max_elem, a, parent))
+
+    # oracle linear order: walk get_next from _head
+    oracle_order = []
+    key = '_head'
+    while True:
+        key = OpSet.get_next(opset, list_id, key)
+        if key is None:
+            break
+        oracle_order.append(key)
+    return elems, oracle_order
+
+
+class TestLinearize:
+    @pytest.mark.parametrize('n_ops,seed', [(1, 0), (5, 1), (30, 2), (100, 3),
+                                            (100, 4), (250, 5)])
+    def test_matches_oracle_walk(self, n_ops, seed):
+        rng = random.Random(seed)
+        elems, oracle_order = build_forest_via_oracle(rng, n_ops)
+        L = len(elems)
+        index_of = {e[0]: i for i, e in enumerate(elems)}
+        obj = np.zeros((L,), np.int32)
+        parent = np.array([index_of.get(e[3], -1) for e in elems], np.int32)
+        ctr = np.array([e[1] for e in elems], np.int32)
+        actor = np.array([e[2] for e in elems], np.int32)
+        valid = np.ones((L,), bool)
+        rank = np.asarray(linearize(obj, parent, ctr, actor, valid,
+                                    n_iters=ceil_log2(L) + 1))
+        got_order = [None] * L
+        for i in range(L):
+            got_order[rank[i]] = elems[i][0]
+        assert got_order == oracle_order
+
+    def test_multiple_objects(self):
+        # two independent lists in one arena: obj 0 has a->b, obj 1 has c
+        obj = np.array([0, 0, 1], np.int32)
+        parent = np.array([-1, 0, -1], np.int32)
+        ctr = np.array([1, 2, 1], np.int32)
+        actor = np.array([0, 0, 0], np.int32)
+        valid = np.ones((3,), bool)
+        rank = np.asarray(linearize(obj, parent, ctr, actor, valid, n_iters=3))
+        assert rank.tolist() == [0, 1, 0]
+
+    def test_padding_rows(self):
+        obj = np.array([0, 0, 0, 0], np.int32)
+        parent = np.array([-1, 0, -1, -1], np.int32)
+        ctr = np.array([1, 2, 7, 9], np.int32)
+        actor = np.array([0, 0, 0, 0], np.int32)
+        valid = np.array([True, True, False, False])
+        rank = np.asarray(linearize(obj, parent, ctr, actor, valid, n_iters=3))
+        assert rank[0] == 0 and rank[1] == 1
+        assert rank[2] == -1 and rank[3] == -1
+
+
+class TestDominanceIndexes:
+    def test_against_bruteforce(self):
+        rng = random.Random(11)
+        for trial in range(10):
+            L = rng.randint(1, 40)
+            T = rng.randint(1, 60)
+            n_objs = rng.randint(1, 3)
+            elem_obj = np.array([rng.randrange(n_objs) for _ in range(L)],
+                                np.int32)
+            # unique ranks per object
+            elem_rank = np.zeros((L,), np.int32)
+            for o in range(n_objs):
+                idxs = [i for i in range(L) if elem_obj[i] == o]
+                for r, i in enumerate(rng.sample(idxs, len(idxs))):
+                    elem_rank[i] = r
+            vis = np.array([rng.random() < 0.5 for _ in range(L)], np.float32)
+            vis0 = vis.copy()
+
+            op_elem = np.zeros((T,), np.int32)
+            op_delta = np.zeros((T,), np.int32)
+            expect = np.zeros((T,), np.int32)
+            vis_state = vis.copy()
+            for t in range(T):
+                e = rng.randrange(L)
+                op_elem[t] = e
+                expect[t] = int(sum(
+                    vis_state[i] for i in range(L)
+                    if elem_obj[i] == elem_obj[e]
+                    and elem_rank[i] < elem_rank[e]))
+                if vis_state[e] > 0 and rng.random() < 0.5:
+                    op_delta[t] = -1
+                elif vis_state[e] == 0 and rng.random() < 0.7:
+                    op_delta[t] = 1
+                vis_state[e] += op_delta[t]
+
+            got = np.asarray(dominance_indexes(
+                elem_obj, elem_rank, vis0,
+                op_elem, elem_obj[op_elem], elem_rank[op_elem],
+                op_delta, np.ones((T,), bool), chunk=8))
+            assert got.tolist() == expect.tolist(), trial
+
+
+class TestRegisters:
+    def test_lww_partition_and_conflicts(self):
+        from automerge_tpu.ops.registers import resolve_registers
+        # actors A(0), B(1), C(2).  A1 and B1 set key k concurrently;
+        # C1 (deps A:1, B:1) overwrites both; A2 (deps C:1) deletes.
+        A = 3
+        T = 4
+        group = np.zeros((T,), np.int32)
+        time = np.arange(T, dtype=np.int32)
+        actor = np.array([0, 1, 2, 0], np.int32)
+        seq = np.array([1, 1, 1, 2], np.int32)
+        clock = np.zeros((T, A), np.int32)
+        clock[2] = [1, 1, 0]            # C1 allDeps
+        clock[3] = [1, 1, 1]            # A2 allDeps
+        is_del = np.array([False, False, False, True])
+        out = resolve_registers(group, time, actor, seq, clock, is_del,
+                                np.ones((T,), bool))
+        alive = np.asarray(out['alive_after'])
+        winner = np.asarray(out['winner'])
+        conflicts = np.asarray(out['conflicts'])
+        visible_before = np.asarray(out['visible_before'])
+        assert alive.tolist() == [1, 2, 1, 0]
+        assert winner.tolist() == [0, 1, 2, -1]
+        # after B1: both alive, winner B (higher actor), conflict = A's op
+        assert conflicts[1, 0] == 0 and conflicts[1, 1] == -1
+        assert visible_before.tolist() == [False, True, True, True]
+        assert not np.asarray(out['overflow']).any()
+
+    def test_state_ops_superseded(self):
+        from automerge_tpu.ops.registers import resolve_registers
+        # state op (B, 1) persisted from a previous batch at time -1;
+        # batch op (A, 2) with allDeps covering B:1 supersedes it.
+        A = 2
+        group = np.zeros((2,), np.int32)
+        time = np.array([-1, 0], np.int32)
+        actor = np.array([1, 0], np.int32)
+        seq = np.array([1, 2], np.int32)
+        clock = np.array([[0, 0], [1, 1]], np.int32)
+        is_del = np.zeros((2,), bool)
+        out = resolve_registers(group, time, actor, seq, clock, is_del,
+                                np.ones((2,), bool))
+        assert np.asarray(out['alive_after']).tolist() == [1, 1]
+        assert np.asarray(out['winner']).tolist() == [0, 1]
+        assert np.asarray(out['visible_before']).tolist() == [False, True]
+
+    def test_concurrent_state_and_batch(self):
+        from automerge_tpu.ops.registers import resolve_registers
+        # state op (B, 1); batch op (A, 1) concurrent -> conflict set of 2,
+        # winner is B (higher actor rank)
+        A = 2
+        group = np.zeros((2,), np.int32)
+        time = np.array([-1, 0], np.int32)
+        actor = np.array([1, 0], np.int32)
+        seq = np.array([1, 1], np.int32)
+        clock = np.zeros((2, A), np.int32)
+        is_del = np.zeros((2,), bool)
+        out = resolve_registers(group, time, actor, seq, clock, is_del,
+                                np.ones((2,), bool))
+        assert np.asarray(out['alive_after']).tolist() == [1, 2]
+        assert np.asarray(out['winner']).tolist() == [0, 0]  # B's op index 0
+        assert np.asarray(out['conflicts'])[1, 0] == 1       # A's op loses
