@@ -116,9 +116,10 @@ def linearize(obj, parent, ctr, actor, valid, n_iters):
     return rank
 
 
-@partial(jax.jit, static_argnames=('chunk',))
+@partial(jax.jit, static_argnames=('chunk', 'axis_name'))
 def dominance_indexes(elem_obj, elem_rank, vis0, op_elem, op_obj, op_rank,
-                      op_delta, op_valid, chunk=128):
+                      op_delta, op_valid, chunk=128, axis_name=None,
+                      l_offset=0):
     """Per-op list indexes as time-windowed dominance counts.
 
     index(op t on element e) = #{e' : obj(e') == obj(e), rank(e') < rank(e),
@@ -129,13 +130,22 @@ def dominance_indexes(elem_obj, elem_rank, vis0, op_elem, op_obj, op_rank,
     visibility vector with one [L] x [L, K] mask product (MXU work), then
     applies within-chunk pairwise corrections (K x K) and updates the vector.
 
+    Sequence-parallel mode (`axis_name` set, inside shard_map): the element
+    arrays hold only this device's block of the arena; base counts become
+    partial sums completed with `lax.psum` over `axis_name`, and visibility
+    updates apply only to ops whose global element index (rebased by
+    `l_offset`) falls inside the local block.
+
     Args:
       elem_obj: [L] int32, elem_rank: [L] int32, vis0: [L] float32 (0/1).
-      op_elem: [T] int32 -- arena element index each op touches (-1 = none).
+      op_elem: [T] int32 -- arena element index each op touches (-1 = none);
+               global indexes in sequence-parallel mode.
       op_obj:  [T] int32, op_rank: [T] int32 -- of the touched element.
       op_delta:[T] int32 -- visibility change this op causes.
       op_valid:[T] bool.
       chunk: static int.
+      axis_name: static -- mesh axis to psum partial counts over, or None.
+      l_offset: int -- global index of this device's first element.
 
     Returns: index [T] int32 -- visible-before-e count for each op.
     """
@@ -167,6 +177,8 @@ def dominance_indexes(elem_obj, elem_rank, vis0, op_elem, op_obj, op_rank,
         mask = (elem_obj[:, None] == o[None, :]) \
             & (elem_rank[:, None] < r[None, :])
         base = vis @ mask.astype(jnp.float32)                      # [K]
+        if axis_name is not None:
+            base = jax.lax.psum(base, axis_name)
 
         # within-chunk corrections: op j before op k, same object, and the
         # element op j touches ranks below op k's element
@@ -176,10 +188,13 @@ def dominance_indexes(elem_obj, elem_rank, vis0, op_elem, op_obj, op_rank,
 
         idx = (base + corr).astype(jnp.int32)
 
-        # visibility update: net delta per element this chunk
+        # visibility update: net delta per element of the local block
+        le = e - l_offset
+        in_block = (le >= 0) & (le < L) & v
         upd = jax.ops.segment_sum(
-            jnp.where(v, d, 0).astype(jnp.float32),
-            jnp.clip(jnp.where(v, e, L), 0, L), num_segments=L + 1)[:L]
+            jnp.where(in_block, d, 0).astype(jnp.float32),
+            jnp.clip(jnp.where(in_block, le, L), 0, L),
+            num_segments=L + 1)[:L]
         vis = vis + upd
         return vis, idx
 

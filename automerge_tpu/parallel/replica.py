@@ -1,0 +1,54 @@
+"""Replica-mesh sync collectives.
+
+The reference syncs peers with clock gossip: each `Connection` keeps
+`ourClock`/`theirClock`, unions incoming clocks (elementwise max,
+`/root/reference/src/connection.js:9-14`), and ships every change the peer's
+clock doesn't cover (`maybeSendChanges`, `src/connection.js:58-73` ->
+`getMissingChanges`, `backend/op_set.js:339-346`).
+
+Over a device mesh the same protocol is three collectives/kernels:
+
+  frontier  = pmax(local clocks)        -- cluster-wide knowledge frontier
+  deficit   = frontier - local clock    -- what each replica still needs
+  want_mask = per (replica, actor, seq) selection of changes to ship
+
+These run per-document batched: `clocks` is [R, A] for R replica shards (or
+[R, D, A] vmapped over docs).
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def clock_union(clocks_axis0):
+    """Union (elementwise max) of clocks stacked on axis 0 -- the batched
+    form of the reference's clockUnion.  The pairwise form lives in
+    `ops/clock.clock_union`."""
+    return jnp.max(clocks_axis0, axis=0)
+
+
+def frontier_pmax(local_clock, axis_name):
+    """Cluster-wide frontier across a mesh axis of replicas: one pmax over
+    ICI replaces the reference's pairwise clock advertisement rounds."""
+    return jax.lax.pmax(local_clock, axis_name)
+
+
+@jax.jit
+def replica_deficits(clocks):
+    """For replicas' clocks [R, A]: returns (frontier [A], deficit [R, A])
+    where deficit[r, a] = number of changes by actor a that replica r is
+    missing relative to the union of all replicas' knowledge."""
+    frontier = clock_union(clocks)
+    return frontier, frontier[None, :] - clocks
+
+
+@jax.jit
+def want_matrix(clocks, have_clock):
+    """Which (replica, actor) streams need shipping from a holder with
+    `have_clock` [A]: True where the holder knows changes the replica lacks.
+    clocks: [R, A].  Returns [R, A] bool and the per-stream (from_seq,
+    to_seq] shipping windows."""
+    from_seq = clocks
+    to_seq = jnp.broadcast_to(have_clock[None, :], clocks.shape)
+    need = to_seq > from_seq
+    return need, from_seq, to_seq

@@ -5,10 +5,16 @@ hardware, per the build contract."""
 import os
 import sys
 
-os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+os.environ['JAX_PLATFORMS'] = 'cpu'  # override (env may preset a TPU backend)
 flags = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in flags:
     os.environ['XLA_FLAGS'] = (
         flags + ' --xla_force_host_platform_device_count=8').strip()
+
+# sitecustomize may have registered an accelerator platform and prepended it
+# to jax_platforms before this file runs; pin the config back to cpu (backend
+# init is lazy, so this takes effect as long as no test imported jax first)
+import jax  # noqa: E402
+jax.config.update('jax_platforms', 'cpu')
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
