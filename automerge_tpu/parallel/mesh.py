@@ -73,6 +73,16 @@ def make_mesh(n_devices=None, sp=None):
 # the per-doc pipeline (runs identically sharded and unsharded)
 # ---------------------------------------------------------------------------
 
+def _op_metadata(elem_obj, elem_rank, op_elem, op_valid):
+    """Per-op (object, rank) of the touched element, gathered over the FULL
+    arena; invalid ops get the sentinels dominance_indexes excludes
+    (obj=-2 never matches an element, rank=-1)."""
+    ge = jnp.clip(op_elem, 0, elem_obj.shape[0] - 1)
+    orank = jnp.where(op_valid, elem_rank[ge], -1)
+    oobj = jnp.where(op_valid, elem_obj[ge], -2)
+    return oobj, orank
+
+
 def _doc_pipeline(batch, n_linearize_iters):
     """schedule + register-resolve + linearize for a [D, ...] doc batch.
     Pure per-doc vmap -- no cross-doc communication."""
@@ -147,8 +157,9 @@ def build_sharded_step(mesh, n_linearize_iters, chunk=64):
              in_specs=(_BATCH_SPECS,), out_specs=_OUT_SPECS)
     def step(batch):
         L = batch['eo'].shape[1]
-        assert L % n_sp == 0, (
-            'element axis %d must be divisible by sp=%d' % (L, n_sp))
+        if L % n_sp != 0:
+            raise ValueError(
+                'element axis %d must be divisible by sp=%d' % (L, n_sp))
         order, doc_clock, reg, rank = _doc_pipeline(batch, n_linearize_iters)
 
         # replica clock gossip: union = elementwise max over the dp axis
@@ -167,9 +178,7 @@ def build_sharded_step(mesh, n_linearize_iters, chunk=64):
         vis_b = slice_block(batch['vis0'])
 
         def per_doc(eo, er, vis, rank_full, eo_full, oe, od, ov):
-            ge = jnp.clip(oe, 0, L - 1)
-            orank = jnp.where(ov, rank_full[ge], -1)
-            oobj = jnp.where(ov, eo_full[ge], -2)
+            oobj, orank = _op_metadata(eo_full, rank_full, oe, ov)
             return list_rank.dominance_indexes(
                 eo, er, vis, oe, oobj, orank, od, ov,
                 chunk=chunk, axis_name='sp', l_offset=off)
@@ -202,9 +211,7 @@ def single_step(batch, n_linearize_iters):
     L = batch['eo'].shape[1]
 
     def per_doc(eo, er, vis, oe, od, ov):
-        ge = jnp.clip(oe, 0, L - 1)
-        orank = jnp.where(ov, er[ge], -1)
-        oobj = jnp.where(ov, eo[ge], -2)
+        oobj, orank = _op_metadata(eo, er, oe, ov)
         return list_rank.dominance_indexes(
             eo, er, vis, oe, oobj, orank, od, ov)
 

@@ -1,0 +1,147 @@
+"""Benchmark driver -- batched TPU backend vs single-thread scalar backend.
+
+Headline config (BASELINE.json config 3, scaled by env): N Text docs, K
+actors each, interleaved insert/delete ops, delivered as ONE causal
+catch-up batch -- the "1M queued ops across 10k docs" north-star shape.
+
+Methodology:
+  * workload: per doc, actor a0 creates a Text object, then every actor
+    appends/deletes characters over R rounds; all changes are queued and
+    applied in one `TPUDocPool.apply_batch` pass (the batched device path).
+  * baseline: the same changes through `automerge_tpu.backend` -- the
+    single-threaded host backend whose semantics mirror the reference's
+    Node.js backend (`/root/reference/backend/op_set.js`).  Node itself is
+    not installed in this image, so this scalar path is the measured
+    denominator; it is byte-compatible with the reference (see
+    tests/test_backend.py golden cases).  Measured on a sampled doc subset,
+    reported as per-op rate.
+  * parity: pool patches must equal oracle patches on the sampled docs.
+  * jit-compile warmup: the workload runs once on a throwaway pool so the
+    timed run measures steady-state (compile cache is standard practice);
+    cold-compile seconds are reported to stderr.
+
+Prints ONE json line to stdout:
+  {"metric": ..., "value": ..., "unit": "ops/sec", "vs_baseline": ...}
+"""
+
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+ROOT_ID = '00000000-0000-0000-0000-000000000000'
+
+
+def env_int(name, default):
+    return int(os.environ.get(name, default))
+
+
+N_DOCS = env_int('AMTPU_BENCH_DOCS', 2048)
+N_ACTORS = env_int('AMTPU_BENCH_ACTORS', 8)
+N_ROUNDS = env_int('AMTPU_BENCH_ROUNDS', 2)
+OPS_PER_CHANGE = env_int('AMTPU_BENCH_OPS_PER_CHANGE', 16)
+ORACLE_DOCS = env_int('AMTPU_BENCH_ORACLE_DOCS', 48)
+SEED = env_int('AMTPU_BENCH_SEED', 7)
+
+
+def make_doc_changes(doc, rng):
+    """One doc's queued change history: create a Text object, then
+    interleaved insert/delete rounds from N_ACTORS concurrent actors."""
+    tid = 'text-%d' % doc
+    changes = [{'actor': 'a0', 'seq': 1, 'deps': {}, 'ops': [
+        {'action': 'makeText', 'obj': tid},
+        {'action': 'ins', 'obj': tid, 'key': '_head', 'elem': 1},
+        {'action': 'set', 'obj': tid, 'key': 'a0:1', 'value': 'x'},
+        {'action': 'link', 'obj': ROOT_ID, 'key': 'text', 'value': tid}]}]
+    max_elem = 1
+    last = {}
+    for r in range(1, N_ROUNDS + 1):
+        for a in range(N_ACTORS):
+            actor = 'a%d' % a
+            seq = r + 1 if a == 0 else r
+            ops = []
+            for _ in range(OPS_PER_CHANGE // 2):
+                max_elem += 1
+                elem = max_elem
+                prev = last.get(a) or 'a0:1'
+                ops.append({'action': 'ins', 'obj': tid, 'key': prev,
+                            'elem': elem})
+                if rng.random() < 0.15 and a in last:
+                    ops.append({'action': 'del', 'obj': tid, 'key': last[a]})
+                else:
+                    ops.append({'action': 'set', 'obj': tid,
+                                'key': '%s:%d' % (actor, elem),
+                                'value': chr(97 + elem % 26)})
+                last[a] = '%s:%d' % (actor, elem)
+            changes.append({'actor': actor, 'seq': seq, 'deps': {'a0': 1},
+                            'ops': ops})
+    return changes
+
+
+def main():
+    from automerge_tpu import backend as Backend
+    from automerge_tpu.parallel.engine import TPUDocPool
+
+    rng = random.Random(SEED)
+    batch = {d: make_doc_changes(d, rng) for d in range(N_DOCS)}
+    total_ops = sum(len(c['ops']) for chs in batch.values() for c in chs)
+    per_doc_ops = total_ops // N_DOCS
+    print('workload: %d docs x %d ops = %d total ops'
+          % (N_DOCS, per_doc_ops, total_ops), file=sys.stderr)
+
+    # ---- baseline: single-thread scalar backend on a doc subset ----------
+    oracle_docs = list(range(min(ORACLE_DOCS, N_DOCS)))
+    oracle_states = {}
+    t0 = time.perf_counter()
+    for d in oracle_docs:
+        state = Backend.init()
+        state, _patch = Backend.apply_changes(state, batch[d])
+        oracle_states[d] = state
+    oracle_s = time.perf_counter() - t0
+    oracle_ops = per_doc_ops * len(oracle_docs)
+    oracle_rate = oracle_ops / oracle_s
+    print('baseline (scalar backend, %d docs): %.2fs -> %.0f ops/sec'
+          % (len(oracle_docs), oracle_s, oracle_rate), file=sys.stderr)
+
+    # ---- warmup: compile cache ------------------------------------------
+    t0 = time.perf_counter()
+    TPUDocPool().apply_batch(batch)
+    warm_s = time.perf_counter() - t0
+    print('warmup (incl. jit compile): %.2fs' % warm_s, file=sys.stderr)
+
+    # ---- timed run -------------------------------------------------------
+    pool = TPUDocPool()
+    t0 = time.perf_counter()
+    pool.apply_batch(batch)
+    tpu_s = time.perf_counter() - t0
+    tpu_rate = total_ops / tpu_s
+    print('batched pool: %.2fs -> %.0f ops/sec' % (tpu_s, tpu_rate),
+          file=sys.stderr)
+
+    # ---- parity ----------------------------------------------------------
+    for d in oracle_docs:
+        got = pool.get_patch(d)
+        want = Backend.get_patch(oracle_states[d])
+        if got != want:
+            print('PARITY FAILURE on doc %d' % d, file=sys.stderr)
+            print(json.dumps({'metric': 'text_catchup_ops_per_sec',
+                              'value': 0.0, 'unit': 'ops/sec',
+                              'vs_baseline': 0.0, 'parity': False}))
+            return 1
+    print('parity: ok (%d docs byte-identical)' % len(oracle_docs),
+          file=sys.stderr)
+
+    print(json.dumps({
+        'metric': 'text_catchup_ops_per_sec',
+        'value': round(tpu_rate, 1),
+        'unit': 'ops/sec',
+        'vs_baseline': round(tpu_rate / oracle_rate, 3),
+    }))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

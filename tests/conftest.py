@@ -3,13 +3,15 @@ multi-chip sharding paths (dp/sp over a Mesh) are exercised without TPU
 hardware, per the build contract."""
 
 import os
+import re
 import sys
 
 os.environ['JAX_PLATFORMS'] = 'cpu'  # override (env may preset a TPU backend)
-flags = os.environ.get('XLA_FLAGS', '')
-if '--xla_force_host_platform_device_count' not in flags:
-    os.environ['XLA_FLAGS'] = (
-        flags + ' --xla_force_host_platform_device_count=8').strip()
+# force 8 virtual devices even if the env presets a different count
+flags = re.sub(r'--xla_force_host_platform_device_count=\d+', '',
+               os.environ.get('XLA_FLAGS', ''))
+os.environ['XLA_FLAGS'] = (
+    flags + ' --xla_force_host_platform_device_count=8').strip()
 
 # sitecustomize may have registered an accelerator platform and prepended it
 # to jax_platforms before this file runs; pin the config back to cpu (backend
