@@ -38,8 +38,8 @@ def ceil_log2(n):
     return bits
 
 
-@partial(jax.jit, static_argnames=('n_iters',))
-def linearize(obj, parent, ctr, actor, valid, n_iters):
+@jax.jit
+def linearize(obj, parent, ctr, actor, valid, n_iters, sort_idx=None):
     """Computes the total RGA order of every element of every list object.
 
     Args:
@@ -48,7 +48,16 @@ def linearize(obj, parent, ctr, actor, valid, n_iters):
       ctr:    [L] int32 -- elemId counter.
       actor:  [L] int32 -- elemId actor rank (string-order preserving).
       valid:  [L] bool.
-      n_iters: static int >= ceil(log2(L)) + 1 (pointer-doubling rounds).
+      n_iters: int >= ceil(log2(L)) + 1 (pointer-doubling rounds).  Runs as
+              a dynamic-trip-count device loop: the [L] shapes still key one
+              compile per size bucket, but the HLO stays small (the rounds
+              are not unrolled), which keeps XLA compile time flat.
+      sort_idx: optional [L] int32 -- precomputed host-side sibling sort
+              permutation (np.lexsort((-actor, -ctr, parent, obj-with-
+              invalid-last))).  XLA:CPU compiles large in-graph sorts in
+              tens of seconds, so batch callers hoist the sort to numpy;
+              omitted (None) the sort runs in-graph (small per-doc shapes
+              under vmap, e.g. the sharded mesh pipeline).
 
     Returns:
       rank: [L] int32 -- position in the object's total element order
@@ -59,8 +68,9 @@ def linearize(obj, parent, ctr, actor, valid, n_iters):
     rows = jnp.arange(L)
 
     # --- 1. sibling sort: (obj, parent, -ctr, -actor); invalid rows last ---
-    skey_obj = jnp.where(valid, obj, BIG)
-    sort_idx = jnp.lexsort((-actor, -ctr, parent, skey_obj))
+    if sort_idx is None:
+        skey_obj = jnp.where(valid, obj, BIG)
+        sort_idx = jnp.lexsort((-actor, -ctr, parent, skey_obj))
     s_valid = valid[sort_idx]
     s_obj = jnp.where(s_valid, obj[sort_idx], -2)
     s_parent = jnp.where(s_valid, parent[sort_idx], -3)
@@ -83,29 +93,38 @@ def linearize(obj, parent, ctr, actor, valid, n_iters):
 
     # --- 2. escape pointers: next sibling, else parent's escape ------------
     # sentinel: -1 = unresolved, -2 = resolved "no escape" (end of object)
-    esc = jnp.where(sib_next >= 0, sib_next,
-                    jnp.where(parent == -1, -2, -1))
-    link = parent
-    for _ in range(n_iters + 1):
+    esc0 = jnp.where(sib_next >= 0, sib_next,
+                     jnp.where(parent == -1, -2, -1))
+
+    def esc_round(_i, state):
+        esc, link = state
         link_safe = jnp.clip(link, 0, L - 1)
         consult = esc[link_safe]
         unresolved = (esc == -1) & (link >= 0)
         esc = jnp.where(unresolved & (consult != -1), consult, esc)
         # shortcut the consult chain (doubling: link <- link's link)
         link = jnp.where(unresolved, link[link_safe], link)
+        return esc, link
+
+    esc, _ = jax.lax.fori_loop(0, n_iters + 1, esc_round, (esc0, parent))
     escape = jnp.where(esc == -2, -1, esc)
 
     # --- 3. dfs_next + list ranking ---------------------------------------
     dfs_next = jnp.where(first_child >= 0, first_child, escape)
     dfs_next = jnp.where(valid, dfs_next, -1)
 
-    dist = jnp.where(dfs_next >= 0, 1, 0).astype(jnp.int32)
-    nxt = dfs_next
-    for _ in range(n_iters):
+    def rank_round(_i, state):
+        dist, nxt = state
         take = nxt >= 0
         nxt_safe = jnp.clip(nxt, 0, L - 1)
         dist = dist + jnp.where(take, dist[nxt_safe], 0)
         nxt = jnp.where(take, nxt[nxt_safe], nxt)
+        return dist, nxt
+
+    dist, _ = jax.lax.fori_loop(
+        0, n_iters,
+        rank_round,
+        (jnp.where(dfs_next >= 0, 1, 0).astype(jnp.int32), dfs_next))
 
     # per-object element count -> rank = size - 1 - hops_to_end
     obj_sizes = jax.ops.segment_sum(
@@ -114,6 +133,61 @@ def linearize(obj, parent, ctr, actor, valid, n_iters):
     size_of_elem = obj_sizes[jnp.clip(obj, 0, L)]
     rank = jnp.where(valid, size_of_elem - 1 - dist, -1)
     return rank
+
+
+@partial(jax.jit, static_argnames=('chunk',))
+def dominance_grouped(vis0, elem_rank, op_elem, op_rank, op_delta, op_valid,
+                      chunk=64):
+    """Per-object dominance indexes: like `dominance_indexes`, but the batch
+    axis IS the list-object axis, so the same-object mask term vanishes and
+    per-chunk work is O(L_obj * K) instead of O(L_total * K).
+
+    Args:
+      vis0:      [O, L] float32 -- visibility (0/1) per element at batch
+                 start; padding rows are 0.
+      elem_rank: [O, L] int32 -- total-order rank per element (-1 padding;
+                 never counted because vis stays 0 there).
+      op_elem:   [O, T] int32 -- local element index each op toggles
+                 (-1 = padding).
+      op_rank:   [O, T] int32 -- rank of the touched element.
+      op_delta:  [O, T] int32 -- visibility change in {-1, 0, +1}.
+      op_valid:  [O, T] bool.
+      chunk: static int; T must be a multiple of it.
+
+    Returns: index [O, T] int32.
+    """
+    O, L = vis0.shape
+    T = op_elem.shape[1]
+    K = chunk
+    if T % K != 0:
+        raise ValueError('T=%d must be a multiple of chunk=%d' % (T, K))
+    n_chunks = T // K
+    tri = (jnp.arange(K)[:, None] < jnp.arange(K)[None, :])
+
+    def per_obj(vis, rank, oe, orank, od, ov):
+        def body(vis, c):
+            sl = c * K
+            e = jax.lax.dynamic_slice(oe, (sl,), (K,))
+            r = jax.lax.dynamic_slice(orank, (sl,), (K,))
+            d = jax.lax.dynamic_slice(od, (sl,), (K,))
+            v = jax.lax.dynamic_slice(ov, (sl,), (K,))
+            # base: visible elements ranked below, at chunk start
+            mask = (rank[:, None] < r[None, :])                     # [L, K]
+            base = vis @ mask.astype(jnp.float32)                   # [K]
+            # within-chunk: earlier op j toggling a lower-ranked element
+            cross = tri & (r[:, None] < r[None, :])
+            corr = jnp.sum(cross * d[:, None].astype(jnp.float32), axis=0)
+            idx = (base + corr).astype(jnp.int32)
+            upd = jax.ops.segment_sum(
+                jnp.where(v, d, 0).astype(jnp.float32),
+                jnp.clip(jnp.where(v & (e >= 0), e, L), 0, L),
+                num_segments=L + 1)[:L]
+            return vis + upd, idx
+        _, idxs = jax.lax.scan(body, vis, jnp.arange(n_chunks))
+        return idxs.reshape(-1)
+
+    return jax.vmap(per_obj)(vis0, elem_rank, op_elem, op_rank,
+                             op_delta, op_valid)
 
 
 @partial(jax.jit, static_argnames=('chunk', 'axis_name'))

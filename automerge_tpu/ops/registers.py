@@ -31,7 +31,7 @@ WINDOW = 8
 
 @partial(jax.jit, static_argnames=('window',))
 def resolve_registers(group, time, actor, seq, clock, is_del, alive_in,
-                      window=WINDOW):
+                      window=WINDOW, sort_idx=None):
     """Resolves every register op of a batch.
 
     Args:
@@ -45,6 +45,9 @@ def resolve_registers(group, time, actor, seq, clock, is_del, alive_in,
       is_del:[T] bool -- 'del' ops overwrite but never join the register.
       alive_in: [T] bool -- for pre-existing state ops: True; for batch ops:
              True (they are considered at their own time).
+      sort_idx: optional [T] int32 -- precomputed np.lexsort((time, group))
+             permutation; hoisted to the host by batch callers because
+             XLA:CPU compiles large in-graph sorts in tens of seconds.
 
     Returns dict of [T]-shaped outputs (original op order):
       alive_after: int32 -- register size right after this op.
@@ -59,7 +62,8 @@ def resolve_registers(group, time, actor, seq, clock, is_del, alive_in,
     W = window
 
     # sort by (group, time); padding (group == -1) sorts first and is inert
-    sort_idx = jnp.lexsort((time, group))
+    if sort_idx is None:
+        sort_idx = jnp.lexsort((time, group))
     g_s = group[sort_idx]
     t_s = time[sort_idx]
     a_s = actor[sort_idx]

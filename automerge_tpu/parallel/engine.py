@@ -475,7 +475,8 @@ class TPUDocPool:
             d_arr[:T] = d_col
             reg_out = register_ops.resolve_registers(
                 g_arr, t_arr, a_arr, s_arr, c_arr, d_arr,
-                np.ones((Tp,), bool))
+                np.ones((Tp,), bool),
+                sort_idx=np.lexsort((t_arr, g_arr)).astype(np.int32))
             reg_out = {k: np.asarray(v)[:T] for k, v in reg_out.items()}
         else:
             reg_out = None
@@ -512,9 +513,12 @@ class TPUDocPool:
             act_arr[:L] = act_l
             val_arr = np.zeros((Lp,), bool)
             val_arr[:L] = True
+            skey_obj = np.where(val_arr, obj_arr, 2 ** 30)
+            sort_idx = np.lexsort(
+                (-act_arr, -ctr_arr, par_arr, skey_obj)).astype(np.int32)
             rank = np.asarray(list_rank.linearize(
                 obj_arr, par_arr, ctr_arr, act_arr, val_arr,
-                n_iters=list_rank.ceil_log2(Lp) + 1))[:L]
+                n_iters=list_rank.ceil_log2(Lp) + 1, sort_idx=sort_idx))[:L]
         else:
             rank = np.zeros((0,), np.int32)
 
@@ -554,7 +558,8 @@ class TPUDocPool:
                     state, scratch[gkey], op)
                 host_registers[op_idx] = list(scratch[gkey])
 
-        op_elem, op_delta, op_valid, op_src = [], [], [], []
+        # per-object op sequences, in global application order
+        obj_ops = {}       # akey -> [(op_idx, row, local_eidx, delta)]
         if reg_out is not None:
             vis_now = {}
             for op_idx, (doc_id, op) in enumerate(ops):
@@ -580,41 +585,14 @@ class TPUDocPool:
                             'Missing index entry for list element '
                             + str(op['key']))
                     continue
-                flat = base_of[akey] + eidx
-                key = flat
-                before = vis_now.get(key, bool(vis0[flat] > 0))
+                key = (akey, eidx)
+                before = vis_now.get(key, arena.visible[eidx])
                 after = alive_now
                 vis_now[key] = after
-                op_elem.append(flat)
-                op_delta.append(int(after) - int(before))
-                op_valid.append(True)
-                op_src.append((op_idx, row))
+                obj_ops.setdefault(akey, []).append(
+                    (op_idx, row, eidx, int(after) - int(before)))
 
-        Tl = len(op_elem)
-        if Tl > 0 and L > 0:
-            Lp = _bucket(L)
-            Tlp = _bucket(Tl)
-            eo_arr = np.full((Lp,), -3, np.int32)
-            eo_arr[:L] = obj_l
-            er_arr = np.full((Lp,), -1, np.int32)
-            er_arr[:L] = rank
-            v0_arr = np.zeros((Lp,), np.float32)
-            v0_arr[:L] = vis0
-            oe_arr = np.full((Tlp,), -1, np.int32)
-            oe_arr[:Tl] = op_elem
-            oo_arr = np.full((Tlp,), -2, np.int32)
-            oo_arr[:Tl] = eo_arr[oe_arr[:Tl]]
-            or_arr = np.full((Tlp,), -1, np.int32)
-            or_arr[:Tl] = er_arr[oe_arr[:Tl]]
-            od_arr = np.zeros((Tlp,), np.int32)
-            od_arr[:Tl] = op_delta
-            ov_arr = np.zeros((Tlp,), bool)
-            ov_arr[:Tl] = True
-            indexes = np.asarray(list_rank.dominance_indexes(
-                eo_arr, er_arr, v0_arr, oe_arr, oo_arr, or_arr,
-                od_arr, ov_arr))[:Tl]
-        else:
-            indexes = np.zeros((0,), np.int32)
+        list_index_of_op = self._dominance(obj_ops, base_of, rank, vis0)
 
         return {
             'reg_out': reg_out,
@@ -623,9 +601,66 @@ class TPUDocPool:
             'rank': rank,
             'base_of': base_of,
             'host_registers': host_registers,
-            'list_index_of_op': {src[0]: (int(indexes[i]), src[1])
-                                 for i, src in enumerate(op_src)},
+            'list_index_of_op': list_index_of_op,
         }
+
+    # chunk length of the grouped dominance kernel (ops per mask product)
+    _DOM_CHUNK = 64
+
+    def _dominance(self, obj_ops, base_of, rank, vis0):
+        """Per-op list indexes via the per-object grouped kernel.
+
+        Objects are bucketed into (element-count, op-count) size classes so
+        one padded [O, L] x [O, T] dispatch per class serves arbitrarily
+        skewed batches while jit compile caches across calls.
+
+        Returns {op_idx: (index, register_row)}."""
+        K = self._DOM_CHUNK
+        classes = {}   # (Lp, Tp) -> [akey]
+        for akey, entries in obj_ops.items():
+            if not entries:
+                continue
+            Lp = _bucket(max(self._arena_len(akey), 1))
+            Tp = _bucket(len(entries), floor=K)
+            classes.setdefault((Lp, Tp), []).append(akey)
+
+        out = {}
+        for (Lp, Tp), akeys in classes.items():
+            # slab width: bucketed so the vmap axis shape (and the compile
+            # cache key) stays stable, bounded so one slab's [W, Lp, K] mask
+            # product never exceeds ~256 MB even for a single huge Text
+            W = _bucket(min(len(akeys), 256), floor=1)
+            while W > 1 and W * Lp * K * 4 > 256 * 2 ** 20:
+                W //= 2
+            for s in range(0, len(akeys), W):
+                slab = akeys[s:s + W]
+                v0 = np.zeros((W, Lp), np.float32)
+                er = np.full((W, Lp), -1, np.int32)
+                oe = np.full((W, Tp), -1, np.int32)
+                orank = np.full((W, Tp), -1, np.int32)
+                od = np.zeros((W, Tp), np.int32)
+                ov = np.zeros((W, Tp), bool)
+                for o, akey in enumerate(slab):
+                    base = base_of[akey]
+                    n = self._arena_len(akey)
+                    v0[o, :n] = vis0[base:base + n]
+                    er[o, :n] = rank[base:base + n]
+                    for t, (_op_idx, _row, eidx, delta) in \
+                            enumerate(obj_ops[akey]):
+                        oe[o, t] = eidx
+                        orank[o, t] = rank[base + eidx]
+                        od[o, t] = delta
+                        ov[o, t] = True
+                idxs = np.asarray(list_rank.dominance_grouped(
+                    v0, er, oe, orank, od, ov, chunk=K))
+                for o, akey in enumerate(slab):
+                    for t, (op_idx, row, _e, _d) in enumerate(obj_ops[akey]):
+                        out[op_idx] = (int(idxs[o, t]), row)
+        return out
+
+    def _arena_len(self, akey):
+        doc_id, obj = akey
+        return len(self.docs[doc_id].arenas[obj].ctr)
 
     # ------------------------------------------------------------------
     # emission
@@ -704,9 +739,12 @@ class TPUDocPool:
         key = (op['obj'], op['key'])
         old = state.registers.get(key, [])
         old_links = [o for o in old if o['action'] == 'link']
-        new_set = [(o['actor'], o['seq'], o.get('value')) for o in new_register]
-        for o in old_links:
-            if (o['actor'], o['seq'], o.get('value')) not in new_set:
+        if old_links:
+            new_set = [(o['actor'], o['seq'], o.get('value'))
+                       for o in new_register]
+            for o in old_links:
+                if (o['actor'], o['seq'], o.get('value')) in new_set:
+                    continue
                 target = state.objects.get(o['value'])
                 if target is not None:
                     target['inbound'] = [

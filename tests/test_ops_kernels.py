@@ -244,6 +244,48 @@ class TestDominanceIndexes:
                 op_delta, np.ones((T,), bool), chunk=8))
             assert got.tolist() == expect.tolist(), trial
 
+    def test_grouped_matches_flat(self):
+        """dominance_grouped == dominance_indexes on random single-object
+        batches (the grouped kernel's batch axis IS the object axis)."""
+        from automerge_tpu.ops.list_rank import dominance_grouped
+        rng = random.Random(23)
+        K = 8
+        n_objs = 4
+        Lp, Tp = 32, 24
+        v0 = np.zeros((n_objs, Lp), np.float32)
+        er = np.full((n_objs, Lp), -1, np.int32)
+        oe = np.full((n_objs, Tp), -1, np.int32)
+        orank = np.full((n_objs, Tp), -1, np.int32)
+        od = np.zeros((n_objs, Tp), np.int32)
+        ov = np.zeros((n_objs, Tp), bool)
+        expect = np.zeros((n_objs, Tp), np.int32)
+        for o in range(n_objs):
+            L = rng.randint(1, Lp)
+            T = rng.randint(1, Tp)
+            ranks = list(range(L))
+            rng.shuffle(ranks)
+            er[o, :L] = ranks
+            vis = np.array([rng.random() < 0.5 for _ in range(L)],
+                           np.float32)
+            v0[o, :L] = vis
+            vis_state = vis.copy()
+            for t in range(T):
+                e = rng.randrange(L)
+                oe[o, t] = e
+                orank[o, t] = er[o, e]
+                ov[o, t] = True
+                expect[o, t] = int(sum(
+                    vis_state[i] for i in range(L)
+                    if er[o, i] < er[o, e]))
+                if vis_state[e] > 0 and rng.random() < 0.5:
+                    od[o, t] = -1
+                elif vis_state[e] == 0 and rng.random() < 0.7:
+                    od[o, t] = 1
+                vis_state[e] += od[o, t]
+        got = np.asarray(dominance_grouped(v0, er, oe, orank, od, ov,
+                                           chunk=K))
+        assert (got[ov] == expect[ov]).all()
+
 
 class TestRegisters:
     def test_lww_partition_and_conflicts(self):
