@@ -1,0 +1,306 @@
+"""Native host runtime bindings.
+
+Loads `libamtpu_core.so` (built from /root/repo/native/) and exposes
+`NativeDocPool`: the C++ host runtime driving the same JAX device kernels
+as the Python `TPUDocPool`, with all per-op host stages (causal scheduling,
+columnar encoding, patch emission, mirror maintenance) in C++ and
+changes/patches crossing the boundary as msgpack bytes.
+
+`NativeDocPool.apply_batch(dict)` round-trips through msgpack for drop-in
+test parity with TPUDocPool; `apply_batch_bytes(bytes) -> bytes` is the
+zero-Python wire path the sidecar serves.
+"""
+
+import ctypes
+import os
+import subprocess
+
+import msgpack
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(os.path.dirname(os.path.dirname(_DIR)), 'native')
+_LIB_PATH = os.path.join(_DIR, 'libamtpu_core.so')
+
+
+def _build():
+    subprocess.run(['make'], cwd=_SRC, check=True,
+                   stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+
+def _load():
+    if not os.path.exists(_LIB_PATH) or (
+            os.path.exists(os.path.join(_SRC, 'core.cpp')) and
+            os.path.getmtime(os.path.join(_SRC, 'core.cpp')) >
+            os.path.getmtime(_LIB_PATH)):
+        _build()
+    lib = ctypes.CDLL(_LIB_PATH)
+    lib.amtpu_pool_new.restype = ctypes.c_void_p
+    lib.amtpu_pool_free.argtypes = [ctypes.c_void_p]
+    lib.amtpu_last_error.restype = ctypes.c_char_p
+    lib.amtpu_last_error_kind.restype = ctypes.c_int
+    lib.amtpu_begin.restype = ctypes.c_void_p
+    lib.amtpu_begin.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.c_int64]
+    lib.amtpu_batch_free.argtypes = [ctypes.c_void_p]
+    lib.amtpu_batch_dims.argtypes = [ctypes.c_void_p,
+                                     ctypes.POINTER(ctypes.c_int64)]
+    for name in ('g', 't', 'a', 's', 'clock', 'sort',
+                 'obj', 'par', 'ctr', 'act', 'linsort'):
+        fn = getattr(lib, 'amtpu_col_' + name)
+        fn.restype = ctypes.POINTER(ctypes.c_int32)
+        fn.argtypes = [ctypes.c_void_p]
+    for name in ('d', 'val'):
+        fn = getattr(lib, 'amtpu_col_' + name)
+        fn.restype = ctypes.POINTER(ctypes.c_uint8)
+        fn.argtypes = [ctypes.c_void_p]
+    lib.amtpu_mid.restype = ctypes.c_int
+    lib.amtpu_mid.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int32)]
+    lib.amtpu_dom_dims.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                   ctypes.POINTER(ctypes.c_int64)]
+    lib.amtpu_dom_v0.restype = ctypes.POINTER(ctypes.c_float)
+    lib.amtpu_dom_v0.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    for name in ('er', 'oe', 'orank', 'od'):
+        fn = getattr(lib, 'amtpu_dom_' + name)
+        fn.restype = ctypes.POINTER(ctypes.c_int32)
+        fn.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.amtpu_dom_ov.restype = ctypes.POINTER(ctypes.c_uint8)
+    lib.amtpu_dom_ov.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.amtpu_dom_set_indexes.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                          ctypes.POINTER(ctypes.c_int32)]
+    lib.amtpu_finish.restype = ctypes.c_int
+    lib.amtpu_finish.argtypes = [ctypes.c_void_p]
+    lib.amtpu_result.restype = ctypes.POINTER(ctypes.c_uint8)
+    lib.amtpu_result.argtypes = [ctypes.c_void_p,
+                                 ctypes.POINTER(ctypes.c_int64)]
+    lib.amtpu_get_patch.restype = ctypes.POINTER(ctypes.c_uint8)
+    lib.amtpu_get_patch.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.POINTER(ctypes.c_int64)]
+    lib.amtpu_get_missing_deps.restype = ctypes.POINTER(ctypes.c_uint8)
+    lib.amtpu_get_missing_deps.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64)]
+    lib.amtpu_get_missing_changes.restype = ctypes.POINTER(ctypes.c_uint8)
+    lib.amtpu_get_missing_changes.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64)]
+    lib.amtpu_buf_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+    return lib
+
+
+_lib = None
+
+
+def lib():
+    global _lib
+    if _lib is None:
+        _lib = _load()
+    return _lib
+
+
+def _np_view(ptr, shape, dtype):
+    n = int(np.prod(shape))
+    if n == 0:
+        return np.zeros(shape, dtype)
+    arr = np.ctypeslib.as_array(ptr, shape=(n,))
+    return arr.reshape(shape).view(dtype) if arr.dtype != dtype else \
+        arr.reshape(shape)
+
+
+def _take_buf(ptr, length):
+    try:
+        return bytes(bytearray(ctypes.cast(
+            ptr, ctypes.POINTER(ctypes.c_uint8 * length)).contents))
+    finally:
+        lib().amtpu_buf_free(ptr)
+
+
+class NativeError(Exception):
+    pass
+
+
+def _raise_last():
+    from ..errors import AutomergeError, RangeError
+    msg = lib().amtpu_last_error().decode()
+    kind = lib().amtpu_last_error_kind()
+    raise (RangeError if kind == 1 else AutomergeError)(msg)
+
+
+class NativeDocPool:
+    """C++ host runtime + JAX kernels; drop-in for TPUDocPool."""
+
+    #: window width of the register kernel (ops/registers.WINDOW)
+    WINDOW = 8
+
+    def __init__(self):
+        self._pool = lib().amtpu_pool_new()
+
+    def __del__(self):
+        if getattr(self, '_pool', None):
+            lib().amtpu_pool_free(self._pool)
+            self._pool = None
+
+    # -- wire path ------------------------------------------------------
+
+    def apply_batch_bytes(self, payload):
+        """msgpack {doc_id: [change...]} -> msgpack {doc_id: patch}."""
+        L = lib()
+        bh = L.amtpu_begin(self._pool, payload, len(payload))
+        if not bh:
+            _raise_last()
+        try:
+            dims = (ctypes.c_int64 * 8)()
+            L.amtpu_batch_dims(bh, dims)
+            T, Tp, A, Ap, Larena, Lp, n_blocks, max_obj = \
+                [int(x) for x in dims]
+
+            reg_out = self._run_register_kernel(L, bh, Tp, Ap)
+            rank = self._run_linearize(L, bh, Lp, max_obj)
+
+            win = ctypes.POINTER(ctypes.c_int32)
+            if Tp > 0:
+                winner = np.ascontiguousarray(reg_out['winner'], np.int32)
+                conflicts = np.ascontiguousarray(reg_out['conflicts'],
+                                                 np.int32)
+                alive = np.ascontiguousarray(reg_out['alive_after'], np.int32)
+                visible = np.ascontiguousarray(
+                    reg_out['visible_before'], np.uint8)
+                overflow = np.ascontiguousarray(reg_out['overflow'], np.uint8)
+            else:
+                winner = conflicts = alive = np.zeros(0, np.int32)
+                visible = overflow = np.zeros(0, np.uint8)
+            rank_arr = np.ascontiguousarray(rank, np.int32)
+
+            def ip(a):
+                return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+            def up(a):
+                return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+            if L.amtpu_mid(bh, ip(winner), ip(conflicts), self.WINDOW,
+                           ip(alive), up(visible), up(overflow),
+                           ip(rank_arr)) != 0:
+                _raise_last()
+
+            self._run_dominance(L, bh)
+
+            if L.amtpu_finish(bh) != 0:
+                _raise_last()
+            out_len = ctypes.c_int64()
+            ptr = L.amtpu_result(bh, ctypes.byref(out_len))
+            return bytes(bytearray(ctypes.cast(
+                ptr, ctypes.POINTER(
+                    ctypes.c_uint8 * out_len.value)).contents)) \
+                if out_len.value else b'\x80'
+        finally:
+            L.amtpu_batch_free(bh)
+
+    # -- kernel dispatch ------------------------------------------------
+
+    def _run_register_kernel(self, L, bh, Tp, Ap):
+        if Tp == 0:
+            return None
+        from ..ops import registers as register_ops
+        g = np.ctypeslib.as_array(L.amtpu_col_g(bh), shape=(Tp,))
+        t = np.ctypeslib.as_array(L.amtpu_col_t(bh), shape=(Tp,))
+        a = np.ctypeslib.as_array(L.amtpu_col_a(bh), shape=(Tp,))
+        s = np.ctypeslib.as_array(L.amtpu_col_s(bh), shape=(Tp,))
+        d = np.ctypeslib.as_array(L.amtpu_col_d(bh), shape=(Tp,))
+        c = np.ctypeslib.as_array(L.amtpu_col_clock(bh), shape=(Tp, Ap))
+        si = np.ctypeslib.as_array(L.amtpu_col_sort(bh), shape=(Tp,))
+        out = register_ops.resolve_registers(
+            g, t, a, s, c, d.astype(bool), np.ones((Tp,), bool),
+            window=self.WINDOW, sort_idx=si)
+        return {k: np.asarray(v) for k, v in out.items()}
+
+    def _run_linearize(self, L, bh, Lp, max_obj_len):
+        if Lp == 0:
+            return np.zeros((0,), np.int32)
+        from ..ops import list_rank
+        obj = np.ctypeslib.as_array(L.amtpu_col_obj(bh), shape=(Lp,))
+        par = np.ctypeslib.as_array(L.amtpu_col_par(bh), shape=(Lp,))
+        ctr = np.ctypeslib.as_array(L.amtpu_col_ctr(bh), shape=(Lp,))
+        act = np.ctypeslib.as_array(L.amtpu_col_act(bh), shape=(Lp,))
+        val = np.ctypeslib.as_array(L.amtpu_col_val(bh), shape=(Lp,))
+        si = np.ctypeslib.as_array(L.amtpu_col_linsort(bh), shape=(Lp,))
+        # pointer-doubling depth: DFS chains never cross objects, so the
+        # bound is the largest single arena, not the whole flat batch
+        return np.asarray(list_rank.linearize(
+            obj, par, ctr, act, val.astype(bool),
+            n_iters=list_rank.ceil_log2(max(max_obj_len, 1)) + 1,
+            sort_idx=si))
+
+    def _run_dominance(self, L, bh):
+        from ..ops import list_rank
+        dims = (ctypes.c_int64 * 7)()
+        L.amtpu_batch_dims(bh, dims)
+        n_blocks = int(dims[6])
+        bdims = (ctypes.c_int64 * 3)()
+        for blk in range(n_blocks):
+            L.amtpu_dom_dims(bh, blk, bdims)
+            W, Lp, Tp = [int(x) for x in bdims]
+            v0 = np.ctypeslib.as_array(L.amtpu_dom_v0(bh, blk),
+                                       shape=(W, Lp))
+            er = np.ctypeslib.as_array(L.amtpu_dom_er(bh, blk),
+                                       shape=(W, Lp))
+            oe = np.ctypeslib.as_array(L.amtpu_dom_oe(bh, blk),
+                                       shape=(W, Tp))
+            orank = np.ctypeslib.as_array(L.amtpu_dom_orank(bh, blk),
+                                          shape=(W, Tp))
+            od = np.ctypeslib.as_array(L.amtpu_dom_od(bh, blk),
+                                       shape=(W, Tp))
+            ov = np.ctypeslib.as_array(L.amtpu_dom_ov(bh, blk),
+                                       shape=(W, Tp))
+            idx = np.ascontiguousarray(np.asarray(list_rank.dominance_grouped(
+                v0, er, oe, orank, od, ov.astype(bool),
+                chunk=64)), np.int32)
+            L.amtpu_dom_set_indexes(
+                bh, blk, idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+
+    # -- dict-level API (test parity with TPUDocPool) -------------------
+
+    @staticmethod
+    def _doc_key(doc_id):
+        return doc_id if isinstance(doc_id, str) else 'i:%d' % doc_id
+
+    def apply_batch(self, changes_by_doc):
+        keyed = {self._doc_key(d): chs for d, chs in changes_by_doc.items()}
+        payload = msgpack.packb(keyed, use_bin_type=True)
+        out = msgpack.unpackb(self.apply_batch_bytes(payload),
+                              raw=False, strict_map_key=False)
+        return {d: out[self._doc_key(d)] for d in changes_by_doc}
+
+    def apply_changes(self, doc_id, changes):
+        return self.apply_batch({doc_id: changes})[doc_id]
+
+    def get_patch(self, doc_id):
+        out_len = ctypes.c_int64()
+        ptr = lib().amtpu_get_patch(
+            self._pool, self._doc_key(doc_id).encode(),
+            ctypes.byref(out_len))
+        if not ptr:
+            _raise_last()
+        return msgpack.unpackb(_take_buf(ptr, out_len.value), raw=False)
+
+    def get_missing_deps(self, doc_id):
+        out_len = ctypes.c_int64()
+        ptr = lib().amtpu_get_missing_deps(
+            self._pool, self._doc_key(doc_id).encode(),
+            ctypes.byref(out_len))
+        if not ptr:
+            _raise_last()
+        return msgpack.unpackb(_take_buf(ptr, out_len.value), raw=False)
+
+    def get_missing_changes(self, doc_id, have_deps):
+        have = msgpack.packb(dict(have_deps), use_bin_type=True)
+        out_len = ctypes.c_int64()
+        ptr = lib().amtpu_get_missing_changes(
+            self._pool, self._doc_key(doc_id).encode(), have, len(have),
+            ctypes.byref(out_len))
+        if not ptr:
+            _raise_last()
+        return msgpack.unpackb(_take_buf(ptr, out_len.value), raw=False)

@@ -60,6 +60,7 @@ def resolve_registers(group, time, actor, seq, clock, is_del, alive_in,
     """
     T = group.shape[0]
     W = window
+    A = clock.shape[1]
 
     # sort by (group, time); padding (group == -1) sorts first and is inert
     if sort_idx is None:
@@ -71,38 +72,40 @@ def resolve_registers(group, time, actor, seq, clock, is_del, alive_in,
     c_s = clock[sort_idx]
     d_s = is_del[sort_idx]
 
-    pos = jnp.arange(T)
-    # window member w of op i lives at sorted position i - 1 - w
-    offs = jnp.arange(1, W + 1)
-    wpos = pos[:, None] - offs[None, :]                       # [T, W]
-    wvalid = (wpos >= 0) & (g_s[jnp.clip(wpos, 0, T - 1)] == g_s[:, None]) \
-        & (g_s[:, None] >= 0)
-    widx = jnp.clip(wpos, 0, T - 1)
+    # Window member w of op i lives at sorted position i - w (w in 1..W):
+    # a SLIDING window, so member arrays are shifted copies, not gathers
+    # (TPU: slices fuse; random gathers do not).
+    def shifted(arr, w, fill):
+        if w >= arr.shape[0]:
+            return jnp.full(arr.shape, fill, arr.dtype)
+        pad = jnp.full((w,) + arr.shape[1:], fill, arr.dtype)
+        return jnp.concatenate([pad, arr[:-w]], axis=0)
 
-    # member arrays: slot 0 = self, slots 1..W = predecessors (recent first)
-    def gather_members(arr):
-        return jnp.concatenate([arr[:, None], arr[widx]], axis=1)   # [T, W+1]
+    def members(arr, fill):
+        """[T, W+1, ...]: slot 0 = self, slot w = w-th predecessor."""
+        return jnp.stack([arr] + [shifted(arr, w, fill)
+                                  for w in range(1, W + 1)], axis=1)
 
-    m_actor = gather_members(a_s)
-    m_seq = gather_members(q_s)
-    m_del = gather_members(d_s)
-    m_valid = jnp.concatenate(
-        [(g_s >= 0)[:, None], wvalid], axis=1)                      # [T, W+1]
-    m_clock = jnp.concatenate([c_s[:, None, :], c_s[widx]], axis=1)  # [T,W+1,A]
+    m_actor = members(a_s, 0)
+    m_seq = members(q_s, 0)
+    m_del = members(d_s, False)
+    m_group = members(g_s, -2)
+    m_valid = (m_group == g_s[:, None]) & (g_s >= 0)[:, None]   # [T, W+1]
+    m_clock = members(c_s, 0)                                   # [T, W+1, A]
 
     # pairwise: does member u supersede member v?  (u applied later, and they
     # are NOT concurrent).  Member order by slot: slot 0 is the latest op,
     # larger slots are earlier.  u later than v  <=>  slot_u < slot_v.
-    bt = jnp.arange(T)[:, None, None]
-    u_actor = m_actor[:, :, None]          # [T, W+1, 1]
-    v_actor = m_actor[:, None, :]          # [T, 1, W+1]
+    #
+    # clock_u[actor_v] via one-hot batched matmul (MXU work) instead of a
+    # [T, W+1, W+1] random gather:  P[t, u, v] = m_clock[t, u, actor_v].
+    onehot = jax.nn.one_hot(m_actor, A, dtype=jnp.int32)        # [T, W+1, A]
+    P = jnp.einsum('tua,tva->tuv', m_clock, onehot)             # [T,W+1,W+1]
+    u_clock_at_v = P
+    v_clock_at_u = jnp.swapaxes(P, 1, 2)
     u_seq = m_seq[:, :, None]
     v_seq = m_seq[:, None, :]
-    u_clock_at_v = m_clock[bt, jnp.arange(W + 1)[None, :, None],
-                           jnp.clip(v_actor, 0, m_clock.shape[2] - 1)]
-    v_clock_at_u = m_clock[bt, jnp.arange(W + 1)[None, None, :],
-                           jnp.clip(u_actor, 0, m_clock.shape[2] - 1)]
-    concurrent = (u_clock_at_v < v_seq) & (v_clock_at_u < u_seq)    # [T,W+1,W+1]
+    concurrent = (u_clock_at_v < v_seq) & (v_clock_at_u < u_seq)  # [T,W+1,W+1]
     later = (jnp.arange(W + 1)[:, None] < jnp.arange(W + 1)[None, :])  # u<v slot
     supersedes = later[None, :, :] & ~concurrent \
         & m_valid[:, :, None] & m_valid[:, None, :]
@@ -125,8 +128,7 @@ def resolve_registers(group, time, actor, seq, clock, is_del, alive_in,
     actor_keyed = jnp.where(alive, m_actor, -1)
     order = jnp.argsort(-actor_keyed, axis=1, stable=True)          # [T, W+1]
     sorted_alive = jnp.take_along_axis(alive, order, axis=1)
-    member_src = jnp.concatenate(
-        [sort_idx[:, None], sort_idx[widx]], axis=1)                # [T, W+1]
+    member_src = members(sort_idx, -1)                              # [T, W+1]
     sorted_src = jnp.take_along_axis(member_src, order, axis=1)
     sorted_src = jnp.where(sorted_alive, sorted_src, -1)
 

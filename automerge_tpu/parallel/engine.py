@@ -487,6 +487,7 @@ class TPUDocPool:
         # build the flat arena arrays of all touched objects
         base_of = {}
         obj_l, par_l, ctr_l, act_l = [], [], [], []
+        max_obj_len = 0
         for akey, local_obj in arena_objs.items():
             doc_id, obj = akey
             arena = self.docs[doc_id].arenas.get(obj)
@@ -495,6 +496,7 @@ class TPUDocPool:
             base = len(obj_l)
             base_of[akey] = base
             n = len(arena.ctr)
+            max_obj_len = max(max_obj_len, n)
             obj_l.extend([local_obj] * n)
             par_l.extend(p + base if p >= 0 else -1 for p in arena.parent)
             ctr_l.extend(arena.ctr)
@@ -516,9 +518,11 @@ class TPUDocPool:
             skey_obj = np.where(val_arr, obj_arr, 2 ** 30)
             sort_idx = np.lexsort(
                 (-act_arr, -ctr_arr, par_arr, skey_obj)).astype(np.int32)
+            # doubling depth bound: DFS chains never cross objects
             rank = np.asarray(list_rank.linearize(
                 obj_arr, par_arr, ctr_arr, act_arr, val_arr,
-                n_iters=list_rank.ceil_log2(Lp) + 1, sort_idx=sort_idx))[:L]
+                n_iters=list_rank.ceil_log2(max(max_obj_len, 1)) + 1,
+                sort_idx=sort_idx))[:L]
         else:
             rank = np.zeros((0,), np.int32)
 
@@ -629,8 +633,11 @@ class TPUDocPool:
             # slab width: bucketed so the vmap axis shape (and the compile
             # cache key) stays stable, bounded so one slab's [W, Lp, K] mask
             # product never exceeds ~256 MB even for a single huge Text
-            W = _bucket(min(len(akeys), 256), floor=1)
-            while W > 1 and W * Lp * K * 4 > 256 * 2 ** 20:
+            W = _bucket(min(len(akeys), 4096), floor=1)
+            # bound BOTH the [W, Lp, K] mask product and the [W, Tp]
+            # op-timeline arrays
+            while W > 1 and (W * Lp * K * 4 > 256 * 2 ** 20
+                             or W * Tp * 4 > 256 * 2 ** 20):
                 W //= 2
             for s in range(0, len(akeys), W):
                 slab = akeys[s:s + W]

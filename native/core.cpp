@@ -1,0 +1,1701 @@
+// automerge_tpu native host runtime.
+//
+// Owns the host-resident document state (interner, clocks, change logs,
+// registers, list arenas) and runs every per-op host stage of the batched
+// resolver -- exact-order causal scheduling, columnar encoding, patch
+// emission, mirror maintenance -- in C++, leaving only the three device
+// kernels (register resolution, RGA linearization, dominance indexes) to
+// JAX.  Python talks to it through a 3-phase C ABI (begin / mid / finish)
+// passing columnar arrays by pointer, and changes/patches cross the
+// boundary as msgpack bytes.
+//
+// Semantics are a faithful port of automerge_tpu/parallel/engine.py, which
+// is itself byte-compatible with the reference backend
+// (/root/reference/backend/op_set.js).  Differential tests in
+// tests/test_native.py pin native output == Python pool output == oracle.
+//
+// Build: see native/Makefile (g++ -O2 -shared -fPIC).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <memory>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "msgpack.h"
+
+namespace amtpu {
+
+using u8 = uint8_t;
+using i32 = int32_t;
+using u32 = uint32_t;
+using i64 = int64_t;
+using u64 = uint64_t;
+
+static const char* ROOT_ID = "00000000-0000-0000-0000-000000000000";
+
+// ---------------------------------------------------------------------------
+// interner
+// ---------------------------------------------------------------------------
+
+struct Interner {
+  std::unordered_map<std::string, u32> ids;
+  std::vector<std::string> strs;
+
+  u32 id_of(const std::string& s) {
+    auto it = ids.find(s);
+    if (it != ids.end()) return it->second;
+    u32 id = static_cast<u32>(strs.size());
+    ids.emplace(s, id);
+    strs.push_back(s);
+    return id;
+  }
+  const std::string& str(u32 id) const { return strs[id]; }
+};
+
+// ---------------------------------------------------------------------------
+// records
+// ---------------------------------------------------------------------------
+
+enum Action : u8 {
+  A_SET, A_DEL, A_LINK, A_INS, A_MAKE_MAP, A_MAKE_LIST, A_MAKE_TEXT,
+  A_MAKE_TABLE
+};
+
+enum ObjType : u8 { T_MAP, T_LIST, T_TEXT, T_TABLE };
+
+static bool is_list_type(u8 t) { return t == T_LIST || t == T_TEXT; }
+static bool is_assign(u8 a) { return a <= A_LINK; }
+
+static const u32 NONE = 0xffffffffu;
+
+struct OpRec {
+  u8 action;
+  u32 obj;              // sid
+  u32 key;              // sid of key / elemId string; NONE if absent
+  i64 elem;             // for ins
+  u32 actor;            // sid (authoring change)
+  u32 seq;
+  u32 datatype;         // sid or NONE
+  bool has_value;
+  std::vector<u8> value;  // raw msgpack value bytes
+  u32 value_sid;          // sid when value is a string (link targets), else NONE
+};
+
+using Clock = std::vector<std::pair<u32, u32>>;  // (actor sid, seq), sorted
+
+static u32 clock_get(const Clock& c, u32 actor) {
+  for (auto& p : c) if (p.first == actor) return p.second;
+  return 0;
+}
+static void clock_set_max(Clock& c, u32 actor, u32 seq) {
+  for (auto& p : c) {
+    if (p.first == actor) { if (seq > p.second) p.second = seq; return; }
+  }
+  c.emplace_back(actor, seq);
+}
+
+struct ChangeRec {
+  u32 actor;
+  u32 seq;
+  Clock deps;
+  std::vector<OpRec> ops;
+  std::vector<u8> raw;          // raw change msgpack (missing-changes replay)
+  bool has_message = false;
+  std::vector<u8> message;      // raw message value
+};
+
+static bool ops_equal(const OpRec& a, const OpRec& b) {
+  return a.action == b.action && a.obj == b.obj && a.key == b.key &&
+         a.elem == b.elem && a.datatype == b.datatype &&
+         a.has_value == b.has_value && a.value == b.value;
+}
+static bool changes_equal(const ChangeRec& a, const ChangeRec& b) {
+  if (a.actor != b.actor || a.seq != b.seq) return false;
+  Clock da = a.deps, db = b.deps;
+  std::sort(da.begin(), da.end());
+  std::sort(db.begin(), db.end());
+  if (da != db) return false;
+  if (a.ops.size() != b.ops.size()) return false;
+  for (size_t i = 0; i < a.ops.size(); ++i)
+    if (!ops_equal(a.ops[i], b.ops[i])) return false;
+  return true;
+}
+
+struct StateEntry {
+  ChangeRec change;
+  Clock all_deps;
+};
+
+struct InboundRef {
+  u32 obj, key, actor, value;
+  u32 seq;
+  bool operator==(const InboundRef& o) const {
+    return obj == o.obj && key == o.key && actor == o.actor &&
+           value == o.value && seq == o.seq;
+  }
+};
+
+struct ObjMeta {
+  u8 type = T_MAP;
+  std::vector<InboundRef> inbound;
+  std::vector<u32> key_order;   // register keys in first-write order
+};
+
+struct Arena {
+  std::vector<i32> ctr;
+  std::vector<u32> actor_sid;
+  std::vector<i32> parent;
+  std::vector<u8> visible;
+  std::unordered_map<u64, i32> index_of;  // (actor_sid<<20 no -- use map of pair)
+  std::vector<i32> visible_order;
+  i64 max_elem = 0;
+
+  static u64 ekey(u32 actor_sid, i64 elem) {
+    return (static_cast<u64>(actor_sid) << 32) ^ static_cast<u64>(elem);
+  }
+};
+
+using Register = std::vector<OpRec>;
+
+struct DocState {
+  Clock clock;
+  Clock deps;
+  std::unordered_map<u32, std::vector<StateEntry>> states;
+  std::vector<u32> state_actor_order;   // actors in first-seen order
+  std::vector<ChangeRec> queue;
+  std::unordered_map<u32, ObjMeta> objects;
+  std::unordered_map<u64, Register> registers;  // (obj<<32|key)
+  std::unordered_map<u32, Arena> arenas;
+
+  static u64 rkey(u32 obj, u32 key) {
+    return (static_cast<u64>(obj) << 32) | key;
+  }
+
+  DocState() {}
+};
+
+struct Error : std::runtime_error {
+  // kind 0 = AutomergeError, 1 = RangeError
+  int kind;
+  Error(int k, const std::string& m) : std::runtime_error(m), kind(k) {}
+};
+
+// ---------------------------------------------------------------------------
+// pool
+// ---------------------------------------------------------------------------
+
+struct Pool {
+  Interner intern;
+  u32 root_sid;
+  std::unordered_map<std::string, DocState> docs;
+  std::vector<std::string> doc_order;   // first-seen order
+
+  Pool() {
+    root_sid = intern.id_of(ROOT_ID);
+  }
+
+  DocState& doc(const std::string& id) {
+    auto it = docs.find(id);
+    if (it != docs.end()) return it->second;
+    DocState& d = docs[id];
+    d.objects[root_sid] = ObjMeta{T_MAP, {}, {}};
+    doc_order.push_back(id);
+    return d;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// change decoding
+// ---------------------------------------------------------------------------
+
+static u8 parse_action(const std::string& s) {
+  if (s == "set") return A_SET;
+  if (s == "del") return A_DEL;
+  if (s == "link") return A_LINK;
+  if (s == "ins") return A_INS;
+  if (s == "makeMap") return A_MAKE_MAP;
+  if (s == "makeList") return A_MAKE_LIST;
+  if (s == "makeText") return A_MAKE_TEXT;
+  if (s == "makeTable") return A_MAKE_TABLE;
+  throw Error(1, "Unknown operation type " + s);
+}
+static const char* action_name(u8 a) {
+  switch (a) {
+    case A_SET: return "set";
+    case A_DEL: return "del";
+    case A_LINK: return "link";
+    case A_INS: return "ins";
+    case A_MAKE_MAP: return "makeMap";
+    case A_MAKE_LIST: return "makeList";
+    case A_MAKE_TEXT: return "makeText";
+    default: return "makeTable";
+  }
+}
+static u8 make_type(u8 a) {
+  switch (a) {
+    case A_MAKE_MAP: return T_MAP;
+    case A_MAKE_LIST: return T_LIST;
+    case A_MAKE_TEXT: return T_TEXT;
+    default: return T_TABLE;
+  }
+}
+static const char* type_name(u8 t) {
+  switch (t) {
+    case T_MAP: return "map";
+    case T_LIST: return "list";
+    case T_TEXT: return "text";
+    default: return "table";
+  }
+}
+
+static OpRec decode_op(Reader& r, Interner& intern, u32 actor, u32 seq) {
+  OpRec op;
+  op.action = 0xff;
+  op.obj = NONE; op.key = NONE; op.elem = -1;
+  op.actor = actor; op.seq = seq;
+  op.datatype = NONE; op.has_value = false; op.value_sid = NONE;
+  size_t n = r.read_map();
+  for (size_t i = 0; i < n; ++i) {
+    std::string k = r.read_str();
+    if (k == "action") op.action = parse_action(r.read_str());
+    else if (k == "obj") op.obj = intern.id_of(r.read_str());
+    else if (k == "key") op.key = intern.id_of(r.read_str());
+    else if (k == "elem") op.elem = r.read_int();
+    else if (k == "datatype") op.datatype = intern.id_of(r.read_str());
+    else if (k == "value") {
+      op.has_value = true;
+      if (r.peek_type() == Type::Str) {
+        const uint8_t* start = r.pos();
+        std::string s = r.read_str();
+        op.value_sid = intern.id_of(s);
+        op.value.assign(start, r.pos());
+      } else {
+        auto span = r.raw_value();
+        op.value.assign(span.first, span.first + span.second);
+      }
+    } else r.skip();
+  }
+  if (op.action == 0xff) throw Error(1, "Unknown operation type undefined");
+  return op;
+}
+
+static ChangeRec decode_change(Reader& r, Interner& intern) {
+  ChangeRec ch;
+  const uint8_t* start = r.pos();
+  size_t n = r.read_map();
+  ch.actor = NONE; ch.seq = 0;
+  const uint8_t* ops_start = nullptr;
+  const uint8_t* ops_end = nullptr;
+  size_t ops_count = 0;
+  for (size_t i = 0; i < n; ++i) {
+    std::string k = r.read_str();
+    if (k == "actor") ch.actor = intern.id_of(r.read_str());
+    else if (k == "seq") ch.seq = static_cast<u32>(r.read_int());
+    else if (k == "deps") {
+      size_t m = r.read_map();
+      for (size_t j = 0; j < m; ++j) {
+        u32 a = intern.id_of(r.read_str());
+        u32 s = static_cast<u32>(r.read_int());
+        ch.deps.emplace_back(a, s);
+      }
+    } else if (k == "ops") {
+      // ops need actor/seq which may be decoded after this key; remember
+      // the span and re-parse once the whole map is read
+      ops_start = r.pos();
+      ops_count = r.read_array();
+      for (size_t j = 0; j < ops_count; ++j) r.skip();
+      ops_end = r.pos();
+    } else if (k == "message") {
+      auto span = r.raw_value();
+      ch.has_message = true;
+      ch.message.assign(span.first, span.first + span.second);
+    } else r.skip();
+  }
+  ch.raw.assign(start, r.pos());
+  if (ops_start) {
+    Reader ro(ops_start, static_cast<size_t>(ops_end - ops_start));
+    ro.read_array();
+    ch.ops.reserve(ops_count);
+    for (size_t j = 0; j < ops_count; ++j)
+      ch.ops.push_back(decode_op(ro, intern, ch.actor, ch.seq));
+  }
+  return ch;
+}
+
+// parse elemId "actor:counter"; returns false for "_head" / malformed
+static bool parse_elem_id(const std::string& s, Interner& intern,
+                          u32* actor_sid, i64* ctr) {
+  size_t pos = s.rfind(':');
+  if (pos == std::string::npos) return false;
+  i64 v = 0;
+  if (pos + 1 >= s.size()) return false;
+  for (size_t i = pos + 1; i < s.size(); ++i) {
+    char c = s[i];
+    if (c < '0' || c > '9') return false;
+    v = v * 10 + (c - '0');
+  }
+  *actor_sid = intern.id_of(s.substr(0, pos));
+  *ctr = v;
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// batch
+// ---------------------------------------------------------------------------
+
+static i64 bucket(i64 n, i64 floor_ = 16) {
+  i64 size = floor_;
+  while (size < n) size *= 2;
+  return size;
+}
+
+struct AppliedChange {
+  std::string doc_id;
+  ChangeRec change;
+};
+
+struct DomEntry {    // one list-assign op in a per-object timeline
+  i64 op_idx;
+  i64 reg_row;
+  i32 eidx;
+  i32 delta;
+};
+
+struct DomBlock {    // one packed kernel dispatch
+  i64 W, Lp, Tp;
+  std::vector<float> v0;       // [W*Lp]
+  std::vector<i32> er;         // [W*Lp]
+  std::vector<i32> oe, orank, od;  // [W*Tp]
+  std::vector<u8> ov;          // [W*Tp]
+  std::vector<std::pair<std::string, u32>> akeys;  // slab rows
+  std::vector<i32> indexes;    // filled by python, [W*Tp]
+};
+
+struct Batch {
+  Pool* pool;
+  std::vector<AppliedChange> applied;
+  std::vector<std::pair<std::string, ChangeRec>> duplicates;
+
+  // flat ops
+  struct FlatOp { std::string doc_id; const OpRec* op; };
+  std::vector<FlatOp> ops;
+
+  // actor rank table
+  std::vector<i32> rank_of;     // sid -> rank or -1
+  std::vector<u32> rank_to_sid; // rank -> sid
+  i64 A = 0, Ap = 0;
+
+  // register rows
+  i64 T = 0, Tp = 0;
+  std::vector<i32> g_col, t_col, a_col, s_col, sort_idx;
+  std::vector<u8> d_col;
+  std::vector<i32> clock_mat;   // [Tp*Ap]
+  // batch-owned copies of state register records: register mirrors are
+  // REPLACED during emit, so src_records must never point into
+  // st.registers (dangling after the first mirror update of a group)
+  std::deque<OpRec> state_rec_store;
+  std::vector<const OpRec*> src_records;  // row -> op record
+  std::vector<i64> assign_row_of_op;      // op_idx -> row or -1
+  std::unordered_map<u64, u32> group_ids; // per doc+obj+key -- see make_gid
+
+  // arenas
+  i64 L = 0, Lp = 0;
+  i64 max_arena_len = 0;   // bound on DFS chain length (chains are per-object)
+  std::vector<i32> obj_col, par_col, ctr_col, act_col, lin_sort;
+  std::vector<u8> val_col;
+  std::vector<std::pair<std::string, u32>> arena_keys;  // order
+  std::unordered_map<std::string, i64> arena_base;      // "doc\x00obj"
+
+  // register kernel outputs (copied in at mid())
+  std::vector<i32> k_winner, k_conflicts, k_alive;
+  std::vector<u8> k_visible, k_overflow;
+  std::vector<i32> rank;        // [L]
+  int window = 8;
+
+  // overflow fallback
+  std::unordered_map<i64, Register> host_registers;  // op_idx -> register
+
+  // dominance
+  std::vector<DomBlock> dom_blocks;
+  std::unordered_map<i64, std::pair<i32, i64>> list_index_of_op;
+  std::vector<std::string> obj_ops_order;
+  std::unordered_map<std::string, std::vector<DomEntry>> obj_ops;
+
+  // result
+  std::vector<u8> result;
+
+  std::string err_msg;
+  int err_kind = -1;
+};
+
+// ---------------------------------------------------------------------------
+// phase 1: schedule + prepass + encode
+// ---------------------------------------------------------------------------
+
+static Clock all_deps_of(DocState& st, u32 actor, u32 seq) {
+  auto it = st.states.find(actor);
+  if (it == st.states.end()) return {};
+  if (seq == 0 || seq > it->second.size()) return {};
+  return it->second[seq - 1].all_deps;
+}
+
+static void schedule(Pool& pool, Batch& b,
+                     std::vector<std::pair<std::string,
+                                           std::vector<ChangeRec>>>& incoming) {
+  for (auto& [doc_id, changes] : incoming) {
+    DocState& st = pool.doc(doc_id);
+    Clock shadow = st.clock;
+    std::vector<ChangeRec> queue = std::move(st.queue);
+    st.queue.clear();
+    for (auto& ch : changes) {
+      queue.push_back(ch);
+      bool progress = true;
+      while (progress) {
+        progress = false;
+        std::vector<ChangeRec> next_q;
+        for (auto& c : queue) {
+          bool ready = clock_get(shadow, c.actor) >= c.seq - 1;
+          if (ready)
+            for (auto& [da, ds] : c.deps)
+              if (clock_get(shadow, da) < ds) { ready = false; break; }
+          if (ready) {
+            progress = true;
+            if (c.seq <= clock_get(shadow, c.actor)) {
+              b.duplicates.emplace_back(doc_id, c);
+            } else {
+              clock_set_max(shadow, c.actor, c.seq);
+              b.applied.push_back({doc_id, c});
+            }
+          } else {
+            next_q.push_back(std::move(c));
+          }
+        }
+        queue = std::move(next_q);
+        if (!progress) break;
+      }
+    }
+    st.queue = std::move(queue);
+  }
+}
+
+static void update_states(Pool& pool, Batch& b) {
+  for (auto& ac : b.applied) {
+    DocState& st = pool.doc(ac.doc_id);
+    const ChangeRec& ch = ac.change;
+    Clock base = ch.deps;
+    clock_set_max(base, ch.actor, 0);  // ensure present
+    // pin authoring actor at seq-1
+    for (auto& p : base) if (p.first == ch.actor) p.second = ch.seq - 1;
+    Clock all_deps;
+    for (auto& [da, ds] : base) {
+      if (ds == 0) continue;
+      Clock trans = all_deps_of(st, da, ds);
+      for (auto& [ta, ts] : trans) clock_set_max(all_deps, ta, ts);
+      clock_set_max(all_deps, da, ds);
+    }
+    if (st.states.find(ch.actor) == st.states.end())
+      st.state_actor_order.push_back(ch.actor);
+    st.states[ch.actor].push_back({ch, all_deps});
+    clock_set_max(st.clock, ch.actor, ch.seq);
+    Clock remaining;
+    for (auto& [a, s] : st.deps)
+      if (s > clock_get(all_deps, a)) remaining.emplace_back(a, s);
+    clock_set_max(remaining, ch.actor, ch.seq);
+    // deps[actor] = seq exactly (not max -- seq is the new frontier)
+    for (auto& p : remaining) if (p.first == ch.actor) p.second = ch.seq;
+    st.deps = std::move(remaining);
+  }
+  // duplicate consistency (after state updates: in-batch reuse caught too)
+  for (auto& [doc_id, ch] : b.duplicates) {
+    DocState& st = pool.doc(doc_id);
+    auto it = st.states.find(ch.actor);
+    if (it == st.states.end()) continue;
+    if (ch.seq >= 1 && ch.seq - 1 < it->second.size()) {
+      if (!changes_equal(it->second[ch.seq - 1].change, ch))
+        throw Error(0, "Inconsistent reuse of sequence number " +
+                           std::to_string(ch.seq) + " by " +
+                           pool.intern.str(ch.actor));
+    }
+  }
+}
+
+static void prepass(Pool& pool, Batch& b) {
+  for (auto& ac : b.applied) {
+    DocState& st = pool.doc(ac.doc_id);
+    for (const OpRec& op : ac.change.ops) {
+      if (op.action >= A_MAKE_MAP) {
+        if (st.objects.count(op.obj))
+          throw Error(0, "Duplicate creation of object " +
+                             pool.intern.str(op.obj));
+        ObjMeta meta;
+        meta.type = make_type(op.action);
+        st.objects.emplace(op.obj, std::move(meta));
+        if (is_list_type(make_type(op.action))) st.arenas[op.obj];
+      } else if (op.action == A_INS) {
+        auto oit = st.objects.find(op.obj);
+        if (oit == st.objects.end())
+          throw Error(0, "Modification of unknown object " +
+                             pool.intern.str(op.obj));
+        Arena& ar = st.arenas[op.obj];
+        u64 ek = Arena::ekey(op.actor, op.elem);
+        if (ar.index_of.count(ek))
+          throw Error(0, "Duplicate list element ID " +
+                             pool.intern.str(op.actor) + ":" +
+                             std::to_string(op.elem));
+        i32 parent_idx;
+        const std::string& pkey = pool.intern.str(op.key);
+        if (pkey == "_head") {
+          parent_idx = -1;
+        } else {
+          u32 pa; i64 pc;
+          bool ok = parse_elem_id(pkey, pool.intern, &pa, &pc);
+          if (ok) {
+            auto pit = ar.index_of.find(Arena::ekey(pa, pc));
+            if (pit == ar.index_of.end()) ok = false;
+            else parent_idx = pit->second;
+          }
+          if (!ok)
+            throw Error(0, "Missing index entry for list element " + pkey);
+        }
+        ar.index_of[ek] = static_cast<i32>(ar.ctr.size());
+        ar.ctr.push_back(static_cast<i32>(op.elem));
+        ar.actor_sid.push_back(op.actor);
+        ar.parent.push_back(parent_idx);
+        ar.visible.push_back(0);
+        if (op.elem > ar.max_elem) ar.max_elem = op.elem;
+      } else if (is_assign(op.action)) {
+        if (!st.objects.count(op.obj))
+          throw Error(0, "Modification of unknown object " +
+                             pool.intern.str(op.obj));
+      } else {
+        throw Error(1, std::string("Unknown operation type ") +
+                           action_name(op.action));
+      }
+    }
+  }
+}
+
+static void encode(Pool& pool, Batch& b) {
+  Interner& in = pool.intern;
+
+  // flat op list
+  for (auto& ac : b.applied)
+    for (const OpRec& op : ac.change.ops)
+      b.ops.push_back({ac.doc_id, &op});
+
+  // --- discover groups / arenas; collect involved actors -----------------
+  std::vector<u8> involved(in.strs.size(), 0);
+  auto mark = [&](u32 sid) {
+    if (sid >= involved.size()) involved.resize(sid + 1, 0);
+    involved[sid] = 1;
+  };
+  for (auto& ac : b.applied) {
+    DocState& st = pool.doc(ac.doc_id);
+    mark(ac.change.actor);
+    for (auto& [da, ds] : all_deps_of(st, ac.change.actor, ac.change.seq))
+      mark(da);
+  }
+
+  // group ids: key = doc-index * big + obj/key pair; use string map
+  std::unordered_map<std::string, u32> gid_map;
+  auto gid_key = [&](const std::string& doc, u32 obj, u32 key) {
+    std::string s = doc;
+    s.push_back('\x00');
+    s.append(reinterpret_cast<const char*>(&obj), 4);
+    s.append(reinterpret_cast<const char*>(&key), 4);
+    return s;
+  };
+  std::vector<std::tuple<std::string, u32, u32>> gid_order;
+
+  auto arena_key = [&](const std::string& doc, u32 obj) {
+    std::string s = doc;
+    s.push_back('\x00');
+    s.append(reinterpret_cast<const char*>(&obj), 4);
+    return s;
+  };
+
+  for (auto& f : b.ops) {
+    DocState& st = pool.doc(f.doc_id);
+    const OpRec& op = *f.op;
+    if (is_assign(op.action)) {
+      std::string gk = gid_key(f.doc_id, op.obj, op.key);
+      if (!gid_map.count(gk)) {
+        gid_map.emplace(gk, static_cast<u32>(gid_order.size()));
+        gid_order.emplace_back(f.doc_id, op.obj, op.key);
+        auto rit = st.registers.find(DocState::rkey(op.obj, op.key));
+        if (rit != st.registers.end()) {
+          for (auto& rec : rit->second) {
+            mark(rec.actor);
+            for (auto& [da, ds] : all_deps_of(st, rec.actor, rec.seq))
+              mark(da);
+          }
+        }
+      }
+      auto oit = st.objects.find(op.obj);
+      if (oit != st.objects.end() && is_list_type(oit->second.type)) {
+        std::string ak = arena_key(f.doc_id, op.obj);
+        if (!b.arena_base.count(ak)) {
+          b.arena_base.emplace(ak, -1);
+          b.arena_keys.emplace_back(f.doc_id, op.obj);
+        }
+      }
+    } else if (op.action == A_INS) {
+      std::string ak = arena_key(f.doc_id, op.obj);
+      if (!b.arena_base.count(ak)) {
+        b.arena_base.emplace(ak, -1);
+        b.arena_keys.emplace_back(f.doc_id, op.obj);
+      }
+    }
+  }
+  for (auto& [doc_id, obj] : b.arena_keys) {
+    Arena& ar = pool.doc(doc_id).arenas[obj];
+    for (u32 sid : ar.actor_sid) mark(sid);
+  }
+
+  // --- actor rank table (string lex order) --------------------------------
+  std::vector<u32> inv_sids;
+  for (u32 sid = 0; sid < involved.size(); ++sid)
+    if (involved[sid]) inv_sids.push_back(sid);
+  if (inv_sids.empty()) inv_sids.push_back(in.id_of(""));
+  std::sort(inv_sids.begin(), inv_sids.end(),
+            [&](u32 a, u32 c) { return in.str(a) < in.str(c); });
+  b.rank_of.assign(in.strs.size(), -1);
+  b.rank_to_sid = inv_sids;
+  for (size_t i = 0; i < inv_sids.size(); ++i)
+    b.rank_of[inv_sids[i]] = static_cast<i32>(i);
+  b.A = static_cast<i64>(inv_sids.size());
+  b.Ap = bucket(b.A, 4);
+
+  // --- register rows ------------------------------------------------------
+  auto densify = [&](const Clock& c, i32* row) {
+    std::memset(row, 0, sizeof(i32) * b.Ap);
+    for (auto& [a, s] : c) {
+      i32 r = (a < b.rank_of.size()) ? b.rank_of[a] : -1;
+      if (r >= 0) row[r] = static_cast<i32>(s);
+    }
+  };
+
+  // cache the densified clock per (doc, actor, seq) change -- ops of one
+  // change share it
+  std::unordered_map<std::string, std::vector<i32>> clock_cache;
+
+  // state rows
+  for (auto& [doc_id, obj, key] : gid_order) {
+    DocState& st = pool.doc(doc_id);
+    u32 gid = gid_map[gid_key(doc_id, obj, key)];
+    auto rit = st.registers.find(DocState::rkey(obj, key));
+    if (rit == st.registers.end()) continue;
+    auto& recs = rit->second;
+    for (size_t i = 0; i < recs.size(); ++i) {
+      b.g_col.push_back(static_cast<i32>(gid));
+      b.t_col.push_back(static_cast<i32>(i) - static_cast<i32>(recs.size()));
+      b.a_col.push_back(b.rank_of[recs[i].actor]);
+      b.s_col.push_back(static_cast<i32>(recs[i].seq));
+      b.d_col.push_back(0);
+      b.clock_mat.resize(b.clock_mat.size() + b.Ap);
+      densify(all_deps_of(st, recs[i].actor, recs[i].seq),
+              b.clock_mat.data() + b.clock_mat.size() - b.Ap);
+      b.state_rec_store.push_back(recs[i]);
+      b.src_records.push_back(&b.state_rec_store.back());
+    }
+  }
+
+  // batch assign rows (time = op index)
+  b.assign_row_of_op.assign(b.ops.size(), -1);
+  for (size_t op_idx = 0; op_idx < b.ops.size(); ++op_idx) {
+    auto& f = b.ops[op_idx];
+    const OpRec& op = *f.op;
+    if (!is_assign(op.action)) continue;
+    DocState& st = pool.doc(f.doc_id);
+    u32 gid = gid_map[gid_key(f.doc_id, op.obj, op.key)];
+    b.assign_row_of_op[op_idx] = static_cast<i64>(b.g_col.size());
+    b.g_col.push_back(static_cast<i32>(gid));
+    b.t_col.push_back(static_cast<i32>(op_idx));
+    b.a_col.push_back(b.rank_of[op.actor]);
+    b.s_col.push_back(static_cast<i32>(op.seq));
+    b.d_col.push_back(op.action == A_DEL ? 1 : 0);
+    std::string ck = f.doc_id;
+    ck.push_back('\x00');
+    ck.append(reinterpret_cast<const char*>(&op.actor), 4);
+    ck.append(reinterpret_cast<const char*>(&op.seq), 4);
+    auto cit = clock_cache.find(ck);
+    if (cit == clock_cache.end()) {
+      std::vector<i32> row(b.Ap);
+      densify(all_deps_of(st, op.actor, op.seq), row.data());
+      cit = clock_cache.emplace(ck, std::move(row)).first;
+    }
+    b.clock_mat.insert(b.clock_mat.end(), cit->second.begin(),
+                       cit->second.end());
+    b.src_records.push_back(&op);
+  }
+
+  b.T = static_cast<i64>(b.g_col.size());
+  if (b.T > 0) {
+    b.Tp = bucket(b.T);
+    b.g_col.resize(b.Tp, -1);
+    b.t_col.resize(b.Tp, 0);
+    b.a_col.resize(b.Tp, 0);
+    b.s_col.resize(b.Tp, 0);
+    b.d_col.resize(b.Tp, 0);
+    b.clock_mat.resize(b.Tp * b.Ap, 0);
+    // host sort (group, time); padding g=-1 first
+    b.sort_idx.resize(b.Tp);
+    for (i64 i = 0; i < b.Tp; ++i) b.sort_idx[i] = static_cast<i32>(i);
+    std::stable_sort(b.sort_idx.begin(), b.sort_idx.end(),
+                     [&](i32 x, i32 y) {
+                       if (b.g_col[x] != b.g_col[y])
+                         return b.g_col[x] < b.g_col[y];
+                       return b.t_col[x] < b.t_col[y];
+                     });
+  } else {
+    b.Tp = 0;
+  }
+
+  // --- arena columns ------------------------------------------------------
+  for (size_t k = 0; k < b.arena_keys.size(); ++k) {
+    auto& [doc_id, obj] = b.arena_keys[k];
+    Arena& ar = pool.doc(doc_id).arenas[obj];
+    if (static_cast<i64>(ar.ctr.size()) > b.max_arena_len)
+      b.max_arena_len = static_cast<i64>(ar.ctr.size());
+    i64 base = static_cast<i64>(b.obj_col.size());
+    std::string akey = doc_id;
+    akey.push_back('\x00');
+    akey.append(reinterpret_cast<const char*>(&obj), 4);
+    b.arena_base[akey] = base;
+    for (size_t i = 0; i < ar.ctr.size(); ++i) {
+      b.obj_col.push_back(static_cast<i32>(k));
+      b.par_col.push_back(ar.parent[i] >= 0
+                              ? static_cast<i32>(ar.parent[i] + base) : -1);
+      b.ctr_col.push_back(ar.ctr[i]);
+      b.act_col.push_back(b.rank_of[ar.actor_sid[i]]);
+      b.val_col.push_back(1);
+    }
+  }
+  b.L = static_cast<i64>(b.obj_col.size());
+  if (b.L > 0) {
+    b.Lp = bucket(b.L);
+    b.obj_col.resize(b.Lp, 0);
+    b.par_col.resize(b.Lp, -1);
+    b.ctr_col.resize(b.Lp, 0);
+    b.act_col.resize(b.Lp, 0);
+    b.val_col.resize(b.Lp, 0);
+    // sibling sort: (obj-with-invalid-last, parent, -ctr, -actor)
+    b.lin_sort.resize(b.Lp);
+    for (i64 i = 0; i < b.Lp; ++i) b.lin_sort[i] = static_cast<i32>(i);
+    const i32 BIG = 1 << 30;
+    std::stable_sort(
+        b.lin_sort.begin(), b.lin_sort.end(), [&](i32 x, i32 y) {
+          i32 ox = b.val_col[x] ? b.obj_col[x] : BIG;
+          i32 oy = b.val_col[y] ? b.obj_col[y] : BIG;
+          if (ox != oy) return ox < oy;
+          if (b.par_col[x] != b.par_col[y]) return b.par_col[x] < b.par_col[y];
+          if (b.ctr_col[x] != b.ctr_col[y]) return b.ctr_col[x] > b.ctr_col[y];
+          return b.act_col[x] > b.act_col[y];
+        });
+  } else {
+    b.Lp = 0;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// phase 2: register outputs in -> dominance blocks out
+// ---------------------------------------------------------------------------
+
+static bool rec_concurrent(DocState& st, const OpRec& o1, const OpRec& o2) {
+  Clock c1 = all_deps_of(st, o1.actor, o1.seq);
+  Clock c2 = all_deps_of(st, o2.actor, o2.seq);
+  return clock_get(c1, o2.actor) < o2.seq && clock_get(c2, o1.actor) < o1.seq;
+}
+
+static void mid_phase(Pool& pool, Batch& b) {
+  // overflow fallback: re-resolve whole groups with oracle semantics
+  if (b.T > 0) {
+    std::unordered_map<std::string, char> overflowed;
+    auto gkey = [&](const std::string& doc, u32 obj, u32 key) {
+      std::string s = doc;
+      s.push_back('\x00');
+      s.append(reinterpret_cast<const char*>(&obj), 4);
+      s.append(reinterpret_cast<const char*>(&key), 4);
+      return s;
+    };
+    bool any = false;
+    for (size_t op_idx = 0; op_idx < b.ops.size(); ++op_idx) {
+      i64 row = b.assign_row_of_op[op_idx];
+      if (row >= 0 && b.k_overflow[row]) {
+        auto& f = b.ops[op_idx];
+        overflowed[gkey(f.doc_id, f.op->obj, f.op->key)] = 1;
+        any = true;
+      }
+    }
+    if (any) {
+      std::unordered_map<std::string, Register> scratch;
+      for (size_t op_idx = 0; op_idx < b.ops.size(); ++op_idx) {
+        auto& f = b.ops[op_idx];
+        const OpRec& op = *f.op;
+        if (!is_assign(op.action)) continue;
+        std::string gk = gkey(f.doc_id, op.obj, op.key);
+        if (!overflowed.count(gk)) continue;
+        DocState& st = pool.doc(f.doc_id);
+        auto sit = scratch.find(gk);
+        if (sit == scratch.end()) {
+          Register init;
+          auto rit = st.registers.find(DocState::rkey(op.obj, op.key));
+          if (rit != st.registers.end()) init = rit->second;
+          sit = scratch.emplace(gk, std::move(init)).first;
+        }
+        // oracle rule: keep concurrent priors, append op unless del,
+        // sort by actor string descending
+        Register remaining;
+        for (auto& o : sit->second)
+          if (rec_concurrent(st, o, op)) remaining.push_back(o);
+        if (op.action != A_DEL) remaining.push_back(op);
+        std::stable_sort(remaining.begin(), remaining.end(),
+                         [&](const OpRec& x, const OpRec& y) {
+                           return pool.intern.str(x.actor) >
+                                  pool.intern.str(y.actor);
+                         });
+        sit->second = remaining;
+        b.host_registers[static_cast<i64>(op_idx)] = remaining;
+      }
+    }
+  }
+
+  // per-object dominance timelines
+  std::unordered_map<std::string, std::vector<DomEntry>> obj_ops;
+  std::vector<std::string> obj_order;
+  std::unordered_map<u64, char> vis_now;  // (arena base + eidx) -> bool
+
+  auto akey_of = [&](const std::string& doc, u32 obj) {
+    std::string s = doc;
+    s.push_back('\x00');
+    s.append(reinterpret_cast<const char*>(&obj), 4);
+    return s;
+  };
+
+  for (size_t op_idx = 0; op_idx < b.ops.size(); ++op_idx) {
+    i64 row = b.assign_row_of_op[op_idx];
+    if (row < 0) continue;
+    auto& f = b.ops[op_idx];
+    const OpRec& op = *f.op;
+    DocState& st = pool.doc(f.doc_id);
+    auto oit = st.objects.find(op.obj);
+    if (oit == st.objects.end() || !is_list_type(oit->second.type)) continue;
+    std::string ak = akey_of(f.doc_id, op.obj);
+    Arena& ar = st.arenas[op.obj];
+    const std::string& kstr = pool.intern.str(op.key);
+    u32 ea; i64 ec;
+    i32 eidx = -1;
+    if (parse_elem_id(kstr, pool.intern, &ea, &ec)) {
+      auto eit = ar.index_of.find(Arena::ekey(ea, ec));
+      if (eit != ar.index_of.end()) eidx = eit->second;
+    }
+    bool alive_now;
+    auto hit = b.host_registers.find(static_cast<i64>(op_idx));
+    if (hit != b.host_registers.end()) alive_now = !hit->second.empty();
+    else alive_now = b.k_alive[row] > 0;
+    if (eidx < 0) {
+      if (alive_now)
+        throw Error(0, "Missing index entry for list element " + kstr);
+      continue;
+    }
+    i64 base = b.arena_base[ak];
+    u64 vk = static_cast<u64>(base + eidx);
+    bool before;
+    auto vit = vis_now.find(vk);
+    if (vit != vis_now.end()) before = vit->second;
+    else before = ar.visible[eidx] != 0;
+    vis_now[vk] = alive_now ? 1 : 0;
+    auto oit2 = obj_ops.find(ak);
+    if (oit2 == obj_ops.end()) {
+      obj_order.push_back(ak);
+      oit2 = obj_ops.emplace(ak, std::vector<DomEntry>{}).first;
+    }
+    oit2->second.push_back({static_cast<i64>(op_idx), row, eidx,
+                            static_cast<i32>(alive_now) -
+                                static_cast<i32>(before)});
+  }
+
+  // size classes -> memory-bounded slabs (mirrors engine._dominance)
+  const i64 K = 64;
+  std::map<std::pair<i64, i64>, std::vector<std::string>> classes;
+  for (auto& ak : obj_order) {
+    auto& entries = obj_ops[ak];
+    if (entries.empty()) continue;
+    // arena length
+    i64 n_elems = 0;
+    {
+      // decode akey back: find arena via stored base + lookup in arena_keys
+      // (arena_base stores base; length from pool)
+      size_t z = ak.find('\x00');
+      std::string doc = ak.substr(0, z);
+      u32 obj;
+      std::memcpy(&obj, ak.data() + z + 1, 4);
+      n_elems = static_cast<i64>(pool.doc(doc).arenas[obj].ctr.size());
+    }
+    i64 Lp = bucket(std::max<i64>(n_elems, 1));
+    i64 Tp = bucket(static_cast<i64>(entries.size()), K);
+    classes[{Lp, Tp}].push_back(ak);
+  }
+
+  for (auto& [key, aks] : classes) {
+    auto [Lp, Tp] = key;
+    i64 W = bucket(std::min<i64>(static_cast<i64>(aks.size()), 4096), 1);
+    // bound BOTH the [W, Lp, K] mask product and the [W, Tp] op arrays
+    while (W > 1 && (W * Lp * K * 4 > 256LL * (1 << 20) ||
+                     W * Tp * 4 > 256LL * (1 << 20)))
+      W /= 2;
+    for (size_t s = 0; s < aks.size(); s += W) {
+      DomBlock blk;
+      blk.W = W; blk.Lp = Lp; blk.Tp = Tp;
+      blk.v0.assign(W * Lp, 0.0f);
+      blk.er.assign(W * Lp, -1);
+      blk.oe.assign(W * Tp, -1);
+      blk.orank.assign(W * Tp, -1);
+      blk.od.assign(W * Tp, 0);
+      blk.ov.assign(W * Tp, 0);
+      size_t hi = std::min(aks.size(), s + W);
+      for (size_t o = s; o < hi; ++o) {
+        const std::string& ak = aks[o];
+        i64 base = b.arena_base[ak];
+        size_t z = ak.find('\x00');
+        std::string doc = ak.substr(0, z);
+        u32 obj;
+        std::memcpy(&obj, ak.data() + z + 1, 4);
+        Arena& ar = pool.doc(doc).arenas[obj];
+        i64 row = static_cast<i64>(o - s);
+        for (size_t i = 0; i < ar.ctr.size(); ++i) {
+          blk.v0[row * Lp + i] = ar.visible[i] ? 1.0f : 0.0f;
+          blk.er[row * Lp + i] = b.rank[base + i];
+        }
+        auto& entries = obj_ops[ak];
+        for (size_t t = 0; t < entries.size(); ++t) {
+          blk.oe[row * Tp + t] = entries[t].eidx;
+          blk.orank[row * Tp + t] = b.rank[base + entries[t].eidx];
+          blk.od[row * Tp + t] = entries[t].delta;
+          blk.ov[row * Tp + t] = 1;
+        }
+        blk.akeys.emplace_back(ak, 0);
+      }
+      blk.indexes.assign(W * Tp, 0);
+      b.dom_blocks.push_back(std::move(blk));
+    }
+  }
+
+  // stash obj_ops for finish(): encode into list_index map after python
+  // fills blk.indexes; store entries alongside blocks
+  // (re-derive in finish via the same obj_ops ordering kept here)
+  b.result.clear();
+  // keep obj_ops in batch for finish
+  b.obj_ops_order = std::move(obj_order);
+  b.obj_ops = std::move(obj_ops);
+}
+
+// ---------------------------------------------------------------------------
+// phase 3: emission
+// ---------------------------------------------------------------------------
+
+static void collect_indexes(Batch& b) {
+  // map per-block kernel outputs back to op ids
+  for (auto& blk : b.dom_blocks) {
+    for (size_t o = 0; o < blk.akeys.size(); ++o) {
+      const std::string& ak = blk.akeys[o].first;
+      auto& entries = b.obj_ops[ak];
+      for (size_t t = 0; t < entries.size(); ++t) {
+        b.list_index_of_op[entries[t].op_idx] = {
+            blk.indexes[o * blk.Tp + t], entries[t].reg_row};
+      }
+    }
+  }
+}
+
+static Register register_from_kernel(Batch& b, i64 row) {
+  Register reg;
+  i32 w = b.k_winner[row];
+  if (w >= 0) reg.push_back(*b.src_records[w]);
+  for (int c = 0; c < b.window; ++c) {
+    i32 s = b.k_conflicts[row * b.window + c];
+    if (s >= 0) reg.push_back(*b.src_records[s]);
+  }
+  return reg;
+}
+
+static void update_register_mirror(Pool& pool, DocState& st, const OpRec& op,
+                                   const Register& new_register) {
+  u64 rk = DocState::rkey(op.obj, op.key);
+  auto rit = st.registers.find(rk);
+  if (rit != st.registers.end()) {
+    // drop inbound refs of links no longer in the register
+    for (auto& o : rit->second) {
+      if (o.action != A_LINK) continue;
+      bool still = false;
+      for (auto& n : new_register)
+        if (n.actor == o.actor && n.seq == o.seq && n.value == o.value &&
+            n.value_sid == o.value_sid) { still = true; break; }
+      if (still) continue;
+      if (o.value_sid == NONE) continue;
+      auto tit = st.objects.find(o.value_sid);
+      if (tit == st.objects.end()) continue;
+      auto& inbound = tit->second.inbound;
+      for (size_t i = 0; i < inbound.size(); ++i) {
+        if (inbound[i].actor == o.actor && inbound[i].seq == o.seq &&
+            inbound[i].key == o.key && inbound[i].obj == o.obj) {
+          inbound.erase(inbound.begin() + i);
+          --i;
+        }
+      }
+    }
+  }
+  if (op.action == A_LINK && op.value_sid != NONE) {
+    auto tit = st.objects.find(op.value_sid);
+    if (tit != st.objects.end()) {
+      InboundRef ref{op.obj, op.key, op.actor, op.value_sid, op.seq};
+      bool present = false;
+      for (auto& r : tit->second.inbound)
+        if (r == ref) { present = true; break; }
+      if (!present) tit->second.inbound.push_back(ref);
+    }
+  }
+  if (rit == st.registers.end()) {
+    auto oit = st.objects.find(op.obj);
+    if (oit != st.objects.end()) oit->second.key_order.push_back(op.key);
+    st.registers.emplace(rk, new_register);
+  } else {
+    rit->second = new_register;
+  }
+}
+
+// path from root to object: list of either string keys or list indexes.
+// Returns false if the object is unreachable (emit 'path: null').
+struct PathElem { bool is_index; i32 index; u32 key; };
+
+static bool get_path(Pool& pool, DocState& st, u32 object_id,
+                     std::vector<PathElem>& out) {
+  out.clear();
+  while (object_id != pool.root_sid) {
+    auto mit = st.objects.find(object_id);
+    if (mit == st.objects.end() || mit->second.inbound.empty()) return false;
+    const InboundRef& ref = mit->second.inbound[0];
+    object_id = ref.obj;
+    auto pit = st.objects.find(object_id);
+    u8 ptype = (pit != st.objects.end()) ? pit->second.type : T_MAP;
+    if (is_list_type(ptype)) {
+      auto ait = st.arenas.find(object_id);
+      if (ait == st.arenas.end()) return false;
+      Arena& ar = ait->second;
+      const std::string& kstr = pool.intern.str(ref.key);
+      u32 ea; i64 ec;
+      if (!parse_elem_id(kstr, pool.intern, &ea, &ec)) return false;
+      auto eit = ar.index_of.find(Arena::ekey(ea, ec));
+      if (eit == ar.index_of.end()) return false;
+      i32 eidx = eit->second;
+      i32 pos = -1;
+      for (size_t i = 0; i < ar.visible_order.size(); ++i)
+        if (ar.visible_order[i] == eidx) { pos = static_cast<i32>(i); break; }
+      if (pos < 0) return false;
+      out.insert(out.begin(), PathElem{true, pos, 0});
+    } else {
+      out.insert(out.begin(), PathElem{false, 0, ref.key});
+    }
+  }
+  return true;
+}
+
+static void write_path(Writer& w, Pool& pool, bool ok,
+                       const std::vector<PathElem>& path) {
+  if (!ok) { w.nil(); return; }
+  w.array(path.size());
+  for (auto& p : path) {
+    if (p.is_index) w.integer(p.index);
+    else w.str(pool.intern.str(p.key));
+  }
+}
+
+static void write_conflicts(Writer& w, Pool& pool, const Register& reg) {
+  w.array(reg.size() - 1);
+  for (size_t i = 1; i < reg.size(); ++i) {
+    const OpRec& o = reg[i];
+    size_t n = 2 + (o.action == A_LINK ? 1 : 0);
+    w.map(n);
+    w.str("actor"); w.str(pool.intern.str(o.actor));
+    w.str("value");
+    if (o.has_value) w.raw(o.value); else w.nil();
+    if (o.action == A_LINK) { w.str("link"); w.boolean(true); }
+  }
+}
+
+// emits one map/table diff; mirrors engine._emit_map_diff
+static void emit_map_diff(Writer& w, Pool& pool, DocState& st,
+                          const OpRec& op, const Register& reg, u8 obj_type) {
+  const char* type_ =
+      (op.obj == pool.root_sid) ? "map" : type_name(obj_type);
+  std::vector<PathElem> path;
+  bool ok = get_path(pool, st, op.obj, path);
+  if (reg.empty()) {
+    w.map(5);
+    w.str("action"); w.str("remove");
+    w.str("type"); w.str(type_);
+    w.str("obj"); w.str(pool.intern.str(op.obj));
+    w.str("key"); w.str(pool.intern.str(op.key));
+    w.str("path"); write_path(w, pool, ok, path);
+    return;
+  }
+  const OpRec& first = reg[0];
+  size_t n = 6 + (first.action == A_LINK ? 1 : 0) +
+             (first.datatype != NONE ? 1 : 0) + (reg.size() > 1 ? 1 : 0);
+  w.map(n);
+  w.str("action"); w.str("set");
+  w.str("type"); w.str(type_);
+  w.str("obj"); w.str(pool.intern.str(op.obj));
+  w.str("key"); w.str(pool.intern.str(op.key));
+  w.str("path"); write_path(w, pool, ok, path);
+  w.str("value");
+  if (first.has_value) w.raw(first.value); else w.nil();
+  if (first.action == A_LINK) { w.str("link"); w.boolean(true); }
+  if (first.datatype != NONE) {
+    w.str("datatype"); w.str(pool.intern.str(first.datatype));
+  }
+  if (reg.size() > 1) { w.str("conflicts"); write_conflicts(w, pool, reg); }
+}
+
+// emits one list/text diff and maintains visibility mirrors;
+// returns false when no diff is produced
+static bool emit_list_diff(Writer& w, Pool& pool, DocState& st,
+                           const OpRec& op, const Register& reg, i64 op_idx,
+                           Batch& b, u8 obj_type) {
+  Arena& ar = st.arenas[op.obj];
+  auto iit = b.list_index_of_op.find(op_idx);
+  const std::string& kstr = pool.intern.str(op.key);
+  u32 ea; i64 ec;
+  i32 eidx = -1;
+  if (parse_elem_id(kstr, pool.intern, &ea, &ec)) {
+    auto eit = ar.index_of.find(Arena::ekey(ea, ec));
+    if (eit != ar.index_of.end()) eidx = eit->second;
+  }
+  if (iit == b.list_index_of_op.end() || eidx < 0) return false;
+  i32 index = iit->second.first;
+  bool visible_before = ar.visible[eidx] != 0;
+  bool alive = !reg.empty();
+
+  // path computed before the visibility mutation (oracle evaluation order)
+  std::vector<PathElem> path;
+  bool ok = get_path(pool, st, op.obj, path);
+
+  const char* action;
+  if (visible_before && alive) {
+    action = "set";
+  } else if (visible_before && !alive) {
+    action = "remove";
+    ar.visible_order.erase(ar.visible_order.begin() + index);
+    ar.visible[eidx] = 0;
+  } else if (!visible_before && alive) {
+    action = "insert";
+    ar.visible_order.insert(ar.visible_order.begin() + index, eidx);
+    ar.visible[eidx] = 1;
+  } else {
+    return false;
+  }
+  bool ins = action[0] == 'i';
+  bool setlike = alive;
+  const OpRec* first = alive ? &reg[0] : nullptr;
+  size_t n = 5 + (ins ? 1 : 0);
+  if (setlike) {
+    n += 1 + (first->action == A_LINK ? 1 : 0) +
+         (first->datatype != NONE ? 1 : 0) + (reg.size() > 1 ? 1 : 0);
+  }
+  w.map(n);
+  w.str("action"); w.str(action);
+  w.str("type"); w.str(type_name(obj_type));
+  w.str("obj"); w.str(pool.intern.str(op.obj));
+  w.str("index"); w.integer(index);
+  w.str("path"); write_path(w, pool, ok, path);
+  if (ins) { w.str("elemId"); w.str(kstr); }
+  if (setlike) {
+    w.str("value");
+    if (first->has_value) w.raw(first->value); else w.nil();
+    if (first->action == A_LINK) { w.str("link"); w.boolean(true); }
+    if (first->datatype != NONE) {
+      w.str("datatype"); w.str(pool.intern.str(first->datatype));
+    }
+    if (reg.size() > 1) { w.str("conflicts"); write_conflicts(w, pool, reg); }
+  }
+  return true;
+}
+
+static void write_clock(Writer& w, Pool& pool, const Clock& c) {
+  w.map(c.size());
+  for (auto& [a, s] : c) {
+    w.str(pool.intern.str(a));
+    w.integer(s);
+  }
+}
+
+static void emit(Pool& pool, Batch& b,
+                 const std::vector<std::string>& doc_ids) {
+  // diffs per doc, in op order
+  std::unordered_map<std::string, Writer> diff_bufs;
+  std::unordered_map<std::string, size_t> diff_counts;
+
+  for (size_t op_idx = 0; op_idx < b.ops.size(); ++op_idx) {
+    auto& f = b.ops[op_idx];
+    const OpRec& op = *f.op;
+    DocState& st = pool.doc(f.doc_id);
+    Writer& w = diff_bufs[f.doc_id];
+
+    if (op.action >= A_MAKE_MAP) {
+      w.map(3);
+      w.str("action"); w.str("create");
+      w.str("obj"); w.str(pool.intern.str(op.obj));
+      w.str("type"); w.str(type_name(make_type(op.action)));
+      diff_counts[f.doc_id]++;
+      continue;
+    }
+    if (op.action == A_INS) continue;
+
+    i64 row = b.assign_row_of_op[op_idx];
+    Register reg;
+    auto hit = b.host_registers.find(static_cast<i64>(op_idx));
+    if (hit != b.host_registers.end()) reg = hit->second;
+    else reg = register_from_kernel(b, row);
+
+    update_register_mirror(pool, st, op, reg);
+    u8 obj_type = st.objects[op.obj].type;
+    if (is_list_type(obj_type)) {
+      if (emit_list_diff(w, pool, st, op, reg, static_cast<i64>(op_idx), b,
+                         obj_type))
+        diff_counts[f.doc_id]++;
+    } else {
+      emit_map_diff(w, pool, st, op, reg, obj_type);
+      diff_counts[f.doc_id]++;
+    }
+  }
+
+  // assemble {doc_id: patch}
+  Writer out;
+  out.map(doc_ids.size());
+  for (auto& doc_id : doc_ids) {
+    DocState& st = pool.doc(doc_id);
+    out.str(doc_id);
+    out.map(5);
+    out.str("clock"); write_clock(out, pool, st.clock);
+    out.str("deps"); write_clock(out, pool, st.deps);
+    out.str("canUndo"); out.boolean(false);
+    out.str("canRedo"); out.boolean(false);
+    out.str("diffs");
+    out.array(diff_counts.count(doc_id) ? diff_counts[doc_id] : 0);
+    auto dit = diff_bufs.find(doc_id);
+    if (dit != diff_bufs.end()) out.raw(dit->second.buf);
+  }
+  b.result = std::move(out.buf);
+}
+
+// ---------------------------------------------------------------------------
+// whole-doc materialization (getPatch parity)
+// ---------------------------------------------------------------------------
+
+static void materialize(Pool& pool, DocState& st, u32 object_id, Writer& w,
+                        size_t& count, std::vector<u8>& seen);
+
+static void materialize_value(Pool& pool, DocState& st, const OpRec& rec,
+                              Writer& w, size_t& count, std::vector<u8>& seen,
+                              Writer& own, size_t& extra_keys);
+
+static void materialize_conflicts(Pool& pool, DocState& st,
+                                  const Register& reg, Writer& diffs,
+                                  size_t& count, std::vector<u8>& seen,
+                                  Writer& out) {
+  out.array(reg.size() - 1);
+  for (size_t i = 1; i < reg.size(); ++i) {
+    const OpRec& rec = reg[i];
+    Writer val;
+    size_t extra = 0;
+    materialize_value(pool, st, rec, diffs, count, seen, val, extra);
+    out.map(1 + 1 + extra);
+    out.str("actor"); out.str(pool.intern.str(rec.actor));
+    out.raw(val.buf);
+  }
+}
+
+// writes "value": ... (+ optional link/datatype) into `own`; recursing into
+// children first (their diffs land in `diffs` before the caller's diff)
+static void materialize_value(Pool& pool, DocState& st, const OpRec& rec,
+                              Writer& diffs, size_t& count,
+                              std::vector<u8>& seen, Writer& own,
+                              size_t& extra_keys) {
+  if (rec.action == A_LINK && rec.value_sid != NONE) {
+    materialize(pool, st, rec.value_sid, diffs, count, seen);
+    own.str("value");
+    own.raw(rec.value);
+    own.str("link"); own.boolean(true);
+    extra_keys = 1;
+  } else {
+    own.str("value");
+    if (rec.has_value) own.raw(rec.value); else own.nil();
+    if (rec.datatype != NONE) {
+      own.str("datatype"); own.str(pool.intern.str(rec.datatype));
+      extra_keys = 1;
+    } else {
+      extra_keys = 0;
+    }
+  }
+}
+
+static void materialize(Pool& pool, DocState& st, u32 object_id, Writer& w,
+                        size_t& count, std::vector<u8>& seen) {
+  if (object_id < seen.size() && seen[object_id]) return;
+  if (object_id >= seen.size()) seen.resize(object_id + 1, 0);
+  seen[object_id] = 1;
+  auto mit = st.objects.find(object_id);
+  u8 type_ = (mit != st.objects.end()) ? mit->second.type : T_MAP;
+  Writer own;
+  size_t own_count = 0;
+
+  if (is_list_type(type_)) {
+    own.map(3);
+    own.str("obj"); own.str(pool.intern.str(object_id));
+    own.str("type"); own.str(type_name(type_));
+    own.str("action"); own.str("create");
+    own_count++;
+    auto ait = st.arenas.find(object_id);
+    if (ait != st.arenas.end()) {
+      Arena& ar = ait->second;
+      // elemId strings per arena index
+      for (size_t index = 0; index < ar.visible_order.size(); ++index) {
+        i32 eidx = ar.visible_order[index];
+        std::string elem_id = pool.intern.str(ar.actor_sid[eidx]) + ":" +
+                              std::to_string(ar.ctr[eidx]);
+        u32 key_sid = pool.intern.id_of(elem_id);
+        auto rit = st.registers.find(DocState::rkey(object_id, key_sid));
+        if (rit == st.registers.end() || rit->second.empty()) continue;
+        const Register& reg = rit->second;
+        Writer val;
+        size_t extra = 0;
+        materialize_value(pool, st, reg[0], w, count, seen, val, extra);
+        Writer conf;
+        size_t nconf = 0;
+        if (reg.size() > 1) {
+          materialize_conflicts(pool, st, reg, w, count, seen, conf);
+          nconf = 1;
+        }
+        own.map(5 + 1 + extra + nconf);
+        own.str("obj"); own.str(pool.intern.str(object_id));
+        own.str("type"); own.str(type_name(type_));
+        own.str("action"); own.str("insert");
+        own.str("index"); own.integer(static_cast<i64>(index));
+        own.str("elemId"); own.str(elem_id);
+        own.raw(val.buf);
+        if (nconf) { own.str("conflicts"); own.raw(conf.buf); }
+        own_count++;
+      }
+    }
+  } else {
+    if (object_id != pool.root_sid) {
+      own.map(3);
+      own.str("obj"); own.str(pool.intern.str(object_id));
+      own.str("type"); own.str(type_name(type_));
+      own.str("action"); own.str("create");
+      own_count++;
+    }
+    if (mit != st.objects.end()) {
+      for (u32 key : mit->second.key_order) {
+        auto rit = st.registers.find(DocState::rkey(object_id, key));
+        if (rit == st.registers.end() || rit->second.empty()) continue;
+        const Register& reg = rit->second;
+        Writer val;
+        size_t extra = 0;
+        materialize_value(pool, st, reg[0], w, count, seen, val, extra);
+        Writer conf;
+        size_t nconf = 0;
+        if (reg.size() > 1) {
+          materialize_conflicts(pool, st, reg, w, count, seen, conf);
+          nconf = 1;
+        }
+        own.map(4 + 1 + extra + nconf);
+        own.str("obj"); own.str(pool.intern.str(object_id));
+        own.str("type"); own.str(type_name(type_));
+        own.str("action"); own.str("set");
+        own.str("key"); own.str(pool.intern.str(key));
+        own.raw(val.buf);
+        if (nconf) { own.str("conflicts"); own.raw(conf.buf); }
+        own_count++;
+      }
+    }
+  }
+  w.raw(own.buf);
+  count += own_count;
+}
+
+}  // namespace amtpu
+
+// ===========================================================================
+// C ABI
+// ===========================================================================
+
+using namespace amtpu;
+
+struct BatchHandle {
+  Pool* pool;
+  Batch batch;
+  std::vector<std::string> doc_ids;
+};
+
+static thread_local std::string g_error;
+static thread_local int g_error_kind = 0;
+
+extern "C" {
+
+void* amtpu_pool_new() { return new Pool(); }
+void amtpu_pool_free(void* p) { delete static_cast<Pool*>(p); }
+
+const char* amtpu_last_error() { return g_error.c_str(); }
+int amtpu_last_error_kind() { return g_error_kind; }
+
+// ---- phase 1 --------------------------------------------------------------
+// input: msgpack map {doc_id: [change, ...]}
+void* amtpu_begin(void* pool_ptr, const uint8_t* data, int64_t len) {
+  Pool& pool = *static_cast<Pool*>(pool_ptr);
+  auto h = std::make_unique<BatchHandle>();
+  h->pool = &pool;
+  h->batch.pool = &pool;
+  try {
+    Reader r(data, static_cast<size_t>(len));
+    size_t n_docs = r.read_map();
+    std::vector<std::pair<std::string, std::vector<ChangeRec>>> incoming;
+    incoming.reserve(n_docs);
+    for (size_t i = 0; i < n_docs; ++i) {
+      std::string doc_id = r.read_str();
+      size_t n_changes = r.read_array();
+      std::vector<ChangeRec> chs;
+      chs.reserve(n_changes);
+      for (size_t j = 0; j < n_changes; ++j)
+        chs.push_back(decode_change(r, pool.intern));
+      h->doc_ids.push_back(doc_id);
+      incoming.emplace_back(std::move(doc_id), std::move(chs));
+    }
+    schedule(pool, h->batch, incoming);
+    update_states(pool, h->batch);
+    prepass(pool, h->batch);
+    encode(pool, h->batch);
+  } catch (const Error& e) {
+    g_error = e.what(); g_error_kind = e.kind;
+    return nullptr;
+  } catch (const std::exception& e) {
+    g_error = e.what(); g_error_kind = 0;
+    return nullptr;
+  }
+  return h.release();
+}
+
+void amtpu_batch_free(void* b) { delete static_cast<BatchHandle*>(b); }
+
+// dims: [T, Tp, A, Ap, L, Lp, n_dom_blocks, max_arena_len]
+void amtpu_batch_dims(void* bp, int64_t* out) {
+  Batch& b = static_cast<BatchHandle*>(bp)->batch;
+  out[0] = b.T; out[1] = b.Tp; out[2] = b.A; out[3] = b.Ap;
+  out[4] = b.L; out[5] = b.Lp;
+  out[6] = static_cast<int64_t>(b.dom_blocks.size());
+  out[7] = b.max_arena_len;
+}
+
+// register columns (valid when Tp > 0)
+const int32_t* amtpu_col_g(void* bp) { return static_cast<BatchHandle*>(bp)->batch.g_col.data(); }
+const int32_t* amtpu_col_t(void* bp) { return static_cast<BatchHandle*>(bp)->batch.t_col.data(); }
+const int32_t* amtpu_col_a(void* bp) { return static_cast<BatchHandle*>(bp)->batch.a_col.data(); }
+const int32_t* amtpu_col_s(void* bp) { return static_cast<BatchHandle*>(bp)->batch.s_col.data(); }
+const uint8_t* amtpu_col_d(void* bp) { return static_cast<BatchHandle*>(bp)->batch.d_col.data(); }
+const int32_t* amtpu_col_clock(void* bp) { return static_cast<BatchHandle*>(bp)->batch.clock_mat.data(); }
+const int32_t* amtpu_col_sort(void* bp) { return static_cast<BatchHandle*>(bp)->batch.sort_idx.data(); }
+
+// arena columns (valid when Lp > 0)
+const int32_t* amtpu_col_obj(void* bp) { return static_cast<BatchHandle*>(bp)->batch.obj_col.data(); }
+const int32_t* amtpu_col_par(void* bp) { return static_cast<BatchHandle*>(bp)->batch.par_col.data(); }
+const int32_t* amtpu_col_ctr(void* bp) { return static_cast<BatchHandle*>(bp)->batch.ctr_col.data(); }
+const int32_t* amtpu_col_act(void* bp) { return static_cast<BatchHandle*>(bp)->batch.act_col.data(); }
+const uint8_t* amtpu_col_val(void* bp) { return static_cast<BatchHandle*>(bp)->batch.val_col.data(); }
+const int32_t* amtpu_col_linsort(void* bp) { return static_cast<BatchHandle*>(bp)->batch.lin_sort.data(); }
+
+// ---- phase 2 --------------------------------------------------------------
+// feed register kernel outputs ([Tp] / [Tp, window]) and rank [Lp];
+// computes overflow fallbacks + dominance blocks
+int amtpu_mid(void* bp, const int32_t* winner, const int32_t* conflicts,
+              int window, const int32_t* alive, const uint8_t* visible,
+              const uint8_t* overflow, const int32_t* rank) {
+  BatchHandle& h = *static_cast<BatchHandle*>(bp);
+  Batch& b = h.batch;
+  try {
+    b.window = window;
+    if (b.Tp > 0) {
+      b.k_winner.assign(winner, winner + b.Tp);
+      b.k_conflicts.assign(conflicts, conflicts + b.Tp * window);
+      b.k_alive.assign(alive, alive + b.Tp);
+      b.k_visible.assign(visible, visible + b.Tp);
+      b.k_overflow.assign(overflow, overflow + b.Tp);
+    }
+    if (b.Lp > 0) b.rank.assign(rank, rank + b.Lp);
+    mid_phase(*h.pool, b);
+  } catch (const Error& e) {
+    g_error = e.what(); g_error_kind = e.kind;
+    return -1;
+  } catch (const std::exception& e) {
+    g_error = e.what(); g_error_kind = 0;
+    return -1;
+  }
+  return 0;
+}
+
+// dominance block accessors
+void amtpu_dom_dims(void* bp, int64_t blk, int64_t* out) {
+  DomBlock& d = static_cast<BatchHandle*>(bp)->batch.dom_blocks[blk];
+  out[0] = d.W; out[1] = d.Lp; out[2] = d.Tp;
+}
+const float* amtpu_dom_v0(void* bp, int64_t blk) { return static_cast<BatchHandle*>(bp)->batch.dom_blocks[blk].v0.data(); }
+const int32_t* amtpu_dom_er(void* bp, int64_t blk) { return static_cast<BatchHandle*>(bp)->batch.dom_blocks[blk].er.data(); }
+const int32_t* amtpu_dom_oe(void* bp, int64_t blk) { return static_cast<BatchHandle*>(bp)->batch.dom_blocks[blk].oe.data(); }
+const int32_t* amtpu_dom_orank(void* bp, int64_t blk) { return static_cast<BatchHandle*>(bp)->batch.dom_blocks[blk].orank.data(); }
+const int32_t* amtpu_dom_od(void* bp, int64_t blk) { return static_cast<BatchHandle*>(bp)->batch.dom_blocks[blk].od.data(); }
+const uint8_t* amtpu_dom_ov(void* bp, int64_t blk) { return static_cast<BatchHandle*>(bp)->batch.dom_blocks[blk].ov.data(); }
+void amtpu_dom_set_indexes(void* bp, int64_t blk, const int32_t* idx) {
+  DomBlock& d = static_cast<BatchHandle*>(bp)->batch.dom_blocks[blk];
+  d.indexes.assign(idx, idx + d.W * d.Tp);
+}
+
+// ---- phase 3 --------------------------------------------------------------
+int amtpu_finish(void* bp) {
+  BatchHandle& h = *static_cast<BatchHandle*>(bp);
+  try {
+    collect_indexes(h.batch);
+    emit(*h.pool, h.batch, h.doc_ids);
+  } catch (const Error& e) {
+    g_error = e.what(); g_error_kind = e.kind;
+    return -1;
+  } catch (const std::exception& e) {
+    g_error = e.what(); g_error_kind = 0;
+    return -1;
+  }
+  return 0;
+}
+
+const uint8_t* amtpu_result(void* bp, int64_t* len) {
+  Batch& b = static_cast<BatchHandle*>(bp)->batch;
+  *len = static_cast<int64_t>(b.result.size());
+  return b.result.data();
+}
+
+// ---- queries --------------------------------------------------------------
+
+// whole-doc materialization patch; returns malloc'd buffer (caller frees
+// via amtpu_buf_free)
+uint8_t* amtpu_get_patch(void* pool_ptr, const char* doc_id, int64_t* len) {
+  Pool& pool = *static_cast<Pool*>(pool_ptr);
+  try {
+    DocState& st = pool.doc(doc_id);
+    Writer diffs;
+    size_t count = 0;
+    std::vector<u8> seen;
+    materialize(pool, st, pool.root_sid, diffs, count, seen);
+    Writer out;
+    out.map(5);
+    out.str("clock"); write_clock(out, pool, st.clock);
+    out.str("deps"); write_clock(out, pool, st.deps);
+    out.str("canUndo"); out.boolean(false);
+    out.str("canRedo"); out.boolean(false);
+    out.str("diffs");
+    out.array(count);
+    out.raw(diffs.buf);
+    *len = static_cast<int64_t>(out.buf.size());
+    uint8_t* res = static_cast<uint8_t*>(std::malloc(out.buf.size()));
+    std::memcpy(res, out.buf.data(), out.buf.size());
+    return res;
+  } catch (const std::exception& e) {
+    g_error = e.what(); g_error_kind = 0;
+    *len = -1;
+    return nullptr;
+  }
+}
+
+// missing deps: msgpack map {actor: seq}
+uint8_t* amtpu_get_missing_deps(void* pool_ptr, const char* doc_id,
+                                int64_t* len) {
+  Pool& pool = *static_cast<Pool*>(pool_ptr);
+  try {
+    DocState& st = pool.doc(doc_id);
+    Clock missing;
+    for (auto& ch : st.queue) {
+      Clock deps = ch.deps;
+      bool found = false;
+      for (auto& p : deps)
+        if (p.first == ch.actor) { p.second = ch.seq - 1; found = true; }
+      if (!found) deps.emplace_back(ch.actor, ch.seq - 1);
+      for (auto& [da, ds] : deps)
+        if (clock_get(st.clock, da) < ds) clock_set_max(missing, da, ds);
+    }
+    Writer out;
+    write_clock(out, pool, missing);
+    *len = static_cast<int64_t>(out.buf.size());
+    uint8_t* res = static_cast<uint8_t*>(std::malloc(out.buf.size()));
+    std::memcpy(res, out.buf.data(), out.buf.size());
+    return res;
+  } catch (const std::exception& e) {
+    g_error = e.what(); g_error_kind = 0;
+    *len = -1;
+    return nullptr;
+  }
+}
+
+// missing changes given have_deps msgpack map {actor: seq}:
+// returns msgpack array of raw changes
+uint8_t* amtpu_get_missing_changes(void* pool_ptr, const char* doc_id,
+                                   const uint8_t* have, int64_t have_len,
+                                   int64_t* len) {
+  Pool& pool = *static_cast<Pool*>(pool_ptr);
+  try {
+    DocState& st = pool.doc(doc_id);
+    Reader r(have, static_cast<size_t>(have_len));
+    Clock have_deps;
+    size_t n = r.read_map();
+    for (size_t i = 0; i < n; ++i) {
+      u32 a = pool.intern.id_of(r.read_str());
+      u32 s = static_cast<u32>(r.read_int());
+      have_deps.emplace_back(a, s);
+    }
+    Clock all_deps;
+    for (auto& [da, ds] : have_deps) {
+      if (ds == 0) continue;
+      for (auto& [ta, ts] : all_deps_of(st, da, ds))
+        clock_set_max(all_deps, ta, ts);
+      clock_set_max(all_deps, da, ds);
+    }
+    Writer out;
+    size_t count = 0;
+    for (u32 actor : st.state_actor_order) {
+      auto& entries = st.states[actor];
+      u32 from = clock_get(all_deps, actor);
+      for (size_t i = from; i < entries.size(); ++i) count++;
+    }
+    out.array(count);
+    for (u32 actor : st.state_actor_order) {
+      auto& entries = st.states[actor];
+      u32 from = clock_get(all_deps, actor);
+      for (size_t i = from; i < entries.size(); ++i)
+        out.raw(entries[i].change.raw);
+    }
+    *len = static_cast<int64_t>(out.buf.size());
+    uint8_t* res = static_cast<uint8_t*>(std::malloc(out.buf.size()));
+    std::memcpy(res, out.buf.data(), out.buf.size());
+    return res;
+  } catch (const std::exception& e) {
+    g_error = e.what(); g_error_kind = 0;
+    *len = -1;
+    return nullptr;
+  }
+}
+
+void amtpu_buf_free(uint8_t* p) { std::free(p); }
+
+}  // extern "C"
+

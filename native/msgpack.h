@@ -1,0 +1,266 @@
+// Minimal msgpack reader/writer for the automerge_tpu native host runtime.
+//
+// Implements the subset of the msgpack spec the change/patch protocol uses:
+// nil, bool, int (all widths), float64, str, bin, array, map.  The reader
+// exposes raw byte slices so opaque values (op payloads) can be copied
+// verbatim into output messages without re-encoding -- that is what keeps
+// value round-trips byte-exact between the Node frontend and this backend.
+//
+// Reference protocol shapes: /root/reference/backend/index.js:133-138
+// (change objects), /root/reference/frontend/index.js:296-331 (patches).
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace amtpu {
+
+struct MsgpackError : std::runtime_error {
+  explicit MsgpackError(const std::string& m) : std::runtime_error(m) {}
+};
+
+// ---------------------------------------------------------------------------
+// Reader
+// ---------------------------------------------------------------------------
+
+enum class Type : uint8_t { Nil, Bool, Int, Float, Str, Bin, Array, Map };
+
+class Reader {
+ public:
+  Reader(const uint8_t* data, size_t len) : p_(data), end_(data + len) {}
+
+  bool done() const { return p_ >= end_; }
+  const uint8_t* pos() const { return p_; }
+
+  Type peek_type() const {
+    uint8_t b = peek();
+    if (b <= 0x7f || b >= 0xe0) return Type::Int;
+    if (b <= 0x8f) return Type::Map;
+    if (b <= 0x9f) return Type::Array;
+    if (b <= 0xbf) return Type::Str;
+    switch (b) {
+      case 0xc0: return Type::Nil;
+      case 0xc2: case 0xc3: return Type::Bool;
+      case 0xc4: case 0xc5: case 0xc6: return Type::Bin;
+      case 0xca: case 0xcb: return Type::Float;
+      case 0xcc: case 0xcd: case 0xce: case 0xcf:
+      case 0xd0: case 0xd1: case 0xd2: case 0xd3: return Type::Int;
+      case 0xd9: case 0xda: case 0xdb: return Type::Str;
+      case 0xdc: case 0xdd: return Type::Array;
+      case 0xde: case 0xdf: return Type::Map;
+      default: throw MsgpackError("unsupported msgpack byte");
+    }
+  }
+
+  bool read_nil() {
+    if (peek() == 0xc0) { ++p_; return true; }
+    return false;
+  }
+
+  bool read_bool() {
+    uint8_t b = next();
+    if (b == 0xc2) return false;
+    if (b == 0xc3) return true;
+    throw MsgpackError("expected bool");
+  }
+
+  int64_t read_int() {
+    uint8_t b = next();
+    if (b <= 0x7f) return b;
+    if (b >= 0xe0) return static_cast<int8_t>(b);
+    switch (b) {
+      case 0xcc: return u8();
+      case 0xcd: return u16();
+      case 0xce: return u32();
+      case 0xcf: return static_cast<int64_t>(u64());
+      case 0xd0: return static_cast<int8_t>(u8());
+      case 0xd1: return static_cast<int16_t>(u16());
+      case 0xd2: return static_cast<int32_t>(u32());
+      case 0xd3: return static_cast<int64_t>(u64());
+      default: throw MsgpackError("expected int");
+    }
+  }
+
+  double read_float() {
+    uint8_t b = next();
+    if (b == 0xca) {
+      uint32_t v = u32(); float f; std::memcpy(&f, &v, 4); return f;
+    }
+    if (b == 0xcb) {
+      uint64_t v = u64(); double d; std::memcpy(&d, &v, 8); return d;
+    }
+    throw MsgpackError("expected float");
+  }
+
+  std::string read_str() {
+    uint8_t b = next();
+    size_t n;
+    if ((b & 0xe0) == 0xa0) n = b & 0x1f;
+    else if (b == 0xd9) n = u8();
+    else if (b == 0xda) n = u16();
+    else if (b == 0xdb) n = u32();
+    else throw MsgpackError("expected str");
+    need(n);
+    std::string s(reinterpret_cast<const char*>(p_), n);
+    p_ += n;
+    return s;
+  }
+
+  size_t read_array() {
+    uint8_t b = next();
+    if ((b & 0xf0) == 0x90) return b & 0x0f;
+    if (b == 0xdc) return u16();
+    if (b == 0xdd) return u32();
+    throw MsgpackError("expected array");
+  }
+
+  size_t read_map() {
+    uint8_t b = next();
+    if ((b & 0xf0) == 0x80) return b & 0x0f;
+    if (b == 0xde) return u16();
+    if (b == 0xdf) return u32();
+    throw MsgpackError("expected map");
+  }
+
+  // Skips one complete value, returning its raw byte span.
+  std::pair<const uint8_t*, size_t> raw_value() {
+    const uint8_t* start = p_;
+    skip();
+    return {start, static_cast<size_t>(p_ - start)};
+  }
+
+  void skip() {
+    switch (peek_type()) {
+      case Type::Nil: ++p_; break;
+      case Type::Bool: ++p_; break;
+      case Type::Int: read_int(); break;
+      case Type::Float: read_float(); break;
+      case Type::Str: read_str(); break;
+      case Type::Bin: {
+        uint8_t b = next();
+        size_t n = (b == 0xc4) ? u8() : (b == 0xc5) ? u16() : u32();
+        need(n); p_ += n;
+        break;
+      }
+      case Type::Array: {
+        size_t n = read_array();
+        for (size_t i = 0; i < n; ++i) skip();
+        break;
+      }
+      case Type::Map: {
+        size_t n = read_map();
+        for (size_t i = 0; i < n; ++i) { skip(); skip(); }
+        break;
+      }
+    }
+  }
+
+ private:
+  uint8_t peek() const {
+    if (p_ >= end_) throw MsgpackError("truncated input");
+    return *p_;
+  }
+  uint8_t next() {
+    uint8_t b = peek(); ++p_; return b;
+  }
+  void need(size_t n) const {
+    if (static_cast<size_t>(end_ - p_) < n)
+      throw MsgpackError("truncated input");
+  }
+  uint8_t u8() { need(1); return *p_++; }
+  uint16_t u16() {
+    need(2);
+    uint16_t v = (uint16_t(p_[0]) << 8) | p_[1];
+    p_ += 2; return v;
+  }
+  uint32_t u32() {
+    need(4);
+    uint32_t v = (uint32_t(p_[0]) << 24) | (uint32_t(p_[1]) << 16) |
+                 (uint32_t(p_[2]) << 8) | p_[3];
+    p_ += 4; return v;
+  }
+  uint64_t u64() {
+    need(8);
+    uint64_t v = 0;
+    for (int i = 0; i < 8; ++i) v = (v << 8) | p_[i];
+    p_ += 8; return v;
+  }
+
+  const uint8_t* p_;
+  const uint8_t* end_;
+};
+
+// ---------------------------------------------------------------------------
+// Writer
+// ---------------------------------------------------------------------------
+
+class Writer {
+ public:
+  std::vector<uint8_t> buf;
+
+  void nil() { buf.push_back(0xc0); }
+  void boolean(bool v) { buf.push_back(v ? 0xc3 : 0xc2); }
+
+  void integer(int64_t v) {
+    if (v >= 0) {
+      if (v <= 0x7f) { buf.push_back(uint8_t(v)); }
+      else if (v <= 0xff) { buf.push_back(0xcc); u8(uint8_t(v)); }
+      else if (v <= 0xffff) { buf.push_back(0xcd); u16(uint16_t(v)); }
+      else if (v <= 0xffffffffLL) { buf.push_back(0xce); u32(uint32_t(v)); }
+      else { buf.push_back(0xd3); u64(uint64_t(v)); }
+    } else {
+      if (v >= -32) { buf.push_back(uint8_t(v)); }
+      else if (v >= -128) { buf.push_back(0xd0); u8(uint8_t(v)); }
+      else if (v >= -32768) { buf.push_back(0xd1); u16(uint16_t(v)); }
+      else if (v >= -2147483648LL) { buf.push_back(0xd2); u32(uint32_t(v)); }
+      else { buf.push_back(0xd3); u64(uint64_t(v)); }
+    }
+  }
+
+  void real(double v) {
+    buf.push_back(0xcb);
+    uint64_t bits; std::memcpy(&bits, &v, 8);
+    u64(bits);
+  }
+
+  void str(const char* s, size_t n) {
+    if (n <= 31) buf.push_back(0xa0 | uint8_t(n));
+    else if (n <= 0xff) { buf.push_back(0xd9); u8(uint8_t(n)); }
+    else if (n <= 0xffff) { buf.push_back(0xda); u16(uint16_t(n)); }
+    else { buf.push_back(0xdb); u32(uint32_t(n)); }
+    append(reinterpret_cast<const uint8_t*>(s), n);
+  }
+  void str(const std::string& s) { str(s.data(), s.size()); }
+
+  void array(size_t n) {
+    if (n <= 15) buf.push_back(0x90 | uint8_t(n));
+    else if (n <= 0xffff) { buf.push_back(0xdc); u16(uint16_t(n)); }
+    else { buf.push_back(0xdd); u32(uint32_t(n)); }
+  }
+
+  void map(size_t n) {
+    if (n <= 15) buf.push_back(0x80 | uint8_t(n));
+    else if (n <= 0xffff) { buf.push_back(0xde); u16(uint16_t(n)); }
+    else { buf.push_back(0xdf); u32(uint32_t(n)); }
+  }
+
+  // verbatim splice of a previously captured raw value
+  void raw(const uint8_t* data, size_t n) { append(data, n); }
+  void raw(const std::vector<uint8_t>& v) { append(v.data(), v.size()); }
+
+ private:
+  void append(const uint8_t* d, size_t n) { buf.insert(buf.end(), d, d + n); }
+  void u8(uint8_t v) { buf.push_back(v); }
+  void u16(uint16_t v) { buf.push_back(v >> 8); buf.push_back(v & 0xff); }
+  void u32(uint32_t v) {
+    for (int i = 3; i >= 0; --i) buf.push_back((v >> (8 * i)) & 0xff);
+  }
+  void u64(uint64_t v) {
+    for (int i = 7; i >= 0; --i) buf.push_back((v >> (8 * i)) & 0xff);
+  }
+};
+
+}  // namespace amtpu

@@ -1,0 +1,227 @@
+"""Differential tests for the C++ native host runtime (NativeDocPool):
+its patches must equal the Python pool's and the scalar oracle's for the
+same change streams, including msgpack round-trips of every value type.
+"""
+
+import random
+
+import pytest
+
+import automerge_tpu as am
+from automerge_tpu import backend as Backend
+from automerge_tpu.parallel.engine import TPUDocPool
+
+from test_engine_differential import WorkloadGen
+
+ROOT_ID = '00000000-0000-0000-0000-000000000000'
+
+
+def native_pool():
+    from automerge_tpu.native import NativeDocPool
+    return NativeDocPool()
+
+
+def deliver_and_compare(change_batches, n_docs=1):
+    """Feeds identical batches to oracle, Python pool and native pool;
+    asserts patch equality at every step and getPatch equality at the end."""
+    oracle_states = {d: Backend.init() for d in range(n_docs)}
+    py = TPUDocPool()
+    nat = native_pool()
+
+    for batch in change_batches:
+        expected = {}
+        for doc, changes in batch.items():
+            oracle_states[doc], patch = Backend.apply_changes(
+                oracle_states[doc], changes)
+            expected[doc] = patch
+        got_py = py.apply_batch(batch)
+        got_nat = nat.apply_batch(batch)
+        for doc in batch:
+            assert got_py[doc] == expected[doc]
+            assert got_nat[doc] == expected[doc], (
+                'native patch mismatch for doc %r:\nexpected %r\ngot      %r'
+                % (doc, expected[doc], got_nat[doc]))
+
+    for doc in range(n_docs):
+        want = Backend.get_patch(oracle_states[doc])
+        assert nat.get_patch(doc) == want
+
+
+class TestNativeBasics:
+    def test_map_sets_and_dels(self):
+        deliver_and_compare([
+            {0: [{'actor': 'a', 'seq': 1, 'deps': {}, 'ops': [
+                {'action': 'set', 'obj': ROOT_ID, 'key': 'bird',
+                 'value': 'magpie'}]}]},
+            {0: [{'actor': 'a', 'seq': 2, 'deps': {}, 'ops': [
+                {'action': 'set', 'obj': ROOT_ID, 'key': 'bird',
+                 'value': 'jay'},
+                {'action': 'del', 'obj': ROOT_ID, 'key': 'bird'}]}]},
+        ])
+
+    def test_value_types_round_trip(self):
+        # int, float, bool, None, str, timestamp datatype
+        deliver_and_compare([{0: [{'actor': 'a', 'seq': 1, 'deps': {},
+                                   'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'i', 'value': 42},
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'neg', 'value': -7},
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'big',
+             'value': 2 ** 40},
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'f', 'value': 3.25},
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'b', 'value': True},
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'n', 'value': None},
+            {'action': 'set', 'obj': ROOT_ID, 'key': 's', 'value': 'hi'},
+            {'action': 'set', 'obj': ROOT_ID, 'key': 't', 'value': 1234567,
+             'datatype': 'timestamp'}]}]}])
+
+    def test_concurrent_conflict(self):
+        deliver_and_compare([
+            {0: [{'actor': 'a1', 'seq': 1, 'deps': {}, 'ops': [
+                {'action': 'set', 'obj': ROOT_ID, 'key': 'k',
+                 'value': 'from-a'}]},
+                {'actor': 'z9', 'seq': 1, 'deps': {}, 'ops': [
+                    {'action': 'set', 'obj': ROOT_ID, 'key': 'k',
+                     'value': 'from-z'}]}]},
+        ])
+
+    def test_nested_maps_and_links(self):
+        deliver_and_compare([
+            {0: [{'actor': 'a', 'seq': 1, 'deps': {}, 'ops': [
+                {'action': 'makeMap', 'obj': 'm1'},
+                {'action': 'set', 'obj': 'm1', 'key': 'x', 'value': 1},
+                {'action': 'link', 'obj': ROOT_ID, 'key': 'child',
+                 'value': 'm1'}]}]},
+            {0: [{'actor': 'a', 'seq': 2, 'deps': {}, 'ops': [
+                {'action': 'set', 'obj': 'm1', 'key': 'y', 'value': 2},
+                {'action': 'del', 'obj': ROOT_ID, 'key': 'child'}]}]},
+        ])
+
+    def test_out_of_order_buffering(self):
+        nat = native_pool()
+        ch1 = {'actor': 'a', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'k', 'value': 1}]}
+        ch2 = {'actor': 'b', 'seq': 1, 'deps': {'a': 1}, 'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'k', 'value': 2}]}
+        st = Backend.init()
+        st, _ = Backend.apply_changes(st, [ch2])
+        nat.apply_changes(0, [ch2])
+        assert nat.get_missing_deps(0) == Backend.get_missing_deps(st)
+        st, _ = Backend.apply_changes(st, [ch1, ch1])  # dup tolerated
+        nat.apply_changes(0, [ch1, ch1])
+        assert nat.get_patch(0) == Backend.get_patch(st)
+
+    def test_inconsistent_seq_reuse_raises(self):
+        from automerge_tpu.errors import AutomergeError
+        nat = native_pool()
+        nat.apply_changes(0, [{'actor': 'a', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'k', 'value': 1}]}])
+        with pytest.raises(AutomergeError):
+            nat.apply_changes(0, [{'actor': 'a', 'seq': 1, 'deps': {},
+                                   'ops': [
+                {'action': 'set', 'obj': ROOT_ID, 'key': 'k',
+                 'value': 999}]}])
+
+    def test_get_missing_changes(self):
+        nat = native_pool()
+        st = Backend.init()
+        chs = [
+            {'actor': 'a', 'seq': 1, 'deps': {}, 'ops': [
+                {'action': 'set', 'obj': ROOT_ID, 'key': 'k', 'value': 1}]},
+            {'actor': 'a', 'seq': 2, 'deps': {}, 'ops': [
+                {'action': 'set', 'obj': ROOT_ID, 'key': 'k', 'value': 2}]},
+            {'actor': 'b', 'seq': 1, 'deps': {'a': 2}, 'ops': [
+                {'action': 'set', 'obj': ROOT_ID, 'key': 'j', 'value': 3}]},
+        ]
+        st, _ = Backend.apply_changes(st, chs)
+        nat.apply_changes(0, chs)
+        for have in ({}, {'a': 1}, {'a': 2}, {'a': 2, 'b': 1}):
+            want = Backend.get_missing_changes(st, have)
+            got = nat.get_missing_changes(0, have)
+            assert got == want, (have, got, want)
+
+
+class TestNativeLists:
+    def test_text_interleaved(self):
+        actor = 'actor-a'
+        deliver_and_compare([
+            {0: [{'actor': actor, 'seq': 1, 'deps': {}, 'ops': [
+                {'action': 'makeText', 'obj': 'text-1'},
+                {'action': 'ins', 'obj': 'text-1', 'key': '_head',
+                 'elem': 1},
+                {'action': 'set', 'obj': 'text-1', 'key': '%s:1' % actor,
+                 'value': 'h'},
+                {'action': 'ins', 'obj': 'text-1', 'key': '%s:1' % actor,
+                 'elem': 2},
+                {'action': 'set', 'obj': 'text-1', 'key': '%s:2' % actor,
+                 'value': 'i'},
+                {'action': 'link', 'obj': ROOT_ID, 'key': 'text',
+                 'value': 'text-1'}]}]},
+            {0: [{'actor': actor, 'seq': 2, 'deps': {}, 'ops': [
+                {'action': 'del', 'obj': 'text-1', 'key': '%s:1' % actor},
+                {'action': 'ins', 'obj': 'text-1', 'key': '%s:1' % actor,
+                 'elem': 3},
+                {'action': 'set', 'obj': 'text-1', 'key': '%s:3' % actor,
+                 'value': 'H'}]}]},
+        ])
+
+    def test_concurrent_same_position_inserts(self):
+        deliver_and_compare([
+            {0: [{'actor': 'aa', 'seq': 1, 'deps': {}, 'ops': [
+                {'action': 'makeList', 'obj': 'list-1'},
+                {'action': 'ins', 'obj': 'list-1', 'key': '_head',
+                 'elem': 1},
+                {'action': 'set', 'obj': 'list-1', 'key': 'aa:1',
+                 'value': 'base'},
+                {'action': 'link', 'obj': ROOT_ID, 'key': 'l',
+                 'value': 'list-1'}]}]},
+            {0: [{'actor': 'aa', 'seq': 2, 'deps': {}, 'ops': [
+                {'action': 'ins', 'obj': 'list-1', 'key': 'aa:1', 'elem': 2},
+                {'action': 'set', 'obj': 'list-1', 'key': 'aa:2',
+                 'value': 'from-aa'}]}]},
+            {0: [{'actor': 'zz', 'seq': 1, 'deps': {'aa': 1}, 'ops': [
+                {'action': 'ins', 'obj': 'list-1', 'key': 'aa:1', 'elem': 2},
+                {'action': 'set', 'obj': 'list-1', 'key': 'zz:2',
+                 'value': 'from-zz'}]}]},
+        ])
+
+    def test_multi_doc_batch(self):
+        batches = []
+        for d in range(4):
+            tid = 'text-%d' % d
+            batches.append({'actor': 'a', 'seq': 1, 'deps': {}, 'ops': [
+                {'action': 'makeText', 'obj': tid},
+                {'action': 'ins', 'obj': tid, 'key': '_head', 'elem': 1},
+                {'action': 'set', 'obj': tid, 'key': 'a:1',
+                 'value': chr(97 + d)},
+                {'action': 'link', 'obj': ROOT_ID, 'key': 'text',
+                 'value': tid}]})
+        deliver_and_compare([{d: [batches[d]] for d in range(4)}], n_docs=4)
+
+
+class TestNativeRandomWorkloads:
+    @pytest.mark.parametrize('seed,structure', [
+        (1, 'map'), (3, 'list'), (5, 'mixed'), (6, 'mixed'),
+    ])
+    def test_in_order_delivery(self, seed, structure):
+        changes = WorkloadGen(seed, structure=structure).generate(20)
+        deliver_and_compare([{0: [c]} for c in changes])
+
+    @pytest.mark.parametrize('seed', [11, 13])
+    def test_shuffled_delivery(self, seed):
+        rng = random.Random(seed)
+        changes = WorkloadGen(seed, structure='mixed').generate(16)
+        shuffled = list(changes)
+        rng.shuffle(shuffled)
+        deliver_and_compare([{0: shuffled}])
+
+    @pytest.mark.parametrize('seed', [21, 22])
+    def test_batched_delivery(self, seed):
+        rng = random.Random(seed)
+        changes = WorkloadGen(seed, structure='mixed').generate(24)
+        batches = []
+        i = 0
+        while i < len(changes):
+            n = rng.randint(1, 6)
+            batches.append({0: changes[i:i + n]})
+            i += n
+        deliver_and_compare(batches)
