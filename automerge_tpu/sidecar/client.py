@@ -1,0 +1,109 @@
+"""Sidecar client: drives a backend server process over stdio or a unix
+socket.  This is the Python twin of the Node `backend=tpu` adapter -- it
+implements the reference Backend call surface (backend/index.js:312-315)
+by shipping requests across the process boundary, which is exactly the
+deployment seam the reference designed the frontend/backend split for
+(CHANGELOG.md:36-39, "work moved to a background thread")."""
+
+import json
+import socket
+import struct
+import subprocess
+import sys
+
+
+class SidecarClient:
+    def __init__(self, proc=None, sock_path=None, use_msgpack=False):
+        """Connects to a server.  Exactly one of:
+          * proc=None, sock_path=None: spawn a stdio server subprocess
+          * sock_path: connect to a unix socket
+          * proc: adopt an existing subprocess with stdio pipes
+        """
+        self._msgpack = use_msgpack
+        self._next_id = 0
+        self._proc = None
+        self._sock = None
+        if sock_path:
+            self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            self._sock.connect(sock_path)
+            self._r = self._sock.makefile('rb')
+            self._w = self._sock.makefile('wb')
+        else:
+            if proc is None:
+                cmd = [sys.executable, '-m', 'automerge_tpu.sidecar.server']
+                if use_msgpack:
+                    cmd.append('--msgpack')
+                proc = subprocess.Popen(
+                    cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE)
+            self._proc = proc
+            self._r = proc.stdout
+            self._w = proc.stdin
+
+    def close(self):
+        try:
+            self._w.close()
+        except Exception:
+            pass
+        if self._proc is not None:
+            self._proc.wait(timeout=10)
+        if self._sock is not None:
+            self._sock.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- rpc ------------------------------------------------------------
+
+    def call(self, cmd, **kwargs):
+        self._next_id += 1
+        req = dict(kwargs, cmd=cmd, id=self._next_id)
+        if self._msgpack:
+            import msgpack
+            body = msgpack.packb(req, use_bin_type=True)
+            self._w.write(struct.pack('>I', len(body)) + body)
+            self._w.flush()
+            head = self._r.read(4)
+            if len(head) < 4:
+                raise ConnectionError('sidecar server closed the stream')
+            (n,) = struct.unpack('>I', head)
+            resp = msgpack.unpackb(self._r.read(n), raw=False,
+                                   strict_map_key=False)
+        else:
+            self._w.write((json.dumps(req) + '\n').encode())
+            self._w.flush()
+            line = self._r.readline()
+            if not line:
+                raise ConnectionError('sidecar server closed the stream')
+            resp = json.loads(line)
+        if 'error' in resp:
+            from ..errors import AutomergeError, RangeError
+            types = {'AutomergeError': AutomergeError,
+                     'RangeError': RangeError, 'TypeError': TypeError,
+                     'KeyError': KeyError}
+            raise types.get(resp.get('errorType'), AutomergeError)(
+                resp['error'])
+        return resp['result']
+
+    # -- Backend surface -------------------------------------------------
+
+    def apply_changes(self, doc, changes):
+        return self.call('apply_changes', doc=doc, changes=changes)
+
+    def apply_batch(self, docs):
+        return self.call('apply_batch', docs=docs)
+
+    def apply_local_change(self, doc, request):
+        return self.call('apply_local_change', doc=doc, request=request)
+
+    def get_patch(self, doc):
+        return self.call('get_patch', doc=doc)
+
+    def get_missing_deps(self, doc):
+        return self.call('get_missing_deps', doc=doc)
+
+    def get_missing_changes(self, doc, have_deps):
+        return self.call('get_missing_changes', doc=doc,
+                         have_deps=have_deps)

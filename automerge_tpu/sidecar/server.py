@@ -1,0 +1,212 @@
+"""Backend sidecar: serves the reference's Backend protocol over
+stdio or a unix socket, so a frontend in another process/language (the
+reference's Node.js frontend via a `backend=tpu` adapter) can drive the
+batched native resolver through the existing change/patch JSON boundary
+(reference seam: frontend/index.js:98,315; surface: backend/index.js:312-315).
+
+Two framings:
+  * JSON lines (default): one request object per line, one response per
+    line -- easy to drive from a shell or the reference's JS frontend.
+  * msgpack (--msgpack): 4-byte big-endian length prefix + msgpack body.
+    Patches/changes then stay msgpack end-to-end (the C++ runtime's
+    native serialization); the request envelope itself is decoded in
+    Python before dispatch.
+
+Requests (fields beyond `cmd`/`id` per command):
+  {"id": 1, "cmd": "apply_changes",      "doc": d, "changes": [...]}
+  {"id": 2, "cmd": "apply_batch",        "docs": {d: [...], ...}}
+  {"id": 3, "cmd": "apply_local_change", "doc": d, "request": {...}}
+  {"id": 4, "cmd": "get_patch",          "doc": d}
+  {"id": 5, "cmd": "get_missing_deps",   "doc": d}
+  {"id": 6, "cmd": "get_missing_changes","doc": d, "have_deps": {...}}
+  {"id": 7, "cmd": "ping"}
+
+Responses: {"id": ..., "result": ...} or {"id": ..., "error": msg,
+"errorType": "AutomergeError"|"RangeError"|"TypeError"}.
+
+Run: python -m automerge_tpu.sidecar.server [--socket PATH] [--msgpack]
+"""
+
+import argparse
+import json
+import os
+import socket
+import struct
+import sys
+
+from ..errors import AutomergeError, RangeError
+
+
+class SidecarBackend:
+    """Protocol command dispatch over one NativeDocPool."""
+
+    def __init__(self, pool=None):
+        if pool is None:
+            from ..native import NativeDocPool
+            pool = NativeDocPool()
+        self.pool = pool
+        # per-doc clocks tracked from returned patches, so local-change
+        # seq validation does not re-materialize the whole document
+        self._clocks = {}
+
+    def _note_patch(self, doc, patch):
+        self._clocks[doc] = dict(patch.get('clock', {}))
+        return patch
+
+    # -- commands -------------------------------------------------------
+
+    def apply_changes(self, doc, changes):
+        return self._note_patch(doc, self.pool.apply_changes(doc, changes))
+
+    def apply_batch(self, docs):
+        patches = self.pool.apply_batch(docs)
+        for doc, patch in patches.items():
+            self._note_patch(doc, patch)
+        return patches
+
+    def apply_local_change(self, doc, request):
+        """Local change request with the reference's validation
+        (backend/index.js:175-197).  requestType 'change' only: undo/redo
+        execution is a Backend-state feature the pool does not yet expose
+        over the wire."""
+        if not isinstance(request.get('actor'), str) or \
+                not isinstance(request.get('seq'), int):
+            # 'requries' [sic]: byte parity with the reference's own error
+            # text (backend/index.js:177)
+            raise TypeError(
+                'Change request requries `actor` and `seq` properties')
+        clock = self._clocks.get(doc)
+        if clock is None:
+            clock = self.pool.get_patch(doc)['clock']
+            self._clocks[doc] = dict(clock)
+        if request['seq'] <= clock.get(request['actor'], 0):
+            raise RangeError('Change request has already been applied')
+        request_type = request.get('requestType', 'change')
+        if request_type != 'change':
+            raise RangeError('Unknown requestType: %s' % request_type)
+        # requestType is transport-only: it must not leak into the stored
+        # change history that get_missing_changes ships to peers
+        change = {k: v for k, v in request.items() if k != 'requestType'}
+        patch = self._note_patch(doc, self.pool.apply_changes(doc, [change]))
+        patch['actor'] = request['actor']
+        patch['seq'] = request['seq']
+        return patch
+
+    def get_patch(self, doc):
+        return self.pool.get_patch(doc)
+
+    def get_missing_deps(self, doc):
+        return self.pool.get_missing_deps(doc)
+
+    def get_missing_changes(self, doc, have_deps):
+        return self.pool.get_missing_changes(doc, have_deps)
+
+    # -- dispatch -------------------------------------------------------
+
+    def handle(self, req):
+        rid = req.get('id')
+        try:
+            cmd = req.get('cmd')
+            if cmd == 'ping':
+                result = {'ok': True}
+            elif cmd == 'apply_changes':
+                result = self.apply_changes(req['doc'], req['changes'])
+            elif cmd == 'apply_batch':
+                result = self.apply_batch(req['docs'])
+            elif cmd == 'apply_local_change':
+                result = self.apply_local_change(req['doc'], req['request'])
+            elif cmd == 'get_patch':
+                result = self.get_patch(req['doc'])
+            elif cmd == 'get_missing_deps':
+                result = self.get_missing_deps(req['doc'])
+            elif cmd == 'get_missing_changes':
+                result = self.get_missing_changes(req['doc'],
+                                                  req.get('have_deps', {}))
+            else:
+                raise RangeError('Unknown command: %r' % (cmd,))
+            return {'id': rid, 'result': result}
+        except (AutomergeError, RangeError, TypeError, KeyError) as e:
+            return {'id': rid, 'error': str(e),
+                    'errorType': type(e).__name__}
+
+
+def serve_stream(rfile, wfile, use_msgpack=False, backend=None):
+    """Serves requests from a byte stream until EOF."""
+    backend = backend or SidecarBackend()
+    if use_msgpack:
+        import msgpack
+        while True:
+            head = rfile.read(4)
+            if len(head) < 4:
+                break
+            (n,) = struct.unpack('>I', head)
+            body = rfile.read(n)
+            if len(body) < n:
+                break
+            try:
+                req = msgpack.unpackb(body, raw=False, strict_map_key=False)
+                if not isinstance(req, dict):
+                    raise ValueError('request is not a map')
+            except Exception as e:
+                resp = {'id': None, 'error': 'bad msgpack: %s' % e,
+                        'errorType': 'RangeError'}
+            else:
+                resp = backend.handle(req)
+            out = msgpack.packb(resp, use_bin_type=True)
+            wfile.write(struct.pack('>I', len(out)) + out)
+            wfile.flush()
+    else:
+        for line in rfile:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                req = json.loads(line)
+            except ValueError as e:
+                resp = {'id': None, 'error': 'bad json: %s' % e,
+                        'errorType': 'RangeError'}
+            else:
+                resp = backend.handle(req)
+            wfile.write((json.dumps(resp) + '\n').encode())
+            wfile.flush()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument('--socket', help='serve on a unix socket path '
+                                     'instead of stdio')
+    ap.add_argument('--msgpack', action='store_true',
+                    help='length-prefixed msgpack framing instead of '
+                         'JSON lines')
+    args = ap.parse_args(argv)
+
+    if args.socket:
+        if os.path.exists(args.socket):
+            os.unlink(args.socket)
+        srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        srv.bind(args.socket)
+        srv.listen(1)
+        backend = SidecarBackend()   # pool shared across connections
+        try:
+            while True:
+                conn, _ = srv.accept()
+                with conn:
+                    rfile = conn.makefile('rb')
+                    wfile = conn.makefile('wb')
+                    try:
+                        serve_stream(rfile, wfile, args.msgpack, backend)
+                    except (BrokenPipeError, ConnectionError, OSError) as e:
+                        # one misbehaving client must not take down the
+                        # shared pool for everyone else
+                        print('sidecar: connection dropped: %s' % e,
+                              file=sys.stderr)
+        finally:
+            srv.close()
+            if os.path.exists(args.socket):
+                os.unlink(args.socket)
+    else:
+        serve_stream(sys.stdin.buffer, sys.stdout.buffer, args.msgpack)
+
+
+if __name__ == '__main__':
+    main()

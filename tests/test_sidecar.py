@@ -1,0 +1,117 @@
+"""Sidecar protocol tests: a real server subprocess driven over stdio
+(JSON lines and msgpack framing) and a unix socket, with patches compared
+against the scalar oracle."""
+
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+import pytest
+
+from automerge_tpu import backend as Backend
+from automerge_tpu.errors import AutomergeError, RangeError
+from automerge_tpu.sidecar.client import SidecarClient
+
+ROOT_ID = '00000000-0000-0000-0000-000000000000'
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def spawn(extra=()):
+    env = dict(os.environ, PYTHONPATH=REPO)
+    proc = subprocess.Popen(
+        [sys.executable, '-m', 'automerge_tpu.sidecar.server', *extra],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, env=env, cwd=REPO)
+    return proc
+
+
+CHS = [
+    {'actor': 'a', 'seq': 1, 'deps': {}, 'ops': [
+        {'action': 'set', 'obj': ROOT_ID, 'key': 'bird',
+         'value': 'magpie'}]},
+    {'actor': 'b', 'seq': 1, 'deps': {'a': 1}, 'ops': [
+        {'action': 'makeText', 'obj': 't1'},
+        {'action': 'ins', 'obj': 't1', 'key': '_head', 'elem': 1},
+        {'action': 'set', 'obj': 't1', 'key': 'b:1', 'value': 'x'},
+        {'action': 'link', 'obj': ROOT_ID, 'key': 'text', 'value': 't1'}]},
+]
+
+
+def oracle_patches():
+    st = Backend.init()
+    patches = []
+    for ch in CHS:
+        st, p = Backend.apply_changes(st, [ch])
+        patches.append(p)
+    return st, patches
+
+
+@pytest.mark.parametrize('framing', ['json', 'msgpack'])
+def test_stdio_round_trip(framing):
+    extra = ['--msgpack'] if framing == 'msgpack' else []
+    proc = spawn(extra)
+    st, want = oracle_patches()
+    with SidecarClient(proc=proc, use_msgpack=(framing == 'msgpack')) as c:
+        assert c.call('ping') == {'ok': True}
+        for ch, wp in zip(CHS, want):
+            got = c.apply_changes('doc1', [ch])
+            assert got == wp
+        assert c.get_patch('doc1') == Backend.get_patch(st)
+        assert c.get_missing_deps('doc1') == {}
+        for have in ({}, {'a': 1}, {'a': 1, 'b': 1}):
+            got_changes = c.get_missing_changes('doc1', have)
+            assert got_changes == Backend.get_missing_changes(st, have)
+
+
+def test_apply_batch_and_errors():
+    proc = spawn()
+    with SidecarClient(proc=proc) as c:
+        patches = c.apply_batch({'d1': [CHS[0]], 'd2': [CHS[0]]})
+        assert set(patches) == {'d1', 'd2'}
+        assert patches['d1']['clock'] == {'a': 1}
+        # inconsistent seq reuse -> AutomergeError over the wire
+        with pytest.raises(AutomergeError):
+            c.apply_changes('d1', [{
+                'actor': 'a', 'seq': 1, 'deps': {}, 'ops': [
+                    {'action': 'set', 'obj': ROOT_ID, 'key': 'bird',
+                     'value': 'DIFFERENT'}]}])
+        # unknown command
+        with pytest.raises(RangeError):
+            c.call('frobnicate')
+
+
+def test_apply_local_change():
+    proc = spawn()
+    with SidecarClient(proc=proc) as c:
+        patch = c.apply_local_change('d1', dict(CHS[0], requestType='change'))
+        assert patch['actor'] == 'a' and patch['seq'] == 1
+        # replay of an applied seq is rejected (backend/index.js:178-180)
+        with pytest.raises(RangeError):
+            c.apply_local_change('d1', dict(CHS[0], requestType='change'))
+        with pytest.raises(TypeError):
+            c.apply_local_change('d1', {'requestType': 'change', 'ops': []})
+        # transport-only requestType must not leak into shipped history
+        shipped = c.get_missing_changes('d1', {})
+        assert shipped and all('requestType' not in ch for ch in shipped)
+
+
+def test_unix_socket():
+    path = os.path.join(tempfile.mkdtemp(), 'amtpu.sock')
+    env = dict(os.environ, PYTHONPATH=REPO)
+    proc = subprocess.Popen(
+        [sys.executable, '-m', 'automerge_tpu.sidecar.server',
+         '--socket', path], env=env, cwd=REPO)
+    try:
+        for _ in range(100):
+            if os.path.exists(path):
+                break
+            time.sleep(0.1)
+        with SidecarClient(sock_path=path) as c:
+            assert c.call('ping') == {'ok': True}
+            st, want = oracle_patches()
+            got = c.apply_changes('doc1', [CHS[0]])
+            assert got == want[0]
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
