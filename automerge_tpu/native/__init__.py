@@ -59,7 +59,7 @@ def _load():
         ctypes.c_void_p,
         ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
         ctypes.c_int,
-        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_int32),
         ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int32)]
     lib.amtpu_dom_dims.argtypes = [ctypes.c_void_p, ctypes.c_int64,
                                    ctypes.POINTER(ctypes.c_int64)]
@@ -89,6 +89,16 @@ def _load():
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64,
         ctypes.POINTER(ctypes.c_int64)]
     lib.amtpu_buf_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+    lib.amtpu_doc_shard.restype = ctypes.c_uint32
+    lib.amtpu_doc_shard.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                    ctypes.c_int]
+    lib.amtpu_shard_split.restype = ctypes.c_void_p
+    lib.amtpu_shard_split.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                      ctypes.c_int]
+    lib.amtpu_shard_buf.restype = ctypes.POINTER(ctypes.c_uint8)
+    lib.amtpu_shard_buf.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                    ctypes.POINTER(ctypes.c_int64)]
+    lib.amtpu_shard_free.argtypes = [ctypes.c_void_p]
     return lib
 
 
@@ -121,6 +131,37 @@ def _take_buf(ptr, length):
 
 class NativeError(Exception):
     pass
+
+
+def _read_map_header(buf):
+    """Returns (n_entries, header_len) of a msgpack map."""
+    b = buf[0]
+    if (b & 0xf0) == 0x80:
+        return b & 0x0f, 1
+    if b == 0xde:
+        return int.from_bytes(buf[1:3], 'big'), 3
+    if b == 0xdf:
+        return int.from_bytes(buf[1:5], 'big'), 5
+    raise NativeError('expected msgpack map, got 0x%02x' % b)
+
+
+def _map_header(n):
+    if n <= 15:
+        return bytes([0x80 | n])
+    if n <= 0xffff:
+        return b'\xde' + n.to_bytes(2, 'big')
+    return b'\xdf' + n.to_bytes(4, 'big')
+
+
+def _apply_batch_dicts(pool, changes_by_doc):
+    """Shared dict-level apply_batch: msgpack round trip through the
+    pool's wire path (pool is any object with apply_batch_bytes)."""
+    keyed = {NativeDocPool._doc_key(d): chs
+             for d, chs in changes_by_doc.items()}
+    payload = msgpack.packb(keyed, use_bin_type=True)
+    out = msgpack.unpackb(pool.apply_batch_bytes(payload),
+                          raw=False, strict_map_key=False)
+    return {d: out[NativeDocPool._doc_key(d)] for d in changes_by_doc}
 
 
 def _raise_last():
@@ -161,18 +202,12 @@ class NativeDocPool:
             reg_out = self._run_register_kernel(L, bh, Tp, Ap)
             rank = self._run_linearize(L, bh, Lp, max_obj)
 
-            win = ctypes.POINTER(ctypes.c_int32)
             if Tp > 0:
-                winner = np.ascontiguousarray(reg_out['winner'], np.int32)
-                conflicts = np.ascontiguousarray(reg_out['conflicts'],
-                                                 np.int32)
-                alive = np.ascontiguousarray(reg_out['alive_after'], np.int32)
-                visible = np.ascontiguousarray(
-                    reg_out['visible_before'], np.uint8)
-                overflow = np.ascontiguousarray(reg_out['overflow'], np.uint8)
+                winner, conflicts, alive, overflow = \
+                    self._unpack_register_out(reg_out, Tp)
             else:
                 winner = conflicts = alive = np.zeros(0, np.int32)
-                visible = overflow = np.zeros(0, np.uint8)
+                overflow = np.zeros(0, np.uint8)
             rank_arr = np.ascontiguousarray(rank, np.int32)
 
             def ip(a):
@@ -182,8 +217,7 @@ class NativeDocPool:
                 return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
 
             if L.amtpu_mid(bh, ip(winner), ip(conflicts), self.WINDOW,
-                           ip(alive), up(visible), up(overflow),
-                           ip(rank_arr)) != 0:
+                           ip(alive), up(overflow), ip(rank_arr)) != 0:
                 _raise_last()
 
             self._run_dominance(L, bh)
@@ -212,10 +246,40 @@ class NativeDocPool:
         d = np.ctypeslib.as_array(L.amtpu_col_d(bh), shape=(Tp,))
         c = np.ctypeslib.as_array(L.amtpu_col_clock(bh), shape=(Tp, Ap))
         si = np.ctypeslib.as_array(L.amtpu_col_sort(bh), shape=(Tp,))
-        out = register_ops.resolve_registers(
+        # device arrays; transfers happen selectively in
+        # _unpack_register_out
+        return register_ops.resolve_registers(
             g, t, a, s, c, d.astype(bool), np.ones((Tp,), bool),
             window=self.WINDOW, sort_idx=si)
-        return {k: np.asarray(v) for k, v in out.items()}
+
+    def _unpack_register_out(self, reg_out, Tp):
+        """One packed [Tp] i32 transfer for winner/alive/overflow plus a
+        lazy row-gather of conflicts only where a register kept >1 member
+        (D2H over the device link is the scarce resource, not compute)."""
+        from ..ops import registers as register_ops
+        if Tp >= 1 << 24:    # packed winner field width exceeded
+            winner = np.ascontiguousarray(reg_out['winner'], np.int32)
+            conflicts = np.ascontiguousarray(reg_out['conflicts'], np.int32)
+            alive = np.ascontiguousarray(reg_out['alive_after'], np.int32)
+            overflow = np.ascontiguousarray(reg_out['overflow'], np.uint8)
+            return winner, conflicts, alive, overflow
+        packed = np.asarray(reg_out['packed'])
+        winner = np.ascontiguousarray(packed & 0xffffff, np.int32)
+        winner[winner == 0xffffff] = -1
+        alive = np.ascontiguousarray((packed >> 24) & 0xf, np.int32)
+        overflow = np.ascontiguousarray((packed >> 28) & 1, np.uint8)
+        conflicts = np.full((Tp, self.WINDOW), -1, np.int32)
+        rows = np.nonzero(alive > 1)[0]
+        if rows.size:
+            pad = 1
+            while pad < rows.size:
+                pad *= 2
+            rows_p = np.zeros((pad,), np.int32)
+            rows_p[:rows.size] = rows
+            got = np.asarray(register_ops.gather_rows(
+                reg_out['conflicts'], rows_p))[:rows.size]
+            conflicts[rows] = got
+        return winner, conflicts, alive, overflow
 
     def _run_linearize(self, L, bh, Lp, max_obj_len):
         if Lp == 0:
@@ -268,11 +332,7 @@ class NativeDocPool:
         return doc_id if isinstance(doc_id, str) else 'i:%d' % doc_id
 
     def apply_batch(self, changes_by_doc):
-        keyed = {self._doc_key(d): chs for d, chs in changes_by_doc.items()}
-        payload = msgpack.packb(keyed, use_bin_type=True)
-        out = msgpack.unpackb(self.apply_batch_bytes(payload),
-                              raw=False, strict_map_key=False)
-        return {d: out[self._doc_key(d)] for d in changes_by_doc}
+        return _apply_batch_dicts(self, changes_by_doc)
 
     def apply_changes(self, doc_id, changes):
         return self.apply_batch({doc_id: changes})[doc_id]
@@ -304,3 +364,95 @@ class NativeDocPool:
         if not ptr:
             _raise_last()
         return msgpack.unpackb(_take_buf(ptr, out_len.value), raw=False)
+
+
+class ShardedNativePool:
+    """S independent native pools driven by S threads.
+
+    Document-level independence is the framework's data-parallel axis
+    (SURVEY.md section 2); on the host it also shards the C++ runtime:
+    ctypes releases the GIL around native calls, so begin/emit of all
+    shards run truly concurrently, and each shard's device dispatches
+    overlap other shards' host work.  Doc -> shard routing uses the same
+    FNV-1a hash as the C++ payload splitter.
+
+    API-compatible with NativeDocPool for apply_batch/apply_batch_bytes
+    and the per-doc queries.
+    """
+
+    def __init__(self, n_shards=None):
+        if n_shards is None:
+            n_shards = min(8, os.cpu_count() or 1)
+        if n_shards < 1:
+            raise ValueError('n_shards must be >= 1, got %r' % (n_shards,))
+        self.n_shards = n_shards
+        self.pools = [NativeDocPool() for _ in range(n_shards)]
+
+    def _shard_of(self, doc_id):
+        key = NativeDocPool._doc_key(doc_id).encode()
+        return int(lib().amtpu_doc_shard(key, len(key), self.n_shards))
+
+    def apply_batch_bytes(self, payload):
+        L = lib()
+        sp = L.amtpu_shard_split(payload, len(payload), self.n_shards)
+        if not sp:
+            _raise_last()
+        try:
+            subs = []
+            for s in range(self.n_shards):
+                n = ctypes.c_int64()
+                ptr = L.amtpu_shard_buf(sp, s, ctypes.byref(n))
+                subs.append(bytes(bytearray(ctypes.cast(
+                    ptr, ctypes.POINTER(
+                        ctypes.c_uint8 * n.value)).contents))
+                    if n.value else b'\x80')
+        finally:
+            L.amtpu_shard_free(sp)
+
+        results = [None] * self.n_shards
+        errors = []
+
+        def run(s):
+            try:
+                if subs[s] != b'\x80':
+                    results[s] = self.pools[s].apply_batch_bytes(subs[s])
+            except Exception as e:         # re-raised on the caller thread
+                errors.append(e)
+
+        import threading
+        threads = [threading.Thread(target=run, args=(s,))
+                   for s in range(self.n_shards)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
+        # merge the per-shard {doc: patch} maps at the byte level: sum the
+        # map headers, splice the bodies -- no decode of patch contents
+        total = 0
+        bodies = []
+        for r in results:
+            if r is None:
+                continue
+            n, off = _read_map_header(r)
+            total += n
+            bodies.append(r[off:])
+        return _map_header(total) + b''.join(bodies)
+
+    def apply_batch(self, changes_by_doc):
+        return _apply_batch_dicts(self, changes_by_doc)
+
+    def apply_changes(self, doc_id, changes):
+        return self.pools[self._shard_of(doc_id)].apply_changes(
+            doc_id, changes)
+
+    def get_patch(self, doc_id):
+        return self.pools[self._shard_of(doc_id)].get_patch(doc_id)
+
+    def get_missing_deps(self, doc_id):
+        return self.pools[self._shard_of(doc_id)].get_missing_deps(doc_id)
+
+    def get_missing_changes(self, doc_id, have_deps):
+        return self.pools[self._shard_of(doc_id)].get_missing_changes(
+            doc_id, have_deps)

@@ -148,4 +148,22 @@ def resolve_registers(group, time, actor, seq, clock, is_del, alive_in,
         'visible_before': jnp.zeros((T,), jnp.bool_).at[sort_idx].set(visible_before),
         'overflow': jnp.zeros((T,), jnp.bool_).at[sort_idx].set(overflow),
     }
+    # transfer-packed summary: winner (24 bits, 0xffffff = none) | alive
+    # (4 bits) | overflow (1 bit).  One [T] i32 D2H instead of four arrays;
+    # conflicts rows are fetched lazily only where alive > 1.  Callers must
+    # use the unpacked outputs when T >= 2**24.
+    if window > 14:
+        raise ValueError(
+            'packed alive_after field is 4 bits; window=%d overflows it '
+            '(max alive_after is window+1)' % window)
+    out['packed'] = (jnp.where(out['winner'] >= 0, out['winner'],
+                               0xffffff).astype(jnp.int32)
+                     | (out['alive_after'] << 24)
+                     | (out['overflow'].astype(jnp.int32) << 28))
     return out
+
+
+@jax.jit
+def gather_rows(mat, rows):
+    """Row gather for the lazy conflicts fetch."""
+    return mat[rows]

@@ -24,6 +24,7 @@
 #include <map>
 #include <memory>
 #include <string>
+#include <string_view>
 #include <unordered_map>
 #include <vector>
 
@@ -44,18 +45,37 @@ static const char* ROOT_ID = "00000000-0000-0000-0000-000000000000";
 // ---------------------------------------------------------------------------
 
 struct Interner {
-  std::unordered_map<std::string, u32> ids;
-  std::vector<std::string> strs;
+  // storage is a deque so string data never moves; the id map keys are
+  // views into that storage, and lookups by string_view never allocate
+  std::unordered_map<std::string_view, u32> ids;
+  std::deque<std::string> strs;
 
-  u32 id_of(const std::string& s) {
+  u32 id_of(std::string_view s) {
     auto it = ids.find(s);
     if (it != ids.end()) return it->second;
     u32 id = static_cast<u32>(strs.size());
-    ids.emplace(s, id);
-    strs.push_back(s);
+    strs.emplace_back(s);
+    ids.emplace(std::string_view(strs.back()), id);
     return id;
   }
   const std::string& str(u32 id) const { return strs[id]; }
+  size_t size() const { return strs.size(); }
+};
+
+// composite integer keys replacing per-op string keys (hash-map identity
+// must be exact, so fields are kept, not hashed together)
+struct K3 {
+  u32 a, b, c;
+  bool operator==(const K3& o) const {
+    return a == o.a && b == o.b && c == o.c;
+  }
+};
+struct K3Hash {
+  size_t operator()(const K3& k) const {
+    u64 h = (u64(k.a) << 42) ^ (u64(k.b) << 21) ^ k.c;
+    h ^= h >> 33; h *= 0xff51afd7ed558ccdULL; h ^= h >> 33;
+    return static_cast<size_t>(h);
+  }
 };
 
 // ---------------------------------------------------------------------------
@@ -74,6 +94,9 @@ static bool is_assign(u8 a) { return a <= A_LINK; }
 
 static const u32 NONE = 0xffffffffu;
 
+// Values are interned raw msgpack spans (vid into Pool::vals): op records
+// stay POD-copyable and identical values (e.g. single chars of a Text)
+// dedup to one entry.
 struct OpRec {
   u8 action;
   u32 obj;              // sid
@@ -82,9 +105,8 @@ struct OpRec {
   u32 actor;            // sid (authoring change)
   u32 seq;
   u32 datatype;         // sid or NONE
-  bool has_value;
-  std::vector<u8> value;  // raw msgpack value bytes
-  u32 value_sid;          // sid when value is a string (link targets), else NONE
+  u32 value_rid;        // vid of raw msgpack value bytes, NONE if absent
+  u32 value_sid;        // sid when value is a string (link targets), else NONE
 };
 
 using Clock = std::vector<std::pair<u32, u32>>;  // (actor sid, seq), sorted
@@ -113,7 +135,7 @@ struct ChangeRec {
 static bool ops_equal(const OpRec& a, const OpRec& b) {
   return a.action == b.action && a.obj == b.obj && a.key == b.key &&
          a.elem == b.elem && a.datatype == b.datatype &&
-         a.has_value == b.has_value && a.value == b.value;
+         a.value_rid == b.value_rid;
 }
 static bool changes_equal(const ChangeRec& a, const ChangeRec& b) {
   if (a.actor != b.actor || a.seq != b.seq) return false;
@@ -192,6 +214,7 @@ struct Error : std::runtime_error {
 
 struct Pool {
   Interner intern;
+  Interner vals;     // raw msgpack value spans, interned (vid)
   u32 root_sid;
   std::unordered_map<std::string, DocState> docs;
   std::vector<std::string> doc_order;   // first-seen order
@@ -210,11 +233,16 @@ struct Pool {
   }
 };
 
+// interned raw msgpack bytes of an op's value
+static inline const std::string& val_bytes(Pool& pool, const OpRec& op) {
+  return pool.vals.str(op.value_rid);
+}
+
 // ---------------------------------------------------------------------------
 // change decoding
 // ---------------------------------------------------------------------------
 
-static u8 parse_action(const std::string& s) {
+static u8 parse_action_sv(std::string_view s) {
   if (s == "set") return A_SET;
   if (s == "del") return A_DEL;
   if (s == "link") return A_LINK;
@@ -223,7 +251,7 @@ static u8 parse_action(const std::string& s) {
   if (s == "makeList") return A_MAKE_LIST;
   if (s == "makeText") return A_MAKE_TEXT;
   if (s == "makeTable") return A_MAKE_TABLE;
-  throw Error(1, "Unknown operation type " + s);
+  throw Error(1, "Unknown operation type " + std::string(s));
 }
 static const char* action_name(u8 a) {
   switch (a) {
@@ -254,30 +282,32 @@ static const char* type_name(u8 t) {
   }
 }
 
-static OpRec decode_op(Reader& r, Interner& intern, u32 actor, u32 seq) {
+static OpRec decode_op(Reader& r, Pool& pool, u32 actor, u32 seq) {
   OpRec op;
   op.action = 0xff;
   op.obj = NONE; op.key = NONE; op.elem = -1;
   op.actor = actor; op.seq = seq;
-  op.datatype = NONE; op.has_value = false; op.value_sid = NONE;
+  op.datatype = NONE; op.value_rid = NONE; op.value_sid = NONE;
   size_t n = r.read_map();
   for (size_t i = 0; i < n; ++i) {
-    std::string k = r.read_str();
-    if (k == "action") op.action = parse_action(r.read_str());
-    else if (k == "obj") op.obj = intern.id_of(r.read_str());
-    else if (k == "key") op.key = intern.id_of(r.read_str());
+    std::string_view k = r.read_str_view();
+    if (k == "action") op.action = parse_action_sv(r.read_str_view());
+    else if (k == "obj") op.obj = pool.intern.id_of(r.read_str_view());
+    else if (k == "key") op.key = pool.intern.id_of(r.read_str_view());
     else if (k == "elem") op.elem = r.read_int();
-    else if (k == "datatype") op.datatype = intern.id_of(r.read_str());
+    else if (k == "datatype")
+      op.datatype = pool.intern.id_of(r.read_str_view());
     else if (k == "value") {
-      op.has_value = true;
       if (r.peek_type() == Type::Str) {
         const uint8_t* start = r.pos();
-        std::string s = r.read_str();
-        op.value_sid = intern.id_of(s);
-        op.value.assign(start, r.pos());
+        std::string_view s = r.read_str_view();
+        op.value_sid = pool.intern.id_of(s);
+        op.value_rid = pool.vals.id_of(std::string_view(
+            reinterpret_cast<const char*>(start), r.pos() - start));
       } else {
         auto span = r.raw_value();
-        op.value.assign(span.first, span.first + span.second);
+        op.value_rid = pool.vals.id_of(std::string_view(
+            reinterpret_cast<const char*>(span.first), span.second));
       }
     } else r.skip();
   }
@@ -285,7 +315,7 @@ static OpRec decode_op(Reader& r, Interner& intern, u32 actor, u32 seq) {
   return op;
 }
 
-static ChangeRec decode_change(Reader& r, Interner& intern) {
+static ChangeRec decode_change(Reader& r, Pool& pool) {
   ChangeRec ch;
   const uint8_t* start = r.pos();
   size_t n = r.read_map();
@@ -294,13 +324,13 @@ static ChangeRec decode_change(Reader& r, Interner& intern) {
   const uint8_t* ops_end = nullptr;
   size_t ops_count = 0;
   for (size_t i = 0; i < n; ++i) {
-    std::string k = r.read_str();
-    if (k == "actor") ch.actor = intern.id_of(r.read_str());
+    std::string_view k = r.read_str_view();
+    if (k == "actor") ch.actor = pool.intern.id_of(r.read_str_view());
     else if (k == "seq") ch.seq = static_cast<u32>(r.read_int());
     else if (k == "deps") {
       size_t m = r.read_map();
       for (size_t j = 0; j < m; ++j) {
-        u32 a = intern.id_of(r.read_str());
+        u32 a = pool.intern.id_of(r.read_str_view());
         u32 s = static_cast<u32>(r.read_int());
         ch.deps.emplace_back(a, s);
       }
@@ -323,7 +353,7 @@ static ChangeRec decode_change(Reader& r, Interner& intern) {
     ro.read_array();
     ch.ops.reserve(ops_count);
     for (size_t j = 0; j < ops_count; ++j)
-      ch.ops.push_back(decode_op(ro, intern, ch.actor, ch.seq));
+      ch.ops.push_back(decode_op(ro, pool, ch.actor, ch.seq));
   }
   return ch;
 }
@@ -349,14 +379,24 @@ static bool parse_elem_id(const std::string& s, Interner& intern,
 // batch
 // ---------------------------------------------------------------------------
 
+// shape bucket: next size in {2^k, 3*2^(k-1)} >= n, so padded kernel
+// shapes waste at most 33% instead of 100% while the jit compile cache
+// stays small (two shapes per octave)
 static i64 bucket(i64 n, i64 floor_ = 16) {
   i64 size = floor_;
-  while (size < n) size *= 2;
+  while (size < n) {
+    i64 mid = size + size / 2;
+    if (mid >= n && mid % floor_ == 0) return mid;
+    size *= 2;
+  }
   return size;
 }
 
+// (the mid % floor_ guard also keeps dominance timelines a multiple of the
+// kernel chunk, whose floor is the chunk length)
+
 struct AppliedChange {
-  std::string doc_id;
+  u32 doc;            // dense batch doc index
   ChangeRec change;
 };
 
@@ -373,17 +413,20 @@ struct DomBlock {    // one packed kernel dispatch
   std::vector<i32> er;         // [W*Lp]
   std::vector<i32> oe, orank, od;  // [W*Tp]
   std::vector<u8> ov;          // [W*Tp]
-  std::vector<std::pair<std::string, u32>> akeys;  // slab rows
+  std::vector<u64> akeys;      // slab rows: (doc << 32 | obj)
   std::vector<i32> indexes;    // filled by python, [W*Tp]
 };
 
 struct Batch {
   Pool* pool;
+  // dense per-batch doc table: index -> (payload key, state)
+  std::vector<std::string> bdoc_ids;
+  std::vector<DocState*> bdocs;
   std::vector<AppliedChange> applied;
-  std::vector<std::pair<std::string, ChangeRec>> duplicates;
+  std::vector<std::pair<u32, ChangeRec>> duplicates;
 
   // flat ops
-  struct FlatOp { std::string doc_id; const OpRec* op; };
+  struct FlatOp { u32 doc; const OpRec* op; };
   std::vector<FlatOp> ops;
 
   // actor rank table
@@ -402,19 +445,18 @@ struct Batch {
   std::deque<OpRec> state_rec_store;
   std::vector<const OpRec*> src_records;  // row -> op record
   std::vector<i64> assign_row_of_op;      // op_idx -> row or -1
-  std::unordered_map<u64, u32> group_ids; // per doc+obj+key -- see make_gid
 
   // arenas
   i64 L = 0, Lp = 0;
   i64 max_arena_len = 0;   // bound on DFS chain length (chains are per-object)
   std::vector<i32> obj_col, par_col, ctr_col, act_col, lin_sort;
   std::vector<u8> val_col;
-  std::vector<std::pair<std::string, u32>> arena_keys;  // order
-  std::unordered_map<std::string, i64> arena_base;      // "doc\x00obj"
+  std::vector<u64> arena_keys;                   // (doc << 32 | obj), order
+  std::unordered_map<u64, i64> arena_base;
 
   // register kernel outputs (copied in at mid())
   std::vector<i32> k_winner, k_conflicts, k_alive;
-  std::vector<u8> k_visible, k_overflow;
+  std::vector<u8> k_overflow;
   std::vector<i32> rank;        // [L]
   int window = 8;
 
@@ -424,8 +466,8 @@ struct Batch {
   // dominance
   std::vector<DomBlock> dom_blocks;
   std::unordered_map<i64, std::pair<i32, i64>> list_index_of_op;
-  std::vector<std::string> obj_ops_order;
-  std::unordered_map<std::string, std::vector<DomEntry>> obj_ops;
+  std::vector<u64> obj_ops_order;
+  std::unordered_map<u64, std::vector<DomEntry>> obj_ops;
 
   // result
   std::vector<u8> result;
@@ -446,10 +488,10 @@ static Clock all_deps_of(DocState& st, u32 actor, u32 seq) {
 }
 
 static void schedule(Pool& pool, Batch& b,
-                     std::vector<std::pair<std::string,
-                                           std::vector<ChangeRec>>>& incoming) {
-  for (auto& [doc_id, changes] : incoming) {
-    DocState& st = pool.doc(doc_id);
+                     std::vector<std::vector<ChangeRec>>& incoming) {
+  for (u32 doc = 0; doc < incoming.size(); ++doc) {
+    auto& changes = incoming[doc];
+    DocState& st = *b.bdocs[doc];
     Clock shadow = st.clock;
     std::vector<ChangeRec> queue = std::move(st.queue);
     st.queue.clear();
@@ -467,10 +509,10 @@ static void schedule(Pool& pool, Batch& b,
           if (ready) {
             progress = true;
             if (c.seq <= clock_get(shadow, c.actor)) {
-              b.duplicates.emplace_back(doc_id, c);
+              b.duplicates.emplace_back(doc, c);
             } else {
               clock_set_max(shadow, c.actor, c.seq);
-              b.applied.push_back({doc_id, c});
+              b.applied.push_back({doc, c});
             }
           } else {
             next_q.push_back(std::move(c));
@@ -486,7 +528,7 @@ static void schedule(Pool& pool, Batch& b,
 
 static void update_states(Pool& pool, Batch& b) {
   for (auto& ac : b.applied) {
-    DocState& st = pool.doc(ac.doc_id);
+    DocState& st = *b.bdocs[ac.doc];
     const ChangeRec& ch = ac.change;
     Clock base = ch.deps;
     clock_set_max(base, ch.actor, 0);  // ensure present
@@ -512,8 +554,8 @@ static void update_states(Pool& pool, Batch& b) {
     st.deps = std::move(remaining);
   }
   // duplicate consistency (after state updates: in-batch reuse caught too)
-  for (auto& [doc_id, ch] : b.duplicates) {
-    DocState& st = pool.doc(doc_id);
+  for (auto& [doc, ch] : b.duplicates) {
+    DocState& st = *b.bdocs[doc];
     auto it = st.states.find(ch.actor);
     if (it == st.states.end()) continue;
     if (ch.seq >= 1 && ch.seq - 1 < it->second.size()) {
@@ -527,7 +569,7 @@ static void update_states(Pool& pool, Batch& b) {
 
 static void prepass(Pool& pool, Batch& b) {
   for (auto& ac : b.applied) {
-    DocState& st = pool.doc(ac.doc_id);
+    DocState& st = *b.bdocs[ac.doc];
     for (const OpRec& op : ac.change.ops) {
       if (op.action >= A_MAKE_MAP) {
         if (st.objects.count(op.obj))
@@ -587,47 +629,35 @@ static void encode(Pool& pool, Batch& b) {
   // flat op list
   for (auto& ac : b.applied)
     for (const OpRec& op : ac.change.ops)
-      b.ops.push_back({ac.doc_id, &op});
+      b.ops.push_back({ac.doc, &op});
 
   // --- discover groups / arenas; collect involved actors -----------------
-  std::vector<u8> involved(in.strs.size(), 0);
+  std::vector<u8> involved(in.size(), 0);
   auto mark = [&](u32 sid) {
     if (sid >= involved.size()) involved.resize(sid + 1, 0);
     involved[sid] = 1;
   };
   for (auto& ac : b.applied) {
-    DocState& st = pool.doc(ac.doc_id);
+    DocState& st = *b.bdocs[ac.doc];
     mark(ac.change.actor);
     for (auto& [da, ds] : all_deps_of(st, ac.change.actor, ac.change.seq))
       mark(da);
   }
 
-  // group ids: key = doc-index * big + obj/key pair; use string map
-  std::unordered_map<std::string, u32> gid_map;
-  auto gid_key = [&](const std::string& doc, u32 obj, u32 key) {
-    std::string s = doc;
-    s.push_back('\x00');
-    s.append(reinterpret_cast<const char*>(&obj), 4);
-    s.append(reinterpret_cast<const char*>(&key), 4);
-    return s;
-  };
-  std::vector<std::tuple<std::string, u32, u32>> gid_order;
-
-  auto arena_key = [&](const std::string& doc, u32 obj) {
-    std::string s = doc;
-    s.push_back('\x00');
-    s.append(reinterpret_cast<const char*>(&obj), 4);
-    return s;
+  std::unordered_map<K3, u32, K3Hash> gid_map;     // (doc, obj, key)
+  std::vector<K3> gid_order;
+  auto akey_of = [](u32 doc, u32 obj) {
+    return (static_cast<u64>(doc) << 32) | obj;
   };
 
   for (auto& f : b.ops) {
-    DocState& st = pool.doc(f.doc_id);
+    DocState& st = *b.bdocs[f.doc];
     const OpRec& op = *f.op;
     if (is_assign(op.action)) {
-      std::string gk = gid_key(f.doc_id, op.obj, op.key);
+      K3 gk{f.doc, op.obj, op.key};
       if (!gid_map.count(gk)) {
         gid_map.emplace(gk, static_cast<u32>(gid_order.size()));
-        gid_order.emplace_back(f.doc_id, op.obj, op.key);
+        gid_order.push_back(gk);
         auto rit = st.registers.find(DocState::rkey(op.obj, op.key));
         if (rit != st.registers.end()) {
           for (auto& rec : rit->second) {
@@ -639,22 +669,22 @@ static void encode(Pool& pool, Batch& b) {
       }
       auto oit = st.objects.find(op.obj);
       if (oit != st.objects.end() && is_list_type(oit->second.type)) {
-        std::string ak = arena_key(f.doc_id, op.obj);
+        u64 ak = akey_of(f.doc, op.obj);
         if (!b.arena_base.count(ak)) {
           b.arena_base.emplace(ak, -1);
-          b.arena_keys.emplace_back(f.doc_id, op.obj);
+          b.arena_keys.push_back(ak);
         }
       }
     } else if (op.action == A_INS) {
-      std::string ak = arena_key(f.doc_id, op.obj);
+      u64 ak = akey_of(f.doc, op.obj);
       if (!b.arena_base.count(ak)) {
         b.arena_base.emplace(ak, -1);
-        b.arena_keys.emplace_back(f.doc_id, op.obj);
+        b.arena_keys.push_back(ak);
       }
     }
   }
-  for (auto& [doc_id, obj] : b.arena_keys) {
-    Arena& ar = pool.doc(doc_id).arenas[obj];
+  for (u64 ak : b.arena_keys) {
+    Arena& ar = b.bdocs[ak >> 32]->arenas[static_cast<u32>(ak)];
     for (u32 sid : ar.actor_sid) mark(sid);
   }
 
@@ -665,7 +695,7 @@ static void encode(Pool& pool, Batch& b) {
   if (inv_sids.empty()) inv_sids.push_back(in.id_of(""));
   std::sort(inv_sids.begin(), inv_sids.end(),
             [&](u32 a, u32 c) { return in.str(a) < in.str(c); });
-  b.rank_of.assign(in.strs.size(), -1);
+  b.rank_of.assign(in.size(), -1);
   b.rank_to_sid = inv_sids;
   for (size_t i = 0; i < inv_sids.size(); ++i)
     b.rank_of[inv_sids[i]] = static_cast<i32>(i);
@@ -683,12 +713,12 @@ static void encode(Pool& pool, Batch& b) {
 
   // cache the densified clock per (doc, actor, seq) change -- ops of one
   // change share it
-  std::unordered_map<std::string, std::vector<i32>> clock_cache;
+  std::unordered_map<K3, std::vector<i32>, K3Hash> clock_cache;
 
   // state rows
-  for (auto& [doc_id, obj, key] : gid_order) {
-    DocState& st = pool.doc(doc_id);
-    u32 gid = gid_map[gid_key(doc_id, obj, key)];
+  for (u32 gid = 0; gid < gid_order.size(); ++gid) {
+    auto [doc, obj, key] = gid_order[gid];
+    DocState& st = *b.bdocs[doc];
     auto rit = st.registers.find(DocState::rkey(obj, key));
     if (rit == st.registers.end()) continue;
     auto& recs = rit->second;
@@ -712,18 +742,15 @@ static void encode(Pool& pool, Batch& b) {
     auto& f = b.ops[op_idx];
     const OpRec& op = *f.op;
     if (!is_assign(op.action)) continue;
-    DocState& st = pool.doc(f.doc_id);
-    u32 gid = gid_map[gid_key(f.doc_id, op.obj, op.key)];
+    DocState& st = *b.bdocs[f.doc];
+    u32 gid = gid_map[K3{f.doc, op.obj, op.key}];
     b.assign_row_of_op[op_idx] = static_cast<i64>(b.g_col.size());
     b.g_col.push_back(static_cast<i32>(gid));
     b.t_col.push_back(static_cast<i32>(op_idx));
     b.a_col.push_back(b.rank_of[op.actor]);
     b.s_col.push_back(static_cast<i32>(op.seq));
     b.d_col.push_back(op.action == A_DEL ? 1 : 0);
-    std::string ck = f.doc_id;
-    ck.push_back('\x00');
-    ck.append(reinterpret_cast<const char*>(&op.actor), 4);
-    ck.append(reinterpret_cast<const char*>(&op.seq), 4);
+    K3 ck{f.doc, op.actor, op.seq};
     auto cit = clock_cache.find(ck);
     if (cit == clock_cache.end()) {
       std::vector<i32> row(b.Ap);
@@ -759,14 +786,11 @@ static void encode(Pool& pool, Batch& b) {
 
   // --- arena columns ------------------------------------------------------
   for (size_t k = 0; k < b.arena_keys.size(); ++k) {
-    auto& [doc_id, obj] = b.arena_keys[k];
-    Arena& ar = pool.doc(doc_id).arenas[obj];
+    u64 akey = b.arena_keys[k];
+    Arena& ar = b.bdocs[akey >> 32]->arenas[static_cast<u32>(akey)];
     if (static_cast<i64>(ar.ctr.size()) > b.max_arena_len)
       b.max_arena_len = static_cast<i64>(ar.ctr.size());
     i64 base = static_cast<i64>(b.obj_col.size());
-    std::string akey = doc_id;
-    akey.push_back('\x00');
-    akey.append(reinterpret_cast<const char*>(&obj), 4);
     b.arena_base[akey] = base;
     for (size_t i = 0; i < ar.ctr.size(); ++i) {
       b.obj_col.push_back(static_cast<i32>(k));
@@ -816,32 +840,25 @@ static bool rec_concurrent(DocState& st, const OpRec& o1, const OpRec& o2) {
 static void mid_phase(Pool& pool, Batch& b) {
   // overflow fallback: re-resolve whole groups with oracle semantics
   if (b.T > 0) {
-    std::unordered_map<std::string, char> overflowed;
-    auto gkey = [&](const std::string& doc, u32 obj, u32 key) {
-      std::string s = doc;
-      s.push_back('\x00');
-      s.append(reinterpret_cast<const char*>(&obj), 4);
-      s.append(reinterpret_cast<const char*>(&key), 4);
-      return s;
-    };
+    std::unordered_map<K3, char, K3Hash> overflowed;
     bool any = false;
     for (size_t op_idx = 0; op_idx < b.ops.size(); ++op_idx) {
       i64 row = b.assign_row_of_op[op_idx];
       if (row >= 0 && b.k_overflow[row]) {
         auto& f = b.ops[op_idx];
-        overflowed[gkey(f.doc_id, f.op->obj, f.op->key)] = 1;
+        overflowed[K3{f.doc, f.op->obj, f.op->key}] = 1;
         any = true;
       }
     }
     if (any) {
-      std::unordered_map<std::string, Register> scratch;
+      std::unordered_map<K3, Register, K3Hash> scratch;
       for (size_t op_idx = 0; op_idx < b.ops.size(); ++op_idx) {
         auto& f = b.ops[op_idx];
         const OpRec& op = *f.op;
         if (!is_assign(op.action)) continue;
-        std::string gk = gkey(f.doc_id, op.obj, op.key);
+        K3 gk{f.doc, op.obj, op.key};
         if (!overflowed.count(gk)) continue;
-        DocState& st = pool.doc(f.doc_id);
+        DocState& st = *b.bdocs[f.doc];
         auto sit = scratch.find(gk);
         if (sit == scratch.end()) {
           Register init;
@@ -867,26 +884,19 @@ static void mid_phase(Pool& pool, Batch& b) {
   }
 
   // per-object dominance timelines
-  std::unordered_map<std::string, std::vector<DomEntry>> obj_ops;
-  std::vector<std::string> obj_order;
+  std::unordered_map<u64, std::vector<DomEntry>> obj_ops;
+  std::vector<u64> obj_order;
   std::unordered_map<u64, char> vis_now;  // (arena base + eidx) -> bool
-
-  auto akey_of = [&](const std::string& doc, u32 obj) {
-    std::string s = doc;
-    s.push_back('\x00');
-    s.append(reinterpret_cast<const char*>(&obj), 4);
-    return s;
-  };
 
   for (size_t op_idx = 0; op_idx < b.ops.size(); ++op_idx) {
     i64 row = b.assign_row_of_op[op_idx];
     if (row < 0) continue;
     auto& f = b.ops[op_idx];
     const OpRec& op = *f.op;
-    DocState& st = pool.doc(f.doc_id);
+    DocState& st = *b.bdocs[f.doc];
     auto oit = st.objects.find(op.obj);
     if (oit == st.objects.end() || !is_list_type(oit->second.type)) continue;
-    std::string ak = akey_of(f.doc_id, op.obj);
+    u64 ak = (static_cast<u64>(f.doc) << 32) | op.obj;
     Arena& ar = st.arenas[op.obj];
     const std::string& kstr = pool.intern.str(op.key);
     u32 ea; i64 ec;
@@ -923,21 +933,12 @@ static void mid_phase(Pool& pool, Batch& b) {
 
   // size classes -> memory-bounded slabs (mirrors engine._dominance)
   const i64 K = 64;
-  std::map<std::pair<i64, i64>, std::vector<std::string>> classes;
-  for (auto& ak : obj_order) {
+  std::map<std::pair<i64, i64>, std::vector<u64>> classes;
+  for (u64 ak : obj_order) {
     auto& entries = obj_ops[ak];
     if (entries.empty()) continue;
-    // arena length
-    i64 n_elems = 0;
-    {
-      // decode akey back: find arena via stored base + lookup in arena_keys
-      // (arena_base stores base; length from pool)
-      size_t z = ak.find('\x00');
-      std::string doc = ak.substr(0, z);
-      u32 obj;
-      std::memcpy(&obj, ak.data() + z + 1, 4);
-      n_elems = static_cast<i64>(pool.doc(doc).arenas[obj].ctr.size());
-    }
+    Arena& ar = b.bdocs[ak >> 32]->arenas[static_cast<u32>(ak)];
+    i64 n_elems = static_cast<i64>(ar.ctr.size());
     i64 Lp = bucket(std::max<i64>(n_elems, 1));
     i64 Tp = bucket(static_cast<i64>(entries.size()), K);
     classes[{Lp, Tp}].push_back(ak);
@@ -961,13 +962,9 @@ static void mid_phase(Pool& pool, Batch& b) {
       blk.ov.assign(W * Tp, 0);
       size_t hi = std::min(aks.size(), s + W);
       for (size_t o = s; o < hi; ++o) {
-        const std::string& ak = aks[o];
+        u64 ak = aks[o];
         i64 base = b.arena_base[ak];
-        size_t z = ak.find('\x00');
-        std::string doc = ak.substr(0, z);
-        u32 obj;
-        std::memcpy(&obj, ak.data() + z + 1, 4);
-        Arena& ar = pool.doc(doc).arenas[obj];
+        Arena& ar = b.bdocs[ak >> 32]->arenas[static_cast<u32>(ak)];
         i64 row = static_cast<i64>(o - s);
         for (size_t i = 0; i < ar.ctr.size(); ++i) {
           blk.v0[row * Lp + i] = ar.visible[i] ? 1.0f : 0.0f;
@@ -980,7 +977,7 @@ static void mid_phase(Pool& pool, Batch& b) {
           blk.od[row * Tp + t] = entries[t].delta;
           blk.ov[row * Tp + t] = 1;
         }
-        blk.akeys.emplace_back(ak, 0);
+        blk.akeys.push_back(ak);
       }
       blk.indexes.assign(W * Tp, 0);
       b.dom_blocks.push_back(std::move(blk));
@@ -1004,7 +1001,7 @@ static void collect_indexes(Batch& b) {
   // map per-block kernel outputs back to op ids
   for (auto& blk : b.dom_blocks) {
     for (size_t o = 0; o < blk.akeys.size(); ++o) {
-      const std::string& ak = blk.akeys[o].first;
+      u64 ak = blk.akeys[o];
       auto& entries = b.obj_ops[ak];
       for (size_t t = 0; t < entries.size(); ++t) {
         b.list_index_of_op[entries[t].op_idx] = {
@@ -1035,8 +1032,8 @@ static void update_register_mirror(Pool& pool, DocState& st, const OpRec& op,
       if (o.action != A_LINK) continue;
       bool still = false;
       for (auto& n : new_register)
-        if (n.actor == o.actor && n.seq == o.seq && n.value == o.value &&
-            n.value_sid == o.value_sid) { still = true; break; }
+        if (n.actor == o.actor && n.seq == o.seq &&
+            n.value_rid == o.value_rid) { still = true; break; }
       if (still) continue;
       if (o.value_sid == NONE) continue;
       auto tit = st.objects.find(o.value_sid);
@@ -1124,7 +1121,7 @@ static void write_conflicts(Writer& w, Pool& pool, const Register& reg) {
     w.map(n);
     w.str("actor"); w.str(pool.intern.str(o.actor));
     w.str("value");
-    if (o.has_value) w.raw(o.value); else w.nil();
+    if (o.value_rid != NONE) w.raw(val_bytes(pool, o)); else w.nil();
     if (o.action == A_LINK) { w.str("link"); w.boolean(true); }
   }
 }
@@ -1155,7 +1152,8 @@ static void emit_map_diff(Writer& w, Pool& pool, DocState& st,
   w.str("key"); w.str(pool.intern.str(op.key));
   w.str("path"); write_path(w, pool, ok, path);
   w.str("value");
-  if (first.has_value) w.raw(first.value); else w.nil();
+  if (first.value_rid != NONE) w.raw(val_bytes(pool, first));
+  else w.nil();
   if (first.action == A_LINK) { w.str("link"); w.boolean(true); }
   if (first.datatype != NONE) {
     w.str("datatype"); w.str(pool.intern.str(first.datatype));
@@ -1217,7 +1215,8 @@ static bool emit_list_diff(Writer& w, Pool& pool, DocState& st,
   if (ins) { w.str("elemId"); w.str(kstr); }
   if (setlike) {
     w.str("value");
-    if (first->has_value) w.raw(first->value); else w.nil();
+    if (first->value_rid != NONE) w.raw(val_bytes(pool, *first));
+    else w.nil();
     if (first->action == A_LINK) { w.str("link"); w.boolean(true); }
     if (first->datatype != NONE) {
       w.str("datatype"); w.str(pool.intern.str(first->datatype));
@@ -1235,24 +1234,23 @@ static void write_clock(Writer& w, Pool& pool, const Clock& c) {
   }
 }
 
-static void emit(Pool& pool, Batch& b,
-                 const std::vector<std::string>& doc_ids) {
+static void emit(Pool& pool, Batch& b) {
   // diffs per doc, in op order
-  std::unordered_map<std::string, Writer> diff_bufs;
-  std::unordered_map<std::string, size_t> diff_counts;
+  std::vector<Writer> diff_bufs(b.bdoc_ids.size());
+  std::vector<size_t> diff_counts(b.bdoc_ids.size(), 0);
 
   for (size_t op_idx = 0; op_idx < b.ops.size(); ++op_idx) {
     auto& f = b.ops[op_idx];
     const OpRec& op = *f.op;
-    DocState& st = pool.doc(f.doc_id);
-    Writer& w = diff_bufs[f.doc_id];
+    DocState& st = *b.bdocs[f.doc];
+    Writer& w = diff_bufs[f.doc];
 
     if (op.action >= A_MAKE_MAP) {
       w.map(3);
       w.str("action"); w.str("create");
       w.str("obj"); w.str(pool.intern.str(op.obj));
       w.str("type"); w.str(type_name(make_type(op.action)));
-      diff_counts[f.doc_id]++;
+      diff_counts[f.doc]++;
       continue;
     }
     if (op.action == A_INS) continue;
@@ -1268,28 +1266,27 @@ static void emit(Pool& pool, Batch& b,
     if (is_list_type(obj_type)) {
       if (emit_list_diff(w, pool, st, op, reg, static_cast<i64>(op_idx), b,
                          obj_type))
-        diff_counts[f.doc_id]++;
+        diff_counts[f.doc]++;
     } else {
       emit_map_diff(w, pool, st, op, reg, obj_type);
-      diff_counts[f.doc_id]++;
+      diff_counts[f.doc]++;
     }
   }
 
   // assemble {doc_id: patch}
   Writer out;
-  out.map(doc_ids.size());
-  for (auto& doc_id : doc_ids) {
-    DocState& st = pool.doc(doc_id);
-    out.str(doc_id);
+  out.map(b.bdoc_ids.size());
+  for (size_t d = 0; d < b.bdoc_ids.size(); ++d) {
+    DocState& st = *b.bdocs[d];
+    out.str(b.bdoc_ids[d]);
     out.map(5);
     out.str("clock"); write_clock(out, pool, st.clock);
     out.str("deps"); write_clock(out, pool, st.deps);
     out.str("canUndo"); out.boolean(false);
     out.str("canRedo"); out.boolean(false);
     out.str("diffs");
-    out.array(diff_counts.count(doc_id) ? diff_counts[doc_id] : 0);
-    auto dit = diff_bufs.find(doc_id);
-    if (dit != diff_bufs.end()) out.raw(dit->second.buf);
+    out.array(diff_counts[d]);
+    out.raw(diff_bufs[d].buf);
   }
   b.result = std::move(out.buf);
 }
@@ -1330,12 +1327,13 @@ static void materialize_value(Pool& pool, DocState& st, const OpRec& rec,
   if (rec.action == A_LINK && rec.value_sid != NONE) {
     materialize(pool, st, rec.value_sid, diffs, count, seen);
     own.str("value");
-    own.raw(rec.value);
+    own.raw(val_bytes(pool, rec));
     own.str("link"); own.boolean(true);
     extra_keys = 1;
   } else {
     own.str("value");
-    if (rec.has_value) own.raw(rec.value); else own.nil();
+    if (rec.value_rid != NONE) own.raw(val_bytes(pool, rec));
+    else own.nil();
     if (rec.datatype != NONE) {
       own.str("datatype"); own.str(pool.intern.str(rec.datatype));
       extra_keys = 1;
@@ -1441,7 +1439,6 @@ using namespace amtpu;
 struct BatchHandle {
   Pool* pool;
   Batch batch;
-  std::vector<std::string> doc_ids;
 };
 
 static thread_local std::string g_error;
@@ -1465,7 +1462,8 @@ void* amtpu_begin(void* pool_ptr, const uint8_t* data, int64_t len) {
   try {
     Reader r(data, static_cast<size_t>(len));
     size_t n_docs = r.read_map();
-    std::vector<std::pair<std::string, std::vector<ChangeRec>>> incoming;
+    Batch& b = h->batch;
+    std::vector<std::vector<ChangeRec>> incoming;
     incoming.reserve(n_docs);
     for (size_t i = 0; i < n_docs; ++i) {
       std::string doc_id = r.read_str();
@@ -1473,9 +1471,10 @@ void* amtpu_begin(void* pool_ptr, const uint8_t* data, int64_t len) {
       std::vector<ChangeRec> chs;
       chs.reserve(n_changes);
       for (size_t j = 0; j < n_changes; ++j)
-        chs.push_back(decode_change(r, pool.intern));
-      h->doc_ids.push_back(doc_id);
-      incoming.emplace_back(std::move(doc_id), std::move(chs));
+        chs.push_back(decode_change(r, pool));
+      b.bdocs.push_back(&pool.doc(doc_id));
+      b.bdoc_ids.push_back(std::move(doc_id));
+      incoming.push_back(std::move(chs));
     }
     schedule(pool, h->batch, incoming);
     update_states(pool, h->batch);
@@ -1523,7 +1522,7 @@ const int32_t* amtpu_col_linsort(void* bp) { return static_cast<BatchHandle*>(bp
 // feed register kernel outputs ([Tp] / [Tp, window]) and rank [Lp];
 // computes overflow fallbacks + dominance blocks
 int amtpu_mid(void* bp, const int32_t* winner, const int32_t* conflicts,
-              int window, const int32_t* alive, const uint8_t* visible,
+              int window, const int32_t* alive,
               const uint8_t* overflow, const int32_t* rank) {
   BatchHandle& h = *static_cast<BatchHandle*>(bp);
   Batch& b = h.batch;
@@ -1533,7 +1532,6 @@ int amtpu_mid(void* bp, const int32_t* winner, const int32_t* conflicts,
       b.k_winner.assign(winner, winner + b.Tp);
       b.k_conflicts.assign(conflicts, conflicts + b.Tp * window);
       b.k_alive.assign(alive, alive + b.Tp);
-      b.k_visible.assign(visible, visible + b.Tp);
       b.k_overflow.assign(overflow, overflow + b.Tp);
     }
     if (b.Lp > 0) b.rank.assign(rank, rank + b.Lp);
@@ -1569,7 +1567,7 @@ int amtpu_finish(void* bp) {
   BatchHandle& h = *static_cast<BatchHandle*>(bp);
   try {
     collect_indexes(h.batch);
-    emit(*h.pool, h.batch, h.doc_ids);
+    emit(*h.pool, h.batch);
   } catch (const Error& e) {
     g_error = e.what(); g_error_kind = e.kind;
     return -1;
@@ -1696,6 +1694,73 @@ uint8_t* amtpu_get_missing_changes(void* pool_ptr, const char* doc_id,
 }
 
 void amtpu_buf_free(uint8_t* p) { std::free(p); }
+
+// ---- payload sharding -----------------------------------------------------
+// Splits a {doc_id: [changes]} payload into n_shards sub-payloads by doc-id
+// hash WITHOUT decoding the change bodies (values are copied as raw spans).
+// The hash (FNV-1a over the doc-id string, mod n_shards) is mirrored in
+// automerge_tpu/native/__init__.py for query routing -- keep in sync.
+
+struct ShardSplit {
+  std::vector<std::vector<uint8_t>> bufs;
+};
+
+uint32_t amtpu_doc_shard(const char* doc_id, int64_t len, int n_shards) {
+  if (n_shards < 1) n_shards = 1;
+  uint32_t h = 2166136261u;
+  for (int64_t i = 0; i < len; ++i) {
+    h ^= static_cast<uint8_t>(doc_id[i]);
+    h *= 16777619u;
+  }
+  return h % static_cast<uint32_t>(n_shards);
+}
+
+void* amtpu_shard_split(const uint8_t* data, int64_t len, int n_shards) {
+  if (n_shards < 1) {
+    g_error = "n_shards must be >= 1"; g_error_kind = 0;
+    return nullptr;
+  }
+  try {
+    Reader r(data, static_cast<size_t>(len));
+    size_t n_docs = r.read_map();
+    std::vector<Writer> writers(n_shards);
+    std::vector<size_t> counts(n_shards, 0);
+    std::vector<std::vector<std::pair<const uint8_t*, size_t>>> spans(
+        n_shards);
+    for (size_t i = 0; i < n_docs; ++i) {
+      auto kspan = r.raw_value();   // doc id (str)
+      Reader kr(kspan.first, kspan.second);
+      std::string key = kr.read_str();
+      auto vspan = r.raw_value();   // change array
+      int s = static_cast<int>(
+          amtpu_doc_shard(key.data(), static_cast<int64_t>(key.size()),
+                          n_shards));
+      spans[s].emplace_back(kspan.first,
+                            kspan.second + vspan.second);
+      counts[s]++;
+    }
+    auto out = std::make_unique<ShardSplit>();
+    out->bufs.resize(n_shards);
+    for (int s = 0; s < n_shards; ++s) {
+      Writer w;
+      w.map(counts[s]);
+      for (auto& sp : spans[s]) w.raw(sp.first, sp.second);
+      out->bufs[s] = std::move(w.buf);
+    }
+    return out.release();
+  } catch (const std::exception& e) {
+    g_error = e.what(); g_error_kind = 0;
+    return nullptr;
+  }
+}
+
+const uint8_t* amtpu_shard_buf(void* sp, int shard, int64_t* len) {
+  ShardSplit& s = *static_cast<ShardSplit*>(sp);
+  *len = static_cast<int64_t>(s.bufs[shard].size());
+  return s.bufs[shard].data();
+}
+
+void amtpu_shard_free(void* sp) { delete static_cast<ShardSplit*>(sp); }
 
 }  // extern "C"
 

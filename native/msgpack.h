@@ -14,6 +14,7 @@
 #include <cstring>
 #include <stdexcept>
 #include <string>
+#include <string_view>
 #include <vector>
 
 namespace amtpu {
@@ -95,7 +96,10 @@ class Reader {
     throw MsgpackError("expected float");
   }
 
-  std::string read_str() {
+  std::string read_str() { return std::string(read_str_view()); }
+
+  // zero-copy view into the input buffer (valid while the buffer lives)
+  std::string_view read_str_view() {
     uint8_t b = next();
     size_t n;
     if ((b & 0xe0) == 0xa0) n = b & 0x1f;
@@ -104,7 +108,7 @@ class Reader {
     else if (b == 0xdb) n = u32();
     else throw MsgpackError("expected str");
     need(n);
-    std::string s(reinterpret_cast<const char*>(p_), n);
+    std::string_view s(reinterpret_cast<const char*>(p_), n);
     p_ += n;
     return s;
   }
@@ -138,7 +142,7 @@ class Reader {
       case Type::Bool: ++p_; break;
       case Type::Int: read_int(); break;
       case Type::Float: read_float(); break;
-      case Type::Str: read_str(); break;
+      case Type::Str: read_str_view(); break;
       case Type::Bin: {
         uint8_t b = next();
         size_t n = (b == 0xc4) ? u8() : (b == 0xc5) ? u16() : u32();
@@ -250,6 +254,9 @@ class Writer {
   // verbatim splice of a previously captured raw value
   void raw(const uint8_t* data, size_t n) { append(data, n); }
   void raw(const std::vector<uint8_t>& v) { append(v.data(), v.size()); }
+  void raw(const std::string& v) {
+    append(reinterpret_cast<const uint8_t*>(v.data()), v.size());
+  }
 
  private:
   void append(const uint8_t* d, size_t n) { buf.insert(buf.end(), d, d + n); }

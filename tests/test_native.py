@@ -198,6 +198,62 @@ class TestNativeLists:
         deliver_and_compare([{d: [batches[d]] for d in range(4)}], n_docs=4)
 
 
+class TestShardedPool:
+    def _mk(self, n_shards=3):
+        from automerge_tpu.native import ShardedNativePool
+        return ShardedNativePool(n_shards)
+
+    def test_parity_with_single_pool_many_docs(self):
+        # >15 docs forces the byte-level merge across the fixmap/map16
+        # header boundary; doc set spans all shards
+        from automerge_tpu.native import NativeDocPool
+        batch = {}
+        for d in range(20):
+            tid = 'text-%d' % d
+            batch['doc-%d' % d] = [{'actor': 'a', 'seq': 1, 'deps': {},
+                                    'ops': [
+                {'action': 'makeText', 'obj': tid},
+                {'action': 'ins', 'obj': tid, 'key': '_head', 'elem': 1},
+                {'action': 'set', 'obj': tid, 'key': 'a:1',
+                 'value': chr(97 + d % 26)},
+                {'action': 'link', 'obj': ROOT_ID, 'key': 'text',
+                 'value': tid}]}]
+        single = NativeDocPool()
+        sharded = self._mk(3)
+        want = single.apply_batch(batch)
+        got = sharded.apply_batch(batch)
+        assert got == want
+        for d in batch:
+            assert sharded.get_patch(d) == single.get_patch(d)
+            assert sharded.get_missing_deps(d) == {}
+
+    def test_int_doc_ids_route_consistently(self):
+        sharded = self._mk(4)
+        sharded.apply_changes(7, [{'actor': 'a', 'seq': 1, 'deps': {},
+                                   'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'k', 'value': 1}]}])
+        assert sharded.get_patch(7)['clock'] == {'a': 1}
+
+    def test_empty_payload(self):
+        import msgpack
+        sharded = self._mk(2)
+        out = sharded.apply_batch_bytes(msgpack.packb({}))
+        assert msgpack.unpackb(out, raw=False) == {}
+
+    def test_invalid_shard_count(self):
+        from automerge_tpu.native import ShardedNativePool
+        with pytest.raises(ValueError):
+            ShardedNativePool(0)
+
+    def test_python_cpp_routing_parity(self):
+        from automerge_tpu.native import lib
+        sharded = self._mk(5)
+        for d in ('a', 'doc-42', 'i:7', 'long-document-name-xyz'):
+            key = d.encode()
+            assert sharded._shard_of(d) == \
+                int(lib().amtpu_doc_shard(key, len(key), 5))
+
+
 class TestNativeRandomWorkloads:
     @pytest.mark.parametrize('seed,structure', [
         (1, 'map'), (3, 'list'), (5, 'mixed'), (6, 'mixed'),
