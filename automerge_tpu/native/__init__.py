@@ -299,7 +299,7 @@ class NativeDocPool:
             sort_idx=si))
 
     def _run_dominance(self, L, bh):
-        from ..ops import list_rank
+        from ..ops.pallas_dominance import dominance_grouped_auto
         dims = (ctypes.c_int64 * 7)()
         L.amtpu_batch_dims(bh, dims)
         n_blocks = int(dims[6])
@@ -319,7 +319,7 @@ class NativeDocPool:
                                        shape=(W, Tp))
             ov = np.ctypeslib.as_array(L.amtpu_dom_ov(bh, blk),
                                        shape=(W, Tp))
-            idx = np.ascontiguousarray(np.asarray(list_rank.dominance_grouped(
+            idx = np.ascontiguousarray(np.asarray(dominance_grouped_auto(
                 v0, er, oe, orank, od, ov.astype(bool),
                 chunk=64)), np.int32)
             L.amtpu_dom_set_indexes(

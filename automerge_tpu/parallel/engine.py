@@ -619,6 +619,7 @@ class TPUDocPool:
         skewed batches while jit compile caches across calls.
 
         Returns {op_idx: (index, register_row)}."""
+        from ..ops.pallas_dominance import dominance_grouped_auto
         K = self._DOM_CHUNK
         classes = {}   # (Lp, Tp) -> [akey]
         for akey, entries in obj_ops.items():
@@ -658,7 +659,7 @@ class TPUDocPool:
                         orank[o, t] = rank[base + eidx]
                         od[o, t] = delta
                         ov[o, t] = True
-                idxs = np.asarray(list_rank.dominance_grouped(
+                idxs = np.asarray(dominance_grouped_auto(
                     v0, er, oe, orank, od, ov, chunk=K))
                 for o, akey in enumerate(slab):
                     for t, (op_idx, row, _e, _d) in enumerate(obj_ops[akey]):

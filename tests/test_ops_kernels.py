@@ -348,3 +348,55 @@ class TestRegisters:
         assert np.asarray(out['alive_after']).tolist() == [1, 2]
         assert np.asarray(out['winner']).tolist() == [0, 0]  # B's op index 0
         assert np.asarray(out['conflicts'])[1, 0] == 1       # A's op loses
+
+
+class TestPallasDominance:
+    """The Pallas TPU kernel must equal the XLA kernel bit-for-bit; on the
+    CPU test mesh it runs through the Pallas interpreter."""
+
+    def _random_case(self, seed, W=8, L=128, T=128):
+        rng = random.Random(seed)
+        v0 = np.zeros((W, L), np.float32)
+        er = np.full((W, L), -1, np.int32)
+        oe = np.full((W, T), -1, np.int32)
+        orank = np.full((W, T), -1, np.int32)
+        od = np.zeros((W, T), np.int32)
+        ov = np.zeros((W, T), bool)
+        for o in range(W):
+            n = rng.randint(1, L)
+            t = rng.randint(1, T)
+            ranks = list(range(n))
+            rng.shuffle(ranks)
+            er[o, :n] = ranks
+            v0[o, :n] = [rng.random() < 0.5 for _ in range(n)]
+            for k in range(t):
+                e = rng.randrange(n)
+                oe[o, k] = e
+                orank[o, k] = er[o, e]
+                od[o, k] = rng.choice([-1, 0, 1])
+                ov[o, k] = True
+        return v0, er, oe, orank, od, ov
+
+    @pytest.mark.parametrize('seed,W', [(3, 8), (4, 8), (5, 24)])
+    def test_interpreter_matches_xla(self, seed, W):
+        # W=24 covers grid > 1: per-program VMEM scratch re-init
+        from automerge_tpu.ops.list_rank import dominance_grouped
+        from automerge_tpu.ops.pallas_dominance import \
+            dominance_grouped_pallas
+        args = self._random_case(seed, W=W)
+        want = np.asarray(dominance_grouped(*args, chunk=128))
+        got = np.asarray(dominance_grouped_pallas(*args, chunk=128,
+                                                  interpret=True))
+        ov = args[-1]
+        assert (got[ov] == want[ov]).all()
+
+    def test_auto_dispatch_fallback(self):
+        # off-TPU the dispatcher must route to the XLA kernel
+        from automerge_tpu.ops.list_rank import dominance_grouped
+        from automerge_tpu.ops.pallas_dominance import \
+            dominance_grouped_auto
+        args = self._random_case(9, W=4, L=48, T=64)
+        want = np.asarray(dominance_grouped(*args, chunk=64))
+        got = np.asarray(dominance_grouped_auto(*args, chunk=64))
+        ov = args[-1]
+        assert (got[ov] == want[ov]).all()
