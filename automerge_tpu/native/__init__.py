@@ -89,6 +89,10 @@ def _load():
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64,
         ctypes.POINTER(ctypes.c_int64)]
     lib.amtpu_buf_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+    lib.amtpu_get_register.restype = ctypes.POINTER(ctypes.c_uint8)
+    lib.amtpu_get_register.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64)]
     lib.amtpu_doc_shard.restype = ctypes.c_uint32
     lib.amtpu_doc_shard.argtypes = [ctypes.c_char_p, ctypes.c_int64,
                                     ctypes.c_int]
@@ -365,6 +369,17 @@ class NativeDocPool:
             _raise_last()
         return msgpack.unpackb(_take_buf(ptr, out_len.value), raw=False)
 
+    def get_register(self, doc_id, obj, key):
+        """Current field ops of one (obj, key), winner first -- the
+        Backend.getFieldOps query undo/redo capture reads."""
+        out_len = ctypes.c_int64()
+        ptr = lib().amtpu_get_register(
+            self._pool, self._doc_key(doc_id).encode(), obj.encode(),
+            key.encode(), ctypes.byref(out_len))
+        if not ptr:
+            _raise_last()
+        return msgpack.unpackb(_take_buf(ptr, out_len.value), raw=False)
+
 
 class ShardedNativePool:
     """S independent native pools driven by S threads.
@@ -456,3 +471,7 @@ class ShardedNativePool:
     def get_missing_changes(self, doc_id, have_deps):
         return self.pools[self._shard_of(doc_id)].get_missing_changes(
             doc_id, have_deps)
+
+    def get_register(self, doc_id, obj, key):
+        return self.pools[self._shard_of(doc_id)].get_register(
+            doc_id, obj, key)

@@ -115,12 +115,17 @@ def _on_tpu():
         return False
 
 
-# dispatch decision cached once per process (the platform does not change)
+# platform cached once per process; the AMTPU_NO_PALLAS kill switch is
+# re-read per call so it works whenever it is set
 @functools.lru_cache(maxsize=1)
+def _on_tpu_cached():
+    return _on_tpu()
+
+
 def _use_pallas():
     if os.environ.get('AMTPU_NO_PALLAS'):
         return False
-    return _on_tpu()
+    return _on_tpu_cached()
 
 
 def dominance_grouped_auto(vis0, elem_rank, op_elem, op_rank, op_delta,

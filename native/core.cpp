@@ -1695,6 +1695,52 @@ uint8_t* amtpu_get_missing_changes(void* pool_ptr, const char* doc_id,
 
 void amtpu_buf_free(uint8_t* p) { std::free(p); }
 
+// current register (field ops) of one (doc, obj, key): msgpack array of
+// {action, obj, key, value?, datatype?, actor, seq} records, winner first.
+// This is the Backend.getFieldOps query the undo/redo machinery needs
+// (reference capture: op_set.js:193-200; redo build: backend/index.js:264-278)
+uint8_t* amtpu_get_register(void* pool_ptr, const char* doc_id,
+                            const char* obj, const char* key,
+                            int64_t* len) {
+  Pool& pool = *static_cast<Pool*>(pool_ptr);
+  try {
+    DocState& st = pool.doc(doc_id);
+    u32 obj_sid = pool.intern.id_of(obj);
+    u32 key_sid = pool.intern.id_of(key);
+    Writer out;
+    auto rit = st.registers.find(DocState::rkey(obj_sid, key_sid));
+    if (rit == st.registers.end()) {
+      out.array(0);
+    } else {
+      out.array(rit->second.size());
+      for (const OpRec& o : rit->second) {
+        size_t n = 5 + (o.value_rid != NONE ? 1 : 0) +
+                   (o.datatype != NONE ? 1 : 0);
+        out.map(n);
+        out.str("action"); out.str(action_name(o.action));
+        out.str("obj"); out.str(pool.intern.str(o.obj));
+        out.str("key"); out.str(pool.intern.str(o.key));
+        if (o.value_rid != NONE) {
+          out.str("value"); out.raw(val_bytes(pool, o));
+        }
+        if (o.datatype != NONE) {
+          out.str("datatype"); out.str(pool.intern.str(o.datatype));
+        }
+        out.str("actor"); out.str(pool.intern.str(o.actor));
+        out.str("seq"); out.integer(o.seq);
+      }
+    }
+    *len = static_cast<int64_t>(out.buf.size());
+    uint8_t* res = static_cast<uint8_t*>(std::malloc(out.buf.size()));
+    std::memcpy(res, out.buf.data(), out.buf.size());
+    return res;
+  } catch (const std::exception& e) {
+    g_error = e.what(); g_error_kind = 0;
+    *len = -1;
+    return nullptr;
+  }
+}
+
 // ---- payload sharding -----------------------------------------------------
 // Splits a {doc_id: [changes]} payload into n_shards sub-payloads by doc-id
 // hash WITHOUT decoding the change bodies (values are copied as raw spans).

@@ -96,6 +96,77 @@ def test_apply_local_change():
         assert shipped and all('requestType' not in ch for ch in shipped)
 
 
+def _local_request(actor, seq, ops, request_type='change', deps=None):
+    return {'requestType': request_type, 'actor': actor, 'seq': seq,
+            'deps': deps or {}, 'ops': ops}
+
+
+class TestUndoRedo:
+    """Sidecar undo/redo must match the scalar backend patch-for-patch
+    for the same local-change request stream (backend/index.js:254-310)."""
+
+    def _run_stream(self, requests):
+        from automerge_tpu.sidecar.server import SidecarBackend
+        side = SidecarBackend()
+        st = Backend.init()
+        for req in requests:
+            st, want = Backend.apply_local_change(st, dict(req))
+            got = side.apply_local_change('d', dict(req))
+            assert got == want, '\nreq  %r\ngot  %r\nwant %r' % (
+                req, got, want)
+        return side, st
+
+    def test_set_undo_redo_round_trip(self):
+        self._run_stream([
+            _local_request('a', 1, [{'action': 'set', 'obj': ROOT_ID,
+                                     'key': 'k', 'value': 'v1'}]),
+            _local_request('a', 2, [{'action': 'set', 'obj': ROOT_ID,
+                                     'key': 'k', 'value': 'v2'}]),
+            _local_request('a', 3, [], 'undo'),
+            _local_request('a', 4, [], 'redo'),
+            _local_request('a', 5, [], 'undo'),
+            _local_request('a', 6, [], 'undo'),
+        ])
+
+    def test_undo_del_restores(self):
+        self._run_stream([
+            _local_request('a', 1, [{'action': 'set', 'obj': ROOT_ID,
+                                     'key': 'bird', 'value': 'magpie'}]),
+            _local_request('a', 2, [{'action': 'del', 'obj': ROOT_ID,
+                                     'key': 'bird'}]),
+            _local_request('a', 3, [], 'undo'),   # bird back to magpie
+        ])
+
+    def test_new_change_clears_redo(self):
+        side, st = self._run_stream([
+            _local_request('a', 1, [{'action': 'set', 'obj': ROOT_ID,
+                                     'key': 'k', 'value': 1}]),
+            _local_request('a', 2, [], 'undo'),
+            _local_request('a', 3, [{'action': 'set', 'obj': ROOT_ID,
+                                     'key': 'k', 'value': 2}]),
+        ])
+        with pytest.raises(RangeError):
+            side.apply_local_change('d', _local_request('a', 4, [], 'redo'))
+
+    def test_undo_empty_raises(self):
+        from automerge_tpu.sidecar.server import SidecarBackend
+        side = SidecarBackend()
+        with pytest.raises(RangeError):
+            side.apply_local_change('d', _local_request('a', 1, [], 'undo'))
+
+    def test_timestamp_datatype_redo(self):
+        self._run_stream([
+            _local_request('a', 1, [{'action': 'set', 'obj': ROOT_ID,
+                                     'key': 't', 'value': 123456,
+                                     'datatype': 'timestamp'}]),
+            _local_request('a', 2, [{'action': 'del', 'obj': ROOT_ID,
+                                     'key': 't'}]),
+            _local_request('a', 3, [], 'undo'),
+            _local_request('a', 4, [], 'undo'),
+            _local_request('a', 5, [], 'redo'),
+        ])
+
+
 def test_unix_socket():
     path = os.path.join(tempfile.mkdtemp(), 'amtpu.sock')
     env = dict(os.environ, PYTHONPATH=REPO)
