@@ -93,6 +93,10 @@ def _load():
     lib.amtpu_get_register.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
         ctypes.POINTER(ctypes.c_int64)]
+    lib.amtpu_get_changes_for_actor.restype = ctypes.POINTER(ctypes.c_uint8)
+    lib.amtpu_get_changes_for_actor.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64)]
     lib.amtpu_doc_shard.restype = ctypes.c_uint32
     lib.amtpu_doc_shard.argtypes = [ctypes.c_char_p, ctypes.c_int64,
                                     ctypes.c_int]
@@ -380,6 +384,16 @@ class NativeDocPool:
             _raise_last()
         return msgpack.unpackb(_take_buf(ptr, out_len.value), raw=False)
 
+    def get_changes_for_actor(self, doc_id, actor, after_seq=0):
+        """(parity: op_set.js:347-357)"""
+        out_len = ctypes.c_int64()
+        ptr = lib().amtpu_get_changes_for_actor(
+            self._pool, self._doc_key(doc_id).encode(), actor.encode(),
+            after_seq, ctypes.byref(out_len))
+        if not ptr:
+            _raise_last()
+        return msgpack.unpackb(_take_buf(ptr, out_len.value), raw=False)
+
 
 class ShardedNativePool:
     """S independent native pools driven by S threads.
@@ -475,3 +489,7 @@ class ShardedNativePool:
     def get_register(self, doc_id, obj, key):
         return self.pools[self._shard_of(doc_id)].get_register(
             doc_id, obj, key)
+
+    def get_changes_for_actor(self, doc_id, actor, after_seq=0):
+        return self.pools[self._shard_of(doc_id)].get_changes_for_actor(
+            doc_id, actor, after_seq)

@@ -185,6 +185,9 @@ class SidecarBackend:
     def get_missing_changes(self, doc, have_deps):
         return self.pool.get_missing_changes(doc, have_deps)
 
+    def get_changes_for_actor(self, doc, actor, after_seq=0):
+        return self.pool.get_changes_for_actor(doc, actor, after_seq)
+
     # -- dispatch -------------------------------------------------------
 
     def handle(self, req):
@@ -206,6 +209,9 @@ class SidecarBackend:
             elif cmd == 'get_missing_changes':
                 result = self.get_missing_changes(req['doc'],
                                                   req.get('have_deps', {}))
+            elif cmd == 'get_changes_for_actor':
+                result = self.get_changes_for_actor(
+                    req['doc'], req['actor'], req.get('after_seq', 0))
             else:
                 raise RangeError('Unknown command: %r' % (cmd,))
             return {'id': rid, 'result': result}

@@ -1695,6 +1695,36 @@ uint8_t* amtpu_get_missing_changes(void* pool_ptr, const char* doc_id,
 
 void amtpu_buf_free(uint8_t* p) { std::free(p); }
 
+// all changes authored by one actor after a given seq: msgpack array of
+// raw changes (reference: op_set.js:347-357)
+uint8_t* amtpu_get_changes_for_actor(void* pool_ptr, const char* doc_id,
+                                     const char* actor, int64_t after_seq,
+                                     int64_t* len) {
+  Pool& pool = *static_cast<Pool*>(pool_ptr);
+  try {
+    DocState& st = pool.doc(doc_id);
+    u32 actor_sid = pool.intern.id_of(actor);
+    Writer out;
+    auto it = st.states.find(actor_sid);
+    size_t from = static_cast<size_t>(std::max<int64_t>(after_seq, 0));
+    if (it == st.states.end() || from >= it->second.size()) {
+      out.array(0);
+    } else {
+      out.array(it->second.size() - from);
+      for (size_t i = from; i < it->second.size(); ++i)
+        out.raw(it->second[i].change.raw);
+    }
+    *len = static_cast<int64_t>(out.buf.size());
+    uint8_t* res = static_cast<uint8_t*>(std::malloc(out.buf.size()));
+    std::memcpy(res, out.buf.data(), out.buf.size());
+    return res;
+  } catch (const std::exception& e) {
+    g_error = e.what(); g_error_kind = 0;
+    *len = -1;
+    return nullptr;
+  }
+}
+
 // current register (field ops) of one (doc, obj, key): msgpack array of
 // {action, obj, key, value?, datatype?, actor, seq} records, winner first.
 // This is the Backend.getFieldOps query the undo/redo machinery needs

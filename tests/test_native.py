@@ -121,6 +121,25 @@ class TestNativeBasics:
                 {'action': 'set', 'obj': ROOT_ID, 'key': 'k',
                  'value': 999}]}])
 
+    def test_get_changes_for_actor(self):
+        nat = native_pool()
+        st = Backend.init()
+        chs = [
+            {'actor': 'a', 'seq': 1, 'deps': {}, 'ops': [
+                {'action': 'set', 'obj': ROOT_ID, 'key': 'k', 'value': 1}]},
+            {'actor': 'a', 'seq': 2, 'deps': {}, 'ops': [
+                {'action': 'set', 'obj': ROOT_ID, 'key': 'k', 'value': 2}]},
+            {'actor': 'b', 'seq': 1, 'deps': {}, 'ops': [
+                {'action': 'set', 'obj': ROOT_ID, 'key': 'j', 'value': 3}]},
+        ]
+        st, _ = Backend.apply_changes(st, chs)
+        nat.apply_changes(0, chs)
+        for actor, after in (('a', 0), ('a', 1), ('b', 0), ('zz', 0)):
+            got = nat.get_changes_for_actor(0, actor, after)
+            want = [dict(c) for c in chs
+                    if c['actor'] == actor and c['seq'] > after]
+            assert got == want, (actor, after, got)
+
     def test_get_missing_changes(self):
         nat = native_pool()
         st = Backend.init()
