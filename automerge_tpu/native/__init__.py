@@ -45,7 +45,7 @@ def _load():
     lib.amtpu_batch_free.argtypes = [ctypes.c_void_p]
     lib.amtpu_batch_dims.argtypes = [ctypes.c_void_p,
                                      ctypes.POINTER(ctypes.c_int64)]
-    for name in ('g', 't', 'a', 's', 'clock', 'sort',
+    for name in ('g', 't', 'a', 's', 'clocktab', 'clockidx', 'sort',
                  'obj', 'par', 'ctr', 'act', 'linsort'):
         fn = getattr(lib, 'amtpu_col_' + name)
         fn.restype = ctypes.POINTER(ctypes.c_int32)
@@ -184,6 +184,9 @@ class NativeDocPool:
 
     #: window width of the register kernel (ops/registers.WINDOW)
     WINDOW = 8
+    #: entries amtpu_batch_dims writes -- must match core.cpp exactly
+    #: (an undersized ctypes buffer is silent heap corruption)
+    N_DIMS = 9
 
     def __init__(self):
         self._pool = lib().amtpu_pool_new()
@@ -202,13 +205,13 @@ class NativeDocPool:
         if not bh:
             _raise_last()
         try:
-            dims = (ctypes.c_int64 * 8)()
+            dims = (ctypes.c_int64 * self.N_DIMS)()
             L.amtpu_batch_dims(bh, dims)
-            T, Tp, A, Ap, Larena, Lp, n_blocks, max_obj = \
+            T, Tp, A, Ap, Larena, Lp, n_blocks, max_obj, CTp = \
                 [int(x) for x in dims]
 
-            reg_out = self._run_register_kernel(L, bh, Tp, Ap)
-            rank = self._run_linearize(L, bh, Lp, max_obj)
+            reg_out, rank = self._run_resolver(L, bh, Tp, Ap, CTp, Lp,
+                                               max_obj)
 
             if Tp > 0:
                 winner, conflicts, alive, overflow = \
@@ -243,22 +246,52 @@ class NativeDocPool:
 
     # -- kernel dispatch ------------------------------------------------
 
-    def _run_register_kernel(self, L, bh, Tp, Ap):
-        if Tp == 0:
-            return None
-        from ..ops import registers as register_ops
-        g = np.ctypeslib.as_array(L.amtpu_col_g(bh), shape=(Tp,))
-        t = np.ctypeslib.as_array(L.amtpu_col_t(bh), shape=(Tp,))
-        a = np.ctypeslib.as_array(L.amtpu_col_a(bh), shape=(Tp,))
-        s = np.ctypeslib.as_array(L.amtpu_col_s(bh), shape=(Tp,))
-        d = np.ctypeslib.as_array(L.amtpu_col_d(bh), shape=(Tp,))
-        c = np.ctypeslib.as_array(L.amtpu_col_clock(bh), shape=(Tp, Ap))
-        si = np.ctypeslib.as_array(L.amtpu_col_sort(bh), shape=(Tp,))
-        # device arrays; transfers happen selectively in
-        # _unpack_register_out
-        return register_ops.resolve_registers(
-            g, t, a, s, c, d.astype(bool), np.ones((Tp,), bool),
-            window=self.WINDOW, sort_idx=si)
+    def _run_resolver(self, L, bh, Tp, Ap, CTp, Lp, max_obj_len):
+        """Register resolution + linearization, fused into one dispatch
+        when both are needed (halves blocking round trips on the
+        high-latency device link).  Returns (reg_out device dict | None,
+        rank np.int32 [Lp])."""
+        from ..ops import list_rank, registers as register_ops
+        if Tp > 0:
+            g = np.ctypeslib.as_array(L.amtpu_col_g(bh), shape=(Tp,))
+            t = np.ctypeslib.as_array(L.amtpu_col_t(bh), shape=(Tp,))
+            a = np.ctypeslib.as_array(L.amtpu_col_a(bh), shape=(Tp,))
+            s = np.ctypeslib.as_array(L.amtpu_col_s(bh), shape=(Tp,))
+            d = np.ctypeslib.as_array(L.amtpu_col_d(bh), shape=(Tp,))
+            ctab = np.ctypeslib.as_array(L.amtpu_col_clocktab(bh),
+                                         shape=(CTp, Ap))
+            cidx = np.ctypeslib.as_array(L.amtpu_col_clockidx(bh),
+                                         shape=(Tp,))
+            si = np.ctypeslib.as_array(L.amtpu_col_sort(bh), shape=(Tp,))
+        if Lp > 0:
+            obj = np.ctypeslib.as_array(L.amtpu_col_obj(bh), shape=(Lp,))
+            par = np.ctypeslib.as_array(L.amtpu_col_par(bh), shape=(Lp,))
+            ctr = np.ctypeslib.as_array(L.amtpu_col_ctr(bh), shape=(Lp,))
+            act = np.ctypeslib.as_array(L.amtpu_col_act(bh), shape=(Lp,))
+            val = np.ctypeslib.as_array(L.amtpu_col_val(bh), shape=(Lp,))
+            lsi = np.ctypeslib.as_array(L.amtpu_col_linsort(bh),
+                                        shape=(Lp,))
+            # doubling depth: DFS chains never cross objects
+            n_iters = list_rank.ceil_log2(max(max_obj_len, 1)) + 1
+        if Tp > 0 and Lp > 0:
+            reg_out, rank = register_ops.resolve_and_rank(
+                g, t, a, s, ctab, cidx, d.astype(bool),
+                np.ones((Tp,), bool), si,
+                obj, par, ctr, act, val.astype(bool), lsi, n_iters,
+                window=self.WINDOW)
+            return reg_out, np.asarray(rank)
+        if Tp > 0:
+            reg_out = register_ops.resolve_registers(
+                g, t, a, s, is_del=d.astype(bool),
+                alive_in=np.ones((Tp,), bool), window=self.WINDOW,
+                sort_idx=si, clock_table=ctab, clock_idx=cidx)
+            return reg_out, np.zeros((0,), np.int32)
+        if Lp > 0:
+            rank = np.asarray(list_rank.linearize(
+                obj, par, ctr, act, val.astype(bool), n_iters,
+                sort_idx=lsi))
+            return None, rank
+        return None, np.zeros((0,), np.int32)
 
     def _unpack_register_out(self, reg_out, Tp):
         """One packed [Tp] i32 transfer for winner/alive/overflow plus a
@@ -289,26 +322,9 @@ class NativeDocPool:
             conflicts[rows] = got
         return winner, conflicts, alive, overflow
 
-    def _run_linearize(self, L, bh, Lp, max_obj_len):
-        if Lp == 0:
-            return np.zeros((0,), np.int32)
-        from ..ops import list_rank
-        obj = np.ctypeslib.as_array(L.amtpu_col_obj(bh), shape=(Lp,))
-        par = np.ctypeslib.as_array(L.amtpu_col_par(bh), shape=(Lp,))
-        ctr = np.ctypeslib.as_array(L.amtpu_col_ctr(bh), shape=(Lp,))
-        act = np.ctypeslib.as_array(L.amtpu_col_act(bh), shape=(Lp,))
-        val = np.ctypeslib.as_array(L.amtpu_col_val(bh), shape=(Lp,))
-        si = np.ctypeslib.as_array(L.amtpu_col_linsort(bh), shape=(Lp,))
-        # pointer-doubling depth: DFS chains never cross objects, so the
-        # bound is the largest single arena, not the whole flat batch
-        return np.asarray(list_rank.linearize(
-            obj, par, ctr, act, val.astype(bool),
-            n_iters=list_rank.ceil_log2(max(max_obj_len, 1)) + 1,
-            sort_idx=si))
-
     def _run_dominance(self, L, bh):
         from ..ops.pallas_dominance import dominance_grouped_auto
-        dims = (ctypes.c_int64 * 7)()
+        dims = (ctypes.c_int64 * self.N_DIMS)()
         L.amtpu_batch_dims(bh, dims)
         n_blocks = int(dims[6])
         bdims = (ctypes.c_int64 * 3)()

@@ -30,8 +30,9 @@ WINDOW = 8
 
 
 @partial(jax.jit, static_argnames=('window',))
-def resolve_registers(group, time, actor, seq, clock, is_del, alive_in,
-                      window=WINDOW, sort_idx=None):
+def resolve_registers(group, time, actor, seq, clock=None, is_del=None,
+                      alive_in=None, window=WINDOW, sort_idx=None,
+                      clock_table=None, clock_idx=None):
     """Resolves every register op of a batch.
 
     Args:
@@ -48,6 +49,11 @@ def resolve_registers(group, time, actor, seq, clock, is_del, alive_in,
       sort_idx: optional [T] int32 -- precomputed np.lexsort((time, group))
              permutation; hoisted to the host by batch callers because
              XLA:CPU compiles large in-graph sorts in tens of seconds.
+      clock_table/clock_idx: optional [C, A] + [T] -- deduplicated clock
+             rows (ops of one change share a row): host->device traffic
+             shrinks ~16x and the full [T, A] matrix materializes only
+             on device.  Exactly one of `clock` or the
+             (clock_table, clock_idx) pair must be given.
 
     Returns dict of [T]-shaped outputs (original op order):
       alive_after: int32 -- register size right after this op.
@@ -60,6 +66,12 @@ def resolve_registers(group, time, actor, seq, clock, is_del, alive_in,
     """
     T = group.shape[0]
     W = window
+    if (clock is None) == (clock_table is None) or \
+            (clock_table is None) != (clock_idx is None):
+        raise ValueError('pass exactly one of clock or '
+                         '(clock_table, clock_idx)')
+    if clock_table is not None:
+        clock = clock_table[clock_idx]
     A = clock.shape[1]
 
     # sort by (group, time); padding (group == -1) sorts first and is inert
@@ -167,3 +179,22 @@ def resolve_registers(group, time, actor, seq, clock, is_del, alive_in,
 def gather_rows(mat, rows):
     """Row gather for the lazy conflicts fetch."""
     return mat[rows]
+
+
+@partial(jax.jit, static_argnames=('window',))
+def resolve_and_rank(group, time, actor, seq, clock_table, clock_idx,
+                     is_del, alive_in, sort_idx,
+                     eobj, epar, ectr, eact, evalid, lin_sort, n_iters,
+                     window=WINDOW):
+    """Register resolution + RGA linearization in ONE dispatch: the two
+    computations are independent, so fusing them halves the dispatch /
+    sync round trips of a batch (the device link has ~70ms latency per
+    blocking transfer in this deployment)."""
+    from .list_rank import linearize
+    reg = resolve_registers(group, time, actor, seq, is_del=is_del,
+                            alive_in=alive_in, window=window,
+                            sort_idx=sort_idx, clock_table=clock_table,
+                            clock_idx=clock_idx)
+    rank = linearize(eobj, epar, ectr, eact, evalid, n_iters,
+                     sort_idx=lin_sort)
+    return reg, rank

@@ -438,7 +438,10 @@ struct Batch {
   i64 T = 0, Tp = 0;
   std::vector<i32> g_col, t_col, a_col, s_col, sort_idx;
   std::vector<u8> d_col;
-  std::vector<i32> clock_mat;   // [Tp*Ap]
+  // deduplicated clock rows: ops of one change share one table row
+  std::vector<i32> clock_tab;   // [CTp*Ap]
+  std::vector<i32> clock_idx;   // [Tp] -> table row
+  i64 CT = 0, CTp = 0;
   // batch-owned copies of state register records: register mirrors are
   // REPLACED during emit, so src_records must never point into
   // st.registers (dangling after the first mirror update of a group)
@@ -711,9 +714,19 @@ static void encode(Pool& pool, Batch& b) {
     }
   };
 
-  // cache the densified clock per (doc, actor, seq) change -- ops of one
-  // change share it
-  std::unordered_map<K3, std::vector<i32>, K3Hash> clock_cache;
+  // clock rows dedup to one table entry per (doc, actor, seq)
+  std::unordered_map<K3, u32, K3Hash> clock_cache;
+  auto clock_row_of = [&](u32 doc, DocState& st, u32 actor, u32 seq) {
+    K3 ck{doc, actor, seq};
+    auto cit = clock_cache.find(ck);
+    if (cit != clock_cache.end()) return cit->second;
+    u32 idx = static_cast<u32>(b.clock_tab.size() / b.Ap);
+    b.clock_tab.resize(b.clock_tab.size() + b.Ap);
+    densify(all_deps_of(st, actor, seq),
+            b.clock_tab.data() + b.clock_tab.size() - b.Ap);
+    clock_cache.emplace(ck, idx);
+    return idx;
+  };
 
   // state rows
   for (u32 gid = 0; gid < gid_order.size(); ++gid) {
@@ -728,9 +741,8 @@ static void encode(Pool& pool, Batch& b) {
       b.a_col.push_back(b.rank_of[recs[i].actor]);
       b.s_col.push_back(static_cast<i32>(recs[i].seq));
       b.d_col.push_back(0);
-      b.clock_mat.resize(b.clock_mat.size() + b.Ap);
-      densify(all_deps_of(st, recs[i].actor, recs[i].seq),
-              b.clock_mat.data() + b.clock_mat.size() - b.Ap);
+      b.clock_idx.push_back(static_cast<i32>(
+          clock_row_of(doc, st, recs[i].actor, recs[i].seq)));
       b.state_rec_store.push_back(recs[i]);
       b.src_records.push_back(&b.state_rec_store.back());
     }
@@ -750,15 +762,8 @@ static void encode(Pool& pool, Batch& b) {
     b.a_col.push_back(b.rank_of[op.actor]);
     b.s_col.push_back(static_cast<i32>(op.seq));
     b.d_col.push_back(op.action == A_DEL ? 1 : 0);
-    K3 ck{f.doc, op.actor, op.seq};
-    auto cit = clock_cache.find(ck);
-    if (cit == clock_cache.end()) {
-      std::vector<i32> row(b.Ap);
-      densify(all_deps_of(st, op.actor, op.seq), row.data());
-      cit = clock_cache.emplace(ck, std::move(row)).first;
-    }
-    b.clock_mat.insert(b.clock_mat.end(), cit->second.begin(),
-                       cit->second.end());
+    b.clock_idx.push_back(static_cast<i32>(
+        clock_row_of(f.doc, st, op.actor, op.seq)));
     b.src_records.push_back(&op);
   }
 
@@ -770,7 +775,11 @@ static void encode(Pool& pool, Batch& b) {
     b.a_col.resize(b.Tp, 0);
     b.s_col.resize(b.Tp, 0);
     b.d_col.resize(b.Tp, 0);
-    b.clock_mat.resize(b.Tp * b.Ap, 0);
+    b.clock_idx.resize(b.Tp, 0);
+    b.CT = static_cast<i64>(b.clock_tab.size() / b.Ap);
+    if (b.CT == 0) { b.clock_tab.resize(b.Ap, 0); b.CT = 1; }
+    b.CTp = bucket(b.CT, 4);
+    b.clock_tab.resize(b.CTp * b.Ap, 0);
     // host sort (group, time); padding g=-1 first
     b.sort_idx.resize(b.Tp);
     for (i64 i = 0; i < b.Tp; ++i) b.sort_idx[i] = static_cast<i32>(i);
@@ -1492,13 +1501,14 @@ void* amtpu_begin(void* pool_ptr, const uint8_t* data, int64_t len) {
 
 void amtpu_batch_free(void* b) { delete static_cast<BatchHandle*>(b); }
 
-// dims: [T, Tp, A, Ap, L, Lp, n_dom_blocks, max_arena_len]
+// dims: [T, Tp, A, Ap, L, Lp, n_dom_blocks, max_arena_len, CTp]
 void amtpu_batch_dims(void* bp, int64_t* out) {
   Batch& b = static_cast<BatchHandle*>(bp)->batch;
   out[0] = b.T; out[1] = b.Tp; out[2] = b.A; out[3] = b.Ap;
   out[4] = b.L; out[5] = b.Lp;
   out[6] = static_cast<int64_t>(b.dom_blocks.size());
   out[7] = b.max_arena_len;
+  out[8] = b.CTp;
 }
 
 // register columns (valid when Tp > 0)
@@ -1507,7 +1517,8 @@ const int32_t* amtpu_col_t(void* bp) { return static_cast<BatchHandle*>(bp)->bat
 const int32_t* amtpu_col_a(void* bp) { return static_cast<BatchHandle*>(bp)->batch.a_col.data(); }
 const int32_t* amtpu_col_s(void* bp) { return static_cast<BatchHandle*>(bp)->batch.s_col.data(); }
 const uint8_t* amtpu_col_d(void* bp) { return static_cast<BatchHandle*>(bp)->batch.d_col.data(); }
-const int32_t* amtpu_col_clock(void* bp) { return static_cast<BatchHandle*>(bp)->batch.clock_mat.data(); }
+const int32_t* amtpu_col_clocktab(void* bp) { return static_cast<BatchHandle*>(bp)->batch.clock_tab.data(); }
+const int32_t* amtpu_col_clockidx(void* bp) { return static_cast<BatchHandle*>(bp)->batch.clock_idx.data(); }
 const int32_t* amtpu_col_sort(void* bp) { return static_cast<BatchHandle*>(bp)->batch.sort_idx.data(); }
 
 // arena columns (valid when Lp > 0)

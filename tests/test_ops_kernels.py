@@ -400,3 +400,36 @@ class TestPallasDominance:
         got = np.asarray(dominance_grouped_auto(*args, chunk=64))
         ov = args[-1]
         assert (got[ov] == want[ov]).all()
+
+
+class TestClockTablePath:
+    def test_table_matches_dense(self):
+        """resolve_registers(clock_table, clock_idx) must equal the dense
+        clock path on identical inputs."""
+        from automerge_tpu.ops.registers import resolve_registers
+        rng = random.Random(17)
+        T, A, C = 32, 4, 6
+        group = np.array([rng.randrange(4) for _ in range(T)], np.int32)
+        time = np.arange(T, dtype=np.int32)
+        actor = np.array([rng.randrange(A) for _ in range(T)], np.int32)
+        seq = np.array([rng.randint(1, 5) for _ in range(T)], np.int32)
+        table = np.array([[rng.randint(0, 5) for _ in range(A)]
+                          for _ in range(C)], np.int32)
+        idx = np.array([rng.randrange(C) for _ in range(T)], np.int32)
+        is_del = np.array([rng.random() < 0.2 for _ in range(T)])
+        alive = np.ones((T,), bool)
+        dense = resolve_registers(group, time, actor, seq, table[idx],
+                                  is_del, alive)
+        tabled = resolve_registers(group, time, actor, seq, is_del=is_del,
+                                   alive_in=alive, clock_table=table,
+                                   clock_idx=idx)
+        for k in ('winner', 'alive_after', 'conflicts', 'overflow',
+                  'packed'):
+            assert (np.asarray(dense[k]) == np.asarray(tabled[k])).all(), k
+
+    def test_requires_exactly_one_clock_form(self):
+        from automerge_tpu.ops.registers import resolve_registers
+        z = np.zeros((4,), np.int32)
+        with pytest.raises(ValueError):
+            resolve_registers(z, z, z, z, is_del=z.astype(bool),
+                              alive_in=np.ones(4, bool))
