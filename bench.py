@@ -42,13 +42,13 @@ def env_int(name, default):
     return int(os.environ.get(name, default))
 
 
-N_DOCS = env_int('AMTPU_BENCH_DOCS', 2048)
+N_DOCS = env_int('AMTPU_BENCH_DOCS', 4096)
 N_ACTORS = env_int('AMTPU_BENCH_ACTORS', 8)
 N_ROUNDS = env_int('AMTPU_BENCH_ROUNDS', 2)
 OPS_PER_CHANGE = env_int('AMTPU_BENCH_OPS_PER_CHANGE', 16)
 ORACLE_DOCS = env_int('AMTPU_BENCH_ORACLE_DOCS', 48)
 SEED = env_int('AMTPU_BENCH_SEED', 7)
-N_SHARDS = env_int('AMTPU_BENCH_SHARDS', 6)
+N_SHARDS = env_int('AMTPU_BENCH_SHARDS', 10)
 
 
 def make_doc_changes(doc, rng):
