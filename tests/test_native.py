@@ -300,3 +300,38 @@ class TestNativeRandomWorkloads:
             batches.append({0: changes[i:i + n]})
             i += n
         deliver_and_compare(batches)
+
+
+class TestSingleLargeDoc:
+    def test_long_sequential_text(self):
+        """One Text doc with thousands of sequential inserts from two
+        alternating actors (BASELINE config-1 shape, scaled down): the
+        big-arena size classes and cross-change dependency chains."""
+        nat = native_pool()
+        st = Backend.init()
+        chs = [{'actor': 'a0', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'makeText', 'obj': 't'},
+            {'action': 'link', 'obj': ROOT_ID, 'key': 'text',
+             'value': 't'}]}]
+        last = '_head'
+        seqs = {'a0': 1, 'a1': 0}
+        e = 0
+        while e < 1200:
+            for a in ('a0', 'a1'):
+                ops = []
+                for _ in range(50):
+                    e += 1
+                    ops.append({'action': 'ins', 'obj': 't', 'key': last,
+                                'elem': e})
+                    ops.append({'action': 'set', 'obj': 't',
+                                'key': '%s:%d' % (a, e),
+                                'value': chr(97 + e % 26)})
+                    last = '%s:%d' % (a, e)
+                seqs[a] += 1
+                chs.append({'actor': a, 'seq': seqs[a],
+                            'deps': {k: v for k, v in seqs.items()
+                                     if k != a and v > 0},
+                            'ops': ops})
+        st, _ = Backend.apply_changes(st, chs)
+        nat.apply_changes('big', chs)
+        assert nat.get_patch('big') == Backend.get_patch(st)
