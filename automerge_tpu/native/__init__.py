@@ -18,6 +18,8 @@ import subprocess
 import msgpack
 import numpy as np
 
+from .. import trace
+
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(os.path.dirname(os.path.dirname(_DIR)), 'native')
 _LIB_PATH = os.path.join(_DIR, 'libamtpu_core.so')
@@ -73,8 +75,23 @@ def _load():
     lib.amtpu_dom_ov.argtypes = [ctypes.c_void_p, ctypes.c_int64]
     lib.amtpu_dom_set_indexes.argtypes = [ctypes.c_void_p, ctypes.c_int64,
                                           ctypes.POINTER(ctypes.c_int32)]
+    lib.amtpu_fused_dims.argtypes = [ctypes.c_void_p,
+                                     ctypes.POINTER(ctypes.c_int64)]
+    for name in ('ersrc', 'oranksrc', 'domsrc'):
+        fn = getattr(lib, 'amtpu_fdom_' + name)
+        fn.restype = ctypes.POINTER(ctypes.c_int32)
+        fn.argtypes = [ctypes.c_void_p]
+    lib.amtpu_mid_fused.restype = ctypes.c_int
+    lib.amtpu_mid_fused.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int32)]
     lib.amtpu_finish.restype = ctypes.c_int
     lib.amtpu_finish.argtypes = [ctypes.c_void_p]
+    lib.amtpu_batch_trace.argtypes = [ctypes.c_void_p,
+                                      ctypes.POINTER(ctypes.c_double)]
     lib.amtpu_result.restype = ctypes.POINTER(ctypes.c_uint8)
     lib.amtpu_result.argtypes = [ctypes.c_void_p,
                                  ctypes.POINTER(ctypes.c_int64)]
@@ -192,57 +209,239 @@ class NativeDocPool:
         self._pool = lib().amtpu_pool_new()
 
     def __del__(self):
-        if getattr(self, '_pool', None):
-            lib().amtpu_pool_free(self._pool)
+        # read the module global directly: at interpreter shutdown the
+        # lib() accessor may already have been torn down
+        if getattr(self, '_pool', None) and _lib is not None:
+            _lib.amtpu_pool_free(self._pool)
             self._pool = None
 
     # -- wire path ------------------------------------------------------
 
     def apply_batch_bytes(self, payload):
         """msgpack {doc_id: [change...]} -> msgpack {doc_id: patch}."""
+        ctx = self._phase_a(payload)
+        try:
+            return self._phase_b(ctx)
+        finally:
+            lib().amtpu_batch_free(ctx['bh'])
+
+    def _phase_a(self, payload):
+        """Host begin + async device dispatch.  Returns a context dict;
+        the caller MUST pass it to `_phase_b` and then free ctx['bh'].
+
+        Splitting here lets a sharded driver overlap shard k+1's host
+        `begin` with shard k's in-flight device work on a single thread
+        (jax dispatches are async; the transfer is started with
+        copy_to_host_async and collected in phase b)."""
         L = lib()
-        bh = L.amtpu_begin(self._pool, payload, len(payload))
+        with trace.span('host.begin'):
+            bh = L.amtpu_begin(self._pool, payload, len(payload))
         if not bh:
             _raise_last()
+        ctx = {'bh': bh}
         try:
             dims = (ctypes.c_int64 * self.N_DIMS)()
             L.amtpu_batch_dims(bh, dims)
             T, Tp, A, Ap, Larena, Lp, n_blocks, max_obj, CTp = \
                 [int(x) for x in dims]
+            fdims = (ctypes.c_int64 * 4)()
+            L.amtpu_fused_dims(bh, fdims)
+            fused_ok, W, dLp, dTp = [int(x) for x in fdims]
+            trace.count('ops.register_rows', T)
+            trace.count('ops.arena_elems', Larena)
+            ctx.update(dims=(T, Tp, A, Ap, Larena, Lp, n_blocks, max_obj,
+                             CTp))
 
-            reg_out, rank = self._run_resolver(L, bh, Tp, Ap, CTp, Lp,
-                                               max_obj)
-
-            if Tp > 0:
-                winner, conflicts, alive, overflow = \
-                    self._unpack_register_out(reg_out, Tp)
+            if fused_ok:
+                with trace.span('device.dispatch'):
+                    self._dispatch_fused(L, ctx, Tp, Ap, CTp, Lp, max_obj,
+                                         n_blocks, W, dLp, dTp)
             else:
-                winner = conflicts = alive = np.zeros(0, np.int32)
-                overflow = np.zeros(0, np.uint8)
-            rank_arr = np.ascontiguousarray(rank, np.int32)
+                trace.count('fused.fallback_layout')
+                with trace.span('device.dispatch'):
+                    reg_out, rank = self._run_resolver(
+                        L, bh, Tp, Ap, CTp, Lp, max_obj)
+                ctx.update(mode='old', reg_out=reg_out, rank=rank)
+            return ctx
+        except Exception:
+            L.amtpu_batch_free(bh)
+            raise
 
-            def ip(a):
-                return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+    def _register_views(self, L, bh, Tp, Ap, CTp):
+        """ctypes views of the register columns (single source of truth
+        for their shapes/dtypes)."""
+        return dict(
+            g=np.ctypeslib.as_array(L.amtpu_col_g(bh), shape=(Tp,)),
+            t=np.ctypeslib.as_array(L.amtpu_col_t(bh), shape=(Tp,)),
+            a=np.ctypeslib.as_array(L.amtpu_col_a(bh), shape=(Tp,)),
+            s=np.ctypeslib.as_array(L.amtpu_col_s(bh), shape=(Tp,)),
+            d=np.ctypeslib.as_array(L.amtpu_col_d(bh), shape=(Tp,)),
+            ctab=np.ctypeslib.as_array(L.amtpu_col_clocktab(bh),
+                                       shape=(CTp, Ap)),
+            cidx=np.ctypeslib.as_array(L.amtpu_col_clockidx(bh),
+                                       shape=(Tp,)),
+            si=np.ctypeslib.as_array(L.amtpu_col_sort(bh), shape=(Tp,)))
 
-            def up(a):
-                return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+    def _arena_views(self, L, bh, Lp):
+        """ctypes views of the arena columns."""
+        return dict(
+            obj=np.ctypeslib.as_array(L.amtpu_col_obj(bh), shape=(Lp,)),
+            par=np.ctypeslib.as_array(L.amtpu_col_par(bh), shape=(Lp,)),
+            ctr=np.ctypeslib.as_array(L.amtpu_col_ctr(bh), shape=(Lp,)),
+            act=np.ctypeslib.as_array(L.amtpu_col_act(bh), shape=(Lp,)),
+            val=np.ctypeslib.as_array(L.amtpu_col_val(bh), shape=(Lp,)),
+            lsi=np.ctypeslib.as_array(L.amtpu_col_linsort(bh),
+                                      shape=(Lp,)))
 
-            if L.amtpu_mid(bh, ip(winner), ip(conflicts), self.WINDOW,
-                           ip(alive), up(overflow), ip(rank_arr)) != 0:
-                _raise_last()
+    def _dispatch_fused(self, L, ctx, Tp, Ap, CTp, Lp, max_obj, n_blocks,
+                        W, dLp, dTp):
+        from ..ops import list_rank, registers as register_ops
+        bh = ctx['bh']
+        if Tp == 0:
+            # no register ops: nothing to resolve, and without list-assign
+            # ops there are no dominance timelines either -- no dispatch
+            ctx.update(mode='fused', combo=None, reg_out=None, rank=None)
+            return
+        r = self._register_views(L, bh, Tp, Ap, CTp)
+        if n_blocks == 0:
+            # register work only (maps/tables, or inserts without list
+            # assigns): rank is consumed by nothing on the host
+            reg_out = register_ops.resolve_registers(
+                r['g'], r['t'], r['a'], r['s'],
+                is_del=r['d'].astype(bool),
+                alive_in=np.ones((Tp,), bool), window=self.WINDOW,
+                sort_idx=r['si'], clock_table=r['ctab'],
+                clock_idx=r['cidx'])
+            combo = reg_out['packed']
+            combo.copy_to_host_async()
+            ctx.update(mode='fused', combo=combo, reg_out=reg_out,
+                       rank=None)
+            return
+        e = self._arena_views(L, bh, Lp)
+        n_iters = list_rank.ceil_log2(max(max_obj, 1)) + 1
+        v0 = np.ctypeslib.as_array(L.amtpu_dom_v0(bh, 0), shape=(W, dLp))
+        er_src = np.ctypeslib.as_array(L.amtpu_fdom_ersrc(bh),
+                                       shape=(W, dLp))
+        oe = np.ctypeslib.as_array(L.amtpu_dom_oe(bh, 0), shape=(W, dTp))
+        orank_src = np.ctypeslib.as_array(L.amtpu_fdom_oranksrc(bh),
+                                          shape=(W, dTp))
+        dom_src = np.ctypeslib.as_array(L.amtpu_fdom_domsrc(bh),
+                                        shape=(W, dTp))
+        ov = np.ctypeslib.as_array(L.amtpu_dom_ov(bh, 0), shape=(W, dTp))
+        reg_out, rank, combo = register_ops.resolve_rank_dominate(
+            r['g'], r['t'], r['a'], r['s'], r['ctab'], r['cidx'],
+            r['d'].astype(bool), np.ones((Tp,), bool), r['si'],
+            e['obj'], e['par'], e['ctr'], e['act'], e['val'].astype(bool),
+            e['lsi'], n_iters,
+            v0, er_src, oe, orank_src, dom_src, ov.astype(bool),
+            window=self.WINDOW)
+        combo.copy_to_host_async()
+        ctx.update(mode='fused', combo=combo, reg_out=reg_out, rank=rank)
 
-            self._run_dominance(L, bh)
+    def _phase_b(self, ctx):
+        """Collect device results, run host mid+emit, return patch bytes."""
+        L = lib()
+        bh = ctx['bh']
+        T, Tp, A, Ap, Larena, Lp, n_blocks, max_obj, CTp = ctx['dims']
 
+        def ip(a):
+            return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+        def up(a):
+            return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+        if ctx['mode'] == 'fused':
+            with trace.span('device.collect'):
+                if ctx['combo'] is None:
+                    winner = conflicts = alive = np.zeros(0, np.int32)
+                    overflow = np.zeros(0, np.uint8)
+                    dom_idx = np.zeros(0, np.int32)
+                    fallback = False
+                else:
+                    combo = np.asarray(ctx['combo'])
+                    packed = combo[:Tp]
+                    dom_idx = np.ascontiguousarray(combo[Tp:], np.int32)
+                    winner, alive, overflow = self._unpack_packed(packed)
+                    fallback = bool(overflow.any())
+                    if not fallback:
+                        conflicts = self._gather_conflicts(
+                            ctx['reg_out'], alive, Tp)
+            if fallback:
+                # >window concurrent writers on some register: re-fetch the
+                # full outputs + rank and take the exact host path
+                trace.count('fused.fallback_overflow')
+                reg_out = ctx['reg_out']
+                winner = np.ascontiguousarray(reg_out['winner'], np.int32)
+                conflicts = np.ascontiguousarray(reg_out['conflicts'],
+                                                 np.int32)
+                alive = np.ascontiguousarray(reg_out['alive_after'],
+                                             np.int32)
+                rank_arr = (np.ascontiguousarray(ctx['rank'], np.int32)
+                            if ctx['rank'] is not None
+                            else np.zeros(0, np.int32))
+                with trace.span('host.mid'):
+                    if L.amtpu_mid(bh, ip(winner), ip(conflicts),
+                                   self.WINDOW, ip(alive), up(overflow),
+                                   ip(rank_arr)) != 0:
+                        _raise_last()
+                with trace.span('device.dominance'):
+                    self._run_dominance(L, bh)
+            else:
+                with trace.span('host.mid'):
+                    if L.amtpu_mid_fused(
+                            bh, ip(winner), ip(conflicts), self.WINDOW,
+                            ip(alive), up(overflow), ip(dom_idx)) != 0:
+                        _raise_last()
+        else:
+            with trace.span('device.collect'):
+                reg_out, rank = ctx['reg_out'], ctx['rank']
+                if Tp > 0:
+                    winner, conflicts, alive, overflow = \
+                        self._unpack_register_out(reg_out, Tp)
+                else:
+                    winner = conflicts = alive = np.zeros(0, np.int32)
+                    overflow = np.zeros(0, np.uint8)
+                rank_arr = np.ascontiguousarray(rank, np.int32)
+            with trace.span('host.mid'):
+                if L.amtpu_mid(bh, ip(winner), ip(conflicts), self.WINDOW,
+                               ip(alive), up(overflow),
+                               ip(rank_arr)) != 0:
+                    _raise_last()
+            with trace.span('device.dominance'):
+                self._run_dominance(L, bh)
+
+        with trace.span('host.finish'):
             if L.amtpu_finish(bh) != 0:
                 _raise_last()
-            out_len = ctypes.c_int64()
-            ptr = L.amtpu_result(bh, ctypes.byref(out_len))
-            return bytes(bytearray(ctypes.cast(
-                ptr, ctypes.POINTER(
-                    ctypes.c_uint8 * out_len.value)).contents)) \
-                if out_len.value else b'\x80'
-        finally:
-            L.amtpu_batch_free(bh)
+        if trace.ENABLED:
+            tr = (ctypes.c_double * 6)()
+            L.amtpu_batch_trace(bh, tr)
+            for name, val in zip(('decode', 'schedule', 'encode',
+                                  'mid', 'emit', 'domlay'), tr):
+                trace.add('cxx.' + name, float(val))
+        out_len = ctypes.c_int64()
+        ptr = L.amtpu_result(bh, ctypes.byref(out_len))
+        return bytes(bytearray(ctypes.cast(
+            ptr, ctypes.POINTER(
+                ctypes.c_uint8 * out_len.value)).contents)) \
+            if out_len.value else b'\x80'
+
+    def _gather_conflicts(self, reg_out, alive, Tp):
+        """Lazy conflicts fetch: only registers that kept >1 member have
+        conflict rows worth transferring."""
+        from ..ops import registers as register_ops
+        conflicts = np.full((Tp, self.WINDOW), -1, np.int32)
+        rows = np.nonzero(alive > 1)[0]
+        if rows.size:
+            pad = 1
+            while pad < rows.size:
+                pad *= 2
+            rows_p = np.zeros((pad,), np.int32)
+            rows_p[:rows.size] = rows
+            got = np.asarray(register_ops.gather_rows(
+                reg_out['conflicts'], rows_p))[:rows.size]
+            conflicts[rows] = got
+        return conflicts
 
     # -- kernel dispatch ------------------------------------------------
 
@@ -253,43 +452,31 @@ class NativeDocPool:
         rank np.int32 [Lp])."""
         from ..ops import list_rank, registers as register_ops
         if Tp > 0:
-            g = np.ctypeslib.as_array(L.amtpu_col_g(bh), shape=(Tp,))
-            t = np.ctypeslib.as_array(L.amtpu_col_t(bh), shape=(Tp,))
-            a = np.ctypeslib.as_array(L.amtpu_col_a(bh), shape=(Tp,))
-            s = np.ctypeslib.as_array(L.amtpu_col_s(bh), shape=(Tp,))
-            d = np.ctypeslib.as_array(L.amtpu_col_d(bh), shape=(Tp,))
-            ctab = np.ctypeslib.as_array(L.amtpu_col_clocktab(bh),
-                                         shape=(CTp, Ap))
-            cidx = np.ctypeslib.as_array(L.amtpu_col_clockidx(bh),
-                                         shape=(Tp,))
-            si = np.ctypeslib.as_array(L.amtpu_col_sort(bh), shape=(Tp,))
+            r = self._register_views(L, bh, Tp, Ap, CTp)
         if Lp > 0:
-            obj = np.ctypeslib.as_array(L.amtpu_col_obj(bh), shape=(Lp,))
-            par = np.ctypeslib.as_array(L.amtpu_col_par(bh), shape=(Lp,))
-            ctr = np.ctypeslib.as_array(L.amtpu_col_ctr(bh), shape=(Lp,))
-            act = np.ctypeslib.as_array(L.amtpu_col_act(bh), shape=(Lp,))
-            val = np.ctypeslib.as_array(L.amtpu_col_val(bh), shape=(Lp,))
-            lsi = np.ctypeslib.as_array(L.amtpu_col_linsort(bh),
-                                        shape=(Lp,))
+            e = self._arena_views(L, bh, Lp)
             # doubling depth: DFS chains never cross objects
             n_iters = list_rank.ceil_log2(max(max_obj_len, 1)) + 1
         if Tp > 0 and Lp > 0:
             reg_out, rank = register_ops.resolve_and_rank(
-                g, t, a, s, ctab, cidx, d.astype(bool),
-                np.ones((Tp,), bool), si,
-                obj, par, ctr, act, val.astype(bool), lsi, n_iters,
+                r['g'], r['t'], r['a'], r['s'], r['ctab'], r['cidx'],
+                r['d'].astype(bool), np.ones((Tp,), bool), r['si'],
+                e['obj'], e['par'], e['ctr'], e['act'],
+                e['val'].astype(bool), e['lsi'], n_iters,
                 window=self.WINDOW)
             return reg_out, np.asarray(rank)
         if Tp > 0:
             reg_out = register_ops.resolve_registers(
-                g, t, a, s, is_del=d.astype(bool),
+                r['g'], r['t'], r['a'], r['s'],
+                is_del=r['d'].astype(bool),
                 alive_in=np.ones((Tp,), bool), window=self.WINDOW,
-                sort_idx=si, clock_table=ctab, clock_idx=cidx)
+                sort_idx=r['si'], clock_table=r['ctab'],
+                clock_idx=r['cidx'])
             return reg_out, np.zeros((0,), np.int32)
         if Lp > 0:
             rank = np.asarray(list_rank.linearize(
-                obj, par, ctr, act, val.astype(bool), n_iters,
-                sort_idx=lsi))
+                e['obj'], e['par'], e['ctr'], e['act'],
+                e['val'].astype(bool), n_iters, sort_idx=e['lsi']))
             return None, rank
         return None, np.zeros((0,), np.int32)
 
@@ -305,29 +492,32 @@ class NativeDocPool:
             overflow = np.ascontiguousarray(reg_out['overflow'], np.uint8)
             return winner, conflicts, alive, overflow
         packed = np.asarray(reg_out['packed'])
+        winner, alive, overflow = self._unpack_packed(packed)
+        conflicts = self._gather_conflicts(reg_out, alive, Tp)
+        return winner, conflicts, alive, overflow
+
+    @staticmethod
+    def _unpack_packed(packed):
+        """Splits the packed [T] i32 register summary (24-bit winner,
+        0xffffff = none | 4-bit alive | 1-bit overflow) -- the single
+        source of truth for the transfer-packed bit layout."""
         winner = np.ascontiguousarray(packed & 0xffffff, np.int32)
         winner[winner == 0xffffff] = -1
         alive = np.ascontiguousarray((packed >> 24) & 0xf, np.int32)
         overflow = np.ascontiguousarray((packed >> 28) & 1, np.uint8)
-        conflicts = np.full((Tp, self.WINDOW), -1, np.int32)
-        rows = np.nonzero(alive > 1)[0]
-        if rows.size:
-            pad = 1
-            while pad < rows.size:
-                pad *= 2
-            rows_p = np.zeros((pad,), np.int32)
-            rows_p[:rows.size] = rows
-            got = np.asarray(register_ops.gather_rows(
-                reg_out['conflicts'], rows_p))[:rows.size]
-            conflicts[rows] = got
-        return winner, conflicts, alive, overflow
+        return winner, alive, overflow
 
     def _run_dominance(self, L, bh):
+        """Fallback-path dominance: per size-class device dispatches using
+        the host-filled er/orank/od mirrors (after amtpu_mid).  Blocks are
+        one-per-class since begin; classes too wide for one dispatch are
+        sliced along the object axis here (numpy views are cheap)."""
         from ..ops.pallas_dominance import dominance_grouped_auto
         dims = (ctypes.c_int64 * self.N_DIMS)()
         L.amtpu_batch_dims(bh, dims)
         n_blocks = int(dims[6])
         bdims = (ctypes.c_int64 * 3)()
+        CAP = 256 << 20
         for blk in range(n_blocks):
             L.amtpu_dom_dims(bh, blk, bdims)
             W, Lp, Tp = [int(x) for x in bdims]
@@ -343,9 +533,30 @@ class NativeDocPool:
                                        shape=(W, Tp))
             ov = np.ctypeslib.as_array(L.amtpu_dom_ov(bh, blk),
                                        shape=(W, Tp))
-            idx = np.ascontiguousarray(np.asarray(dominance_grouped_auto(
-                v0, er, oe, orank, od, ov.astype(bool),
-                chunk=64)), np.int32)
+            w_cap = max(1, min(CAP // (Lp * 64 * 4), CAP // (Tp * 4)))
+            if W <= w_cap:
+                idx = np.asarray(dominance_grouped_auto(
+                    v0, er, oe, orank, od, ov.astype(bool), chunk=64))
+            else:
+                idx = np.empty((W, Tp), np.int32)
+                for s in range(0, W, w_cap):
+                    hi = min(W, s + w_cap)
+                    n = hi - s
+
+                    def pad(x, fill):
+                        if n == w_cap:
+                            return x[s:hi]
+                        out = np.full((w_cap,) + x.shape[1:], fill,
+                                      x.dtype)
+                        out[:n] = x[s:hi]
+                        return out
+
+                    got = np.asarray(dominance_grouped_auto(
+                        pad(v0, 0.0), pad(er, -1), pad(oe, -1),
+                        pad(orank, -1), pad(od, 0),
+                        pad(ov, 0).astype(bool), chunk=64))
+                    idx[s:hi] = got[:n]
+            idx = np.ascontiguousarray(idx, np.int32)
             L.amtpu_dom_set_indexes(
                 bh, blk, idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
 
@@ -412,26 +623,47 @@ class NativeDocPool:
 
 
 class ShardedNativePool:
-    """S independent native pools driven by S threads.
+    """S independent native pools, driven pipelined or threaded.
 
     Document-level independence is the framework's data-parallel axis
-    (SURVEY.md section 2); on the host it also shards the C++ runtime:
-    ctypes releases the GIL around native calls, so begin/emit of all
-    shards run truly concurrently, and each shard's device dispatches
-    overlap other shards' host work.  Doc -> shard routing uses the same
-    FNV-1a hash as the C++ payload splitter.
+    (SURVEY.md section 2); on the host it also shards the C++ runtime.
+    Two drive modes (AMTPU_SHARD_MODE=pipeline|threads; default picks by
+    core count):
 
-    API-compatible with NativeDocPool for apply_batch/apply_batch_bytes
-    and the per-doc queries.
+    * pipeline -- single thread, async device dispatch: all shards run
+      host `begin` + kernel dispatch first (phase a), then results are
+      collected and emitted in order (phase b).  jax dispatches are
+      async, so shard k's device work and d->h transfer overlap shard
+      k+1's host begin and shard k-1's emit.  Strictly better on a
+      1-core host, where extra threads only add contention.
+    * threads -- one thread per shard; ctypes releases the GIL around
+      native calls, so on multi-core hosts begin/emit of shards run
+      truly concurrently on top of the same async device overlap.
+
+    Doc -> shard routing uses the same FNV-1a hash as the C++ payload
+    splitter.  API-compatible with NativeDocPool for apply_batch /
+    apply_batch_bytes and the per-doc queries.
+
+    Error semantics: shards commit independently; if one shard's batch
+    fails, other shards may already have applied their sub-batches.  The
+    first shard error is re-raised; callers needing atomicity must keep
+    doc groups within one shard (route by doc id).
     """
 
-    def __init__(self, n_shards=None):
+    def __init__(self, n_shards=None, mode=None):
         if n_shards is None:
             n_shards = min(8, os.cpu_count() or 1)
         if n_shards < 1:
             raise ValueError('n_shards must be >= 1, got %r' % (n_shards,))
         self.n_shards = n_shards
         self.pools = [NativeDocPool() for _ in range(n_shards)]
+        if mode is None:
+            mode = os.environ.get('AMTPU_SHARD_MODE', '')
+        if not mode:
+            mode = 'pipeline' if (os.cpu_count() or 1) == 1 else 'threads'
+        if mode not in ('pipeline', 'threads'):
+            raise ValueError('unknown shard mode %r' % (mode,))
+        self.mode = mode
 
     def _shard_of(self, doc_id):
         key = NativeDocPool._doc_key(doc_id).encode()
@@ -439,21 +671,70 @@ class ShardedNativePool:
 
     def apply_batch_bytes(self, payload):
         L = lib()
-        sp = L.amtpu_shard_split(payload, len(payload), self.n_shards)
-        if not sp:
-            _raise_last()
-        try:
-            subs = []
-            for s in range(self.n_shards):
-                n = ctypes.c_int64()
-                ptr = L.amtpu_shard_buf(sp, s, ctypes.byref(n))
-                subs.append(bytes(bytearray(ctypes.cast(
-                    ptr, ctypes.POINTER(
-                        ctypes.c_uint8 * n.value)).contents))
-                    if n.value else b'\x80')
-        finally:
-            L.amtpu_shard_free(sp)
+        with trace.span('shard.split'):
+            sp = L.amtpu_shard_split(payload, len(payload), self.n_shards)
+            if not sp:
+                _raise_last()
+            try:
+                subs = []
+                for s in range(self.n_shards):
+                    n = ctypes.c_int64()
+                    ptr = L.amtpu_shard_buf(sp, s, ctypes.byref(n))
+                    subs.append(bytes(bytearray(ctypes.cast(
+                        ptr, ctypes.POINTER(
+                            ctypes.c_uint8 * n.value)).contents))
+                        if n.value else b'\x80')
+            finally:
+                L.amtpu_shard_free(sp)
 
+        with trace.span('shard.run'):
+            if self.mode == 'pipeline':
+                results = self._run_pipelined(subs)
+            else:
+                results = self._run_threaded(subs)
+        # merge the per-shard {doc: patch} maps at the byte level: sum the
+        # map headers, splice the bodies -- no decode of patch contents
+        total = 0
+        bodies = []
+        for r in results:
+            if r is None:
+                continue
+            n, off = _read_map_header(r)
+            total += n
+            bodies.append(r[off:])
+        return _map_header(total) + b''.join(bodies)
+
+    def _run_pipelined(self, subs):
+        """Phase a for every shard, then phase b for every shard.  A shard
+        error must NOT leave *other* shards half-applied (their begin has
+        already committed state), so every healthy shard still runs to
+        completion and the first error is re-raised afterwards -- matching
+        the threads-mode semantics."""
+        L = lib()
+        ctxs = [None] * self.n_shards
+        results = [None] * self.n_shards
+        errors = []
+        for s in range(self.n_shards):
+            if subs[s] == b'\x80':
+                continue
+            try:
+                ctxs[s] = self.pools[s]._phase_a(subs[s])
+            except Exception as e:
+                errors.append(e)
+        for s in range(self.n_shards):
+            if ctxs[s] is None:
+                continue
+            try:
+                results[s] = self.pools[s]._phase_b(ctxs[s])
+            except Exception as e:
+                errors.append(e)
+            finally:
+                L.amtpu_batch_free(ctxs[s]['bh'])
+        if errors:
+            raise errors[0]
+        return results
+
+    def _run_threaded(self, subs):
         results = [None] * self.n_shards
         errors = []
 
@@ -473,17 +754,7 @@ class ShardedNativePool:
             t.join()
         if errors:
             raise errors[0]
-        # merge the per-shard {doc: patch} maps at the byte level: sum the
-        # map headers, splice the bodies -- no decode of patch contents
-        total = 0
-        bodies = []
-        for r in results:
-            if r is None:
-                continue
-            n, off = _read_map_header(r)
-            total += n
-            bodies.append(r[off:])
-        return _map_header(total) + b''.join(bodies)
+        return results
 
     def apply_batch(self, changes_by_doc):
         return _apply_batch_dicts(self, changes_by_doc)

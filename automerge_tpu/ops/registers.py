@@ -198,3 +198,56 @@ def resolve_and_rank(group, time, actor, seq, clock_table, clock_idx,
     rank = linearize(eobj, epar, ectr, eact, evalid, n_iters,
                      sort_idx=lin_sort)
     return reg, rank
+
+
+@partial(jax.jit, static_argnames=('window', 'chunk'))
+def resolve_rank_dominate(group, time, actor, seq, clock_table, clock_idx,
+                          is_del, alive_in, sort_idx,
+                          eobj, epar, ectr, eact, evalid, lin_sort, n_iters,
+                          v0, er_src, oe, orank_src, dom_src, ov,
+                          window=WINDOW, chunk=64):
+    """The full resolver in ONE device dispatch: register resolution, RGA
+    linearization, AND per-op list dominance indexes.
+
+    The reference interleaves these stages per op (apply -> skip-list
+    indexOf, `/root/reference/backend/op_set.js:233-295` + skip_list.js);
+    here the dominance stage's rank-dependent inputs are gathered ON
+    DEVICE from the linearize output, and its visibility deltas are
+    derived from the register kernel's own alive/visible outputs -- so a
+    whole multi-doc batch costs a single dispatch and a single packed
+    device->host transfer (winner/alive/overflow + dominance indexes),
+    with no rank readback at all on the common path.
+
+    Dominance-layout args (built by the C++ runtime at begin):
+      v0:        [W, Lp] f32 -- element visibility at batch start.
+      er_src:    [W, Lp] i32 -- arena-global element index, -1 padding.
+      oe:        [W, Tp] i32 -- local element index per timeline op.
+      orank_src: [W, Tp] i32 -- arena-global index of the touched element.
+      dom_src:   [W, Tp] i32 -- register row of the timeline op, -1 pad.
+      ov:        [W, Tp] bool.
+
+    Returns (reg dict, rank [L], combo [T + W*Tp] i32) where combo is the
+    packed register summary concatenated with the dominance indexes --
+    fetch it with ONE transfer; rank stays device-resident unless the
+    overflow fallback needs it.
+    """
+    from .list_rank import dominance_grouped, linearize
+    reg = resolve_registers(group, time, actor, seq, is_del=is_del,
+                            alive_in=alive_in, window=window,
+                            sort_idx=sort_idx, clock_table=clock_table,
+                            clock_idx=clock_idx)
+    rank = linearize(eobj, epar, ectr, eact, evalid, n_iters,
+                     sort_idx=lin_sort)
+    L = rank.shape[0]
+    er = jnp.where(er_src >= 0, rank[jnp.clip(er_src, 0, L - 1)], -1)
+    orank = jnp.where(orank_src >= 0, rank[jnp.clip(orank_src, 0, L - 1)],
+                      -1)
+    T = reg['alive_after'].shape[0]
+    row = jnp.clip(dom_src, 0, T - 1)
+    od = jnp.where(dom_src >= 0,
+                   (reg['alive_after'][row] > 0).astype(jnp.int32)
+                   - reg['visible_before'][row].astype(jnp.int32),
+                   0)
+    idx = dominance_grouped(v0, er, oe, orank, od, ov, chunk=chunk)
+    combo = jnp.concatenate([reg['packed'], idx.reshape(-1)])
+    return reg, rank, combo

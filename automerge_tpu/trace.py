@@ -1,0 +1,84 @@
+"""Opt-in phase tracing (AMTPU_TRACE=1).
+
+The reference ships no instrumentation (SURVEY.md section 5); since this
+framework's metric is ops/sec, it adds an opt-in timing/counter layer:
+per-phase wall time and op counts accumulated across every pool dispatch.
+
+Enable with AMTPU_TRACE=1 (checked once at import).  Phases are
+accumulated under a lock because `ShardedNativePool` drives shards from
+concurrent threads -- phase sums therefore measure *occupancy* (total
+seconds spent in a phase across all threads), which can exceed wall time
+when shards overlap.  That is the useful number on a 1-core host: it shows
+where the serialized host budget goes.
+
+Usage:
+    from automerge_tpu import trace
+    trace.reset()
+    ... run workload ...
+    print(trace.report())
+"""
+
+import os
+import threading
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+
+ENABLED = os.environ.get('AMTPU_TRACE', '0') not in ('', '0')
+
+_lock = threading.Lock()
+_seconds = defaultdict(float)
+_counts = defaultdict(int)
+
+
+def add(phase, seconds, n=1):
+    if not ENABLED:
+        return
+    with _lock:
+        _seconds[phase] += seconds
+        _counts[phase] += n
+
+
+def count(counter, n=1):
+    if not ENABLED:
+        return
+    with _lock:
+        _counts[counter] += n
+
+
+@contextmanager
+def span(phase):
+    """Times a with-block into `phase` (no-op unless AMTPU_TRACE=1)."""
+    if not ENABLED:
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        add(phase, time.perf_counter() - t0)
+
+
+def reset():
+    with _lock:
+        _seconds.clear()
+        _counts.clear()
+
+
+def snapshot():
+    """{phase: {'s': seconds, 'n': calls}} accumulated since reset()."""
+    with _lock:
+        keys = set(_seconds) | set(_counts)
+        return {k: {'s': _seconds.get(k, 0.0), 'n': _counts.get(k, 0)}
+                for k in sorted(keys)}
+
+
+def report():
+    snap = snapshot()
+    if not snap:
+        return 'trace: (empty)'
+    width = max(len(k) for k in snap)
+    lines = ['trace (occupancy seconds; threads overlap):']
+    for k, v in sorted(snap.items(), key=lambda kv: -kv[1]['s']):
+        lines.append('  %-*s %8.3fs  x%d' % (width, k, v['s'], v['n']))
+    return '\n'.join(lines)

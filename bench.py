@@ -132,13 +132,18 @@ def main():
     # median of 3 fresh-pool runs (the device link is shared; single runs
     # jitter +-30%)
     import gc
+
+    from automerge_tpu import trace
     times = []
     pool = None
-    for _ in range(3):
+    for run in range(3):
+        trace.reset()
         pool = ShardedNativePool(N_SHARDS)
         t0 = time.perf_counter()
         pool.apply_batch_bytes(payload)
         times.append(time.perf_counter() - t0)
+        if trace.ENABLED and run == 0:
+            print(trace.report(), file=sys.stderr)
         gc.collect()
     tpu_s = sorted(times)[1]
     tpu_rate = total_ops / tpu_s

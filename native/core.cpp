@@ -17,6 +17,7 @@
 // Build: see native/Makefile (g++ -O2 -shared -fPIC).
 
 #include <algorithm>
+#include <chrono>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
@@ -404,15 +405,21 @@ struct DomEntry {    // one list-assign op in a per-object timeline
   i64 op_idx;
   i64 reg_row;
   i32 eidx;
-  i32 delta;
 };
 
-struct DomBlock {    // one packed kernel dispatch
+// One dominance size class.  Built at begin() with the device-source index
+// maps (er_src/orank_src/dom_src) that let the FUSED kernel gather its
+// rank/delta inputs on device; the host-side er/orank/od mirrors are only
+// filled by mid_phase() on the overflow-fallback path.
+struct DomBlock {
   i64 W, Lp, Tp;
-  std::vector<float> v0;       // [W*Lp]
-  std::vector<i32> er;         // [W*Lp]
-  std::vector<i32> oe, orank, od;  // [W*Tp]
+  std::vector<float> v0;       // [W*Lp] visibility at batch start
+  std::vector<i32> er_src;     // [W*Lp] arena-global element index or -1
+  std::vector<i32> oe;         // [W*Tp] local element index per timeline op
+  std::vector<i32> orank_src;  // [W*Tp] arena-global element index or -1
+  std::vector<i32> dom_src;    // [W*Tp] register row of the op or -1
   std::vector<u8> ov;          // [W*Tp]
+  std::vector<i32> er, orank, od;  // fallback-path mirrors (filled in mid)
   std::vector<u64> akeys;      // slab rows: (doc << 32 | obj)
   std::vector<i32> indexes;    // filled by python, [W*Tp]
 };
@@ -469,24 +476,39 @@ struct Batch {
   // dominance
   std::vector<DomBlock> dom_blocks;
   std::unordered_map<i64, std::pair<i32, i64>> list_index_of_op;
-  std::vector<u64> obj_ops_order;
   std::unordered_map<u64, std::vector<DomEntry>> obj_ops;
+  std::vector<i32> eidx_of_op;                    // op_idx -> eidx or -1
+  std::vector<std::pair<i64, i64>> missing_eidx;  // (op_idx, reg_row)
+  bool fused_ok = false;
 
   // result
   std::vector<u8> result;
 
   std::string err_msg;
   int err_kind = -1;
+
+  // phase wall times (seconds), read back via amtpu_batch_trace
+  double tr_decode = 0, tr_schedule = 0, tr_encode = 0, tr_mid = 0,
+         tr_emit = 0, tr_domlay = 0;
 };
+
+// thread CPU time, not wall: phase costs stay truthful when sharded pools
+// contend for the host's single core (descheduled time doesn't count)
+static inline double mono_now() {
+  timespec ts;
+  clock_gettime(CLOCK_THREAD_CPUTIME_ID, &ts);
+  return ts.tv_sec + ts.tv_nsec * 1e-9;
+}
 
 // ---------------------------------------------------------------------------
 // phase 1: schedule + prepass + encode
 // ---------------------------------------------------------------------------
 
-static Clock all_deps_of(DocState& st, u32 actor, u32 seq) {
+static const Clock& all_deps_of(DocState& st, u32 actor, u32 seq) {
+  static const Clock kEmpty;
   auto it = st.states.find(actor);
-  if (it == st.states.end()) return {};
-  if (seq == 0 || seq > it->second.size()) return {};
+  if (it == st.states.end()) return kEmpty;
+  if (seq == 0 || seq > it->second.size()) return kEmpty;
   return it->second[seq - 1].all_deps;
 }
 
@@ -540,7 +562,7 @@ static void update_states(Pool& pool, Batch& b) {
     Clock all_deps;
     for (auto& [da, ds] : base) {
       if (ds == 0) continue;
-      Clock trans = all_deps_of(st, da, ds);
+      const Clock& trans = all_deps_of(st, da, ds);
       for (auto& [ta, ts] : trans) clock_set_max(all_deps, ta, ts);
       clock_set_max(all_deps, da, ds);
     }
@@ -780,15 +802,18 @@ static void encode(Pool& pool, Batch& b) {
     if (b.CT == 0) { b.clock_tab.resize(b.Ap, 0); b.CT = 1; }
     b.CTp = bucket(b.CT, 4);
     b.clock_tab.resize(b.CTp * b.Ap, 0);
-    // host sort (group, time); padding g=-1 first
+    // host sort by (group, time), padding (g=-1) first.  Rows are already
+    // emitted in time order within each group (state rows carry negative
+    // times and precede batch rows, which are appended in op order), so a
+    // stable counting sort on the group key alone yields the full (g, t)
+    // order in O(T) -- no comparison sort.
+    const i64 n_groups = static_cast<i64>(gid_order.size());
+    std::vector<i32> bucket_pos(n_groups + 2, 0);
+    for (i64 i = 0; i < b.Tp; ++i) bucket_pos[b.g_col[i] + 2]++;
+    for (i64 g = 1; g < n_groups + 2; ++g) bucket_pos[g] += bucket_pos[g - 1];
     b.sort_idx.resize(b.Tp);
-    for (i64 i = 0; i < b.Tp; ++i) b.sort_idx[i] = static_cast<i32>(i);
-    std::stable_sort(b.sort_idx.begin(), b.sort_idx.end(),
-                     [&](i32 x, i32 y) {
-                       if (b.g_col[x] != b.g_col[y])
-                         return b.g_col[x] < b.g_col[y];
-                       return b.t_col[x] < b.t_col[y];
-                     });
+    for (i64 i = 0; i < b.Tp; ++i)
+      b.sort_idx[bucket_pos[b.g_col[i] + 1]++] = static_cast<i32>(i);
   } else {
     b.Tp = 0;
   }
@@ -818,19 +843,26 @@ static void encode(Pool& pool, Batch& b) {
     b.ctr_col.resize(b.Lp, 0);
     b.act_col.resize(b.Lp, 0);
     b.val_col.resize(b.Lp, 0);
-    // sibling sort: (obj-with-invalid-last, parent, -ctr, -actor)
+    // sibling sort: (obj-with-invalid-last, parent, -ctr, -actor).  Arena
+    // columns were emitted arena-by-arena (obj ascending), so sorting each
+    // arena's segment independently gives the global order with much
+    // smaller sorts; padding rows (val=0) sort last by construction.
     b.lin_sort.resize(b.Lp);
     for (i64 i = 0; i < b.Lp; ++i) b.lin_sort[i] = static_cast<i32>(i);
-    const i32 BIG = 1 << 30;
-    std::stable_sort(
-        b.lin_sort.begin(), b.lin_sort.end(), [&](i32 x, i32 y) {
-          i32 ox = b.val_col[x] ? b.obj_col[x] : BIG;
-          i32 oy = b.val_col[y] ? b.obj_col[y] : BIG;
-          if (ox != oy) return ox < oy;
-          if (b.par_col[x] != b.par_col[y]) return b.par_col[x] < b.par_col[y];
-          if (b.ctr_col[x] != b.ctr_col[y]) return b.ctr_col[x] > b.ctr_col[y];
-          return b.act_col[x] > b.act_col[y];
-        });
+    auto sib_less = [&](i32 x, i32 y) {
+      if (b.par_col[x] != b.par_col[y]) return b.par_col[x] < b.par_col[y];
+      if (b.ctr_col[x] != b.ctr_col[y]) return b.ctr_col[x] > b.ctr_col[y];
+      return b.act_col[x] > b.act_col[y];
+    };
+    i64 seg = 0;
+    while (seg < b.L) {
+      i64 end = seg + 1;
+      const i32 o = b.obj_col[seg];
+      while (end < b.L && b.obj_col[end] == o) ++end;
+      std::sort(b.lin_sort.begin() + seg, b.lin_sort.begin() + end,
+                sib_less);
+      seg = end;
+    }
   } else {
     b.Lp = 0;
   }
@@ -841,9 +873,113 @@ static void encode(Pool& pool, Batch& b) {
 // ---------------------------------------------------------------------------
 
 static bool rec_concurrent(DocState& st, const OpRec& o1, const OpRec& o2) {
-  Clock c1 = all_deps_of(st, o1.actor, o1.seq);
-  Clock c2 = all_deps_of(st, o2.actor, o2.seq);
+  const Clock& c1 = all_deps_of(st, o1.actor, o1.seq);
+  const Clock& c2 = all_deps_of(st, o2.actor, o2.seq);
   return clock_get(c1, o2.actor) < o2.seq && clock_get(c2, o1.actor) < o1.seq;
+}
+
+// Built at the end of begin(): per-object dominance timelines and the
+// packed kernel layout.  Deltas (od) and rank-derived inputs (er/orank)
+// are NOT filled here -- the fused device kernel gathers them on device
+// from its own register/linearize outputs via the *_src index maps; the
+// host fallback path (amtpu_mid) fills the er/orank/od mirrors instead.
+static void dom_layout(Pool& pool, Batch& b) {
+  b.eidx_of_op.assign(b.ops.size(), -1);
+  std::vector<u64> obj_order;  // first-seen object order (layout-local)
+
+  for (size_t op_idx = 0; op_idx < b.ops.size(); ++op_idx) {
+    i64 row = b.assign_row_of_op[op_idx];
+    if (row < 0) continue;
+    auto& f = b.ops[op_idx];
+    const OpRec& op = *f.op;
+    DocState& st = *b.bdocs[f.doc];
+    auto oit = st.objects.find(op.obj);
+    if (oit == st.objects.end() || !is_list_type(oit->second.type)) continue;
+    u64 ak = (static_cast<u64>(f.doc) << 32) | op.obj;
+    Arena& ar = st.arenas[op.obj];
+    const std::string& kstr = pool.intern.str(op.key);
+    u32 ea; i64 ec;
+    i32 eidx = -1;
+    if (parse_elem_id(kstr, pool.intern, &ea, &ec)) {
+      auto eit = ar.index_of.find(Arena::ekey(ea, ec));
+      if (eit != ar.index_of.end()) eidx = eit->second;
+    }
+    if (eidx < 0) {
+      // only an error if the op leaves the element visible -- checked
+      // after the register kernel runs (mid/mid_fused)
+      b.missing_eidx.emplace_back(static_cast<i64>(op_idx), row);
+      continue;
+    }
+    b.eidx_of_op[op_idx] = eidx;
+    auto oit2 = b.obj_ops.find(ak);
+    if (oit2 == b.obj_ops.end()) {
+      obj_order.push_back(ak);
+      oit2 = b.obj_ops.emplace(ak, std::vector<DomEntry>{}).first;
+    }
+    oit2->second.push_back({static_cast<i64>(op_idx), row, eidx});
+  }
+
+  // one block per (Lp, Tp) size class
+  const i64 K = 64;
+  std::map<std::pair<i64, i64>, std::vector<u64>> classes;
+  for (u64 ak : obj_order) {
+    auto& entries = b.obj_ops[ak];
+    if (entries.empty()) continue;
+    Arena& ar = b.bdocs[ak >> 32]->arenas[static_cast<u32>(ak)];
+    i64 n_elems = static_cast<i64>(ar.ctr.size());
+    i64 Lp = bucket(std::max<i64>(n_elems, 1));
+    i64 Tp = bucket(static_cast<i64>(entries.size()), K);
+    classes[{Lp, Tp}].push_back(ak);
+  }
+
+  for (auto& [key, aks] : classes) {
+    auto [Lp, Tp] = key;
+    // bucket the object-axis width too: every dim of the kernel shape
+    // keys the jit compile cache, and arena counts vary batch to batch
+    // (padding rows are zero-filled and inert)
+    i64 W = bucket(static_cast<i64>(aks.size()), 1);
+    DomBlock blk;
+    blk.W = W; blk.Lp = Lp; blk.Tp = Tp;
+    blk.v0.assign(W * Lp, 0.0f);
+    blk.er_src.assign(W * Lp, -1);
+    blk.oe.assign(W * Tp, -1);
+    blk.orank_src.assign(W * Tp, -1);
+    blk.dom_src.assign(W * Tp, -1);
+    blk.ov.assign(W * Tp, 0);
+    for (i64 o = 0; o < static_cast<i64>(aks.size()); ++o) {
+      u64 ak = aks[o];
+      i64 base = b.arena_base[ak];
+      Arena& ar = b.bdocs[ak >> 32]->arenas[static_cast<u32>(ak)];
+      for (size_t i = 0; i < ar.ctr.size(); ++i) {
+        blk.v0[o * Lp + i] = ar.visible[i] ? 1.0f : 0.0f;
+        blk.er_src[o * Lp + i] = static_cast<i32>(base + i);
+      }
+      auto& entries = b.obj_ops[ak];
+      for (size_t t = 0; t < entries.size(); ++t) {
+        blk.oe[o * Tp + t] = entries[t].eidx;
+        blk.orank_src[o * Tp + t] = static_cast<i32>(base + entries[t].eidx);
+        blk.dom_src[o * Tp + t] = static_cast<i32>(entries[t].reg_row);
+        blk.ov[o * Tp + t] = 1;
+      }
+      blk.akeys.push_back(ak);
+    }
+    blk.indexes.assign(W * Tp, 0);
+    b.dom_blocks.push_back(std::move(blk));
+  }
+
+  // fused eligibility: at most one size class whose [W, Lp, chunk] mask
+  // intermediate and [W, Tp] op arrays stay within device memory budget,
+  // and T small enough for the packed-transfer winner field
+  if (b.dom_blocks.empty()) {
+    b.fused_ok = true;
+  } else if (b.dom_blocks.size() == 1) {
+    DomBlock& d = b.dom_blocks[0];
+    b.fused_ok = d.W * d.Lp * K * 4 <= (2LL << 30) &&
+                 d.W * d.Tp * 4 <= (1LL << 29);
+  } else {
+    b.fused_ok = false;
+  }
+  if (b.Tp >= (1 << 24)) b.fused_ok = false;
 }
 
 static void mid_phase(Pool& pool, Batch& b) {
@@ -892,114 +1028,51 @@ static void mid_phase(Pool& pool, Batch& b) {
     }
   }
 
-  // per-object dominance timelines
-  std::unordered_map<u64, std::vector<DomEntry>> obj_ops;
-  std::vector<u64> obj_order;
-  std::unordered_map<u64, char> vis_now;  // (arena base + eidx) -> bool
-
-  for (size_t op_idx = 0; op_idx < b.ops.size(); ++op_idx) {
-    i64 row = b.assign_row_of_op[op_idx];
-    if (row < 0) continue;
-    auto& f = b.ops[op_idx];
-    const OpRec& op = *f.op;
-    DocState& st = *b.bdocs[f.doc];
-    auto oit = st.objects.find(op.obj);
-    if (oit == st.objects.end() || !is_list_type(oit->second.type)) continue;
-    u64 ak = (static_cast<u64>(f.doc) << 32) | op.obj;
-    Arena& ar = st.arenas[op.obj];
-    const std::string& kstr = pool.intern.str(op.key);
-    u32 ea; i64 ec;
-    i32 eidx = -1;
-    if (parse_elem_id(kstr, pool.intern, &ea, &ec)) {
-      auto eit = ar.index_of.find(Arena::ekey(ea, ec));
-      if (eit != ar.index_of.end()) eidx = eit->second;
-    }
+  // missing-element check: an op with no arena entry may only leave the
+  // element invisible (a remove of a nonexistent element is dropped)
+  for (auto& [op_idx, row] : b.missing_eidx) {
     bool alive_now;
-    auto hit = b.host_registers.find(static_cast<i64>(op_idx));
+    auto hit = b.host_registers.find(op_idx);
     if (hit != b.host_registers.end()) alive_now = !hit->second.empty();
     else alive_now = b.k_alive[row] > 0;
-    if (eidx < 0) {
-      if (alive_now)
-        throw Error(0, "Missing index entry for list element " + kstr);
-      continue;
-    }
-    i64 base = b.arena_base[ak];
-    u64 vk = static_cast<u64>(base + eidx);
-    bool before;
-    auto vit = vis_now.find(vk);
-    if (vit != vis_now.end()) before = vit->second;
-    else before = ar.visible[eidx] != 0;
-    vis_now[vk] = alive_now ? 1 : 0;
-    auto oit2 = obj_ops.find(ak);
-    if (oit2 == obj_ops.end()) {
-      obj_order.push_back(ak);
-      oit2 = obj_ops.emplace(ak, std::vector<DomEntry>{}).first;
-    }
-    oit2->second.push_back({static_cast<i64>(op_idx), row, eidx,
-                            static_cast<i32>(alive_now) -
-                                static_cast<i32>(before)});
+    if (alive_now)
+      throw Error(0, "Missing index entry for list element " +
+                         pool.intern.str(b.ops[op_idx].op->key));
   }
 
-  // size classes -> memory-bounded slabs (mirrors engine._dominance)
-  const i64 K = 64;
-  std::map<std::pair<i64, i64>, std::vector<u64>> classes;
-  for (u64 ak : obj_order) {
-    auto& entries = obj_ops[ak];
-    if (entries.empty()) continue;
-    Arena& ar = b.bdocs[ak >> 32]->arenas[static_cast<u32>(ak)];
-    i64 n_elems = static_cast<i64>(ar.ctr.size());
-    i64 Lp = bucket(std::max<i64>(n_elems, 1));
-    i64 Tp = bucket(static_cast<i64>(entries.size()), K);
-    classes[{Lp, Tp}].push_back(ak);
-  }
-
-  for (auto& [key, aks] : classes) {
-    auto [Lp, Tp] = key;
-    i64 W = bucket(std::min<i64>(static_cast<i64>(aks.size()), 4096), 1);
-    // bound BOTH the [W, Lp, K] mask product and the [W, Tp] op arrays
-    while (W > 1 && (W * Lp * K * 4 > 256LL * (1 << 20) ||
-                     W * Tp * 4 > 256LL * (1 << 20)))
-      W /= 2;
-    for (size_t s = 0; s < aks.size(); s += W) {
-      DomBlock blk;
-      blk.W = W; blk.Lp = Lp; blk.Tp = Tp;
-      blk.v0.assign(W * Lp, 0.0f);
-      blk.er.assign(W * Lp, -1);
-      blk.oe.assign(W * Tp, -1);
-      blk.orank.assign(W * Tp, -1);
-      blk.od.assign(W * Tp, 0);
-      blk.ov.assign(W * Tp, 0);
-      size_t hi = std::min(aks.size(), s + W);
-      for (size_t o = s; o < hi; ++o) {
-        u64 ak = aks[o];
-        i64 base = b.arena_base[ak];
-        Arena& ar = b.bdocs[ak >> 32]->arenas[static_cast<u32>(ak)];
-        i64 row = static_cast<i64>(o - s);
-        for (size_t i = 0; i < ar.ctr.size(); ++i) {
-          blk.v0[row * Lp + i] = ar.visible[i] ? 1.0f : 0.0f;
-          blk.er[row * Lp + i] = b.rank[base + i];
-        }
-        auto& entries = obj_ops[ak];
-        for (size_t t = 0; t < entries.size(); ++t) {
-          blk.oe[row * Tp + t] = entries[t].eidx;
-          blk.orank[row * Tp + t] = b.rank[base + entries[t].eidx];
-          blk.od[row * Tp + t] = entries[t].delta;
-          blk.ov[row * Tp + t] = 1;
-        }
-        blk.akeys.push_back(ak);
+  // fill the fallback-path mirrors (er/orank from the fetched rank, od
+  // from running host visibility); timelines/layout were built at begin
+  std::unordered_map<u64, char> vis_now;  // (arena base + eidx) -> bool
+  for (auto& blk : b.dom_blocks) {
+    blk.er.assign(blk.W * blk.Lp, -1);
+    blk.orank.assign(blk.W * blk.Tp, -1);
+    blk.od.assign(blk.W * blk.Tp, 0);
+    for (size_t o = 0; o < blk.akeys.size(); ++o) {
+      u64 ak = blk.akeys[o];
+      i64 base = b.arena_base[ak];
+      Arena& ar = b.bdocs[ak >> 32]->arenas[static_cast<u32>(ak)];
+      for (size_t i = 0; i < ar.ctr.size(); ++i)
+        blk.er[o * blk.Lp + i] = b.rank[base + i];
+      auto& entries = b.obj_ops[ak];
+      for (size_t t = 0; t < entries.size(); ++t) {
+        const DomEntry& e = entries[t];
+        bool alive_now;
+        auto hit = b.host_registers.find(e.op_idx);
+        if (hit != b.host_registers.end()) alive_now = !hit->second.empty();
+        else alive_now = b.k_alive[e.reg_row] > 0;
+        u64 vk = static_cast<u64>(base + e.eidx);
+        bool before;
+        auto vit = vis_now.find(vk);
+        if (vit != vis_now.end()) before = vit->second;
+        else before = ar.visible[e.eidx] != 0;
+        vis_now[vk] = alive_now ? 1 : 0;
+        blk.orank[o * blk.Tp + t] = b.rank[base + e.eidx];
+        blk.od[o * blk.Tp + t] = static_cast<i32>(alive_now) -
+                                 static_cast<i32>(before);
       }
-      blk.indexes.assign(W * Tp, 0);
-      b.dom_blocks.push_back(std::move(blk));
     }
   }
-
-  // stash obj_ops for finish(): encode into list_index map after python
-  // fills blk.indexes; store entries alongside blocks
-  // (re-derive in finish via the same obj_ops ordering kept here)
   b.result.clear();
-  // keep obj_ops in batch for finish
-  b.obj_ops_order = std::move(obj_order);
-  b.obj_ops = std::move(obj_ops);
 }
 
 // ---------------------------------------------------------------------------
@@ -1020,15 +1093,14 @@ static void collect_indexes(Batch& b) {
   }
 }
 
-static Register register_from_kernel(Batch& b, i64 row) {
-  Register reg;
+static void register_from_kernel(Batch& b, i64 row, Register& reg) {
+  reg.clear();
   i32 w = b.k_winner[row];
   if (w >= 0) reg.push_back(*b.src_records[w]);
   for (int c = 0; c < b.window; ++c) {
     i32 s = b.k_conflicts[row * b.window + c];
     if (s >= 0) reg.push_back(*b.src_records[s]);
   }
-  return reg;
 }
 
 static void update_register_mirror(Pool& pool, DocState& st, const OpRec& op,
@@ -1178,12 +1250,7 @@ static bool emit_list_diff(Writer& w, Pool& pool, DocState& st,
   Arena& ar = st.arenas[op.obj];
   auto iit = b.list_index_of_op.find(op_idx);
   const std::string& kstr = pool.intern.str(op.key);
-  u32 ea; i64 ec;
-  i32 eidx = -1;
-  if (parse_elem_id(kstr, pool.intern, &ea, &ec)) {
-    auto eit = ar.index_of.find(Arena::ekey(ea, ec));
-    if (eit != ar.index_of.end()) eidx = eit->second;
-  }
+  i32 eidx = b.eidx_of_op[op_idx];  // cached by dom_layout at begin
   if (iit == b.list_index_of_op.end() || eidx < 0) return false;
   i32 index = iit->second.first;
   bool visible_before = ar.visible[eidx] != 0;
@@ -1247,6 +1314,7 @@ static void emit(Pool& pool, Batch& b) {
   // diffs per doc, in op order
   std::vector<Writer> diff_bufs(b.bdoc_ids.size());
   std::vector<size_t> diff_counts(b.bdoc_ids.size(), 0);
+  Register reg;  // reused across ops (capacity persists)
 
   for (size_t op_idx = 0; op_idx < b.ops.size(); ++op_idx) {
     auto& f = b.ops[op_idx];
@@ -1265,10 +1333,9 @@ static void emit(Pool& pool, Batch& b) {
     if (op.action == A_INS) continue;
 
     i64 row = b.assign_row_of_op[op_idx];
-    Register reg;
     auto hit = b.host_registers.find(static_cast<i64>(op_idx));
     if (hit != b.host_registers.end()) reg = hit->second;
-    else reg = register_from_kernel(b, row);
+    else register_from_kernel(b, row, reg);
 
     update_register_mirror(pool, st, op, reg);
     u8 obj_type = st.objects[op.obj].type;
@@ -1469,6 +1536,7 @@ void* amtpu_begin(void* pool_ptr, const uint8_t* data, int64_t len) {
   h->pool = &pool;
   h->batch.pool = &pool;
   try {
+    double t0 = mono_now();
     Reader r(data, static_cast<size_t>(len));
     size_t n_docs = r.read_map();
     Batch& b = h->batch;
@@ -1485,10 +1553,18 @@ void* amtpu_begin(void* pool_ptr, const uint8_t* data, int64_t len) {
       b.bdoc_ids.push_back(std::move(doc_id));
       incoming.push_back(std::move(chs));
     }
+    double t1 = mono_now();
+    b.tr_decode = t1 - t0;
     schedule(pool, h->batch, incoming);
     update_states(pool, h->batch);
     prepass(pool, h->batch);
+    double t2 = mono_now();
+    b.tr_schedule = t2 - t1;
     encode(pool, h->batch);
+    double t3 = mono_now();
+    b.tr_encode = t3 - t2;
+    dom_layout(pool, h->batch);
+    b.tr_domlay = mono_now() - t3;
   } catch (const Error& e) {
     g_error = e.what(); g_error_kind = e.kind;
     return nullptr;
@@ -1545,8 +1621,13 @@ int amtpu_mid(void* bp, const int32_t* winner, const int32_t* conflicts,
       b.k_alive.assign(alive, alive + b.Tp);
       b.k_overflow.assign(overflow, overflow + b.Tp);
     }
-    if (b.Lp > 0) b.rank.assign(rank, rank + b.Lp);
+    // rank is only consumed by the dominance-block mirror fill; callers
+    // with no dominance work pass an empty buffer
+    if (b.Lp > 0 && !b.dom_blocks.empty())
+      b.rank.assign(rank, rank + b.Lp);
+    double t0 = mono_now();
     mid_phase(*h.pool, b);
+    b.tr_mid = mono_now() - t0;
   } catch (const Error& e) {
     g_error = e.what(); g_error_kind = e.kind;
     return -1;
@@ -1555,6 +1636,66 @@ int amtpu_mid(void* bp, const int32_t* winner, const int32_t* conflicts,
     return -1;
   }
   return 0;
+}
+
+// fused-path entry: register outputs + dominance indexes in one call, no
+// rank transfer.  Caller must have verified no overflow bit is set.
+int amtpu_mid_fused(void* bp, const int32_t* winner, const int32_t* conflicts,
+                    int window, const int32_t* alive, const uint8_t* overflow,
+                    const int32_t* dom_idx) {
+  BatchHandle& h = *static_cast<BatchHandle*>(bp);
+  Batch& b = h.batch;
+  try {
+    double t0 = mono_now();
+    b.window = window;
+    if (b.Tp > 0) {
+      b.k_winner.assign(winner, winner + b.Tp);
+      b.k_conflicts.assign(conflicts, conflicts + b.Tp * window);
+      b.k_alive.assign(alive, alive + b.Tp);
+      b.k_overflow.assign(overflow, overflow + b.Tp);
+    }
+    for (auto& [op_idx, row] : b.missing_eidx) {
+      if (b.k_alive[row] > 0)
+        throw Error(0, "Missing index entry for list element " +
+                           h.pool->intern.str(b.ops[op_idx].op->key));
+    }
+    i64 off = 0;
+    for (auto& blk : b.dom_blocks) {
+      blk.indexes.assign(dom_idx + off, dom_idx + off + blk.W * blk.Tp);
+      off += blk.W * blk.Tp;
+    }
+    b.tr_mid = mono_now() - t0;
+  } catch (const Error& e) {
+    g_error = e.what(); g_error_kind = e.kind;
+    return -1;
+  } catch (const std::exception& e) {
+    g_error = e.what(); g_error_kind = 0;
+    return -1;
+  }
+  return 0;
+}
+
+// fused eligibility + single-class dims: [fused_ok, W, Lp, Tp]
+void amtpu_fused_dims(void* bp, int64_t* out) {
+  Batch& b = static_cast<BatchHandle*>(bp)->batch;
+  out[0] = b.fused_ok ? 1 : 0;
+  if (b.dom_blocks.size() == 1) {
+    DomBlock& d = b.dom_blocks[0];
+    out[1] = d.W; out[2] = d.Lp; out[3] = d.Tp;
+  } else {
+    out[1] = out[2] = out[3] = 0;
+  }
+}
+
+// fused-path device-source index maps (block 0)
+const int32_t* amtpu_fdom_ersrc(void* bp) {
+  return static_cast<BatchHandle*>(bp)->batch.dom_blocks[0].er_src.data();
+}
+const int32_t* amtpu_fdom_oranksrc(void* bp) {
+  return static_cast<BatchHandle*>(bp)->batch.dom_blocks[0].orank_src.data();
+}
+const int32_t* amtpu_fdom_domsrc(void* bp) {
+  return static_cast<BatchHandle*>(bp)->batch.dom_blocks[0].dom_src.data();
 }
 
 // dominance block accessors
@@ -1577,8 +1718,10 @@ void amtpu_dom_set_indexes(void* bp, int64_t blk, const int32_t* idx) {
 int amtpu_finish(void* bp) {
   BatchHandle& h = *static_cast<BatchHandle*>(bp);
   try {
+    double t0 = mono_now();
     collect_indexes(h.batch);
     emit(*h.pool, h.batch);
+    h.batch.tr_emit = mono_now() - t0;
   } catch (const Error& e) {
     g_error = e.what(); g_error_kind = e.kind;
     return -1;
@@ -1587,6 +1730,14 @@ int amtpu_finish(void* bp) {
     return -1;
   }
   return 0;
+}
+
+// phase CPU times:
+// [decode, schedule+states+prepass, encode, mid, emit, dom_layout]
+void amtpu_batch_trace(void* bp, double* out) {
+  Batch& b = static_cast<BatchHandle*>(bp)->batch;
+  out[0] = b.tr_decode; out[1] = b.tr_schedule; out[2] = b.tr_encode;
+  out[3] = b.tr_mid; out[4] = b.tr_emit; out[5] = b.tr_domlay;
 }
 
 const uint8_t* amtpu_result(void* bp, int64_t* len) {
