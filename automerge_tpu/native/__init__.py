@@ -229,13 +229,22 @@ class NativeDocPool:
         """Host begin + async device dispatch.  Returns a context dict;
         the caller MUST pass it to `_phase_b` and then free ctx['bh'].
 
+        `payload` is msgpack bytes, or a zero-copy (ctypes char pointer,
+        length) pair -- the sharded driver passes views into the C++
+        splitter's buffers; amtpu_begin copies what it keeps, so the
+        buffer only needs to outlive this call.
+
         Splitting here lets a sharded driver overlap shard k+1's host
         `begin` with shard k's in-flight device work on a single thread
         (jax dispatches are async; the transfer is started with
         copy_to_host_async and collected in phase b)."""
         L = lib()
+        if isinstance(payload, tuple):
+            data, n = payload
+        else:
+            data, n = payload, len(payload)
         with trace.span('host.begin'):
-            bh = L.amtpu_begin(self._pool, payload, len(payload))
+            bh = L.amtpu_begin(self._pool, data, n)
         if not bh:
             _raise_last()
         ctx = {'bh': bh}
@@ -675,23 +684,23 @@ class ShardedNativePool:
             sp = L.amtpu_shard_split(payload, len(payload), self.n_shards)
             if not sp:
                 _raise_last()
-            try:
-                subs = []
-                for s in range(self.n_shards):
-                    n = ctypes.c_int64()
-                    ptr = L.amtpu_shard_buf(sp, s, ctypes.byref(n))
-                    subs.append(bytes(bytearray(ctypes.cast(
-                        ptr, ctypes.POINTER(
-                            ctypes.c_uint8 * n.value)).contents))
-                        if n.value else b'\x80')
-            finally:
-                L.amtpu_shard_free(sp)
-
-        with trace.span('shard.run'):
-            if self.mode == 'pipeline':
-                results = self._run_pipelined(subs)
-            else:
-                results = self._run_threaded(subs)
+        try:
+            # zero-copy: shard sub-payloads stay in the C++ splitter's
+            # buffers; begin() copies what it keeps, so the ShardSplit
+            # only needs to outlive the begin calls (freed below)
+            subs = []
+            for s in range(self.n_shards):
+                n = ctypes.c_int64()
+                ptr = L.amtpu_shard_buf(sp, s, ctypes.byref(n))
+                subs.append((ctypes.cast(ptr, ctypes.c_char_p), n.value)
+                            if n.value > 1 else None)
+            with trace.span('shard.run'):
+                if self.mode == 'pipeline':
+                    results = self._run_pipelined(subs)
+                else:
+                    results = self._run_threaded(subs)
+        finally:
+            L.amtpu_shard_free(sp)
         # merge the per-shard {doc: patch} maps at the byte level: sum the
         # map headers, splice the bodies -- no decode of patch contents
         total = 0
@@ -715,7 +724,7 @@ class ShardedNativePool:
         results = [None] * self.n_shards
         errors = []
         for s in range(self.n_shards):
-            if subs[s] == b'\x80':
+            if subs[s] is None:
                 continue
             try:
                 ctxs[s] = self.pools[s]._phase_a(subs[s])
@@ -740,7 +749,7 @@ class ShardedNativePool:
 
         def run(s):
             try:
-                if subs[s] != b'\x80':
+                if subs[s] is not None:
                     results[s] = self.pools[s].apply_batch_bytes(subs[s])
             except Exception as e:         # re-raised on the caller thread
                 errors.append(e)

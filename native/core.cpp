@@ -520,25 +520,36 @@ static void schedule(Pool& pool, Batch& b,
     Clock shadow = st.clock;
     std::vector<ChangeRec> queue = std::move(st.queue);
     st.queue.clear();
+    auto is_ready = [&](const ChangeRec& c) {
+      if (clock_get(shadow, c.actor) < c.seq - 1) return false;
+      for (auto& [da, ds] : c.deps)
+        if (clock_get(shadow, da) < ds) return false;
+      return true;
+    };
+    auto admit = [&](ChangeRec& c) {
+      if (c.seq <= clock_get(shadow, c.actor)) {
+        b.duplicates.emplace_back(doc, std::move(c));
+      } else {
+        clock_set_max(shadow, c.actor, c.seq);
+        b.applied.push_back({doc, std::move(c)});
+      }
+    };
     for (auto& ch : changes) {
-      queue.push_back(ch);
+      // fast path (the common in-order case): nothing buffered and the
+      // change is causally ready -- no queue machinery at all
+      if (queue.empty() && is_ready(ch)) {
+        admit(ch);
+        continue;
+      }
+      queue.push_back(std::move(ch));
       bool progress = true;
       while (progress) {
         progress = false;
         std::vector<ChangeRec> next_q;
         for (auto& c : queue) {
-          bool ready = clock_get(shadow, c.actor) >= c.seq - 1;
-          if (ready)
-            for (auto& [da, ds] : c.deps)
-              if (clock_get(shadow, da) < ds) { ready = false; break; }
-          if (ready) {
+          if (is_ready(c)) {
             progress = true;
-            if (c.seq <= clock_get(shadow, c.actor)) {
-              b.duplicates.emplace_back(doc, c);
-            } else {
-              clock_set_max(shadow, c.actor, c.seq);
-              b.applied.push_back({doc, c});
-            }
+            admit(c);
           } else {
             next_q.push_back(std::move(c));
           }
@@ -1315,6 +1326,23 @@ static void emit(Pool& pool, Batch& b) {
   std::vector<Writer> diff_bufs(b.bdoc_ids.size());
   std::vector<size_t> diff_counts(b.bdoc_ids.size(), 0);
   Register reg;  // reused across ops (capacity persists)
+
+  // pre-size the hot hash maps / buffers: most assign ops open a fresh
+  // register (every Text elemId is its own), and rehash storms during
+  // the emit loop dominate otherwise
+  {
+    std::vector<size_t> assigns(b.bdoc_ids.size(), 0), per(b.bdoc_ids.size(), 0);
+    for (auto& f : b.ops) {
+      per[f.doc]++;
+      if (is_assign(f.op->action)) assigns[f.doc]++;
+    }
+    for (size_t d = 0; d < b.bdoc_ids.size(); ++d) {
+      if (assigns[d])
+        b.bdocs[d]->registers.reserve(b.bdocs[d]->registers.size() +
+                                      assigns[d]);
+      diff_bufs[d].buf.reserve(per[d] * 48);
+    }
+  }
 
   for (size_t op_idx = 0; op_idx < b.ops.size(); ++op_idx) {
     auto& f = b.ops[op_idx];
