@@ -44,6 +44,9 @@ def _load():
     lib.amtpu_begin.restype = ctypes.c_void_p
     lib.amtpu_begin.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                 ctypes.c_int64]
+    lib.amtpu_begin_local.restype = ctypes.c_void_p
+    lib.amtpu_begin_local.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_char_p, ctypes.c_int64]
     lib.amtpu_batch_free.argtypes = [ctypes.c_void_p]
     lib.amtpu_batch_dims.argtypes = [ctypes.c_void_p,
                                      ctypes.POINTER(ctypes.c_int64)]
@@ -193,6 +196,8 @@ def _raise_last():
     from ..errors import AutomergeError, RangeError
     msg = lib().amtpu_last_error().decode()
     kind = lib().amtpu_last_error_kind()
+    if kind == 2:
+        raise TypeError(msg)
     raise (RangeError if kind == 1 else AutomergeError)(msg)
 
 
@@ -247,6 +252,12 @@ class NativeDocPool:
             bh = L.amtpu_begin(self._pool, data, n)
         if not bh:
             _raise_last()
+        return self._phase_a_rest(bh)
+
+    def _phase_a_rest(self, bh):
+        """Post-begin half of phase a: read batch dims and dispatch the
+        device kernels.  Shared by the batch and local-change entries."""
+        L = lib()
         ctx = {'bh': bh}
         try:
             dims = (ctypes.c_int64 * self.N_DIMS)()
@@ -581,6 +592,26 @@ class NativeDocPool:
     def apply_changes(self, doc_id, changes):
         return self.apply_batch({doc_id: changes})[doc_id]
 
+    def apply_local_change(self, doc_id, request):
+        """Applies one local change request with the reference's undo
+        semantics (backend/index.js:175-197): requestType 'change' records
+        inverse ops on the per-doc undo stack; 'undo'/'redo' execute the
+        stacks.  Returns the patch (incl. actor/seq and real
+        canUndo/canRedo)."""
+        key = self._doc_key(doc_id)
+        payload = msgpack.packb(request, use_bin_type=True)
+        with trace.span('host.begin'):
+            bh = lib().amtpu_begin_local(self._pool, key.encode(), payload,
+                                         len(payload))
+        if not bh:
+            _raise_last()
+        ctx = self._phase_a_rest(bh)
+        try:
+            out = self._phase_b(ctx)
+        finally:
+            lib().amtpu_batch_free(bh)
+        return msgpack.unpackb(out, raw=False, strict_map_key=False)[key]
+
     def get_patch(self, doc_id):
         out_len = ctypes.c_int64()
         ptr = lib().amtpu_get_patch(
@@ -771,6 +802,10 @@ class ShardedNativePool:
     def apply_changes(self, doc_id, changes):
         return self.pools[self._shard_of(doc_id)].apply_changes(
             doc_id, changes)
+
+    def apply_local_change(self, doc_id, request):
+        return self.pools[self._shard_of(doc_id)].apply_local_change(
+            doc_id, request)
 
     def get_patch(self, doc_id):
         return self.pools[self._shard_of(doc_id)].get_patch(doc_id)

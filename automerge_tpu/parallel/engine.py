@@ -77,6 +77,12 @@ class DocState:
         self.objects = {ROOT_ID: {'type': 'map', 'inbound': []}}
         self.registers = {}    # (obj, key) -> [op dicts], winner first
         self.arenas = {}       # obj -> Arena
+        # undo machinery (reference: op_set.js:310-322); stack entries are
+        # projected inverse-op dicts (action/obj/key/value for undo,
+        # + datatype for redo)
+        self.undo_stack = []
+        self.undo_pos = 0
+        self.redo_stack = []
 
 
 class TPUDocPool:
@@ -102,6 +108,70 @@ class TPUDocPool:
     def apply_batch(self, changes_by_doc):
         """Applies a batch of changes across many docs in one device pass;
         returns {doc_id: patch}."""
+        return self._apply_batch_inner(changes_by_doc, local=None)
+
+    def apply_local_change(self, doc_id, request):
+        """Applies one local change request with the reference's undo
+        semantics (backend/index.js:175-197); mirrors the native runtime's
+        amtpu_begin_local."""
+        if not isinstance(request.get('actor'), str) or \
+                not isinstance(request.get('seq'), int):
+            # 'requries' [sic]: parity with backend/index.js:177
+            raise TypeError(
+                'Change request requries `actor` and `seq` properties')
+        state = self.doc(doc_id)
+        actor, seq = request['actor'], request['seq']
+        if seq <= state.clock.get(actor, 0):
+            raise RangeError('Change request has already been applied')
+        request_type = request.get('requestType')
+        local = {'doc_id': doc_id, 'pending_redo': None}
+        if request_type == 'change':
+            local['kind'] = 1
+            change = {k: v for k, v in request.items()
+                      if k != 'requestType'}
+        elif request_type in ('undo', 'redo'):
+            if request_type == 'undo':
+                if state.undo_pos < 1 or \
+                        state.undo_pos > len(state.undo_stack):
+                    raise RangeError(
+                        'Cannot undo: there is nothing to be undone')
+                local['kind'] = 2
+                ops = state.undo_stack[state.undo_pos - 1]
+                redo_ops = []
+                for op in ops:
+                    if op['action'] not in ('set', 'del', 'link'):
+                        raise RangeError(
+                            'Unexpected operation type in undo history: %r'
+                            % (op,))
+                    recs = state.registers.get((op['obj'], op['key']), [])
+                    if not recs:
+                        redo_ops.append({'action': 'del', 'obj': op['obj'],
+                                         'key': op['key']})
+                    else:
+                        redo_ops.extend(
+                            {k: v for k, v in rec.items()
+                             if k not in ('actor', 'seq')} for rec in recs)
+                local['pending_redo'] = redo_ops
+            else:
+                if not state.redo_stack:
+                    raise RangeError(
+                        'Cannot redo: the last change was not an undo')
+                local['kind'] = 3
+                ops = state.redo_stack[-1]
+            change = {'actor': actor, 'seq': seq,
+                      'deps': request.get('deps', {}),
+                      'ops': [dict(op) for op in ops]}
+            if request.get('message') is not None:
+                change['message'] = request['message']
+        else:
+            raise RangeError('Unknown requestType: %s' % request_type)
+        patch = self._apply_batch_inner({doc_id: [change]},
+                                        local=local)[doc_id]
+        patch['actor'] = actor
+        patch['seq'] = seq
+        return patch
+
+    def _apply_batch_inner(self, changes_by_doc, local):
         doc_ids = list(changes_by_doc.keys())
         for doc_id in doc_ids:
             self.doc(doc_id)
@@ -141,13 +211,13 @@ class TPUDocPool:
         self._prepass(applied)
 
         # ---- 4. encode applied ops --------------------------------------
-        enc = self._encode(applied)
+        enc = self._encode(applied, local)
 
         # ---- 4. device kernels ------------------------------------------
         outputs = self._run_kernels(enc)
 
         # ---- 5. emission + mirror updates -------------------------------
-        diffs_by_doc = self._emit(enc, outputs)
+        diffs_by_doc = self._emit(enc, outputs, local)
 
         # ---- 6. patches --------------------------------------------------
         patches = {}
@@ -156,8 +226,8 @@ class TPUDocPool:
             patches[doc_id] = {
                 'clock': dict(state.clock),
                 'deps': dict(state.deps),
-                'canUndo': False,
-                'canRedo': False,
+                'canUndo': state.undo_pos > 0,
+                'canRedo': bool(state.redo_stack),
                 'diffs': diffs_by_doc.get(doc_id, []),
             }
         return patches
@@ -210,8 +280,8 @@ class TPUDocPool:
         return {
             'clock': dict(state.clock),
             'deps': dict(state.deps),
-            'canUndo': False,
-            'canRedo': False,
+            'canUndo': state.undo_pos > 0,
+            'canRedo': bool(state.redo_stack),
             'diffs': diffs,
         }
 
@@ -332,14 +402,16 @@ class TPUDocPool:
     # encoding
     # ------------------------------------------------------------------
 
-    def _encode(self, applied):
+    def _encode(self, applied, local=None):
         """Flattens applied changes into per-op columns + register state rows.
 
         Returns an `enc` dict consumed by _run_kernels/_emit."""
         ops = []           # (doc_id, op dict)
+        capture = []       # undo-capture flag per op (undoable mode only)
         group_ids = {}
         arena_objs = {}    # (doc_id, obj) -> local dense id
         involved_actor_sids = set()
+        undoable = bool(local) and local['kind'] == 1
 
         for doc_id, change in applied:
             actor, seq = change['actor'], change['seq']
@@ -348,9 +420,18 @@ class TPUDocPool:
             all_deps = state.states[actor][seq - 1]['allDeps']
             for da in all_deps:
                 involved_actor_sids.add(self.actor_ids.id_of(da))
+            # topLevel gate: assigns into objects created by the SAME change
+            # never capture inverse ops (op_set.js:233-250 newObjects)
+            new_objs = set()
             for raw_op in change['ops']:
                 op = dict(raw_op, actor=actor, seq=seq)
                 ops.append((doc_id, op))
+                if undoable:
+                    cap = op['action'] in ('set', 'del', 'link') and \
+                        op['obj'] not in new_objs
+                    if op['action'] in _MAKE_TYPES:
+                        new_objs.add(op['obj'])
+                    capture.append(cap)
 
         # actor ranks for this batch: batch actors + all actors appearing in
         # register state rows of touched groups / arena elements
@@ -392,6 +473,7 @@ class TPUDocPool:
 
         return {
             'ops': ops,
+            'capture': capture,
             'group_ids': group_ids,
             'arena_objs': arena_objs,
             'rank_of': rank_of,
@@ -674,12 +756,15 @@ class TPUDocPool:
     # emission
     # ------------------------------------------------------------------
 
-    def _emit(self, enc, outputs):
+    def _emit(self, enc, outputs, local=None):
         ops = enc['ops']
         reg_out = outputs['reg_out']
         src_records = outputs['src_records']
         assign_row_of_op = outputs['assign_row_of_op']
         list_index_of_op = outputs['list_index_of_op']
+        capture = enc['capture']
+        undoable = bool(local) and local['kind'] == 1
+        undo_local = []
 
         diffs_by_doc = {}
         for op_idx, (doc_id, op) in enumerate(ops):
@@ -710,6 +795,19 @@ class TPUDocPool:
                 new_register = self._register_from_kernel(
                     reg_out, row, src_records)
 
+            # undo capture reads the register BEFORE the mirror update --
+            # the reference's interleaved order (op_set.js:193-200);
+            # projection keeps only action/obj/key/value
+            if undoable and capture[op_idx]:
+                recs = state.registers.get((op['obj'], op['key']), [])
+                if recs:
+                    undo_local.extend(
+                        {k: rec[k] for k in ('action', 'obj', 'key', 'value')
+                         if k in rec} for rec in recs)
+                else:
+                    undo_local.append({'action': 'del', 'obj': op['obj'],
+                                       'key': op['key']})
+
             self._update_register_mirror(state, op, new_register)
             obj_type = state.objects[op['obj']]['type']
             if obj_type in _LIST_TYPES:
@@ -720,6 +818,23 @@ class TPUDocPool:
                 diff = self._emit_map_diff(state, op, new_register, obj_type)
             if diff is not None:
                 diffs.append(diff)
+
+        # local-change stack commits before patch assembly, so
+        # canUndo/canRedo report the post-change state
+        # (reference: pushUndoHistory, op_set.js:296-308)
+        if local:
+            state = self.docs[local['doc_id']]
+            if local['kind'] == 1:
+                del state.undo_stack[state.undo_pos:]
+                state.undo_stack.append(undo_local)
+                state.undo_pos += 1
+                state.redo_stack = []
+            elif local['kind'] == 2:
+                state.undo_pos -= 1
+                state.redo_stack.append(local['pending_redo'])
+            elif local['kind'] == 3:
+                state.undo_pos += 1
+                state.redo_stack.pop()
         return diffs_by_doc
 
     def _register_from_kernel(self, reg_out, row, src_records):

@@ -45,139 +45,25 @@ class SidecarBackend:
             from ..native import NativeDocPool
             pool = NativeDocPool()
         self.pool = pool
-        # per-doc clocks tracked from returned patches, so local-change
-        # seq validation does not re-materialize the whole document
-        self._clocks = {}
-        # per-doc undo machinery (reference: op_set.js:297-308 push,
-        # backend/index.js:254-310 execute): undo stack of inverse-op
-        # lists, cursor position, redo stack
-        self._undo = {}    # doc -> {'stack': [...], 'pos': int, 'redo': []}
-
-    def _undo_state(self, doc):
-        return self._undo.setdefault(doc, {'stack': [], 'pos': 0,
-                                           'redo': []})
-
-    def _note_patch(self, doc, patch):
-        self._clocks[doc] = dict(patch.get('clock', {}))
-        u = self._undo.get(doc)
-        if u is not None:
-            patch['canUndo'] = u['pos'] > 0
-            patch['canRedo'] = len(u['redo']) > 0
-        return patch
 
     # -- commands -------------------------------------------------------
 
     def apply_changes(self, doc, changes):
-        return self._note_patch(doc, self.pool.apply_changes(doc, changes))
+        return self.pool.apply_changes(doc, changes)
 
     def apply_batch(self, docs):
-        patches = self.pool.apply_batch(docs)
-        for doc, patch in patches.items():
-            self._note_patch(doc, patch)
-        return patches
+        return self.pool.apply_batch(docs)
 
     def apply_local_change(self, doc, request):
         """Local change request with the reference's validation and undo
-        semantics (backend/index.js:175-197, 254-310)."""
-        if not isinstance(request.get('actor'), str) or \
-                not isinstance(request.get('seq'), int):
-            # 'requries' [sic]: byte parity with the reference's own error
-            # text (backend/index.js:177)
-            raise TypeError(
-                'Change request requries `actor` and `seq` properties')
-        clock = self._clocks.get(doc)
-        if clock is None:
-            clock = self.pool.get_patch(doc)['clock']
-            self._clocks[doc] = dict(clock)
-        if request['seq'] <= clock.get(request['actor'], 0):
-            raise RangeError('Change request has already been applied')
-        request_type = request.get('requestType', 'change')
-        if request_type == 'change':
-            patch = self._local_change(doc, request)
-        elif request_type == 'undo':
-            patch = self._local_undo(doc, request)
-        elif request_type == 'redo':
-            patch = self._local_redo(doc, request)
-        else:
-            raise RangeError('Unknown requestType: %s' % request_type)
-        patch['actor'] = request['actor']
-        patch['seq'] = request['seq']
-        return patch
-
-    @staticmethod
-    def _strip(record, drop):
-        return {k: v for k, v in record.items() if k not in drop}
-
-    def _local_change(self, doc, request):
-        # inverse-op capture BEFORE applying (op_set.js:193-200): per
-        # assign op, the current register projected to action/obj/key/value
-        # -- or a del when the field was empty.  The frontend guarantees at
-        # most one assignment per (obj, key) per change
-        # (frontend/index.js:53), so pre-capture order equals the
-        # reference's interleaved capture.
-        undo_ops = []
-        for op in request.get('ops', []):
-            if op.get('action') not in ('set', 'del', 'link'):
-                continue
-            recs = self.pool.get_register(doc, op['obj'], op['key'])
-            inv = [self._strip(r, ('actor', 'seq', 'datatype'))
-                   for r in recs]
-            undo_ops.extend(inv or [{'action': 'del', 'obj': op['obj'],
-                                     'key': op['key']}])
-        # requestType is transport-only: it must not leak into the stored
-        # change history that get_missing_changes ships to peers
-        change = {k: v for k, v in request.items() if k != 'requestType'}
-        patch = self.pool.apply_changes(doc, [change])
-        u = self._undo_state(doc)
-        u['stack'] = u['stack'][:u['pos']] + [undo_ops]
-        u['pos'] += 1
-        u['redo'] = []
-        return self._note_patch(doc, patch)
-
-    def _local_undo(self, doc, request):
-        u = self._undo_state(doc)
-        if u['pos'] < 1 or u['pos'] > len(u['stack']):
-            raise RangeError('Cannot undo: there is nothing to be undone')
-        undo_ops = u['stack'][u['pos'] - 1]
-        # redo ops from the CURRENT field state (backend/index.js:264-278)
-        redo_ops = []
-        for op in undo_ops:
-            if op['action'] not in ('set', 'del', 'link'):
-                raise RangeError(
-                    'Unexpected operation type in undo history: %r' % (op,))
-            recs = self.pool.get_register(doc, op['obj'], op['key'])
-            if not recs:
-                redo_ops.append({'action': 'del', 'obj': op['obj'],
-                                 'key': op['key']})
-            else:
-                redo_ops.extend(self._strip(r, ('actor', 'seq'))
-                                for r in recs)
-        patch = self._apply_history_ops(doc, request, undo_ops)
-        u['pos'] -= 1
-        u['redo'].append(redo_ops)
-        return self._note_patch(doc, patch)
-
-    def _local_redo(self, doc, request):
-        u = self._undo_state(doc)
-        if not u['redo']:
-            raise RangeError('Cannot redo: the last change was not an undo')
-        redo_ops = u['redo'][-1]
-        patch = self._apply_history_ops(doc, request, redo_ops)
-        u['pos'] += 1
-        u['redo'].pop()
-        return self._note_patch(doc, patch)
-
-    def _apply_history_ops(self, doc, request, ops):
-        """Applies an undo/redo op list as a regular (non-undoable) change
-        with the request's envelope (backend/index.js:255-262)."""
-        change = {'actor': request['actor'], 'seq': request['seq'],
-                  'deps': request.get('deps', {}), 'ops': ops}
-        if request.get('message') is not None:
-            change['message'] = request['message']
-        return self.pool.apply_changes(doc, [change])
+        semantics (backend/index.js:175-197, 254-310).  The undo capture
+        runs inside the pool's runtime (amtpu_begin_local /
+        TPUDocPool.apply_local_change), reading the register mirror
+        in-process with the reference's topLevel gate."""
+        return self.pool.apply_local_change(doc, request)
 
     def get_patch(self, doc):
-        return self._note_patch(doc, self.pool.get_patch(doc))
+        return self.pool.get_patch(doc)
 
     def get_missing_deps(self, doc):
         return self.pool.get_missing_deps(doc)
@@ -215,7 +101,12 @@ class SidecarBackend:
             else:
                 raise RangeError('Unknown command: %r' % (cmd,))
             return {'id': rid, 'result': result}
-        except (AutomergeError, RangeError, TypeError, KeyError) as e:
+        except KeyError as e:
+            # a malformed request (missing field) maps into the protocol's
+            # documented error set instead of leaking Python's KeyError
+            return {'id': rid, 'error': 'missing required field: %s' % e,
+                    'errorType': 'RangeError'}
+        except (AutomergeError, RangeError, TypeError) as e:
             return {'id': rid, 'error': str(e),
                     'errorType': type(e).__name__}
 

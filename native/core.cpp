@@ -23,6 +23,7 @@
 #include <cstring>
 #include <deque>
 #include <map>
+#include <unordered_set>
 #include <memory>
 #include <string>
 #include <string_view>
@@ -195,6 +196,12 @@ struct DocState {
   std::unordered_map<u32, ObjMeta> objects;
   std::unordered_map<u64, Register> registers;  // (obj<<32|key)
   std::unordered_map<u32, Arena> arenas;
+  // undo machinery (reference: op_set.js:310-322 state; entries are
+  // projected inverse ops -- action/obj/key/value only for undo entries,
+  // + datatype for redo entries; actor=NONE, seq=0)
+  std::vector<std::vector<OpRec>> undo_stack;
+  size_t undo_pos = 0;
+  std::vector<std::vector<OpRec>> redo_stack;
 
   static u64 rkey(u32 obj, u32 key) {
     return (static_cast<u64>(obj) << 32) | key;
@@ -204,7 +211,7 @@ struct DocState {
 };
 
 struct Error : std::runtime_error {
-  // kind 0 = AutomergeError, 1 = RangeError
+  // kind 0 = AutomergeError, 1 = RangeError, 2 = TypeError
   int kind;
   Error(int k, const std::string& m) : std::runtime_error(m), kind(k) {}
 };
@@ -316,19 +323,47 @@ static OpRec decode_op(Reader& r, Pool& pool, u32 actor, u32 seq) {
   return op;
 }
 
-static ChangeRec decode_change(Reader& r, Pool& pool) {
+// Local-change request envelope metadata (reference applyLocalChange
+// validation, backend/index.js:175-190).  When passed to decode_change,
+// the requestType pair is also STRIPPED from ch.raw -- requestType is
+// transport-only and must not leak into the stored history that
+// get_missing_changes ships to peers (backend/index.js:145).
+struct LocalReq {
+  bool has_actor = false, has_seq = false, has_request_type = false;
+  std::string request_type;
+};
+
+static ChangeRec decode_change(Reader& r, Pool& pool, LocalReq* lr = nullptr) {
   ChangeRec ch;
   const uint8_t* start = r.pos();
   size_t n = r.read_map();
+  const uint8_t* body = r.pos();
   ch.actor = NONE; ch.seq = 0;
   const uint8_t* ops_start = nullptr;
   const uint8_t* ops_end = nullptr;
+  const uint8_t* rt_start = nullptr;
+  const uint8_t* rt_end = nullptr;
   size_t ops_count = 0;
   for (size_t i = 0; i < n; ++i) {
+    const uint8_t* pair_start = r.pos();
     std::string_view k = r.read_str_view();
-    if (k == "actor") ch.actor = pool.intern.id_of(r.read_str_view());
-    else if (k == "seq") ch.seq = static_cast<u32>(r.read_int());
-    else if (k == "deps") {
+    if (k == "actor") {
+      // local-request mode tolerates a missing/mistyped actor (it becomes
+      // the reference's TypeError); the batch path stays strict
+      if (!lr) {
+        ch.actor = pool.intern.id_of(r.read_str_view());
+      } else if (r.peek_type() == Type::Str) {
+        ch.actor = pool.intern.id_of(r.read_str_view());
+        lr->has_actor = true;
+      } else r.skip();
+    } else if (k == "seq") {
+      if (!lr) {
+        ch.seq = static_cast<u32>(r.read_int());
+      } else if (r.peek_type() == Type::Int) {
+        ch.seq = static_cast<u32>(r.read_int());
+        lr->has_seq = true;
+      } else r.skip();
+    } else if (k == "deps") {
       size_t m = r.read_map();
       for (size_t j = 0; j < m; ++j) {
         u32 a = pool.intern.id_of(r.read_str_view());
@@ -346,9 +381,24 @@ static ChangeRec decode_change(Reader& r, Pool& pool) {
       auto span = r.raw_value();
       ch.has_message = true;
       ch.message.assign(span.first, span.first + span.second);
+    } else if (lr && k == "requestType") {
+      lr->has_request_type = true;
+      if (r.peek_type() == Type::Str)
+        lr->request_type = std::string(r.read_str_view());
+      else r.skip();
+      rt_start = pair_start;
+      rt_end = r.pos();
     } else r.skip();
   }
-  ch.raw.assign(start, r.pos());
+  if (rt_start) {
+    Writer wr;
+    wr.map(n - 1);
+    wr.raw(body, static_cast<size_t>(rt_start - body));
+    wr.raw(rt_end, static_cast<size_t>(r.pos() - rt_end));
+    ch.raw = std::move(wr.buf);
+  } else {
+    ch.raw.assign(start, r.pos());
+  }
   if (ops_start) {
     Reader ro(ops_start, static_cast<size_t>(ops_end - ops_start));
     ro.read_array();
@@ -480,6 +530,15 @@ struct Batch {
   std::vector<i32> eidx_of_op;                    // op_idx -> eidx or -1
   std::vector<std::pair<i64, i64>> missing_eidx;  // (op_idx, reg_row)
   bool fused_ok = false;
+
+  // local-change mode (apply_local_change / undo / redo):
+  // kind 0 = not local, 1 = undoable change, 2 = undo, 3 = redo
+  int local_kind = 0;
+  u32 local_actor = NONE;
+  u32 local_seq = 0;
+  std::vector<u8> capture;        // [n_ops] undo-capture flag (kind 1)
+  std::vector<OpRec> undo_local;  // captured inverse ops (filled in emit)
+  std::vector<OpRec> pending_redo;  // redo ops captured at begin (kind 2)
 
   // result
   std::vector<u8> result;
@@ -662,10 +721,21 @@ static void prepass(Pool& pool, Batch& b) {
 static void encode(Pool& pool, Batch& b) {
   Interner& in = pool.intern;
 
-  // flat op list
-  for (auto& ac : b.applied)
-    for (const OpRec& op : ac.change.ops)
+  // flat op list; in undoable (local-change) mode also flag which assign
+  // ops capture inverse ops: only those whose object was NOT created by
+  // the same change (reference topLevel gate, op_set.js:233-250 newObjects
+  // + :193-200)
+  for (auto& ac : b.applied) {
+    std::unordered_set<u32> new_objs;
+    for (const OpRec& op : ac.change.ops) {
       b.ops.push_back({ac.doc, &op});
+      if (b.local_kind == 1) {
+        bool cap = is_assign(op.action) && !new_objs.count(op.obj);
+        if (op.action >= A_MAKE_MAP) new_objs.insert(op.obj);
+        b.capture.push_back(cap ? 1 : 0);
+      }
+    }
+  }
 
   // --- discover groups / arenas; collect involved actors -----------------
   std::vector<u8> involved(in.size(), 0);
@@ -1365,6 +1435,26 @@ static void emit(Pool& pool, Batch& b) {
     if (hit != b.host_registers.end()) reg = hit->second;
     else register_from_kernel(b, row, reg);
 
+    // undo capture reads the register BEFORE this op's mirror update --
+    // the same interleaved order as the reference (op_set.js:193-200);
+    // projection keeps only action/obj/key/value
+    if (b.local_kind == 1 && b.capture[op_idx]) {
+      auto rit = st.registers.find(DocState::rkey(op.obj, op.key));
+      if (rit != st.registers.end() && !rit->second.empty()) {
+        for (const OpRec& rec : rit->second) {
+          OpRec p = rec;
+          p.actor = NONE; p.seq = 0; p.datatype = NONE; p.elem = -1;
+          b.undo_local.push_back(p);
+        }
+      } else {
+        OpRec d{};
+        d.action = A_DEL; d.obj = op.obj; d.key = op.key;
+        d.elem = -1; d.actor = NONE; d.seq = 0; d.datatype = NONE;
+        d.value_rid = NONE; d.value_sid = NONE;
+        b.undo_local.push_back(d);
+      }
+    }
+
     update_register_mirror(pool, st, op, reg);
     u8 obj_type = st.objects[op.obj].type;
     if (is_list_type(obj_type)) {
@@ -1377,20 +1467,44 @@ static void emit(Pool& pool, Batch& b) {
     }
   }
 
+  // local-change stack commits BEFORE patch assembly, so canUndo/canRedo
+  // report the post-change state (reference: pushUndoHistory before
+  // makePatch, op_set.js:296-308; undo/redo stack updates before
+  // addChange, backend/index.js:275-308)
+  if (b.local_kind == 1) {
+    DocState& st = *b.bdocs[0];
+    st.undo_stack.resize(st.undo_pos);
+    st.undo_stack.push_back(std::move(b.undo_local));
+    st.undo_pos++;
+    st.redo_stack.clear();
+  } else if (b.local_kind == 2) {
+    DocState& st = *b.bdocs[0];
+    st.undo_pos--;
+    st.redo_stack.push_back(std::move(b.pending_redo));
+  } else if (b.local_kind == 3) {
+    DocState& st = *b.bdocs[0];
+    st.undo_pos++;
+    st.redo_stack.pop_back();
+  }
+
   // assemble {doc_id: patch}
   Writer out;
   out.map(b.bdoc_ids.size());
   for (size_t d = 0; d < b.bdoc_ids.size(); ++d) {
     DocState& st = *b.bdocs[d];
     out.str(b.bdoc_ids[d]);
-    out.map(5);
+    out.map(b.local_kind ? 7 : 5);
     out.str("clock"); write_clock(out, pool, st.clock);
     out.str("deps"); write_clock(out, pool, st.deps);
-    out.str("canUndo"); out.boolean(false);
-    out.str("canRedo"); out.boolean(false);
+    out.str("canUndo"); out.boolean(st.undo_pos > 0);
+    out.str("canRedo"); out.boolean(!st.redo_stack.empty());
     out.str("diffs");
     out.array(diff_counts[d]);
     out.raw(diff_bufs[d].buf);
+    if (b.local_kind) {
+      out.str("actor"); out.str(pool.intern.str(b.local_actor));
+      out.str("seq"); out.integer(b.local_seq);
+    }
   }
   b.result = std::move(out.buf);
 }
@@ -1532,6 +1646,43 @@ static void materialize(Pool& pool, DocState& st, u32 object_id, Writer& w,
   count += own_count;
 }
 
+// ---------------------------------------------------------------------------
+// local changes (applyLocalChange / undo / redo)
+// ---------------------------------------------------------------------------
+
+// Encodes an undo/redo-built change as msgpack with the oracle's key order:
+// actor, seq, deps, ops[, message] (backend/__init__.py::_undo/_redo change
+// construction; byte parity of shipped local changes matters for
+// get_missing_changes).
+static std::vector<u8> encode_change_raw(Pool& pool, const ChangeRec& ch,
+                                         bool include_message) {
+  Writer w;
+  w.map(4 + (include_message ? 1 : 0));
+  w.str("actor"); w.str(pool.intern.str(ch.actor));
+  w.str("seq"); w.integer(ch.seq);
+  w.str("deps"); write_clock(w, pool, ch.deps);
+  w.str("ops"); w.array(ch.ops.size());
+  for (const OpRec& op : ch.ops) {
+    size_t k = 3 + (op.value_rid != NONE ? 1 : 0) +
+               (op.datatype != NONE ? 1 : 0);
+    w.map(k);
+    w.str("action"); w.str(action_name(op.action));
+    w.str("obj"); w.str(pool.intern.str(op.obj));
+    w.str("key"); w.str(pool.intern.str(op.key));
+    if (op.value_rid != NONE) { w.str("value"); w.raw(val_bytes(pool, op)); }
+    if (op.datatype != NONE) {
+      w.str("datatype"); w.str(pool.intern.str(op.datatype));
+    }
+  }
+  if (include_message) { w.str("message"); w.raw(ch.message); }
+  return w.buf;
+}
+
+static bool message_is_nil(const ChangeRec& ch) {
+  return !ch.has_message ||
+         (ch.message.size() == 1 && ch.message[0] == 0xc0);
+}
+
 }  // namespace amtpu
 
 // ===========================================================================
@@ -1593,6 +1744,119 @@ void* amtpu_begin(void* pool_ptr, const uint8_t* data, int64_t len) {
     b.tr_encode = t3 - t2;
     dom_layout(pool, h->batch);
     b.tr_domlay = mono_now() - t3;
+  } catch (const Error& e) {
+    g_error = e.what(); g_error_kind = e.kind;
+    return nullptr;
+  } catch (const std::exception& e) {
+    g_error = e.what(); g_error_kind = 0;
+    return nullptr;
+  }
+  return h.release();
+}
+
+// Local change request entry (reference: backend/index.js:175-197).  The
+// returned handle is driven through the same mid/finish phases as
+// amtpu_begin; the patch gains actor/seq keys and real canUndo/canRedo.
+void* amtpu_begin_local(void* pool_ptr, const char* doc_id,
+                        const uint8_t* data, int64_t len) {
+  Pool& pool = *static_cast<Pool*>(pool_ptr);
+  auto h = std::make_unique<BatchHandle>();
+  h->pool = &pool;
+  h->batch.pool = &pool;
+  try {
+    Reader r(data, static_cast<size_t>(len));
+    LocalReq lr;
+    ChangeRec req = decode_change(r, pool, &lr);
+    if (!lr.has_actor || !lr.has_seq)
+      // 'requries' [sic]: parity with the reference's own error text
+      // (backend/index.js:177)
+      throw Error(2, "Change request requries `actor` and `seq` properties");
+    DocState& st = pool.doc(doc_id);
+    if (req.seq <= clock_get(st.clock, req.actor))
+      throw Error(1, "Change request has already been applied");
+
+    Batch& b = h->batch;
+    b.local_actor = req.actor;
+    b.local_seq = req.seq;
+    ChangeRec change;
+    if (lr.has_request_type && lr.request_type == "change") {
+      b.local_kind = 1;
+      change = std::move(req);  // raw already stripped of requestType
+    } else if (lr.has_request_type && (lr.request_type == "undo" ||
+                                       lr.request_type == "redo")) {
+      bool is_undo = lr.request_type == "undo";
+      const std::vector<OpRec>* src_ops;
+      if (is_undo) {
+        if (st.undo_pos < 1 || st.undo_pos > st.undo_stack.size())
+          throw Error(1, "Cannot undo: there is nothing to be undone");
+        b.local_kind = 2;
+        src_ops = &st.undo_stack[st.undo_pos - 1];
+        for (const OpRec& op : *src_ops) {
+          if (!is_assign(op.action))
+            throw Error(1,
+                        std::string("Unexpected operation type in undo "
+                                    "history: ") + action_name(op.action));
+        }
+        // redo ops from the CURRENT field state, captured before the undo
+        // change applies (backend/index.js:264-278); projection keeps
+        // everything except actor/seq (datatype survives)
+        for (const OpRec& op : *src_ops) {
+          auto rit = st.registers.find(DocState::rkey(op.obj, op.key));
+          if (rit == st.registers.end() || rit->second.empty()) {
+            OpRec d{};
+            d.action = A_DEL; d.obj = op.obj; d.key = op.key;
+            d.elem = -1; d.actor = NONE; d.seq = 0; d.datatype = NONE;
+            d.value_rid = NONE; d.value_sid = NONE;
+            b.pending_redo.push_back(d);
+          } else {
+            for (const OpRec& rec : rit->second) {
+              OpRec p = rec;
+              p.actor = NONE; p.seq = 0; p.elem = -1;
+              b.pending_redo.push_back(p);
+            }
+          }
+        }
+      } else {
+        if (st.redo_stack.empty())
+          throw Error(1, "Cannot redo: the last change was not an undo");
+        b.local_kind = 3;
+        src_ops = &st.redo_stack.back();
+      }
+      change.actor = req.actor;
+      change.seq = req.seq;
+      change.deps = req.deps;
+      change.has_message = req.has_message;
+      change.message = req.message;
+      change.ops = *src_ops;
+      for (OpRec& op : change.ops) {
+        op.actor = req.actor;
+        op.seq = req.seq;
+      }
+      change.raw = encode_change_raw(pool, change, !message_is_nil(change));
+    } else {
+      // oracle parity: missing requestType reports as Python None
+      // (backend/__init__.py::apply_local_change)
+      throw Error(1, "Unknown requestType: " +
+                         (lr.has_request_type ? lr.request_type
+                                              : std::string("None")));
+    }
+
+    Batch& bb = h->batch;
+    bb.bdocs.push_back(&st);
+    bb.bdoc_ids.push_back(doc_id);
+    std::vector<std::vector<ChangeRec>> incoming(1);
+    incoming[0].push_back(std::move(change));
+    double t1 = mono_now();
+    schedule(pool, bb, incoming);
+    update_states(pool, bb);
+    prepass(pool, bb);
+    double t2 = mono_now();
+    bb.tr_schedule = t2 - t1;
+    encode(pool, bb);
+    double t3 = mono_now();
+    bb.tr_encode = t3 - t2;
+    dom_layout(pool, bb);
+    bb.tr_domlay = mono_now() - t3;
   } catch (const Error& e) {
     g_error = e.what(); g_error_kind = e.kind;
     return nullptr;
@@ -1790,8 +2054,8 @@ uint8_t* amtpu_get_patch(void* pool_ptr, const char* doc_id, int64_t* len) {
     out.map(5);
     out.str("clock"); write_clock(out, pool, st.clock);
     out.str("deps"); write_clock(out, pool, st.deps);
-    out.str("canUndo"); out.boolean(false);
-    out.str("canRedo"); out.boolean(false);
+    out.str("canUndo"); out.boolean(st.undo_pos > 0);
+    out.str("canRedo"); out.boolean(!st.redo_stack.empty());
     out.str("diffs");
     out.array(count);
     out.raw(diffs.buf);

