@@ -171,6 +171,7 @@ def dominance_grouped(vis0, elem_rank, op_elem, op_rank, op_delta, op_valid,
             r = jax.lax.dynamic_slice(orank, (sl,), (K,))
             d = jax.lax.dynamic_slice(od, (sl,), (K,))
             v = jax.lax.dynamic_slice(ov, (sl,), (K,))
+            d = jnp.where(v, d, 0)   # padding rows must not leak into corr
             # base: visible elements ranked below, at chunk start
             mask = (rank[:, None] < r[None, :])                     # [L, K]
             base = vis @ mask.astype(jnp.float32)                   # [K]

@@ -52,8 +52,10 @@ def _kernel(v0_ref, er_ref, oe_ref, orank_ref, od_ref, ov_ref, idx_ref,
         sl = c * K
         e = oe_ref[:, pl.ds(sl, K)]                    # [R, K]
         r = orank_ref[:, pl.ds(sl, K)]
-        d = od_ref[:, pl.ds(sl, K)].astype(jnp.float32)
         v = ov_ref[:, pl.ds(sl, K)]
+        # padding rows carry d=0 into corr regardless of caller zero-fill
+        d = od_ref[:, pl.ds(sl, K)].astype(jnp.float32) * \
+            v.astype(jnp.float32)
 
         # base: visible elements with rank below, at chunk start
         # (multiply-reduce on the VPU; Mosaic rejects batched dot_general)

@@ -176,8 +176,20 @@ class TPUDocPool:
         for doc_id in doc_ids:
             self.doc(doc_id)
 
-        # ---- 1. schedule -------------------------------------------------
+        # ---- 1. schedule + read-only validation -------------------------
+        # every error fires before any state commit, so a failed batch
+        # leaves the pool untouched (the reference backend is immutable
+        # and discards failed state); schedule only touches the queues,
+        # which are snapshotted and rolled back on error
+        queue_snaps = {d: list(self.docs[d].queue) for d in doc_ids
+                       if self.docs[d].queue}
         applied, dup_checks = self._schedule(doc_ids, changes_by_doc)
+        try:
+            self._validate(applied, dup_checks)
+        except Exception:
+            for d in doc_ids:
+                self.docs[d].queue = queue_snaps.get(d, [])
+            raise
 
         # ---- 2. transitive allDeps + state updates per applied change ----
         for doc_id, change in applied:
@@ -202,10 +214,6 @@ class TPUDocPool:
                          if s > all_deps.get(a, 0)}
             remaining[actor] = seq
             state.deps = remaining
-
-        # duplicate consistency runs after state updates so that in-batch
-        # seq reuse is caught too (oracle parity: op_set.js:255-260)
-        self._check_duplicates(dup_checks)
 
         # ---- 3. metadata pre-pass: object creation + arena appends ------
         self._prepass(applied)
@@ -337,16 +345,75 @@ class TPUDocPool:
             state.queue = queue
         return applied, duplicates
 
-    def _check_duplicates(self, duplicates):
-        for doc_id, change in duplicates:
-            state = self.docs[doc_id]
-            entries = state.states.get(change['actor'], [])
-            seq = change['seq']
-            if seq - 1 < len(entries):
-                if entries[seq - 1]['change'] != change:
+    def _validate(self, applied, duplicates):
+        """Read-only batch validation (duplicate consistency + every
+        prepass/emit error), walking ops in application order -- the same
+        order the oracle surfaces errors.  Mirrors the native runtime's
+        validate_batch."""
+        if duplicates:
+            applied_idx = {(d, c['actor'], c['seq']): c for d, c in applied}
+            for doc_id, change in duplicates:
+                state = self.docs[doc_id]
+                entries = state.states.get(change['actor'], [])
+                seq = change['seq']
+                prior = None
+                if 0 < seq <= len(entries):
+                    prior = entries[seq - 1]['change']
+                if prior is None:
+                    prior = applied_idx.get((doc_id, change['actor'], seq))
+                if prior is not None and prior != change:
                     raise AutomergeError(
                         'Inconsistent reuse of sequence number %s by %s'
                         % (seq, change['actor']))
+
+        shadows = {}   # doc_id -> (created obj -> type, obj -> new elemIds)
+        for doc_id, change in applied:
+            state = self.docs[doc_id]
+            types, elems = shadows.setdefault(doc_id, ({}, {}))
+            actor = change['actor']
+            for op in change['ops']:
+                action = op['action']
+                obj = op['obj']
+                if action in _MAKE_TYPES:
+                    if obj in state.objects or obj in types:
+                        raise AutomergeError(
+                            'Duplicate creation of object ' + obj)
+                    types[obj] = _MAKE_TYPES[action]
+                    continue
+                if obj not in state.objects and obj not in types:
+                    raise AutomergeError(
+                        'Modification of unknown object ' + obj)
+                arena = state.arenas.get(obj)
+                new_elems = elems.setdefault(obj, set())
+
+                def has_elem(eid):
+                    return (arena is not None and eid in arena.index_of) \
+                        or eid in new_elems
+
+                if action == 'ins':
+                    elem_id = '%s:%s' % (actor, op['elem'])
+                    if has_elem(elem_id):
+                        raise AutomergeError(
+                            'Duplicate list element ID ' + elem_id)
+                    if op['key'] != '_head' and not has_elem(op['key']):
+                        raise AutomergeError(
+                            'Missing index entry for list element '
+                            + str(op['key']))
+                    new_elems.add(elem_id)
+                elif action in ('set', 'del', 'link'):
+                    type_ = state.objects[obj]['type'] \
+                        if obj in state.objects else types[obj]
+                    # static form of the missing-element rule: set/link on
+                    # an element absent from the arena always resolves to
+                    # a live register and errors; del on an absent element
+                    # never has surviving priors and is silently dropped
+                    if type_ in _LIST_TYPES and action != 'del' \
+                            and not has_elem(op['key']):
+                        raise AutomergeError(
+                            'Missing index entry for list element '
+                            + str(op['key']))
+                else:
+                    raise RangeError('Unknown operation type %s' % action)
 
     def _prepass(self, applied):
         """Walks applied ops in order registering objects (make*) and arena

@@ -648,16 +648,105 @@ static void update_states(Pool& pool, Batch& b) {
     for (auto& p : remaining) if (p.first == ch.actor) p.second = ch.seq;
     st.deps = std::move(remaining);
   }
-  // duplicate consistency (after state updates: in-batch reuse caught too)
-  for (auto& [doc, ch] : b.duplicates) {
-    DocState& st = *b.bdocs[doc];
-    auto it = st.states.find(ch.actor);
-    if (it == st.states.end()) continue;
-    if (ch.seq >= 1 && ch.seq - 1 < it->second.size()) {
-      if (!changes_equal(it->second[ch.seq - 1].change, ch))
+}
+
+// Read-only validation of the scheduled batch.  Every error an apply can
+// raise fires HERE, before update_states/prepass commit anything, so a
+// failed batch leaves the pool untouched (the reference backend is
+// immutable and discards failed state; a long-lived pool must not record
+// a change whose effects never happened).  Checks walk applied ops in
+// application order, which is also the order the oracle surfaces errors.
+static void validate_batch(Pool& pool, Batch& b) {
+  // duplicate consistency: compare against pre-batch states and against
+  // changes applied earlier in this same batch
+  if (!b.duplicates.empty()) {
+    std::unordered_map<K3, const ChangeRec*, K3Hash> applied_idx;
+    for (auto& ac : b.applied)
+      applied_idx[K3{ac.doc, ac.change.actor, ac.change.seq}] = &ac.change;
+    for (auto& [doc, ch] : b.duplicates) {
+      DocState& st = *b.bdocs[doc];
+      const ChangeRec* prior = nullptr;
+      auto it = st.states.find(ch.actor);
+      if (it != st.states.end() && ch.seq >= 1 &&
+          ch.seq - 1 < it->second.size())
+        prior = &it->second[ch.seq - 1].change;
+      if (!prior) {
+        auto ait = applied_idx.find(K3{doc, ch.actor, ch.seq});
+        if (ait != applied_idx.end()) prior = ait->second;
+      }
+      if (prior && !changes_equal(*prior, ch))
         throw Error(0, "Inconsistent reuse of sequence number " +
                            std::to_string(ch.seq) + " by " +
                            pool.intern.str(ch.actor));
+    }
+  }
+
+  // shadow of the mutations prepass WOULD make, per doc
+  struct Shadow {
+    std::unordered_map<u32, u8> new_types;               // created objects
+    std::unordered_map<u32, std::unordered_set<u64>> new_elems;
+  };
+  std::unordered_map<u32, Shadow> shadows;
+
+  for (auto& ac : b.applied) {
+    DocState& st = *b.bdocs[ac.doc];
+    Shadow& sh = shadows[ac.doc];
+    for (const OpRec& op : ac.change.ops) {
+      if (op.action >= A_MAKE_MAP) {
+        if (st.objects.count(op.obj) || sh.new_types.count(op.obj))
+          throw Error(0, "Duplicate creation of object " +
+                             pool.intern.str(op.obj));
+        sh.new_types.emplace(op.obj, make_type(op.action));
+        continue;
+      }
+      bool known = st.objects.count(op.obj) || sh.new_types.count(op.obj);
+      if (!known)
+        throw Error(0, "Modification of unknown object " +
+                           pool.intern.str(op.obj));
+      auto arena_has = [&](u64 ek) {
+        auto ait = st.arenas.find(op.obj);
+        if (ait != st.arenas.end() && ait->second.index_of.count(ek))
+          return true;
+        auto nit = sh.new_elems.find(op.obj);
+        return nit != sh.new_elems.end() && nit->second.count(ek) > 0;
+      };
+      if (op.action == A_INS) {
+        u64 ek = Arena::ekey(op.actor, op.elem);
+        if (arena_has(ek))
+          throw Error(0, "Duplicate list element ID " +
+                             pool.intern.str(op.actor) + ":" +
+                             std::to_string(op.elem));
+        const std::string& pkey = pool.intern.str(op.key);
+        if (pkey != "_head") {
+          u32 pa; i64 pc;
+          bool ok = parse_elem_id(pkey, pool.intern, &pa, &pc) &&
+                    arena_has(Arena::ekey(pa, pc));
+          if (!ok)
+            throw Error(0, "Missing index entry for list element " + pkey);
+        }
+        sh.new_elems[op.obj].insert(ek);
+      } else if (is_assign(op.action)) {
+        u8 type_;
+        auto oit = st.objects.find(op.obj);
+        if (oit != st.objects.end()) type_ = oit->second.type;
+        else type_ = sh.new_types[op.obj];
+        // static form of the mid-phase missing-element rule: a set/link on
+        // an element absent from the arena ALWAYS resolves to a live
+        // register (the op itself survives) and therefore always errors; a
+        // del on an absent element never has surviving concurrent priors
+        // (they would have errored when applied) and is always dropped
+        if (is_list_type(type_) && op.action != A_DEL) {
+          const std::string& kstr = pool.intern.str(op.key);
+          u32 ea; i64 ec;
+          bool ok = parse_elem_id(kstr, pool.intern, &ea, &ec) &&
+                    arena_has(Arena::ekey(ea, ec));
+          if (!ok)
+            throw Error(0, "Missing index entry for list element " + kstr);
+        }
+      } else {
+        throw Error(1, std::string("Unknown operation type ") +
+                           action_name(op.action));
+      }
     }
   }
 }
@@ -1061,6 +1150,37 @@ static void dom_layout(Pool& pool, Batch& b) {
     b.fused_ok = false;
   }
   if (b.Tp >= (1 << 24)) b.fused_ok = false;
+}
+
+// Shared begin pipeline: schedule, validate (read-only, with queue
+// rollback on error), then commit + encode.  After validate_batch passes,
+// no later phase throws for well-formed pools, so a failed apply leaves
+// every doc exactly as it was.
+static void begin_phases(Pool& pool, Batch& b,
+                         std::vector<std::vector<ChangeRec>>& incoming) {
+  double t1 = mono_now();
+  std::vector<std::pair<u32, std::vector<ChangeRec>>> queue_snaps;
+  for (u32 d = 0; d < b.bdocs.size(); ++d)
+    if (!b.bdocs[d]->queue.empty())
+      queue_snaps.emplace_back(d, b.bdocs[d]->queue);
+  schedule(pool, b, incoming);
+  try {
+    validate_batch(pool, b);
+  } catch (...) {
+    // schedule only touched the queues; restore them and rethrow
+    for (u32 d = 0; d < b.bdocs.size(); ++d) b.bdocs[d]->queue.clear();
+    for (auto& [d, q] : queue_snaps) b.bdocs[d]->queue = std::move(q);
+    throw;
+  }
+  update_states(pool, b);
+  prepass(pool, b);
+  double t2 = mono_now();
+  b.tr_schedule = t2 - t1;
+  encode(pool, b);
+  double t3 = mono_now();
+  b.tr_encode = t3 - t2;
+  dom_layout(pool, b);
+  b.tr_domlay = mono_now() - t3;
 }
 
 static void mid_phase(Pool& pool, Batch& b) {
@@ -1732,18 +1852,8 @@ void* amtpu_begin(void* pool_ptr, const uint8_t* data, int64_t len) {
       b.bdoc_ids.push_back(std::move(doc_id));
       incoming.push_back(std::move(chs));
     }
-    double t1 = mono_now();
-    b.tr_decode = t1 - t0;
-    schedule(pool, h->batch, incoming);
-    update_states(pool, h->batch);
-    prepass(pool, h->batch);
-    double t2 = mono_now();
-    b.tr_schedule = t2 - t1;
-    encode(pool, h->batch);
-    double t3 = mono_now();
-    b.tr_encode = t3 - t2;
-    dom_layout(pool, h->batch);
-    b.tr_domlay = mono_now() - t3;
+    b.tr_decode = mono_now() - t0;
+    begin_phases(pool, h->batch, incoming);
   } catch (const Error& e) {
     g_error = e.what(); g_error_kind = e.kind;
     return nullptr;
@@ -1846,17 +1956,7 @@ void* amtpu_begin_local(void* pool_ptr, const char* doc_id,
     bb.bdoc_ids.push_back(doc_id);
     std::vector<std::vector<ChangeRec>> incoming(1);
     incoming[0].push_back(std::move(change));
-    double t1 = mono_now();
-    schedule(pool, bb, incoming);
-    update_states(pool, bb);
-    prepass(pool, bb);
-    double t2 = mono_now();
-    bb.tr_schedule = t2 - t1;
-    encode(pool, bb);
-    double t3 = mono_now();
-    bb.tr_encode = t3 - t2;
-    dom_layout(pool, bb);
-    bb.tr_domlay = mono_now() - t3;
+    begin_phases(pool, bb, incoming);
   } catch (const Error& e) {
     g_error = e.what(); g_error_kind = e.kind;
     return nullptr;
@@ -2040,12 +2140,23 @@ const uint8_t* amtpu_result(void* bp, int64_t* len) {
 
 // ---- queries --------------------------------------------------------------
 
+// Read-only lookup: unknown doc ids must NOT materialize pool state (a
+// typo'd id in a query would otherwise create a permanent phantom doc --
+// and, in ShardedNativePool, possibly on the wrong shard).  Queries fall
+// back to this empty state instead.
+static DocState g_empty_doc;
+
+static DocState& find_doc(Pool& pool, const char* doc_id) {
+  auto it = pool.docs.find(doc_id);
+  return it == pool.docs.end() ? g_empty_doc : it->second;
+}
+
 // whole-doc materialization patch; returns malloc'd buffer (caller frees
 // via amtpu_buf_free)
 uint8_t* amtpu_get_patch(void* pool_ptr, const char* doc_id, int64_t* len) {
   Pool& pool = *static_cast<Pool*>(pool_ptr);
   try {
-    DocState& st = pool.doc(doc_id);
+    DocState& st = find_doc(pool, doc_id);
     Writer diffs;
     size_t count = 0;
     std::vector<u8> seen;
@@ -2075,7 +2186,7 @@ uint8_t* amtpu_get_missing_deps(void* pool_ptr, const char* doc_id,
                                 int64_t* len) {
   Pool& pool = *static_cast<Pool*>(pool_ptr);
   try {
-    DocState& st = pool.doc(doc_id);
+    DocState& st = find_doc(pool, doc_id);
     Clock missing;
     for (auto& ch : st.queue) {
       Clock deps = ch.deps;
@@ -2106,7 +2217,7 @@ uint8_t* amtpu_get_missing_changes(void* pool_ptr, const char* doc_id,
                                    int64_t* len) {
   Pool& pool = *static_cast<Pool*>(pool_ptr);
   try {
-    DocState& st = pool.doc(doc_id);
+    DocState& st = find_doc(pool, doc_id);
     Reader r(have, static_cast<size_t>(have_len));
     Clock have_deps;
     size_t n = r.read_map();
@@ -2156,7 +2267,7 @@ uint8_t* amtpu_get_changes_for_actor(void* pool_ptr, const char* doc_id,
                                      int64_t* len) {
   Pool& pool = *static_cast<Pool*>(pool_ptr);
   try {
-    DocState& st = pool.doc(doc_id);
+    DocState& st = find_doc(pool, doc_id);
     u32 actor_sid = pool.intern.id_of(actor);
     Writer out;
     auto it = st.states.find(actor_sid);
@@ -2188,7 +2299,7 @@ uint8_t* amtpu_get_register(void* pool_ptr, const char* doc_id,
                             int64_t* len) {
   Pool& pool = *static_cast<Pool*>(pool_ptr);
   try {
-    DocState& st = pool.doc(doc_id);
+    DocState& st = find_doc(pool, doc_id);
     u32 obj_sid = pool.intern.id_of(obj);
     u32 key_sid = pool.intern.id_of(key);
     Writer out;
