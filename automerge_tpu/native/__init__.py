@@ -151,8 +151,7 @@ def _np_view(ptr, shape, dtype):
 
 def _take_buf(ptr, length):
     try:
-        return bytes(bytearray(ctypes.cast(
-            ptr, ctypes.POINTER(ctypes.c_uint8 * length)).contents))
+        return ctypes.string_at(ptr, length)
     finally:
         lib().amtpu_buf_free(ptr)
 
@@ -441,9 +440,7 @@ class NativeDocPool:
                 trace.add('cxx.' + name, float(val))
         out_len = ctypes.c_int64()
         ptr = L.amtpu_result(bh, ctypes.byref(out_len))
-        return bytes(bytearray(ctypes.cast(
-            ptr, ctypes.POINTER(
-                ctypes.c_uint8 * out_len.value)).contents)) \
+        return ctypes.string_at(ptr, out_len.value) \
             if out_len.value else b'\x80'
 
     def _gather_conflicts(self, reg_out, alive, Tp):
@@ -741,7 +738,7 @@ class ShardedNativePool:
                 continue
             n, off = _read_map_header(r)
             total += n
-            bodies.append(r[off:])
+            bodies.append(memoryview(r)[off:])   # no intermediate copy
         return _map_header(total) + b''.join(bodies)
 
     def _run_pipelined(self, subs):

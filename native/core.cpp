@@ -46,18 +46,149 @@ static const char* ROOT_ID = "00000000-0000-0000-0000-000000000000";
 // interner
 // ---------------------------------------------------------------------------
 
-struct Interner {
-  // storage is a deque so string data never moves; the id map keys are
-  // views into that storage, and lookups by string_view never allocate
-  std::unordered_map<std::string_view, u32> ids;
-  std::deque<std::string> strs;
+// Open-addressing hash map u64 -> V, linear probing, power-of-two
+// capacity.  The per-op maps (interner slots, arena element index,
+// register index) live on the hottest host loops; open addressing costs
+// one cache line per probe instead of unordered_map's bucket-chain
+// pointer chase, and inserting never allocates per node.
+// Key 0xffff..ff is reserved as the empty marker (never a valid key here:
+// composite keys are built from interner ids < 2^32).
+template <typename V>
+struct FlatMap {
+  std::vector<u64> keys;
+  std::vector<V> vals;
+  size_t mask = 0, n = 0;
+  static constexpr u64 EMPTY = ~0ull;
 
+  FlatMap() { rehash(16); }
+  static inline size_t mix(u64 k) {
+    k ^= k >> 33;
+    k *= 0xff51afd7ed558ccdULL;
+    k ^= k >> 29;
+    return static_cast<size_t>(k);
+  }
+  void rehash(size_t cap) {
+    std::vector<u64> ok = std::move(keys);
+    std::vector<V> ov = std::move(vals);
+    keys.assign(cap, EMPTY);
+    vals.clear();
+    vals.resize(cap);
+    mask = cap - 1;
+    for (size_t i = 0; i < ok.size(); ++i) {
+      if (ok[i] == EMPTY) continue;
+      size_t j = mix(ok[i]) & mask;
+      while (keys[j] != EMPTY) j = (j + 1) & mask;
+      keys[j] = ok[i];
+      vals[j] = std::move(ov[i]);
+    }
+  }
+  void reserve(size_t want) {
+    size_t cap = mask + 1;
+    while (want * 4 >= cap * 3) cap *= 2;
+    if (cap != mask + 1) rehash(cap);
+  }
+  V* find(u64 k) {
+    size_t i = mix(k) & mask;
+    while (true) {
+      if (keys[i] == k) return &vals[i];
+      if (keys[i] == EMPTY) return nullptr;
+      i = (i + 1) & mask;
+    }
+  }
+  const V* find(u64 k) const {
+    return const_cast<FlatMap*>(this)->find(k);
+  }
+  // returns (slot, inserted)
+  std::pair<V*, bool> insert(u64 k) {
+    if ((n + 1) * 4 >= (mask + 1) * 3) rehash((mask + 1) * 2);
+    size_t i = mix(k) & mask;
+    while (true) {
+      if (keys[i] == k) return {&vals[i], false};
+      if (keys[i] == EMPTY) {
+        keys[i] = k;
+        ++n;
+        return {&vals[i], true};
+      }
+      i = (i + 1) & mask;
+    }
+  }
+  // backward-shift deletion (linear probing invariant preserved); only
+  // the rare rollback path erases
+  void erase(u64 k) {
+    size_t i = mix(k) & mask;
+    while (true) {
+      if (keys[i] == EMPTY) return;
+      if (keys[i] == k) break;
+      i = (i + 1) & mask;
+    }
+    size_t hole = i;
+    size_t j = (i + 1) & mask;
+    while (keys[j] != EMPTY) {
+      size_t home = mix(keys[j]) & mask;
+      // can keys[j] move into the hole? yes iff hole lies cyclically
+      // between home and j
+      if (((j - home) & mask) >= ((j - hole) & mask)) {
+        keys[hole] = keys[j];
+        vals[hole] = std::move(vals[j]);
+        hole = j;
+      }
+      j = (j + 1) & mask;
+    }
+    keys[hole] = EMPTY;
+    vals[hole] = V{};
+    --n;
+  }
+};
+
+struct Interner {
+  // storage is a deque so string data never moves; the open-addressing
+  // slot table stores (hash, id) and resolves rare collisions by string
+  // compare against the stored string
+  std::deque<std::string> strs;
+  std::vector<u64> slot_hash;
+  std::vector<u32> slot_id;
+  size_t mask = 0, n = 0;
+
+  Interner() { rehash(1 << 10); }
+  static inline u64 hash_sv(std::string_view s) {
+    u64 h = 1469598103934665603ull;           // FNV-1a 64
+    for (char c : s) {
+      h ^= static_cast<u8>(c);
+      h *= 1099511628211ull;
+    }
+    return h | 1;                              // 0 marks an empty slot
+  }
+  void rehash(size_t cap) {
+    std::vector<u64> oh = std::move(slot_hash);
+    std::vector<u32> oi = std::move(slot_id);
+    slot_hash.assign(cap, 0);
+    slot_id.assign(cap, 0);
+    mask = cap - 1;
+    for (size_t i = 0; i < oh.size(); ++i) {
+      if (!oh[i]) continue;
+      size_t j = oh[i] & mask;
+      while (slot_hash[j]) j = (j + 1) & mask;
+      slot_hash[j] = oh[i];
+      slot_id[j] = oi[i];
+    }
+  }
   u32 id_of(std::string_view s) {
-    auto it = ids.find(s);
-    if (it != ids.end()) return it->second;
+    u64 h = hash_sv(s);
+    size_t i = h & mask;
+    while (slot_hash[i]) {
+      if (slot_hash[i] == h && strs[slot_id[i]] == s) return slot_id[i];
+      i = (i + 1) & mask;
+    }
+    if ((n + 1) * 4 >= (mask + 1) * 3) {
+      rehash((mask + 1) * 2);
+      i = h & mask;
+      while (slot_hash[i]) i = (i + 1) & mask;
+    }
     u32 id = static_cast<u32>(strs.size());
     strs.emplace_back(s);
-    ids.emplace(std::string_view(strs.back()), id);
+    slot_hash[i] = h;
+    slot_id[i] = id;
+    ++n;
     return id;
   }
   const std::string& str(u32 id) const { return strs[id]; }
@@ -124,12 +255,29 @@ static void clock_set_max(Clock& c, u32 actor, u32 seq) {
   c.emplace_back(actor, seq);
 }
 
+// Raw change bytes as a span into a shared payload slab: one batch copies
+// its whole wire payload once, and every ChangeRec (and every ChangeRec
+// copy -- queue snapshots, state entries) is a refcount bump instead of a
+// per-change buffer copy.  Locally-built changes (undo/redo, stripped
+// requestType) carry their own single-change slab.
+struct RawRef {
+  std::shared_ptr<std::vector<u8>> slab;
+  u32 off = 0, len = 0;
+  const u8* data() const { return slab->data() + off; }
+  size_t size() const { return len; }
+  void adopt(std::vector<u8>&& buf) {
+    slab = std::make_shared<std::vector<u8>>(std::move(buf));
+    off = 0;
+    len = static_cast<u32>(slab->size());
+  }
+};
+
 struct ChangeRec {
   u32 actor;
   u32 seq;
   Clock deps;
   std::vector<OpRec> ops;
-  std::vector<u8> raw;          // raw change msgpack (missing-changes replay)
+  RawRef raw;                   // raw change msgpack (missing-changes replay)
   bool has_message = false;
   std::vector<u8> message;      // raw message value
 };
@@ -176,16 +324,41 @@ struct Arena {
   std::vector<u32> actor_sid;
   std::vector<i32> parent;
   std::vector<u8> visible;
-  std::unordered_map<u64, i32> index_of;  // (actor_sid<<20 no -- use map of pair)
+  FlatMap<i32> index_of;    // ekey(actor_sid, elem) -> arena index
   std::vector<i32> visible_order;
   i64 max_elem = 0;
+  u64 jstamp = 0;   // journal first-touch epoch (see BeginJournal)
 
   static u64 ekey(u32 actor_sid, i64 elem) {
     return (static_cast<u64>(actor_sid) << 32) ^ static_cast<u64>(elem);
   }
 };
 
-using Register = std::vector<OpRec>;
+// Small-vector of field ops: nearly every register holds exactly one live
+// writer, so the single-record case stays inline (no heap allocation per
+// key -- half a million of these are created per 1M-op batch).  When a
+// second record arrives, ALL records move to `rest` so iteration stays
+// contiguous.
+struct Register {
+  OpRec first;
+  std::vector<OpRec> rest;   // holds all records when n >= 2
+  u32 n = 0;
+  bool empty() const { return n == 0; }
+  size_t size() const { return n; }
+  void clear() { n = 0; rest.clear(); }
+  void push_back(const OpRec& o) {
+    if (n == 0) { first = o; n = 1; return; }
+    if (n == 1) { rest.clear(); rest.push_back(first); }
+    rest.push_back(o);
+    ++n;
+  }
+  const OpRec* begin() const { return n <= 1 ? &first : rest.data(); }
+  const OpRec* end() const { return begin() + n; }
+  OpRec* begin() { return n <= 1 ? &first : rest.data(); }
+  OpRec* end() { return begin() + n; }
+  const OpRec& operator[](size_t i) const { return begin()[i]; }
+  OpRec& operator[](size_t i) { return begin()[i]; }
+};
 
 struct DocState {
   Clock clock;
@@ -194,8 +367,11 @@ struct DocState {
   std::vector<u32> state_actor_order;   // actors in first-seen order
   std::vector<ChangeRec> queue;
   std::unordered_map<u32, ObjMeta> objects;
-  std::unordered_map<u64, Register> registers;  // (obj<<32|key)
+  FlatMap<Register> registers;  // rkey(obj, key) -> live field ops
   std::unordered_map<u32, Arena> arenas;
+  // bumped whenever the inbound-link index changes; pure-map path
+  // renderings are cacheable while it holds still
+  u64 path_epoch = 0;
   // undo machinery (reference: op_set.js:310-322 state; entries are
   // projected inverse ops -- action/obj/key/value only for undo entries,
   // + datatype for redo entries; actor=NONE, seq=0)
@@ -226,6 +402,7 @@ struct Pool {
   u32 root_sid;
   std::unordered_map<std::string, DocState> docs;
   std::vector<std::string> doc_order;   // first-seen order
+  u64 epoch = 0;     // bumped per begin; arenas stamp their first touch
 
   Pool() {
     root_sid = intern.id_of(ROOT_ID);
@@ -333,7 +510,9 @@ struct LocalReq {
   std::string request_type;
 };
 
-static ChangeRec decode_change(Reader& r, Pool& pool, LocalReq* lr = nullptr) {
+static ChangeRec decode_change(Reader& r, Pool& pool,
+                               const std::shared_ptr<std::vector<u8>>& slab,
+                               LocalReq* lr = nullptr) {
   ChangeRec ch;
   const uint8_t* start = r.pos();
   size_t n = r.read_map();
@@ -395,9 +574,11 @@ static ChangeRec decode_change(Reader& r, Pool& pool, LocalReq* lr = nullptr) {
     wr.map(n - 1);
     wr.raw(body, static_cast<size_t>(rt_start - body));
     wr.raw(rt_end, static_cast<size_t>(r.pos() - rt_end));
-    ch.raw = std::move(wr.buf);
+    ch.raw.adopt(std::move(wr.buf));
   } else {
-    ch.raw.assign(start, r.pos());
+    ch.raw.slab = slab;
+    ch.raw.off = static_cast<u32>(start - slab->data());
+    ch.raw.len = static_cast<u32>(r.pos() - start);
   }
   if (ops_start) {
     Reader ro(ops_start, static_cast<size_t>(ops_end - ops_start));
@@ -448,7 +629,10 @@ static i64 bucket(i64 n, i64 floor_ = 16) {
 
 struct AppliedChange {
   u32 doc;            // dense batch doc index
-  ChangeRec change;
+  ChangeRec change;   // moved into st.states by update_states
+  // the states entry holding the change after update_states; ops/raw live
+  // there (OpRec heap data is stable across states-vector growth)
+  ChangeRec* stored = nullptr;
 };
 
 struct DomEntry {    // one list-assign op in a per-object timeline
@@ -523,12 +707,15 @@ struct Batch {
   // overflow fallback
   std::unordered_map<i64, Register> host_registers;  // op_idx -> register
 
+  // per-op arena index resolved by prepass in application order:
+  // -2 = not a list assign, -1 = dropped del on an absent element
+  std::vector<i32> pre_eidx;
+
   // dominance
   std::vector<DomBlock> dom_blocks;
   std::unordered_map<i64, std::pair<i32, i64>> list_index_of_op;
   std::unordered_map<u64, std::vector<DomEntry>> obj_ops;
   std::vector<i32> eidx_of_op;                    // op_idx -> eidx or -1
-  std::vector<std::pair<i64, i64>> missing_eidx;  // (op_idx, reg_row)
   bool fused_ok = false;
 
   // local-change mode (apply_local_change / undo / redo):
@@ -621,14 +808,74 @@ static void schedule(Pool& pool, Batch& b,
   }
 }
 
-static void update_states(Pool& pool, Batch& b) {
+// Rollback journal for the begin phases: a failed batch must leave the
+// pool untouched (the reference backend is immutable and discards failed
+// state), but journaling is much cheaper than a separate read-only
+// validation pass -- the success path records one entry per touched
+// doc/arena (plus one per applied change), and only error paths pay the
+// walk-back.
+struct BeginJournal {
+  // queues: pre-schedule contents of non-empty queues (rare)
+  std::vector<std::pair<u32, std::vector<ChangeRec>>> queues;
+  // prepass: objects created in this batch, arena sizes at first touch
+  // (appended elements are erased by re-deriving their ekeys from the
+  // arena columns)
+  std::vector<std::pair<u32, u32>> created_objs;        // (doc, obj sid)
+  std::vector<std::tuple<u32, u32, i64, i64>> arenas;   // (doc,obj,n,max)
+  // update_states: clock/deps snapshots at first touch + appended entries
+  std::vector<u8> snapped;                              // per batch doc
+  std::vector<std::pair<u32, std::pair<Clock, Clock>>> clocks;
+  std::vector<std::pair<u32, u32>> state_pushes;        // (doc, actor sid)
+  std::vector<std::pair<u32, size_t>> actor_orders;     // (doc, old size)
+
+  void rollback(Batch& b) {
+    for (auto it = state_pushes.rbegin(); it != state_pushes.rend(); ++it) {
+      auto& entries = b.bdocs[it->first]->states[it->second];
+      entries.pop_back();
+      if (entries.empty()) b.bdocs[it->first]->states.erase(it->second);
+    }
+    // reverse: per-doc sizes were recorded increasing, the earliest wins
+    for (auto it = actor_orders.rbegin(); it != actor_orders.rend(); ++it)
+      b.bdocs[it->first]->state_actor_order.resize(it->second);
+    for (auto& [d, cd] : clocks) {
+      b.bdocs[d]->clock = std::move(cd.first);
+      b.bdocs[d]->deps = std::move(cd.second);
+    }
+    for (auto it = arenas.rbegin(); it != arenas.rend(); ++it) {
+      auto [d, obj, n, max_elem] = *it;
+      Arena& ar = b.bdocs[d]->arenas[obj];
+      for (size_t i = n; i < ar.ctr.size(); ++i)
+        ar.index_of.erase(Arena::ekey(ar.actor_sid[i], ar.ctr[i]));
+      ar.ctr.resize(n);
+      ar.actor_sid.resize(n);
+      ar.parent.resize(n);
+      ar.visible.resize(n);
+      ar.max_elem = max_elem;
+    }
+    for (auto& [d, obj] : created_objs) {
+      b.bdocs[d]->objects.erase(obj);
+      b.bdocs[d]->arenas.erase(obj);
+    }
+    for (u32 d = 0; d < b.bdocs.size(); ++d) b.bdocs[d]->queue.clear();
+    for (auto& [d, q] : queues) b.bdocs[d]->queue = std::move(q);
+  }
+};
+
+static void update_states(Pool& pool, Batch& b, BeginJournal& j) {
+  j.snapped.assign(b.bdocs.size(), 0);
+  j.state_pushes.reserve(b.applied.size());
   for (auto& ac : b.applied) {
     DocState& st = *b.bdocs[ac.doc];
-    const ChangeRec& ch = ac.change;
+    ChangeRec& ch = ac.change;
+    const u32 actor = ch.actor, seq = ch.seq;
+    if (!j.snapped[ac.doc]) {
+      j.snapped[ac.doc] = 1;
+      j.clocks.emplace_back(ac.doc, std::make_pair(st.clock, st.deps));
+    }
     Clock base = ch.deps;
-    clock_set_max(base, ch.actor, 0);  // ensure present
+    clock_set_max(base, actor, 0);  // ensure present
     // pin authoring actor at seq-1
-    for (auto& p : base) if (p.first == ch.actor) p.second = ch.seq - 1;
+    for (auto& p : base) if (p.first == actor) p.second = seq - 1;
     Clock all_deps;
     for (auto& [da, ds] : base) {
       if (ds == 0) continue;
@@ -636,125 +883,62 @@ static void update_states(Pool& pool, Batch& b) {
       for (auto& [ta, ts] : trans) clock_set_max(all_deps, ta, ts);
       clock_set_max(all_deps, da, ds);
     }
-    if (st.states.find(ch.actor) == st.states.end())
-      st.state_actor_order.push_back(ch.actor);
-    st.states[ch.actor].push_back({ch, all_deps});
-    clock_set_max(st.clock, ch.actor, ch.seq);
+    auto sit = st.states.find(actor);
+    if (sit == st.states.end()) {
+      j.actor_orders.emplace_back(ac.doc, st.state_actor_order.size());
+      st.state_actor_order.push_back(actor);
+      sit = st.states.emplace(actor, std::vector<StateEntry>{}).first;
+    }
+    // the change MOVES into the states entry (its ops/raw heap data stays
+    // put, so batch-held pointers into them remain valid)
+    sit->second.push_back({std::move(ch), std::move(all_deps)});
+    const Clock& adeps = sit->second.back().all_deps;
+    j.state_pushes.emplace_back(ac.doc, actor);
+    clock_set_max(st.clock, actor, seq);
     Clock remaining;
     for (auto& [a, s] : st.deps)
-      if (s > clock_get(all_deps, a)) remaining.emplace_back(a, s);
-    clock_set_max(remaining, ch.actor, ch.seq);
+      if (s > clock_get(adeps, a)) remaining.emplace_back(a, s);
+    clock_set_max(remaining, actor, seq);
     // deps[actor] = seq exactly (not max -- seq is the new frontier)
-    for (auto& p : remaining) if (p.first == ch.actor) p.second = ch.seq;
+    for (auto& p : remaining) if (p.first == actor) p.second = seq;
     st.deps = std::move(remaining);
   }
+  // resolve stored pointers after all pushes (the entries vectors may have
+  // reallocated; states[actor][seq-1] is the invariant address)
+  for (auto& ac : b.applied)
+    ac.stored = &b.bdocs[ac.doc]
+                     ->states[ac.change.actor][ac.change.seq - 1].change;
 }
 
-// Read-only validation of the scheduled batch.  Every error an apply can
-// raise fires HERE, before update_states/prepass commit anything, so a
-// failed batch leaves the pool untouched (the reference backend is
-// immutable and discards failed state; a long-lived pool must not record
-// a change whose effects never happened).  Checks walk applied ops in
-// application order, which is also the order the oracle surfaces errors.
-static void validate_batch(Pool& pool, Batch& b) {
-  // duplicate consistency: compare against pre-batch states and against
-  // changes applied earlier in this same batch
-  if (!b.duplicates.empty()) {
-    std::unordered_map<K3, const ChangeRec*, K3Hash> applied_idx;
-    for (auto& ac : b.applied)
-      applied_idx[K3{ac.doc, ac.change.actor, ac.change.seq}] = &ac.change;
-    for (auto& [doc, ch] : b.duplicates) {
-      DocState& st = *b.bdocs[doc];
-      const ChangeRec* prior = nullptr;
-      auto it = st.states.find(ch.actor);
-      if (it != st.states.end() && ch.seq >= 1 &&
-          ch.seq - 1 < it->second.size())
-        prior = &it->second[ch.seq - 1].change;
-      if (!prior) {
-        auto ait = applied_idx.find(K3{doc, ch.actor, ch.seq});
-        if (ait != applied_idx.end()) prior = ait->second;
-      }
-      if (prior && !changes_equal(*prior, ch))
-        throw Error(0, "Inconsistent reuse of sequence number " +
-                           std::to_string(ch.seq) + " by " +
-                           pool.intern.str(ch.actor));
+// Duplicate consistency, read-only: compares against pre-batch states and
+// against changes applied earlier in this same batch (in-batch seq reuse).
+static void validate_duplicates(Pool& pool, Batch& b) {
+  if (b.duplicates.empty()) return;
+  std::unordered_map<K3, const ChangeRec*, K3Hash> applied_idx;
+  for (auto& ac : b.applied)
+    applied_idx[K3{ac.doc, ac.change.actor, ac.change.seq}] = &ac.change;
+  for (auto& [doc, ch] : b.duplicates) {
+    DocState& st = *b.bdocs[doc];
+    const ChangeRec* prior = nullptr;
+    auto it = st.states.find(ch.actor);
+    if (it != st.states.end() && ch.seq >= 1 &&
+        ch.seq - 1 < it->second.size())
+      prior = &it->second[ch.seq - 1].change;
+    if (!prior) {
+      auto ait = applied_idx.find(K3{doc, ch.actor, ch.seq});
+      if (ait != applied_idx.end()) prior = ait->second;
     }
-  }
-
-  // shadow of the mutations prepass WOULD make, per doc
-  struct Shadow {
-    std::unordered_map<u32, u8> new_types;               // created objects
-    std::unordered_map<u32, std::unordered_set<u64>> new_elems;
-  };
-  std::unordered_map<u32, Shadow> shadows;
-
-  for (auto& ac : b.applied) {
-    DocState& st = *b.bdocs[ac.doc];
-    Shadow& sh = shadows[ac.doc];
-    for (const OpRec& op : ac.change.ops) {
-      if (op.action >= A_MAKE_MAP) {
-        if (st.objects.count(op.obj) || sh.new_types.count(op.obj))
-          throw Error(0, "Duplicate creation of object " +
-                             pool.intern.str(op.obj));
-        sh.new_types.emplace(op.obj, make_type(op.action));
-        continue;
-      }
-      bool known = st.objects.count(op.obj) || sh.new_types.count(op.obj);
-      if (!known)
-        throw Error(0, "Modification of unknown object " +
-                           pool.intern.str(op.obj));
-      auto arena_has = [&](u64 ek) {
-        auto ait = st.arenas.find(op.obj);
-        if (ait != st.arenas.end() && ait->second.index_of.count(ek))
-          return true;
-        auto nit = sh.new_elems.find(op.obj);
-        return nit != sh.new_elems.end() && nit->second.count(ek) > 0;
-      };
-      if (op.action == A_INS) {
-        u64 ek = Arena::ekey(op.actor, op.elem);
-        if (arena_has(ek))
-          throw Error(0, "Duplicate list element ID " +
-                             pool.intern.str(op.actor) + ":" +
-                             std::to_string(op.elem));
-        const std::string& pkey = pool.intern.str(op.key);
-        if (pkey != "_head") {
-          u32 pa; i64 pc;
-          bool ok = parse_elem_id(pkey, pool.intern, &pa, &pc) &&
-                    arena_has(Arena::ekey(pa, pc));
-          if (!ok)
-            throw Error(0, "Missing index entry for list element " + pkey);
-        }
-        sh.new_elems[op.obj].insert(ek);
-      } else if (is_assign(op.action)) {
-        u8 type_;
-        auto oit = st.objects.find(op.obj);
-        if (oit != st.objects.end()) type_ = oit->second.type;
-        else type_ = sh.new_types[op.obj];
-        // static form of the mid-phase missing-element rule: a set/link on
-        // an element absent from the arena ALWAYS resolves to a live
-        // register (the op itself survives) and therefore always errors; a
-        // del on an absent element never has surviving concurrent priors
-        // (they would have errored when applied) and is always dropped
-        if (is_list_type(type_) && op.action != A_DEL) {
-          const std::string& kstr = pool.intern.str(op.key);
-          u32 ea; i64 ec;
-          bool ok = parse_elem_id(kstr, pool.intern, &ea, &ec) &&
-                    arena_has(Arena::ekey(ea, ec));
-          if (!ok)
-            throw Error(0, "Missing index entry for list element " + kstr);
-        }
-      } else {
-        throw Error(1, std::string("Unknown operation type ") +
-                           action_name(op.action));
-      }
-    }
+    if (prior && !changes_equal(*prior, ch))
+      throw Error(0, "Inconsistent reuse of sequence number " +
+                         std::to_string(ch.seq) + " by " +
+                         pool.intern.str(ch.actor));
   }
 }
 
-static void prepass(Pool& pool, Batch& b) {
+static void prepass(Pool& pool, Batch& b, BeginJournal& j) {
   for (auto& ac : b.applied) {
     DocState& st = *b.bdocs[ac.doc];
-    for (const OpRec& op : ac.change.ops) {
+    for (const OpRec& op : ac.stored->ops) {
       if (op.action >= A_MAKE_MAP) {
         if (st.objects.count(op.obj))
           throw Error(0, "Duplicate creation of object " +
@@ -763,14 +947,22 @@ static void prepass(Pool& pool, Batch& b) {
         meta.type = make_type(op.action);
         st.objects.emplace(op.obj, std::move(meta));
         if (is_list_type(make_type(op.action))) st.arenas[op.obj];
+        j.created_objs.emplace_back(ac.doc, op.obj);
+        b.pre_eidx.push_back(-2);
       } else if (op.action == A_INS) {
         auto oit = st.objects.find(op.obj);
         if (oit == st.objects.end())
           throw Error(0, "Modification of unknown object " +
                              pool.intern.str(op.obj));
         Arena& ar = st.arenas[op.obj];
+        if (ar.jstamp != pool.epoch) {
+          ar.jstamp = pool.epoch;
+          j.arenas.emplace_back(ac.doc, op.obj,
+                                static_cast<i64>(ar.ctr.size()),
+                                ar.max_elem);
+        }
         u64 ek = Arena::ekey(op.actor, op.elem);
-        if (ar.index_of.count(ek))
+        if (ar.index_of.find(ek))
           throw Error(0, "Duplicate list element ID " +
                              pool.intern.str(op.actor) + ":" +
                              std::to_string(op.elem));
@@ -782,23 +974,47 @@ static void prepass(Pool& pool, Batch& b) {
           u32 pa; i64 pc;
           bool ok = parse_elem_id(pkey, pool.intern, &pa, &pc);
           if (ok) {
-            auto pit = ar.index_of.find(Arena::ekey(pa, pc));
-            if (pit == ar.index_of.end()) ok = false;
-            else parent_idx = pit->second;
+            const i32* pit = ar.index_of.find(Arena::ekey(pa, pc));
+            if (!pit) ok = false;
+            else parent_idx = *pit;
           }
           if (!ok)
             throw Error(0, "Missing index entry for list element " + pkey);
         }
-        ar.index_of[ek] = static_cast<i32>(ar.ctr.size());
+        *ar.index_of.insert(ek).first = static_cast<i32>(ar.ctr.size());
         ar.ctr.push_back(static_cast<i32>(op.elem));
         ar.actor_sid.push_back(op.actor);
         ar.parent.push_back(parent_idx);
         ar.visible.push_back(0);
         if (op.elem > ar.max_elem) ar.max_elem = op.elem;
+        b.pre_eidx.push_back(-2);
       } else if (is_assign(op.action)) {
-        if (!st.objects.count(op.obj))
+        auto oit = st.objects.find(op.obj);
+        if (oit == st.objects.end())
           throw Error(0, "Modification of unknown object " +
                              pool.intern.str(op.obj));
+        // list assigns resolve their element HERE, in application order
+        // (the oracle applies ops strictly in order, so an assign
+        // referencing an element inserted later in the batch errors, and
+        // a multi-error batch surfaces its FIRST error).  A set/link on
+        // an absent element always resolves to a live register and
+        // errors; a del never has surviving concurrent priors and is
+        // silently dropped.  The resolved index is cached for dom_layout.
+        if (is_list_type(oit->second.type)) {
+          Arena& ar = st.arenas[op.obj];
+          const std::string& kstr = pool.intern.str(op.key);
+          u32 ea; i64 ec;
+          i32 eidx = -1;
+          if (parse_elem_id(kstr, pool.intern, &ea, &ec)) {
+            const i32* eit = ar.index_of.find(Arena::ekey(ea, ec));
+            if (eit) eidx = *eit;
+          }
+          if (eidx < 0 && op.action != A_DEL)
+            throw Error(0, "Missing index entry for list element " + kstr);
+          b.pre_eidx.push_back(eidx);
+        } else {
+          b.pre_eidx.push_back(-2);   // not a list assign
+        }
       } else {
         throw Error(1, std::string("Unknown operation type ") +
                            action_name(op.action));
@@ -816,7 +1032,7 @@ static void encode(Pool& pool, Batch& b) {
   // + :193-200)
   for (auto& ac : b.applied) {
     std::unordered_set<u32> new_objs;
-    for (const OpRec& op : ac.change.ops) {
+    for (const OpRec& op : ac.stored->ops) {
       b.ops.push_back({ac.doc, &op});
       if (b.local_kind == 1) {
         bool cap = is_assign(op.action) && !new_objs.count(op.obj);
@@ -845,36 +1061,53 @@ static void encode(Pool& pool, Batch& b) {
     return (static_cast<u64>(doc) << 32) | obj;
   };
 
+  // register-state pointers per group, stashed at discovery so the
+  // state-row pass below does not re-run the register lookups
+  std::vector<const Register*> gid_regs;
+  // consecutive ops overwhelmingly hit the same (doc, obj): cache the
+  // object-type lookup and the arena-key emplace
+  u32 last_doc = ~0u, last_obj = NONE;
+  bool last_is_list = false, have_last = false;
+  u64 last_ak = ~0ull;
   for (auto& f : b.ops) {
     DocState& st = *b.bdocs[f.doc];
     const OpRec& op = *f.op;
     if (is_assign(op.action)) {
       K3 gk{f.doc, op.obj, op.key};
-      if (!gid_map.count(gk)) {
-        gid_map.emplace(gk, static_cast<u32>(gid_order.size()));
+      auto [git, inserted] =
+          gid_map.emplace(gk, static_cast<u32>(gid_order.size()));
+      (void)git;
+      if (inserted) {
         gid_order.push_back(gk);
-        auto rit = st.registers.find(DocState::rkey(op.obj, op.key));
-        if (rit != st.registers.end()) {
-          for (auto& rec : rit->second) {
+        const Register* reg =
+            st.registers.find(DocState::rkey(op.obj, op.key));
+        gid_regs.push_back(reg);
+        if (reg) {
+          for (auto& rec : *reg) {
             mark(rec.actor);
             for (auto& [da, ds] : all_deps_of(st, rec.actor, rec.seq))
               mark(da);
           }
         }
       }
-      auto oit = st.objects.find(op.obj);
-      if (oit != st.objects.end() && is_list_type(oit->second.type)) {
+      if (!have_last || f.doc != last_doc || op.obj != last_obj) {
+        auto oit = st.objects.find(op.obj);
+        last_is_list =
+            oit != st.objects.end() && is_list_type(oit->second.type);
+        last_doc = f.doc; last_obj = op.obj; have_last = true;
+      }
+      if (last_is_list) {
         u64 ak = akey_of(f.doc, op.obj);
-        if (!b.arena_base.count(ak)) {
-          b.arena_base.emplace(ak, -1);
-          b.arena_keys.push_back(ak);
+        if (ak != last_ak) {
+          last_ak = ak;
+          if (b.arena_base.emplace(ak, -1).second) b.arena_keys.push_back(ak);
         }
       }
     } else if (op.action == A_INS) {
       u64 ak = akey_of(f.doc, op.obj);
-      if (!b.arena_base.count(ak)) {
-        b.arena_base.emplace(ak, -1);
-        b.arena_keys.push_back(ak);
+      if (ak != last_ak) {
+        last_ak = ak;
+        if (b.arena_base.emplace(ak, -1).second) b.arena_keys.push_back(ak);
       }
     }
   }
@@ -923,10 +1156,10 @@ static void encode(Pool& pool, Batch& b) {
   // state rows
   for (u32 gid = 0; gid < gid_order.size(); ++gid) {
     auto [doc, obj, key] = gid_order[gid];
+    (void)obj; (void)key;
     DocState& st = *b.bdocs[doc];
-    auto rit = st.registers.find(DocState::rkey(obj, key));
-    if (rit == st.registers.end()) continue;
-    auto& recs = rit->second;
+    if (gid_regs[gid] == nullptr) continue;
+    auto& recs = *gid_regs[gid];
     for (size_t i = 0; i < recs.size(); ++i) {
       b.g_col.push_back(static_cast<i32>(gid));
       b.t_col.push_back(static_cast<i32>(i) - static_cast<i32>(recs.size()));
@@ -940,23 +1173,33 @@ static void encode(Pool& pool, Batch& b) {
     }
   }
 
-  // batch assign rows (time = op index)
+  // batch assign rows (time = op index).  Ops of one change share
+  // (doc, actor, seq), so the clock row and actor rank resolve once per
+  // change, not once per op.
   b.assign_row_of_op.assign(b.ops.size(), -1);
-  for (size_t op_idx = 0; op_idx < b.ops.size(); ++op_idx) {
-    auto& f = b.ops[op_idx];
-    const OpRec& op = *f.op;
-    if (!is_assign(op.action)) continue;
-    DocState& st = *b.bdocs[f.doc];
-    u32 gid = gid_map[K3{f.doc, op.obj, op.key}];
-    b.assign_row_of_op[op_idx] = static_cast<i64>(b.g_col.size());
-    b.g_col.push_back(static_cast<i32>(gid));
-    b.t_col.push_back(static_cast<i32>(op_idx));
-    b.a_col.push_back(b.rank_of[op.actor]);
-    b.s_col.push_back(static_cast<i32>(op.seq));
-    b.d_col.push_back(op.action == A_DEL ? 1 : 0);
-    b.clock_idx.push_back(static_cast<i32>(
-        clock_row_of(f.doc, st, op.actor, op.seq)));
-    b.src_records.push_back(&op);
+  {
+    u32 c_doc = ~0u, c_actor = NONE, c_seq = 0;
+    i32 c_crow = 0, c_rank = 0;
+    for (size_t op_idx = 0; op_idx < b.ops.size(); ++op_idx) {
+      auto& f = b.ops[op_idx];
+      const OpRec& op = *f.op;
+      if (!is_assign(op.action)) continue;
+      DocState& st = *b.bdocs[f.doc];
+      if (f.doc != c_doc || op.actor != c_actor || op.seq != c_seq) {
+        c_doc = f.doc; c_actor = op.actor; c_seq = op.seq;
+        c_crow = static_cast<i32>(clock_row_of(f.doc, st, op.actor, op.seq));
+        c_rank = b.rank_of[op.actor];
+      }
+      u32 gid = gid_map[K3{f.doc, op.obj, op.key}];
+      b.assign_row_of_op[op_idx] = static_cast<i64>(b.g_col.size());
+      b.g_col.push_back(static_cast<i32>(gid));
+      b.t_col.push_back(static_cast<i32>(op_idx));
+      b.a_col.push_back(c_rank);
+      b.s_col.push_back(static_cast<i32>(op.seq));
+      b.d_col.push_back(op.action == A_DEL ? 1 : 0);
+      b.clock_idx.push_back(c_crow);
+      b.src_records.push_back(&op);
+    }
   }
 
   b.T = static_cast<i64>(b.g_col.size());
@@ -1061,25 +1304,13 @@ static void dom_layout(Pool& pool, Batch& b) {
     i64 row = b.assign_row_of_op[op_idx];
     if (row < 0) continue;
     auto& f = b.ops[op_idx];
+    // element index resolved by prepass in application order; -2 = not a
+    // list assign, -1 = dropped del on an absent element (set/link on an
+    // absent element already errored in prepass)
+    i32 eidx = b.pre_eidx[op_idx];
+    if (eidx < 0) continue;
     const OpRec& op = *f.op;
-    DocState& st = *b.bdocs[f.doc];
-    auto oit = st.objects.find(op.obj);
-    if (oit == st.objects.end() || !is_list_type(oit->second.type)) continue;
     u64 ak = (static_cast<u64>(f.doc) << 32) | op.obj;
-    Arena& ar = st.arenas[op.obj];
-    const std::string& kstr = pool.intern.str(op.key);
-    u32 ea; i64 ec;
-    i32 eidx = -1;
-    if (parse_elem_id(kstr, pool.intern, &ea, &ec)) {
-      auto eit = ar.index_of.find(Arena::ekey(ea, ec));
-      if (eit != ar.index_of.end()) eidx = eit->second;
-    }
-    if (eidx < 0) {
-      // only an error if the op leaves the element visible -- checked
-      // after the register kernel runs (mid/mid_fused)
-      b.missing_eidx.emplace_back(static_cast<i64>(op_idx), row);
-      continue;
-    }
     b.eidx_of_op[op_idx] = eidx;
     auto oit2 = b.obj_ops.find(ak);
     if (oit2 == b.obj_ops.end()) {
@@ -1152,35 +1383,36 @@ static void dom_layout(Pool& pool, Batch& b) {
   if (b.Tp >= (1 << 24)) b.fused_ok = false;
 }
 
-// Shared begin pipeline: schedule, validate (read-only, with queue
-// rollback on error), then commit + encode.  After validate_batch passes,
-// no later phase throws for well-formed pools, so a failed apply leaves
-// every doc exactly as it was.
+// Shared begin pipeline.  Every error any phase can raise fires before the
+// batch handle is returned, and the journal rolls the pool back to its
+// pre-call state on ANY throw -- a failed apply leaves every doc exactly
+// as it was (the reference backend is immutable and discards failed
+// state).  After begin succeeds, no later phase (mid/emit) throws for
+// well-formed pools.
 static void begin_phases(Pool& pool, Batch& b,
                          std::vector<std::vector<ChangeRec>>& incoming) {
   double t1 = mono_now();
-  std::vector<std::pair<u32, std::vector<ChangeRec>>> queue_snaps;
+  BeginJournal j;
+  ++pool.epoch;
   for (u32 d = 0; d < b.bdocs.size(); ++d)
     if (!b.bdocs[d]->queue.empty())
-      queue_snaps.emplace_back(d, b.bdocs[d]->queue);
+      j.queues.emplace_back(d, b.bdocs[d]->queue);
   schedule(pool, b, incoming);
   try {
-    validate_batch(pool, b);
+    validate_duplicates(pool, b);
+    update_states(pool, b, j);
+    prepass(pool, b, j);
+    double t2 = mono_now();
+    b.tr_schedule = t2 - t1;
+    encode(pool, b);
+    double t3 = mono_now();
+    b.tr_encode = t3 - t2;
+    dom_layout(pool, b);
+    b.tr_domlay = mono_now() - t3;
   } catch (...) {
-    // schedule only touched the queues; restore them and rethrow
-    for (u32 d = 0; d < b.bdocs.size(); ++d) b.bdocs[d]->queue.clear();
-    for (auto& [d, q] : queue_snaps) b.bdocs[d]->queue = std::move(q);
+    j.rollback(b);
     throw;
   }
-  update_states(pool, b);
-  prepass(pool, b);
-  double t2 = mono_now();
-  b.tr_schedule = t2 - t1;
-  encode(pool, b);
-  double t3 = mono_now();
-  b.tr_encode = t3 - t2;
-  dom_layout(pool, b);
-  b.tr_domlay = mono_now() - t3;
 }
 
 static void mid_phase(Pool& pool, Batch& b) {
@@ -1208,8 +1440,9 @@ static void mid_phase(Pool& pool, Batch& b) {
         auto sit = scratch.find(gk);
         if (sit == scratch.end()) {
           Register init;
-          auto rit = st.registers.find(DocState::rkey(op.obj, op.key));
-          if (rit != st.registers.end()) init = rit->second;
+          const Register* rit =
+              st.registers.find(DocState::rkey(op.obj, op.key));
+          if (rit) init = *rit;
           sit = scratch.emplace(gk, std::move(init)).first;
         }
         // oracle rule: keep concurrent priors, append op unless del,
@@ -1227,18 +1460,6 @@ static void mid_phase(Pool& pool, Batch& b) {
         b.host_registers[static_cast<i64>(op_idx)] = remaining;
       }
     }
-  }
-
-  // missing-element check: an op with no arena entry may only leave the
-  // element invisible (a remove of a nonexistent element is dropped)
-  for (auto& [op_idx, row] : b.missing_eidx) {
-    bool alive_now;
-    auto hit = b.host_registers.find(op_idx);
-    if (hit != b.host_registers.end()) alive_now = !hit->second.empty();
-    else alive_now = b.k_alive[row] > 0;
-    if (alive_now)
-      throw Error(0, "Missing index entry for list element " +
-                         pool.intern.str(b.ops[op_idx].op->key));
   }
 
   // fill the fallback-path mirrors (er/orank from the fetched rank, od
@@ -1307,10 +1528,10 @@ static void register_from_kernel(Batch& b, i64 row, Register& reg) {
 static void update_register_mirror(Pool& pool, DocState& st, const OpRec& op,
                                    const Register& new_register) {
   u64 rk = DocState::rkey(op.obj, op.key);
-  auto rit = st.registers.find(rk);
-  if (rit != st.registers.end()) {
+  Register* rit = st.registers.find(rk);
+  if (rit) {
     // drop inbound refs of links no longer in the register
-    for (auto& o : rit->second) {
+    for (auto& o : *rit) {
       if (o.action != A_LINK) continue;
       bool still = false;
       for (auto& n : new_register)
@@ -1325,6 +1546,7 @@ static void update_register_mirror(Pool& pool, DocState& st, const OpRec& op,
         if (inbound[i].actor == o.actor && inbound[i].seq == o.seq &&
             inbound[i].key == o.key && inbound[i].obj == o.obj) {
           inbound.erase(inbound.begin() + i);
+          st.path_epoch++;
           --i;
         }
       }
@@ -1337,15 +1559,18 @@ static void update_register_mirror(Pool& pool, DocState& st, const OpRec& op,
       bool present = false;
       for (auto& r : tit->second.inbound)
         if (r == ref) { present = true; break; }
-      if (!present) tit->second.inbound.push_back(ref);
+      if (!present) {
+        tit->second.inbound.push_back(ref);
+        st.path_epoch++;
+      }
     }
   }
-  if (rit == st.registers.end()) {
+  if (!rit) {
     auto oit = st.objects.find(op.obj);
     if (oit != st.objects.end()) oit->second.key_order.push_back(op.key);
-    st.registers.emplace(rk, new_register);
+    *st.registers.insert(rk).first = new_register;
   } else {
-    rit->second = new_register;
+    *rit = new_register;
   }
 }
 
@@ -1370,9 +1595,9 @@ static bool get_path(Pool& pool, DocState& st, u32 object_id,
       const std::string& kstr = pool.intern.str(ref.key);
       u32 ea; i64 ec;
       if (!parse_elem_id(kstr, pool.intern, &ea, &ec)) return false;
-      auto eit = ar.index_of.find(Arena::ekey(ea, ec));
-      if (eit == ar.index_of.end()) return false;
-      i32 eidx = eit->second;
+      const i32* eit = ar.index_of.find(Arena::ekey(ea, ec));
+      if (!eit) return false;
+      i32 eidx = *eit;
       i32 pos = -1;
       for (size_t i = 0; i < ar.visible_order.size(); ++i)
         if (ar.visible_order[i] == eidx) { pos = static_cast<i32>(i); break; }
@@ -1410,18 +1635,17 @@ static void write_conflicts(Writer& w, Pool& pool, const Register& reg) {
 
 // emits one map/table diff; mirrors engine._emit_map_diff
 static void emit_map_diff(Writer& w, Pool& pool, DocState& st,
-                          const OpRec& op, const Register& reg, u8 obj_type) {
+                          const OpRec& op, const Register& reg, u8 obj_type,
+                          const std::vector<u8>& path_bytes) {
   const char* type_ =
       (op.obj == pool.root_sid) ? "map" : type_name(obj_type);
-  std::vector<PathElem> path;
-  bool ok = get_path(pool, st, op.obj, path);
   if (reg.empty()) {
     w.map(5);
     w.str("action"); w.str("remove");
     w.str("type"); w.str(type_);
     w.str("obj"); w.str(pool.intern.str(op.obj));
     w.str("key"); w.str(pool.intern.str(op.key));
-    w.str("path"); write_path(w, pool, ok, path);
+    w.str("path"); w.raw(path_bytes);
     return;
   }
   const OpRec& first = reg[0];
@@ -1432,7 +1656,7 @@ static void emit_map_diff(Writer& w, Pool& pool, DocState& st,
   w.str("type"); w.str(type_);
   w.str("obj"); w.str(pool.intern.str(op.obj));
   w.str("key"); w.str(pool.intern.str(op.key));
-  w.str("path"); write_path(w, pool, ok, path);
+  w.str("path"); w.raw(path_bytes);
   w.str("value");
   if (first.value_rid != NONE) w.raw(val_bytes(pool, first));
   else w.nil();
@@ -1447,7 +1671,8 @@ static void emit_map_diff(Writer& w, Pool& pool, DocState& st,
 // returns false when no diff is produced
 static bool emit_list_diff(Writer& w, Pool& pool, DocState& st,
                            const OpRec& op, const Register& reg, i64 op_idx,
-                           Batch& b, u8 obj_type) {
+                           Batch& b, u8 obj_type,
+                           const std::vector<u8>& path_bytes) {
   Arena& ar = st.arenas[op.obj];
   auto iit = b.list_index_of_op.find(op_idx);
   const std::string& kstr = pool.intern.str(op.key);
@@ -1456,10 +1681,6 @@ static bool emit_list_diff(Writer& w, Pool& pool, DocState& st,
   i32 index = iit->second.first;
   bool visible_before = ar.visible[eidx] != 0;
   bool alive = !reg.empty();
-
-  // path computed before the visibility mutation (oracle evaluation order)
-  std::vector<PathElem> path;
-  bool ok = get_path(pool, st, op.obj, path);
 
   const char* action;
   if (visible_before && alive) {
@@ -1488,7 +1709,7 @@ static bool emit_list_diff(Writer& w, Pool& pool, DocState& st,
   w.str("type"); w.str(type_name(obj_type));
   w.str("obj"); w.str(pool.intern.str(op.obj));
   w.str("index"); w.integer(index);
-  w.str("path"); write_path(w, pool, ok, path);
+  w.str("path"); w.raw(path_bytes);
   if (ins) { w.str("elemId"); w.str(kstr); }
   if (setlike) {
     w.str("value");
@@ -1528,11 +1749,40 @@ static void emit(Pool& pool, Batch& b) {
     }
     for (size_t d = 0; d < b.bdoc_ids.size(); ++d) {
       if (assigns[d])
-        b.bdocs[d]->registers.reserve(b.bdocs[d]->registers.size() +
-                                      assigns[d]);
+        b.bdocs[d]->registers.reserve(b.bdocs[d]->registers.n + assigns[d]);
       diff_bufs[d].buf.reserve(per[d] * 48);
     }
   }
+
+  // inline path cache: consecutive ops overwhelmingly target the same
+  // object, and pure-map paths (no list indexes) are stable while the
+  // doc's inbound-link index (path_epoch) holds still; list-index paths
+  // shift with visibility mutations and are never cached
+  struct {
+    u32 doc = ~0u, obj = NONE;
+    u64 epoch = 0;
+    std::vector<u8> bytes;
+  } pc;
+  std::vector<PathElem> path_scratch;
+  auto render_path = [&](u32 doc, DocState& st,
+                         u32 obj) -> const std::vector<u8>& {
+    if (pc.doc == doc && pc.obj == obj && pc.epoch == st.path_epoch)
+      return pc.bytes;
+    bool ok = get_path(pool, st, obj, path_scratch);
+    Writer pw;
+    write_path(pw, pool, ok, path_scratch);
+    bool cacheable = true;
+    if (ok)
+      for (auto& p : path_scratch)
+        if (p.is_index) { cacheable = false; break; }
+    pc.bytes = std::move(pw.buf);
+    if (cacheable) {
+      pc.doc = doc; pc.obj = obj; pc.epoch = st.path_epoch;
+    } else {
+      pc.doc = ~0u; pc.obj = NONE;
+    }
+    return pc.bytes;
+  };
 
   for (size_t op_idx = 0; op_idx < b.ops.size(); ++op_idx) {
     auto& f = b.ops[op_idx];
@@ -1559,9 +1809,9 @@ static void emit(Pool& pool, Batch& b) {
     // the same interleaved order as the reference (op_set.js:193-200);
     // projection keeps only action/obj/key/value
     if (b.local_kind == 1 && b.capture[op_idx]) {
-      auto rit = st.registers.find(DocState::rkey(op.obj, op.key));
-      if (rit != st.registers.end() && !rit->second.empty()) {
-        for (const OpRec& rec : rit->second) {
+      const Register* rit = st.registers.find(DocState::rkey(op.obj, op.key));
+      if (rit && !rit->empty()) {
+        for (const OpRec& rec : *rit) {
           OpRec p = rec;
           p.actor = NONE; p.seq = 0; p.datatype = NONE; p.elem = -1;
           b.undo_local.push_back(p);
@@ -1577,12 +1827,16 @@ static void emit(Pool& pool, Batch& b) {
 
     update_register_mirror(pool, st, op, reg);
     u8 obj_type = st.objects[op.obj].type;
+    // path rendered AFTER the mirror update (the reference computes it
+    // inside updateMapKey/updateListElement, post inbound maintenance)
+    // but BEFORE this op's visibility mutation
+    const std::vector<u8>& path_bytes = render_path(f.doc, st, op.obj);
     if (is_list_type(obj_type)) {
       if (emit_list_diff(w, pool, st, op, reg, static_cast<i64>(op_idx), b,
-                         obj_type))
+                         obj_type, path_bytes))
         diff_counts[f.doc]++;
     } else {
-      emit_map_diff(w, pool, st, op, reg, obj_type);
+      emit_map_diff(w, pool, st, op, reg, obj_type, path_bytes);
       diff_counts[f.doc]++;
     }
   }
@@ -1706,9 +1960,10 @@ static void materialize(Pool& pool, DocState& st, u32 object_id, Writer& w,
         std::string elem_id = pool.intern.str(ar.actor_sid[eidx]) + ":" +
                               std::to_string(ar.ctr[eidx]);
         u32 key_sid = pool.intern.id_of(elem_id);
-        auto rit = st.registers.find(DocState::rkey(object_id, key_sid));
-        if (rit == st.registers.end() || rit->second.empty()) continue;
-        const Register& reg = rit->second;
+        const Register* rit =
+            st.registers.find(DocState::rkey(object_id, key_sid));
+        if (!rit || rit->empty()) continue;
+        const Register& reg = *rit;
         Writer val;
         size_t extra = 0;
         materialize_value(pool, st, reg[0], w, count, seen, val, extra);
@@ -1739,9 +1994,10 @@ static void materialize(Pool& pool, DocState& st, u32 object_id, Writer& w,
     }
     if (mit != st.objects.end()) {
       for (u32 key : mit->second.key_order) {
-        auto rit = st.registers.find(DocState::rkey(object_id, key));
-        if (rit == st.registers.end() || rit->second.empty()) continue;
-        const Register& reg = rit->second;
+        const Register* rit =
+            st.registers.find(DocState::rkey(object_id, key));
+        if (!rit || rit->empty()) continue;
+        const Register& reg = *rit;
         Writer val;
         size_t extra = 0;
         materialize_value(pool, st, reg[0], w, count, seen, val, extra);
@@ -1836,7 +2092,13 @@ void* amtpu_begin(void* pool_ptr, const uint8_t* data, int64_t len) {
   h->batch.pool = &pool;
   try {
     double t0 = mono_now();
-    Reader r(data, static_cast<size_t>(len));
+    if (len < 0 || len >= (1LL << 32))
+      throw Error(0, "payload too large (raw spans use 32-bit offsets; "
+                     "split batches below 4 GiB)");
+    // one payload copy into a shared slab; every change's raw bytes are
+    // spans into it (the caller's buffer may be freed after this call)
+    auto slab = std::make_shared<std::vector<u8>>(data, data + len);
+    Reader r(slab->data(), slab->size());
     size_t n_docs = r.read_map();
     Batch& b = h->batch;
     std::vector<std::vector<ChangeRec>> incoming;
@@ -1847,13 +2109,33 @@ void* amtpu_begin(void* pool_ptr, const uint8_t* data, int64_t len) {
       std::vector<ChangeRec> chs;
       chs.reserve(n_changes);
       for (size_t j = 0; j < n_changes; ++j)
-        chs.push_back(decode_change(r, pool));
+        chs.push_back(decode_change(r, pool, slab));
       b.bdocs.push_back(&pool.doc(doc_id));
       b.bdoc_ids.push_back(std::move(doc_id));
       incoming.push_back(std::move(chs));
     }
     b.tr_decode = mono_now() - t0;
     begin_phases(pool, h->batch, incoming);
+    // unpin the payload slab when most of it was NOT retained (duplicate-
+    // heavy sync payloads re-send already-applied changes): re-adopt
+    // private copies of the few retained spans so long-lived states/queue
+    // entries don't hold the whole wire buffer alive
+    size_t kept = 0;
+    for (auto& ac : b.applied)
+      if (ac.stored->raw.slab == slab) kept += ac.stored->raw.len;
+    for (auto* d : b.bdocs)
+      for (auto& qc : d->queue)
+        if (qc.raw.slab == slab) kept += qc.raw.len;
+    if (kept * 4 < slab->size()) {
+      auto copy_out = [&](ChangeRec& c) {
+        if (c.raw.slab != slab) return;
+        std::vector<u8> buf(c.raw.data(), c.raw.data() + c.raw.len);
+        c.raw.adopt(std::move(buf));
+      };
+      for (auto& ac : b.applied) copy_out(*ac.stored);
+      for (auto* d : b.bdocs)
+        for (auto& qc : d->queue) copy_out(qc);
+    }
   } catch (const Error& e) {
     g_error = e.what(); g_error_kind = e.kind;
     return nullptr;
@@ -1874,9 +2156,13 @@ void* amtpu_begin_local(void* pool_ptr, const char* doc_id,
   h->pool = &pool;
   h->batch.pool = &pool;
   try {
-    Reader r(data, static_cast<size_t>(len));
+    if (len < 0 || len >= (1LL << 32))
+      throw Error(0, "payload too large (raw spans use 32-bit offsets; "
+                     "split batches below 4 GiB)");
+    auto slab = std::make_shared<std::vector<u8>>(data, data + len);
+    Reader r(slab->data(), slab->size());
     LocalReq lr;
-    ChangeRec req = decode_change(r, pool, &lr);
+    ChangeRec req = decode_change(r, pool, slab, &lr);
     if (!lr.has_actor || !lr.has_seq)
       // 'requries' [sic]: parity with the reference's own error text
       // (backend/index.js:177)
@@ -1911,15 +2197,16 @@ void* amtpu_begin_local(void* pool_ptr, const char* doc_id,
         // change applies (backend/index.js:264-278); projection keeps
         // everything except actor/seq (datatype survives)
         for (const OpRec& op : *src_ops) {
-          auto rit = st.registers.find(DocState::rkey(op.obj, op.key));
-          if (rit == st.registers.end() || rit->second.empty()) {
+          const Register* rit =
+              st.registers.find(DocState::rkey(op.obj, op.key));
+          if (!rit || rit->empty()) {
             OpRec d{};
             d.action = A_DEL; d.obj = op.obj; d.key = op.key;
             d.elem = -1; d.actor = NONE; d.seq = 0; d.datatype = NONE;
             d.value_rid = NONE; d.value_sid = NONE;
             b.pending_redo.push_back(d);
           } else {
-            for (const OpRec& rec : rit->second) {
+            for (const OpRec& rec : *rit) {
               OpRec p = rec;
               p.actor = NONE; p.seq = 0; p.elem = -1;
               b.pending_redo.push_back(p);
@@ -1942,7 +2229,8 @@ void* amtpu_begin_local(void* pool_ptr, const char* doc_id,
         op.actor = req.actor;
         op.seq = req.seq;
       }
-      change.raw = encode_change_raw(pool, change, !message_is_nil(change));
+      change.raw.adopt(
+          encode_change_raw(pool, change, !message_is_nil(change)));
     } else {
       // oracle parity: missing requestType reports as Python None
       // (backend/__init__.py::apply_local_change)
@@ -2045,11 +2333,6 @@ int amtpu_mid_fused(void* bp, const int32_t* winner, const int32_t* conflicts,
       b.k_conflicts.assign(conflicts, conflicts + b.Tp * window);
       b.k_alive.assign(alive, alive + b.Tp);
       b.k_overflow.assign(overflow, overflow + b.Tp);
-    }
-    for (auto& [op_idx, row] : b.missing_eidx) {
-      if (b.k_alive[row] > 0)
-        throw Error(0, "Missing index entry for list element " +
-                           h.pool->intern.str(b.ops[op_idx].op->key));
     }
     i64 off = 0;
     for (auto& blk : b.dom_blocks) {
@@ -2245,7 +2528,8 @@ uint8_t* amtpu_get_missing_changes(void* pool_ptr, const char* doc_id,
       auto& entries = st.states[actor];
       u32 from = clock_get(all_deps, actor);
       for (size_t i = from; i < entries.size(); ++i)
-        out.raw(entries[i].change.raw);
+        out.raw(entries[i].change.raw.data(),
+                entries[i].change.raw.size());
     }
     *len = static_cast<int64_t>(out.buf.size());
     uint8_t* res = static_cast<uint8_t*>(std::malloc(out.buf.size()));
@@ -2277,7 +2561,8 @@ uint8_t* amtpu_get_changes_for_actor(void* pool_ptr, const char* doc_id,
     } else {
       out.array(it->second.size() - from);
       for (size_t i = from; i < it->second.size(); ++i)
-        out.raw(it->second[i].change.raw);
+        out.raw(it->second[i].change.raw.data(),
+                it->second[i].change.raw.size());
     }
     *len = static_cast<int64_t>(out.buf.size());
     uint8_t* res = static_cast<uint8_t*>(std::malloc(out.buf.size()));
@@ -2303,12 +2588,13 @@ uint8_t* amtpu_get_register(void* pool_ptr, const char* doc_id,
     u32 obj_sid = pool.intern.id_of(obj);
     u32 key_sid = pool.intern.id_of(key);
     Writer out;
-    auto rit = st.registers.find(DocState::rkey(obj_sid, key_sid));
-    if (rit == st.registers.end()) {
+    const Register* rit =
+        st.registers.find(DocState::rkey(obj_sid, key_sid));
+    if (!rit) {
       out.array(0);
     } else {
-      out.array(rit->second.size());
-      for (const OpRec& o : rit->second) {
+      out.array(rit->size());
+      for (const OpRec& o : *rit) {
         size_t n = 5 + (o.value_rid != NONE ? 1 : 0) +
                    (o.datatype != NONE ? 1 : 0);
         out.map(n);

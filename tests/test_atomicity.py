@@ -93,6 +93,43 @@ def test_inconsistent_seq_reuse_rejected_without_commit(make_pool):
     assert pool.apply_changes('d', [good(1)])['diffs'] == []
 
 
+@pytest.mark.parametrize('make_pool', POOLS)
+def test_multi_error_batches_surface_first_error_in_op_order(make_pool):
+    """When a batch contains several invalid ops, the FIRST one in
+    application order wins -- the oracle applies ops strictly in order, so
+    every backend must report the same error for the same input."""
+    pool = make_pool()
+    pool.apply_changes('d', [
+        {'actor': 'A', 'seq': 1, 'deps': {},
+         'ops': [{'action': 'makeText', 'obj': 'T'},
+                 {'action': 'link', 'obj': ROOT, 'key': 't',
+                  'value': 'T'}]}])
+    bad = {'actor': 'A', 'seq': 2, 'deps': {},
+           'ops': [{'action': 'set', 'obj': 'T', 'key': 'A:99',
+                    'value': 'x'},          # error 1: absent list element
+                   {'action': 'makeText', 'obj': 'T'}]}  # error 2: dup
+    with pytest.raises(AutomergeError, match='Missing index entry'):
+        pool.apply_changes('d', [bad])
+    assert pool.get_patch('d')['clock'] == {'A': 1}
+
+
+@pytest.mark.parametrize('make_pool', POOLS)
+def test_assign_before_insert_in_same_change_rejected(make_pool):
+    """An assign referencing an element inserted LATER in the same change
+    must error: the oracle applies ops in order, so the element does not
+    exist yet when the assign runs."""
+    pool = make_pool()
+    bad = {'actor': 'A', 'seq': 1, 'deps': {},
+           'ops': [{'action': 'makeText', 'obj': 'T'},
+                   {'action': 'set', 'obj': 'T', 'key': 'A:1',
+                    'value': 'x'},
+                   {'action': 'ins', 'obj': 'T', 'key': '_head',
+                    'elem': 1}]}
+    with pytest.raises(AutomergeError, match='Missing index entry'):
+        pool.apply_changes('d', [bad])
+    assert pool.get_patch('d')['clock'] == {}
+
+
 def test_queries_do_not_materialize_phantom_docs():
     pool = NativeDocPool()
     assert pool.get_patch('never-created')['diffs'] == []
