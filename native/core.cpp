@@ -156,7 +156,7 @@ struct Interner {
       h ^= static_cast<u8>(c);
       h *= 1099511628211ull;
     }
-    return h | 1;                              // 0 marks an empty slot
+    return h ? h : 0x9e3779b97f4a7c15ull;      // 0 marks an empty slot
   }
   void rehash(size_t cap) {
     std::vector<u64> oh = std::move(slot_hash);
@@ -467,7 +467,17 @@ static const char* type_name(u8 t) {
   }
 }
 
-static OpRec decode_op(Reader& r, Pool& pool, u32 actor, u32 seq) {
+// one-entry intern caches for strings that repeat across consecutive ops
+// (object ids within a change, single-char text values): a short memcmp
+// beats a hash+probe
+struct DecodeCache {
+  std::string_view obj_sv, val_sv;
+  u32 obj_sid = NONE;
+  u32 val_sid = NONE, val_rid = NONE;
+};
+
+static OpRec decode_op(Reader& r, Pool& pool, u32 actor, u32 seq,
+                       DecodeCache& dc) {
   OpRec op;
   op.action = 0xff;
   op.obj = NONE; op.key = NONE; op.elem = -1;
@@ -477,8 +487,14 @@ static OpRec decode_op(Reader& r, Pool& pool, u32 actor, u32 seq) {
   for (size_t i = 0; i < n; ++i) {
     std::string_view k = r.read_str_view();
     if (k == "action") op.action = parse_action_sv(r.read_str_view());
-    else if (k == "obj") op.obj = pool.intern.id_of(r.read_str_view());
-    else if (k == "key") op.key = pool.intern.id_of(r.read_str_view());
+    else if (k == "obj") {
+      std::string_view s = r.read_str_view();
+      if (dc.obj_sid == NONE || s != dc.obj_sv) {
+        dc.obj_sid = pool.intern.id_of(s);
+        dc.obj_sv = s;
+      }
+      op.obj = dc.obj_sid;
+    } else if (k == "key") op.key = pool.intern.id_of(r.read_str_view());
     else if (k == "elem") op.elem = r.read_int();
     else if (k == "datatype")
       op.datatype = pool.intern.id_of(r.read_str_view());
@@ -486,9 +502,15 @@ static OpRec decode_op(Reader& r, Pool& pool, u32 actor, u32 seq) {
       if (r.peek_type() == Type::Str) {
         const uint8_t* start = r.pos();
         std::string_view s = r.read_str_view();
-        op.value_sid = pool.intern.id_of(s);
-        op.value_rid = pool.vals.id_of(std::string_view(
-            reinterpret_cast<const char*>(start), r.pos() - start));
+        std::string_view raw(reinterpret_cast<const char*>(start),
+                             r.pos() - start);
+        if (dc.val_sid == NONE || raw != dc.val_sv) {
+          dc.val_sid = pool.intern.id_of(s);
+          dc.val_rid = pool.vals.id_of(raw);
+          dc.val_sv = raw;
+        }
+        op.value_sid = dc.val_sid;
+        op.value_rid = dc.val_rid;
       } else {
         auto span = r.raw_value();
         op.value_rid = pool.vals.id_of(std::string_view(
@@ -584,8 +606,9 @@ static ChangeRec decode_change(Reader& r, Pool& pool,
     Reader ro(ops_start, static_cast<size_t>(ops_end - ops_start));
     ro.read_array();
     ch.ops.reserve(ops_count);
+    DecodeCache dc;
     for (size_t j = 0; j < ops_count; ++j)
-      ch.ops.push_back(decode_op(ro, pool, ch.actor, ch.seq));
+      ch.ops.push_back(decode_op(ro, pool, ch.actor, ch.seq, dc));
   }
   return ch;
 }
@@ -601,6 +624,7 @@ static bool parse_elem_id(const std::string& s, Interner& intern,
     char c = s[i];
     if (c < '0' || c > '9') return false;
     v = v * 10 + (c - '0');
+    if (v > 0x7fffffff) return false;   // arena counters are i32
   }
   *actor_sid = intern.id_of(s.substr(0, pos));
   *ctr = v;
@@ -954,6 +978,12 @@ static void prepass(Pool& pool, Batch& b, BeginJournal& j) {
         if (oit == st.objects.end())
           throw Error(0, "Modification of unknown object " +
                              pool.intern.str(op.obj));
+        // arena columns are i32 (the kernel layout) and ekey packs elem
+        // into the low 32 bits; out-of-range counters would corrupt the
+        // index (and collide with FlatMap's reserved empty key at -1)
+        if (op.elem < 0 || op.elem > 0x7fffffff)
+          throw Error(0, "List element counter out of range: " +
+                             std::to_string(op.elem));
         Arena& ar = st.arenas[op.obj];
         if (ar.jstamp != pool.epoch) {
           ar.jstamp = pool.epoch;
@@ -1055,7 +1085,9 @@ static void encode(Pool& pool, Batch& b) {
       mark(da);
   }
 
-  std::unordered_map<K3, u32, K3Hash> gid_map;     // (doc, obj, key)
+  // group ids per doc, keyed by rkey(obj, key): per-doc flat maps keep
+  // probes in small hot tables instead of one giant shared one
+  std::vector<FlatMap<u32>> doc_gids(b.bdocs.size());
   std::vector<K3> gid_order;
   auto akey_of = [](u32 doc, u32 obj) {
     return (static_cast<u64>(doc) << 32) | obj;
@@ -1073,12 +1105,11 @@ static void encode(Pool& pool, Batch& b) {
     DocState& st = *b.bdocs[f.doc];
     const OpRec& op = *f.op;
     if (is_assign(op.action)) {
-      K3 gk{f.doc, op.obj, op.key};
-      auto [git, inserted] =
-          gid_map.emplace(gk, static_cast<u32>(gid_order.size()));
-      (void)git;
+      auto [slot, inserted] =
+          doc_gids[f.doc].insert(DocState::rkey(op.obj, op.key));
       if (inserted) {
-        gid_order.push_back(gk);
+        *slot = static_cast<u32>(gid_order.size());
+        gid_order.push_back(K3{f.doc, op.obj, op.key});
         const Register* reg =
             st.registers.find(DocState::rkey(op.obj, op.key));
         gid_regs.push_back(reg);
@@ -1190,7 +1221,7 @@ static void encode(Pool& pool, Batch& b) {
         c_crow = static_cast<i32>(clock_row_of(f.doc, st, op.actor, op.seq));
         c_rank = b.rank_of[op.actor];
       }
-      u32 gid = gid_map[K3{f.doc, op.obj, op.key}];
+      u32 gid = *doc_gids[f.doc].find(DocState::rkey(op.obj, op.key));
       b.assign_row_of_op[op_idx] = static_cast<i64>(b.g_col.size());
       b.g_col.push_back(static_cast<i32>(gid));
       b.t_col.push_back(static_cast<i32>(op_idx));

@@ -140,3 +140,27 @@ def test_queries_do_not_materialize_phantom_docs():
     # the doc must still be creatable with full semantics afterwards
     patch = pool.apply_changes('never-created', [good(1)])
     assert [d['key'] for d in patch['diffs']] == ['k']
+
+
+@pytest.mark.parametrize('make_pool', [NativeDocPool,
+                                       lambda: ShardedNativePool(n_shards=2)])
+def test_out_of_range_elem_counter_rejected(make_pool):
+    """Arena columns are i32 (the kernel layout): inserts with counters
+    outside that range are rejected atomically, never silently truncated."""
+    pool = make_pool()
+    pool.apply_changes('d', [
+        {'actor': 'A', 'seq': 1, 'deps': {},
+         'ops': [{'action': 'makeText', 'obj': 'T'}]}])
+    for elem in (-1, 2 ** 31, 2 ** 40):
+        with pytest.raises(AutomergeError, match='out of range'):
+            pool.apply_changes('d', [
+                {'actor': 'A', 'seq': 2, 'deps': {},
+                 'ops': [{'action': 'ins', 'obj': 'T', 'key': '_head',
+                          'elem': elem}]}])
+    assert pool.get_patch('d')['clock'] == {'A': 1}
+    # a huge counter inside an elemId string is malformed, not a wrap
+    with pytest.raises(AutomergeError, match='Missing index entry'):
+        pool.apply_changes('d', [
+            {'actor': 'A', 'seq': 2, 'deps': {},
+             'ops': [{'action': 'ins', 'obj': 'T',
+                      'key': 'A:99999999999999999999', 'elem': 1}]}])
