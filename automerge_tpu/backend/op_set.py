@@ -320,7 +320,14 @@ def apply_assign(op_set, op, top_level):
         if op not in inbound:
             target['_inbound'] = inbound + (op,)
     if op['action'] != 'del':
-        remaining.append(op)
+        # newest-first insertion + stable sort = ties (same actor, only
+        # reachable through a change assigning one key twice -- the
+        # reference frontend can never emit that, ensureSingleAssignment
+        # frontend/index.js:53) order most-recently-applied first.  This is
+        # the one deliberate deviation from the JS sortBy(actor).reverse(),
+        # whose tie order oscillates per application; the batched register
+        # kernel's window order matches this rule exactly.
+        remaining.insert(0, op)
     remaining.sort(key=lambda o: o['actor'], reverse=True)
     obj = _owned_object(op_set, object_id)
     obj[op['key']] = tuple(remaining)

@@ -19,6 +19,7 @@ import msgpack
 import numpy as np
 
 from .. import trace
+from ..utils.common import doc_key
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(os.path.dirname(os.path.dirname(_DIR)), 'native')
@@ -51,11 +52,11 @@ def _load():
     lib.amtpu_batch_dims.argtypes = [ctypes.c_void_p,
                                      ctypes.POINTER(ctypes.c_int64)]
     for name in ('g', 't', 'a', 's', 'clocktab', 'clockidx', 'sort',
-                 'obj', 'par', 'ctr', 'act', 'linsort'):
+                 'obj', 'par', 'ctr', 'act', 'linsort', 'memidx'):
         fn = getattr(lib, 'amtpu_col_' + name)
         fn.restype = ctypes.POINTER(ctypes.c_int32)
         fn.argtypes = [ctypes.c_void_p]
-    for name in ('d', 'val'):
+    for name in ('d', 'val', 'hostovf'):
         fn = getattr(lib, 'amtpu_col_' + name)
         fn.restype = ctypes.POINTER(ctypes.c_uint8)
         fn.argtypes = [ctypes.c_void_p]
@@ -101,6 +102,9 @@ def _load():
     lib.amtpu_get_patch.restype = ctypes.POINTER(ctypes.c_uint8)
     lib.amtpu_get_patch.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                     ctypes.POINTER(ctypes.c_int64)]
+    lib.amtpu_get_clock.restype = ctypes.POINTER(ctypes.c_uint8)
+    lib.amtpu_get_clock.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64)]
     lib.amtpu_get_missing_deps.restype = ctypes.POINTER(ctypes.c_uint8)
     lib.amtpu_get_missing_deps.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64)]
@@ -207,7 +211,7 @@ class NativeDocPool:
     WINDOW = 8
     #: entries amtpu_batch_dims writes -- must match core.cpp exactly
     #: (an undersized ctypes buffer is silent heap corruption)
-    N_DIMS = 9
+    N_DIMS = 11
 
     def __init__(self):
         self._pool = lib().amtpu_pool_new()
@@ -261,15 +265,23 @@ class NativeDocPool:
         try:
             dims = (ctypes.c_int64 * self.N_DIMS)()
             L.amtpu_batch_dims(bh, dims)
-            T, Tp, A, Ap, Larena, Lp, n_blocks, max_obj, CTp = \
-                [int(x) for x in dims]
+            (T, Tp, A, Ap, Larena, Lp, n_blocks, max_obj, CTp,
+             use_members, any_ovf) = [int(x) for x in dims]
             fdims = (ctypes.c_int64 * 4)()
             L.amtpu_fused_dims(bh, fdims)
             fused_ok, W, dLp, dTp = [int(x) for x in fdims]
             trace.count('ops.register_rows', T)
             trace.count('ops.arena_elems', Larena)
+            # member-window mode (hot keys): explicit candidate indexes +
+            # host-computed overflow flags replace the sliding window
+            mem = hovf = None
+            if use_members and Tp > 0:
+                mem = np.ctypeslib.as_array(L.amtpu_col_memidx(bh),
+                                            shape=(Tp, self.WINDOW))
+                hovf = np.ctypeslib.as_array(L.amtpu_col_hostovf(bh),
+                                             shape=(Tp,))
             ctx.update(dims=(T, Tp, A, Ap, Larena, Lp, n_blocks, max_obj,
-                             CTp))
+                             CTp), mem=mem, hovf=hovf)
 
             if fused_ok:
                 with trace.span('device.dispatch'):
@@ -279,7 +291,7 @@ class NativeDocPool:
                 trace.count('fused.fallback_layout')
                 with trace.span('device.dispatch'):
                     reg_out, rank = self._run_resolver(
-                        L, bh, Tp, Ap, CTp, Lp, max_obj)
+                        L, bh, Tp, Ap, CTp, Lp, max_obj, mem)
                 ctx.update(mode='old', reg_out=reg_out, rank=rank)
             return ctx
         except Exception:
@@ -322,15 +334,21 @@ class NativeDocPool:
             ctx.update(mode='fused', combo=None, reg_out=None, rank=None)
             return
         r = self._register_views(L, bh, Tp, Ap, CTp)
+        mem = ctx.get('mem')
         if n_blocks == 0:
             # register work only (maps/tables, or inserts without list
             # assigns): rank is consumed by nothing on the host
-            reg_out = register_ops.resolve_registers(
-                r['g'], r['t'], r['a'], r['s'],
-                is_del=r['d'].astype(bool),
-                alive_in=np.ones((Tp,), bool), window=self.WINDOW,
-                sort_idx=r['si'], clock_table=r['ctab'],
-                clock_idx=r['cidx'])
+            if mem is not None:
+                reg_out = register_ops.resolve_registers_members(
+                    r['t'], r['a'], r['s'], mem, r['d'].astype(bool),
+                    r['ctab'], r['cidx'], window=self.WINDOW)
+            else:
+                reg_out = register_ops.resolve_registers(
+                    r['g'], r['t'], r['a'], r['s'],
+                    is_del=r['d'].astype(bool),
+                    alive_in=np.ones((Tp,), bool), window=self.WINDOW,
+                    sort_idx=r['si'], clock_table=r['ctab'],
+                    clock_idx=r['cidx'])
             combo = reg_out['packed']
             combo.copy_to_host_async()
             ctx.update(mode='fused', combo=combo, reg_out=reg_out,
@@ -353,7 +371,7 @@ class NativeDocPool:
             e['obj'], e['par'], e['ctr'], e['act'], e['val'].astype(bool),
             e['lsi'], n_iters,
             v0, er_src, oe, orank_src, dom_src, ov.astype(bool),
-            window=self.WINDOW)
+            window=self.WINDOW, mem_idx=mem)
         combo.copy_to_host_async()
         ctx.update(mode='fused', combo=combo, reg_out=reg_out, rank=rank)
 
@@ -417,6 +435,10 @@ class NativeDocPool:
                 if Tp > 0:
                     winner, conflicts, alive, overflow = \
                         self._unpack_register_out(reg_out, Tp)
+                    if ctx.get('hovf') is not None:
+                        # member mode: overflow is host-decided (>WINDOW
+                        # concurrent streams / same-change dup assigns)
+                        overflow = np.ascontiguousarray(ctx['hovf'])
                 else:
                     winner = conflicts = alive = np.zeros(0, np.int32)
                     overflow = np.zeros(0, np.uint8)
@@ -462,7 +484,8 @@ class NativeDocPool:
 
     # -- kernel dispatch ------------------------------------------------
 
-    def _run_resolver(self, L, bh, Tp, Ap, CTp, Lp, max_obj_len):
+    def _run_resolver(self, L, bh, Tp, Ap, CTp, Lp, max_obj_len,
+                      mem=None):
         """Register resolution + linearization, fused into one dispatch
         when both are needed (halves blocking round trips on the
         high-latency device link).  Returns (reg_out device dict | None,
@@ -480,15 +503,20 @@ class NativeDocPool:
                 r['d'].astype(bool), np.ones((Tp,), bool), r['si'],
                 e['obj'], e['par'], e['ctr'], e['act'],
                 e['val'].astype(bool), e['lsi'], n_iters,
-                window=self.WINDOW)
+                window=self.WINDOW, mem_idx=mem)
             return reg_out, np.asarray(rank)
         if Tp > 0:
-            reg_out = register_ops.resolve_registers(
-                r['g'], r['t'], r['a'], r['s'],
-                is_del=r['d'].astype(bool),
-                alive_in=np.ones((Tp,), bool), window=self.WINDOW,
-                sort_idx=r['si'], clock_table=r['ctab'],
-                clock_idx=r['cidx'])
+            if mem is not None:
+                reg_out = register_ops.resolve_registers_members(
+                    r['t'], r['a'], r['s'], mem, r['d'].astype(bool),
+                    r['ctab'], r['cidx'], window=self.WINDOW)
+            else:
+                reg_out = register_ops.resolve_registers(
+                    r['g'], r['t'], r['a'], r['s'],
+                    is_del=r['d'].astype(bool),
+                    alive_in=np.ones((Tp,), bool), window=self.WINDOW,
+                    sort_idx=r['si'], clock_table=r['ctab'],
+                    clock_idx=r['cidx'])
             return reg_out, np.zeros((0,), np.int32)
         if Lp > 0:
             rank = np.asarray(list_rank.linearize(
@@ -579,9 +607,7 @@ class NativeDocPool:
 
     # -- dict-level API (test parity with TPUDocPool) -------------------
 
-    @staticmethod
-    def _doc_key(doc_id):
-        return doc_id if isinstance(doc_id, str) else 'i:%d' % doc_id
+    _doc_key = staticmethod(doc_key)
 
     def apply_batch(self, changes_by_doc):
         return _apply_batch_dicts(self, changes_by_doc)
@@ -612,6 +638,17 @@ class NativeDocPool:
     def get_patch(self, doc_id):
         out_len = ctypes.c_int64()
         ptr = lib().amtpu_get_patch(
+            self._pool, self._doc_key(doc_id).encode(),
+            ctypes.byref(out_len))
+        if not ptr:
+            _raise_last()
+        return msgpack.unpackb(_take_buf(ptr, out_len.value), raw=False)
+
+    def get_clock(self, doc_id):
+        """{'clock': ..., 'deps': ...} without materializing the doc --
+        the cheap per-round query replica catch-up gossips."""
+        out_len = ctypes.c_int64()
+        ptr = lib().amtpu_get_clock(
             self._pool, self._doc_key(doc_id).encode(),
             ctypes.byref(out_len))
         if not ptr:
@@ -650,13 +687,21 @@ class NativeDocPool:
 
     def get_changes_for_actor(self, doc_id, actor, after_seq=0):
         """(parity: op_set.js:347-357)"""
+        return msgpack.unpackb(
+            self.get_changes_for_actor_bytes(doc_id, actor, after_seq),
+            raw=False)
+
+    def get_changes_for_actor_bytes(self, doc_id, actor, after_seq=0):
+        """Raw msgpack array of changes -- the zero-decode shipping path
+        replica catch-up uses (change bytes pass sender -> receiver
+        without ever becoming Python objects)."""
         out_len = ctypes.c_int64()
         ptr = lib().amtpu_get_changes_for_actor(
             self._pool, self._doc_key(doc_id).encode(), actor.encode(),
             after_seq, ctypes.byref(out_len))
         if not ptr:
             _raise_last()
-        return msgpack.unpackb(_take_buf(ptr, out_len.value), raw=False)
+        return _take_buf(ptr, out_len.value)
 
 
 class ShardedNativePool:
@@ -807,6 +852,9 @@ class ShardedNativePool:
     def get_patch(self, doc_id):
         return self.pools[self._shard_of(doc_id)].get_patch(doc_id)
 
+    def get_clock(self, doc_id):
+        return self.pools[self._shard_of(doc_id)].get_clock(doc_id)
+
     def get_missing_deps(self, doc_id):
         return self.pools[self._shard_of(doc_id)].get_missing_deps(doc_id)
 
@@ -821,3 +869,7 @@ class ShardedNativePool:
     def get_changes_for_actor(self, doc_id, actor, after_seq=0):
         return self.pools[self._shard_of(doc_id)].get_changes_for_actor(
             doc_id, actor, after_seq)
+
+    def get_changes_for_actor_bytes(self, doc_id, actor, after_seq=0):
+        return self.pools[self._shard_of(doc_id)] \
+            .get_changes_for_actor_bytes(doc_id, actor, after_seq)

@@ -30,6 +30,101 @@ WINDOW = 8
 
 
 @partial(jax.jit, static_argnames=('window',))
+def resolve_registers_members(time, actor, seq, mem_idx, is_del,
+                              clock_table, clock_idx, window=WINDOW):
+    """Member-explicit register resolution -- EXACT for up to `window`
+    concurrent actor streams per key.
+
+    The sliding-window variant (`resolve_registers`) sees the W rows
+    immediately preceding each op, so a key written many times (hot map
+    keys, 8 actors x many rounds) fills the window with DEAD sequential
+    versions and overflows to the host constantly.  Here the host builds
+    `mem_idx[t, w]`: the row index of the w-th candidate predecessor --
+    the LATEST row of each actor stream active on the key before t (an op
+    with an older same-actor successor is always superseded, so only
+    per-actor-latest rows can survive; the true bound is the concurrent
+    antichain width, not the write count).  -1 marks empty slots.
+
+    Supersession among members orders by TIME (later member supersedes a
+    non-concurrent earlier one); winner/conflict order is actor rank
+    descending with ties newest-first, matching the batch tie rule
+    (backend/op_set.py apply_assign).
+
+    Returns the same dict as `resolve_registers`, in original row order;
+    `overflow` is all-False (the host flags >window-stream groups itself
+    and routes them to the oracle fallback before dispatch).
+    """
+    T = time.shape[0]
+    W = window
+    clock = clock_table[clock_idx]
+    A = clock.shape[1]
+
+    valid_m = mem_idx >= 0                                    # [T, W]
+    midx = jnp.clip(mem_idx, 0, T - 1)
+    all_idx = jnp.concatenate(
+        [jnp.arange(T, dtype=jnp.int32)[:, None], midx], axis=1)  # [T, W+1]
+    all_valid = jnp.concatenate(
+        [jnp.ones((T, 1), bool), valid_m], axis=1)
+    m_actor = actor[all_idx]
+    m_seq = seq[all_idx]
+    m_time = time[all_idx]
+    m_del = is_del[all_idx]
+    m_clock = clock[all_idx]                                  # [T, W+1, A]
+
+    onehot = jax.nn.one_hot(m_actor, A, dtype=jnp.int32)
+    P = jnp.einsum('tua,tva->tuv', m_clock, onehot)           # [T,W+1,W+1]
+    u_clock_at_v = P
+    v_clock_at_u = jnp.swapaxes(P, 1, 2)
+    u_seq = m_seq[:, :, None]
+    v_seq = m_seq[:, None, :]
+    concurrent = (u_clock_at_v < v_seq) & (v_clock_at_u < u_seq)
+    later = m_time[:, :, None] > m_time[:, None, :]
+    supersedes = later & ~concurrent \
+        & all_valid[:, :, None] & all_valid[:, None, :]
+
+    superseded = jnp.any(supersedes, axis=1)                  # [T, W+1]
+    alive = all_valid & ~superseded & ~m_del
+
+    superseded_wo_self = jnp.any(supersedes[:, 1:, :], axis=1)
+    alive_before = all_valid & ~superseded_wo_self & ~m_del
+    visible_before = jnp.any(alive_before[:, 1:], axis=1)
+
+    alive_after = jnp.sum(alive, axis=1).astype(jnp.int32)
+
+    # winner/conflicts order: actor desc, ties newest-first.  Composite
+    # int64 keys are unavailable on default-precision TPU, so compose two
+    # stable argsorts: time desc first, then actor desc.
+    t_order = jnp.argsort(-m_time, axis=1, stable=True)
+    actor_t = jnp.take_along_axis(m_actor, t_order, axis=1)
+    alive_t = jnp.take_along_axis(alive, t_order, axis=1)
+    src_t = jnp.take_along_axis(all_idx, t_order, axis=1)
+    actor_keyed = jnp.where(alive_t, actor_t, -1)
+    a_order = jnp.argsort(-actor_keyed, axis=1, stable=True)
+    sorted_alive = jnp.take_along_axis(alive_t, a_order, axis=1)
+    sorted_src = jnp.where(sorted_alive,
+                           jnp.take_along_axis(src_t, a_order, axis=1), -1)
+
+    winner = sorted_src[:, 0]
+    conflicts = sorted_src[:, 1:]
+
+    out = {
+        'alive_after': alive_after,
+        'winner': winner,
+        'conflicts': conflicts,
+        'visible_before': visible_before,
+        'overflow': jnp.zeros((T,), jnp.bool_),
+    }
+    if window > 14:
+        raise ValueError(
+            'packed alive_after field is 4 bits; window=%d overflows it '
+            '(max alive_after is window+1)' % window)
+    out['packed'] = (jnp.where(out['winner'] >= 0, out['winner'],
+                               0xffffff).astype(jnp.int32)
+                     | (out['alive_after'] << 24))
+    return out
+
+
+@partial(jax.jit, static_argnames=('window',))
 def resolve_registers(group, time, actor, seq, clock=None, is_del=None,
                       alive_in=None, window=WINDOW, sort_idx=None,
                       clock_table=None, clock_idx=None):
@@ -181,20 +276,32 @@ def gather_rows(mat, rows):
     return mat[rows]
 
 
+def _resolve(group, time, actor, seq, clock_table, clock_idx, is_del,
+             alive_in, sort_idx, mem_idx, window):
+    """Mode dispatch: member-explicit when the host built mem_idx (groups
+    wider than the sliding window), else the sliding-window kernel."""
+    if mem_idx is not None:
+        return resolve_registers_members(time, actor, seq, mem_idx, is_del,
+                                         clock_table, clock_idx,
+                                         window=window)
+    return resolve_registers(group, time, actor, seq, is_del=is_del,
+                             alive_in=alive_in, window=window,
+                             sort_idx=sort_idx, clock_table=clock_table,
+                             clock_idx=clock_idx)
+
+
 @partial(jax.jit, static_argnames=('window',))
 def resolve_and_rank(group, time, actor, seq, clock_table, clock_idx,
                      is_del, alive_in, sort_idx,
                      eobj, epar, ectr, eact, evalid, lin_sort, n_iters,
-                     window=WINDOW):
+                     window=WINDOW, mem_idx=None):
     """Register resolution + RGA linearization in ONE dispatch: the two
     computations are independent, so fusing them halves the dispatch /
     sync round trips of a batch (the device link has ~70ms latency per
     blocking transfer in this deployment)."""
     from .list_rank import linearize
-    reg = resolve_registers(group, time, actor, seq, is_del=is_del,
-                            alive_in=alive_in, window=window,
-                            sort_idx=sort_idx, clock_table=clock_table,
-                            clock_idx=clock_idx)
+    reg = _resolve(group, time, actor, seq, clock_table, clock_idx, is_del,
+                   alive_in, sort_idx, mem_idx, window)
     rank = linearize(eobj, epar, ectr, eact, evalid, n_iters,
                      sort_idx=lin_sort)
     return reg, rank
@@ -205,7 +312,7 @@ def resolve_rank_dominate(group, time, actor, seq, clock_table, clock_idx,
                           is_del, alive_in, sort_idx,
                           eobj, epar, ectr, eact, evalid, lin_sort, n_iters,
                           v0, er_src, oe, orank_src, dom_src, ov,
-                          window=WINDOW, chunk=64):
+                          window=WINDOW, chunk=64, mem_idx=None):
     """The full resolver in ONE device dispatch: register resolution, RGA
     linearization, AND per-op list dominance indexes.
 
@@ -232,10 +339,8 @@ def resolve_rank_dominate(group, time, actor, seq, clock_table, clock_idx,
     overflow fallback needs it.
     """
     from .list_rank import dominance_grouped, linearize
-    reg = resolve_registers(group, time, actor, seq, is_del=is_del,
-                            alive_in=alive_in, window=window,
-                            sort_idx=sort_idx, clock_table=clock_table,
-                            clock_idx=clock_idx)
+    reg = _resolve(group, time, actor, seq, clock_table, clock_idx, is_del,
+                   alive_in, sort_idx, mem_idx, window)
     rank = linearize(eobj, epar, ectr, eact, evalid, n_iters,
                      sort_idx=lin_sort)
     L = rank.shape[0]

@@ -240,6 +240,12 @@ class TPUDocPool:
             }
         return patches
 
+    def get_clock(self, doc_id):
+        """{'clock': ..., 'deps': ...} without materializing the doc --
+        the cheap per-round query replica catch-up gossips."""
+        state = self.doc(doc_id)
+        return {'clock': dict(state.clock), 'deps': dict(state.deps)}
+
     def get_missing_deps(self, doc_id):
         """(parity: op_set.js:359-370)"""
         state = self.doc(doc_id)
@@ -921,7 +927,8 @@ class TPUDocPool:
 
         remaining = [o for o in priors if concurrent(o, op)]
         if op['action'] != 'del':
-            remaining.append(op)
+            # newest-first tie rule -- see backend/op_set.py apply_assign
+            remaining.insert(0, op)
         remaining.sort(key=lambda o: o['actor'], reverse=True)
         return remaining
 

@@ -43,6 +43,22 @@ def replica_deficits(clocks):
 
 
 @jax.jit
+def batched_plan(mats):
+    """One planning dispatch for a whole DocSet: `mats` is [D, R, A]
+    (docs x replicas x actors).  Returns
+      frontier    [D, A]   -- clock union per doc
+      deficit     [D, R, A] -- what each replica still needs
+      at_frontier [D, R, A] -- replicas able to ship each stream
+    i.e. the vmapped composition of `replica_deficits` + `want_matrix`
+    against the frontier holder, costing one device round trip per gossip
+    round instead of one per doc."""
+    frontier = jnp.max(mats, axis=1)
+    deficit = frontier[:, None, :] - mats
+    at_frontier = mats >= frontier[:, None, :]
+    return frontier, deficit, at_frontier
+
+
+@jax.jit
 def want_matrix(clocks, have_clock):
     """Which (replica, actor) streams need shipping from a holder with
     `have_clock` [A]: True where the holder knows changes the replica lacks.

@@ -1,0 +1,322 @@
+"""Batched replica catch-up -- the reference's Connection protocol
+(`/root/reference/src/connection.js:58-73`: clock gossip, then ship every
+change the peer's clock doesn't cover) executed at POOL granularity: all
+documents of every replica pair exchange in one planned round, and shipped
+changes apply as one batch per receiver.
+
+Planning runs on the device clock kernels (`parallel/replica.py`): replica
+clocks densify to an [R, A] matrix per doc, `replica_deficits` computes the
+knowledge frontier (the pmax the reference reaches by pairwise
+advertisement rounds) and `want_matrix` selects which (receiver, actor)
+streams each holder must ship.  Shipping itself moves raw change bytes
+between pools host-side; duplicate deliveries are no-ops (seq dedup,
+reference op_set.js:255-260) and causal gaps buffer in the receiver's
+queue, so dropped messages simply heal on a later round -- the same
+fault model the reference's connection tests script
+(`/root/reference/test/connection_test.js:17-66`).
+"""
+
+import numpy as np
+
+from ..parallel.replica import batched_plan
+from ..utils.common import ROOT_ID
+from ..utils.common import doc_key as _doc_key
+
+
+class BatchedReplicaSet:
+    """N pool-backed replicas with planned all-pairs catch-up.
+
+    `pool_factory` builds one backend pool per replica (NativeDocPool by
+    default).  `drop` is an optional fault-injection hook
+    ``drop(sender, receiver, doc_id) -> bool``; returning True drops that
+    shipment for the round (it retries on the next round).
+    """
+
+    def __init__(self, n_replicas, pool_factory=None, drop=None):
+        if pool_factory is None:
+            from ..native import NativeDocPool
+            pool_factory = NativeDocPool
+        self.replicas = [pool_factory() for _ in range(n_replicas)]
+        self.doc_ids = []
+        self._doc_set = set()
+        self._drop = drop
+
+    # -- local ingestion ------------------------------------------------
+
+    def _note_doc(self, doc_id):
+        if doc_id not in self._doc_set:
+            self._doc_set.add(doc_id)
+            self.doc_ids.append(doc_id)
+
+    def apply_changes(self, replica, doc_id, changes):
+        """Applies local/incoming changes at one replica."""
+        self._note_doc(doc_id)
+        return self.replicas[replica].apply_changes(doc_id, changes)
+
+    def apply_batch(self, replica, changes_by_doc):
+        for doc_id in changes_by_doc:
+            self._note_doc(doc_id)
+        return self.replicas[replica].apply_batch(changes_by_doc)
+
+    # -- planned catch-up ----------------------------------------------
+
+    def _clock_matrix(self, doc_id):
+        """Dense [R, A] clock matrix + the actor table for one doc."""
+        clocks = [r.get_clock(doc_id)['clock'] for r in self.replicas]
+        actors = sorted({a for c in clocks for a in c})
+        idx = {a: i for i, a in enumerate(actors)}
+        mat = np.zeros((len(self.replicas), max(len(actors), 1)), np.int32)
+        for r, c in enumerate(clocks):
+            for a, s in c.items():
+                mat[r, idx[a]] = s
+        return mat, actors, clocks
+
+    def plan_all(self):
+        """All docs' shipping lists from ONE device planning dispatch:
+        {doc_id: [(sender, receiver, actor, after_seq)]}.  Docs are padded
+        to a common actor width so the whole DocSet plans as one [D, R, A]
+        kernel call."""
+        if not self.doc_ids:
+            return {}
+        per_doc = [self._clock_matrix(d) for d in self.doc_ids]
+        # bucket the actor/doc axes to powers of two: the kernel shape keys
+        # the jit compile cache, and actor counts grow as gossip spreads
+        A = 1
+        while A < max(max(m.shape[1] for m, _, _ in per_doc), 1):
+            A *= 2
+        D = 1
+        while D < len(per_doc):
+            D *= 2
+        R = len(self.replicas)
+        mats = np.zeros((D, R, A), np.int32)
+        for i, (m, _, _) in enumerate(per_doc):
+            mats[i, :, :m.shape[1]] = m
+        frontier, deficit, at_frontier = (np.asarray(x)
+                                          for x in batched_plan(mats))
+        plans = {}   # padded doc rows beyond len(doc_ids) stay unplanned
+        for i, doc_id in enumerate(self.doc_ids):
+            if not deficit[i].any():
+                continue
+            holder = np.argmax(at_frontier[i], axis=0)
+            mat, actors, _ = per_doc[i]
+            ships = []
+            recvs, acts = np.nonzero(deficit[i] > 0)
+            for r, a in zip(recvs.tolist(), acts.tolist()):
+                if a >= len(actors):
+                    continue
+                ships.append((int(holder[a]), int(r), actors[a],
+                              int(mat[r, a])))
+            if ships:
+                plans[doc_id] = ships
+        return plans
+
+    def catch_up(self, max_rounds=None):
+        """Runs gossip rounds until every replica's clock matches the
+        frontier on every doc.  Returns per-round shipped-change counts."""
+        if max_rounds is None:
+            # every round strictly advances the frontier of lagging
+            # replicas unless messages drop; R rounds always suffice for a
+            # connected exchange, plus slack for injected drops
+            max_rounds = 4 * len(self.replicas) + 8
+        rounds = []
+        for _ in range(max_rounds):
+            shipped = self._one_round()
+            rounds.append(shipped)
+            if shipped == 0:
+                return rounds
+        raise RuntimeError(
+            'replica catch-up did not converge in %d rounds' % max_rounds)
+
+    def _one_round(self):
+        # one planning dispatch for all docs, then deliver per receiver as
+        # ONE batch across all docs and senders (the pools resolve a batch
+        # in one pass).  When every replica speaks the bytes wire path,
+        # shipped changes move as raw msgpack spans -- sender to receiver
+        # without ever becoming Python objects.
+        use_bytes = all(
+            hasattr(p, 'get_changes_for_actor_bytes') and
+            hasattr(p, 'apply_batch_bytes') for p in self.replicas)
+        if use_bytes:
+            return self._one_round_bytes()
+        shipped = 0
+        inbox = {}   # receiver -> {doc_id: [changes]}
+        for doc_id, ships in self.plan_all().items():
+            for s, r, actor, after_seq in ships:
+                if self._drop is not None and self._drop(s, r, doc_id):
+                    continue
+                changes = self.replicas[s].get_changes_for_actor(
+                    doc_id, actor, after_seq)
+                if not changes:
+                    continue
+                shipped += len(changes)
+                inbox.setdefault(r, {}).setdefault(doc_id, []).extend(
+                    changes)
+        for r, by_doc in inbox.items():
+            self.replicas[r].apply_batch(by_doc)
+        return shipped
+
+    def _one_round_bytes(self):
+        import msgpack
+
+        shipped = 0
+        inbox = {}   # receiver -> {doc_id: [(count, body_view)]}
+        for doc_id, ships in self.plan_all().items():
+            for s, r, actor, after_seq in ships:
+                if self._drop is not None and self._drop(s, r, doc_id):
+                    continue
+                buf = self.replicas[s].get_changes_for_actor_bytes(
+                    doc_id, actor, after_seq)
+                n, off = _read_array_header(buf)
+                if n == 0:
+                    continue
+                shipped += n
+                inbox.setdefault(r, {}).setdefault(doc_id, []).append(
+                    (n, memoryview(buf)[off:]))
+        # assemble one {doc: [change...]} payload per receiver by splicing
+        # the raw shipped arrays (count headers summed, bodies concatenated)
+        deliveries = []
+        for r, by_doc in inbox.items():
+            parts = [_map_header(len(by_doc))]
+            for doc_id, arrays in by_doc.items():
+                parts.append(msgpack.packb(_doc_key(doc_id),
+                                           use_bin_type=True))
+                parts.append(_array_header(sum(n for n, _ in arrays)))
+                parts.extend(body for _, body in arrays)
+            deliveries.append((self.replicas[r], b''.join(parts)))
+
+        # pipelined delivery: replicas are independent pools, so replica
+        # k's device work overlaps replica k+1's host begin (the same
+        # async-dispatch overlap ShardedNativePool uses across shards)
+        if deliveries and all(hasattr(p, '_phase_a') and
+                              hasattr(p, '_phase_b')
+                              for p, _ in deliveries):
+            from ..native import lib
+            ctxs = []
+            errors = []
+            for pool, payload in deliveries:
+                try:
+                    ctxs.append((pool, pool._phase_a(payload)))
+                except Exception as e:   # collected; healthy pools finish
+                    errors.append(e)
+            for pool, ctx in ctxs:
+                try:
+                    pool._phase_b(ctx)
+                except Exception as e:
+                    errors.append(e)
+                finally:
+                    lib().amtpu_batch_free(ctx['bh'])
+            if errors:
+                raise errors[0]
+        else:
+            for pool, payload in deliveries:
+                pool.apply_batch_bytes(payload)
+        return shipped
+
+    # -- verification ---------------------------------------------------
+
+    def converged(self):
+        """True when all replicas report identical clocks on every doc."""
+        for doc_id in self.doc_ids:
+            clocks = [r.get_clock(doc_id)['clock'] for r in self.replicas]
+            if any(c != clocks[0] for c in clocks[1:]):
+                return False
+        return True
+
+    def assert_identical(self, doc_id):
+        """All replicas hold the same document STATE.  Whole-doc patches
+        list map fields in per-replica key insertion order (exactly like
+        the reference's Immutable.js iteration order), so convergence
+        compares materialized trees + clocks, not diff arrays; list
+        element order IS part of the state.  Returns replica 0's patch."""
+        patches = [r.get_patch(doc_id) for r in self.replicas]
+        t0 = patch_to_tree(patches[0])
+        for i, p in enumerate(patches[1:], 1):
+            if p['clock'] != patches[0]['clock'] or patch_to_tree(p) != t0:
+                raise AssertionError(
+                    'replica %d diverged on %r' % (i, doc_id))
+        return patches[0]
+
+
+def _read_array_header(buf):
+    """(n_elements, header_len) of a msgpack array."""
+    b = buf[0]
+    if (b & 0xf0) == 0x90:
+        return b & 0x0f, 1
+    if b == 0xdc:
+        return int.from_bytes(buf[1:3], 'big'), 3
+    if b == 0xdd:
+        return int.from_bytes(buf[1:5], 'big'), 5
+    raise ValueError('expected msgpack array, got 0x%02x' % b)
+
+
+def _array_header(n):
+    if n <= 15:
+        return bytes([0x90 | n])
+    if n <= 0xffff:
+        return b'\xdc' + n.to_bytes(2, 'big')
+    return b'\xdd' + n.to_bytes(4, 'big')
+
+
+def _map_header(n):
+    if n <= 15:
+        return bytes([0x80 | n])
+    if n <= 0xffff:
+        return b'\xde' + n.to_bytes(2, 'big')
+    return b'\xdf' + n.to_bytes(4, 'big')
+
+
+def patch_to_tree(patch):
+    """Materializes a whole-doc patch into a nested comparable tree
+    (maps -> dict, lists/text -> list, conflicts attached per slot).
+    Two replicas are convergent iff their trees and clocks match."""
+    objs = {ROOT_ID: {}}
+    types = {ROOT_ID: 'map'}
+
+    def slot(d):
+        v = ('link', d['value']) if d.get('link') else ('val', d.get('value'),
+                                                        d.get('datatype'))
+        conflicts = tuple(
+            (c.get('actor'),
+             ('link', c['value']) if c.get('link') else ('val',
+                                                         c.get('value')))
+            for c in d.get('conflicts', ()))
+        return (v, conflicts)
+
+    for d in patch['diffs']:
+        obj = d['obj']
+        action = d['action']
+        if action == 'create':
+            objs[obj] = [] if d['type'] in ('list', 'text') else {}
+            types[obj] = d['type']
+        elif action == 'set':
+            objs.setdefault(obj, {})[d['key']] = slot(d)
+        elif action == 'insert':
+            objs.setdefault(obj, []).insert(d['index'], slot(d))
+        elif action == 'remove':
+            if 'index' in d:
+                objs[obj].pop(d['index'])
+            else:
+                objs[obj].pop(d['key'], None)
+
+    def resolve(ref, seen):
+        kind = ref[0]
+        if kind == 'val':
+            return ref
+        target = ref[1]
+        if target in seen:
+            return ('cycle', target)
+        return ('obj', types.get(target),
+                resolve_obj(target, seen | {target}))
+
+    def resolve_obj(obj, seen):
+        v = objs.get(obj)
+        if isinstance(v, dict):
+            return tuple(sorted(
+                (k, resolve(s[0], seen),
+                 tuple((a, resolve(rv, seen)) for a, rv in s[1]))
+                for k, s in v.items()))
+        return tuple((resolve(s[0], seen),
+                      tuple((a, resolve(rv, seen)) for a, rv in s[1]))
+                     for s in v)
+
+    return resolve_obj(ROOT_ID, {ROOT_ID})

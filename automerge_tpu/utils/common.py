@@ -15,3 +15,10 @@ def less_or_equal(clock1, clock2):
         if clock1.get(key, 0) > clock2.get(key, 0):
             return False
     return True
+
+
+def doc_key(doc_id):
+    """Canonical wire key for a doc id (int ids map to 'i:<n>') -- the ONE
+    definition shared by the pools, the payload splitter mirror, and the
+    replica shipping path."""
+    return doc_id if isinstance(doc_id, str) else 'i:%d' % doc_id

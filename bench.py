@@ -1,32 +1,38 @@
 """Benchmark driver -- batched TPU backend vs single-thread scalar backend.
 
-Headline config (BASELINE.json config 3, scaled by env): N Text docs, K
-actors each, interleaved insert/delete ops, delivered as ONE causal
-catch-up batch -- the "1M queued ops across 10k docs" north-star shape.
+Covers all five BASELINE.json configs (select with --config N or
+AMTPU_BENCH_CONFIG; default 3, the headline shape):
 
-Methodology:
-  * workload: per doc, actor a0 creates a Text object, then every actor
-    appends/deletes characters over R rounds; all changes are queued and
-    delivered as ONE msgpack payload to `NativeDocPool.apply_batch_bytes`
-    -- the C++ host runtime + JAX device kernels, bytes in / patch bytes
-    out, i.e. the split-deployment wire path the reference's
-    frontend/backend protocol boundary ships.
-  * baseline: the same changes through `automerge_tpu.backend` -- the
+  1  single Text doc, 2 actors, sequential char inserts
+  2  many Map docs, 8 concurrent actors, random key set ops
+  3  many Text docs, concurrent actors, interleaved insert/delete (RGA
+     stress) delivered as ONE causal catch-up batch -- the "1M queued ops
+     across 10k docs" north-star shape
+  4  Table docs: concurrent row add/update with nested Map row values
+  5  Connection/DocSet sync: 64 replicas, 100k-op backlog, full causal
+     catch-up (BatchedReplicaSet: device-planned gossip, bytes shipping)
+
+Methodology (all configs):
+  * baseline: the same workload through `automerge_tpu.backend` -- the
     single-threaded host backend whose semantics mirror the reference's
     Node.js backend (`/root/reference/backend/op_set.js`).  Node itself is
     not installed in this image, so this scalar path is the measured
     denominator; it is byte-compatible with the reference (see
-    tests/test_backend.py golden cases).  Measured on a sampled doc subset,
-    reported as per-op rate.
-  * parity: native patches must equal oracle patches on the sampled docs.
-  * warmup: the workload runs twice on throwaway pools -- the first pass
-    pays jit compiles, the second settles dispatch/transfer paths -- so the
-    timed run measures steady state; warmup seconds go to stderr.
+    tests/test_backend.py golden cases).  Measured on a sampled doc
+    subset, reported as per-op rate.
+  * parity: native patches must equal oracle patches on >= 10% of docs
+    (workloads apply changes in identical order, so patches are
+    byte-identical, not just tree-equal).
+  * warmup: the workload runs twice on throwaway pools (first pass pays
+    jit compiles, second settles dispatch/transfer paths); timed result
+    is the median of 3 fresh-pool runs (the tunneled device link jitters
+    +-40% between windows).
 
 Prints ONE json line to stdout:
   {"metric": ..., "value": ..., "unit": "ops/sec", "vs_baseline": ...}
 """
 
+import argparse
 import json
 import os
 import random
@@ -46,14 +52,17 @@ N_DOCS = env_int('AMTPU_BENCH_DOCS', 4096)
 N_ACTORS = env_int('AMTPU_BENCH_ACTORS', 8)
 N_ROUNDS = env_int('AMTPU_BENCH_ROUNDS', 2)
 OPS_PER_CHANGE = env_int('AMTPU_BENCH_OPS_PER_CHANGE', 16)
-ORACLE_DOCS = env_int('AMTPU_BENCH_ORACLE_DOCS', 48)
+ORACLE_DOCS = env_int('AMTPU_BENCH_ORACLE_DOCS', 0)   # 0 = 10% of docs
 SEED = env_int('AMTPU_BENCH_SEED', 7)
 N_SHARDS = env_int('AMTPU_BENCH_SHARDS', 10)
 
 
-def make_doc_changes(doc, rng):
-    """One doc's queued change history: create a Text object, then
-    interleaved insert/delete rounds from N_ACTORS concurrent actors."""
+# ---------------------------------------------------------------------------
+# workload builders: {doc: [change...]} per config
+# ---------------------------------------------------------------------------
+
+def _text_doc_changes(doc, rng, n_actors, n_rounds, ops_per_change):
+    """Interleaved concurrent Text insert/delete (config 3 shape)."""
     tid = 'text-%d' % doc
     changes = [{'actor': 'a0', 'seq': 1, 'deps': {}, 'ops': [
         {'action': 'makeText', 'obj': tid},
@@ -62,44 +71,179 @@ def make_doc_changes(doc, rng):
         {'action': 'link', 'obj': ROOT_ID, 'key': 'text', 'value': tid}]}]
     max_elem = 1
     last = {}
-    for r in range(1, N_ROUNDS + 1):
-        for a in range(N_ACTORS):
+    for r in range(1, n_rounds + 1):
+        for a in range(n_actors):
             actor = 'a%d' % a
             seq = r + 1 if a == 0 else r
             ops = []
-            for _ in range(OPS_PER_CHANGE // 2):
+            for _ in range(ops_per_change // 2):
                 max_elem += 1
                 elem = max_elem
                 prev = last.get(a) or 'a0:1'
                 ops.append({'action': 'ins', 'obj': tid, 'key': prev,
                             'elem': elem})
                 if rng.random() < 0.15 and a in last:
-                    ops.append({'action': 'del', 'obj': tid, 'key': last[a]})
+                    ops.append({'action': 'del', 'obj': tid,
+                                'key': last[a]})
                 else:
                     ops.append({'action': 'set', 'obj': tid,
                                 'key': '%s:%d' % (actor, elem),
                                 'value': chr(97 + elem % 26)})
                 last[a] = '%s:%d' % (actor, elem)
-            changes.append({'actor': actor, 'seq': seq, 'deps': {'a0': 1},
-                            'ops': ops})
+            changes.append({'actor': actor, 'seq': seq,
+                            'deps': {'a0': 1}, 'ops': ops})
     return changes
 
 
-def main():
+def build_config_1(rng):
+    """Single Text doc, 2 actors, sequential char inserts."""
+    chars = env_int('AMTPU_BENCH_C1_CHARS', 10000)
+    per_change = 50
+    tid = 'text-0'
+    changes = [{'actor': 'a0', 'seq': 1, 'deps': {}, 'ops': [
+        {'action': 'makeText', 'obj': tid},
+        {'action': 'link', 'obj': ROOT_ID, 'key': 'text', 'value': tid}]}]
+    seqs = {'a0': 1, 'a1': 0}
+    prev = '_head'
+    elem = 0
+    for start in range(0, chars, per_change):
+        actor = 'a%d' % ((start // per_change) % 2)
+        ops = []
+        for _ in range(min(per_change, chars - start)):
+            elem += 1
+            ops.append({'action': 'ins', 'obj': tid, 'key': prev,
+                        'elem': elem})
+            ops.append({'action': 'set', 'obj': tid,
+                        'key': '%s:%d' % (actor, elem),
+                        'value': chr(97 + elem % 26)})
+            prev = '%s:%d' % (actor, elem)
+        seqs[actor] += 1
+        deps = {a: s for a, s in seqs.items() if a != actor and s}
+        changes.append({'actor': actor, 'seq': seqs[actor], 'deps': deps,
+                        'ops': ops})
+    return {0: changes}, 'text_single_doc_ops_per_sec'
+
+
+def build_config_2(rng):
+    """Map docs, 8 concurrent actors, random key set ops (this Automerge
+    version has no Counter CRDT; "inc" models as read-modify-write set,
+    see BASELINE.md)."""
+    docs = env_int('AMTPU_BENCH_C2_DOCS', 1024)
+    rounds = env_int('AMTPU_BENCH_C2_ROUNDS', 8)
+    batch = {}
+    for d in range(docs):
+        changes = []
+        for r in range(1, rounds + 1):
+            for a in range(N_ACTORS):
+                actor = 'a%d' % a
+                ops = []
+                # distinct keys per change: the reference frontend dedupes
+                # assignments per (obj, key) within one change
+                # (ensureSingleAssignment, frontend/index.js:53), so real
+                # change streams never assign a key twice
+                for key_n in rng.sample(range(max(32, OPS_PER_CHANGE)),
+                                         OPS_PER_CHANGE):
+                    key = 'k%d' % key_n
+                    if rng.random() < 0.1:
+                        ops.append({'action': 'del', 'obj': ROOT_ID,
+                                    'key': key})
+                    elif rng.random() < 0.1:
+                        ops.append({'action': 'set', 'obj': ROOT_ID,
+                                    'key': key, 'value': r * 1000 + a,
+                                    'datatype': 'timestamp'})
+                    else:
+                        ops.append({'action': 'set', 'obj': ROOT_ID,
+                                    'key': key, 'value': r * 1000 + a})
+                changes.append({'actor': actor, 'seq': r, 'deps': {},
+                                'ops': ops})
+        batch[d] = changes
+    return batch, 'map_concurrent_ops_per_sec'
+
+
+def build_config_3(rng):
+    batch = {d: _text_doc_changes(d, rng, N_ACTORS, N_ROUNDS,
+                                  OPS_PER_CHANGE)
+             for d in range(N_DOCS)}
+    return batch, 'text_catchup_ops_per_sec'
+
+
+def build_config_4(rng):
+    """Table docs: concurrent row add/update, nested Map row values
+    (reference Table semantics: frontend/table.js:26-196; a row add is
+    makeMap + field sets + link into the table keyed by row id)."""
+    docs = env_int('AMTPU_BENCH_C4_DOCS', 1024)
+    rows_per_actor = env_int('AMTPU_BENCH_C4_ROWS', 16)
+    batch = {}
+    for d in range(docs):
+        table = 'table-%d' % d
+        changes = [{'actor': 'a0', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'makeTable', 'obj': table},
+            {'action': 'link', 'obj': ROOT_ID, 'key': 'rows',
+             'value': table}]}]
+        row_ids = []
+        for a in range(N_ACTORS):
+            actor = 'a%d' % a
+            seq = 2 if a == 0 else 1
+            ops = []
+            for i in range(rows_per_actor):
+                row = 'row-%d-%d-%d' % (d, a, i)
+                ops.extend([
+                    {'action': 'makeMap', 'obj': row},
+                    {'action': 'set', 'obj': row, 'key': 'name',
+                     'value': 'r%d' % i},
+                    {'action': 'set', 'obj': row, 'key': 'n',
+                     'value': i * a},
+                    {'action': 'link', 'obj': table, 'key': row,
+                     'value': row}])
+                row_ids.append(row)
+            changes.append({'actor': actor, 'seq': seq,
+                            'deps': {'a0': 1}, 'ops': ops})
+        # concurrent updates of random existing rows
+        for a in range(N_ACTORS):
+            actor = 'a%d' % a
+            seq = 3 if a == 0 else 2
+            ops = []
+            for _ in range(rows_per_actor):
+                row = row_ids[rng.randrange(len(row_ids))]
+                ops.append({'action': 'set', 'obj': row, 'key': 'n',
+                            'value': rng.randrange(1000)})
+            changes.append({'actor': actor, 'seq': seq,
+                            'deps': {'a%d' % b: (2 if b == 0 else 1)
+                                     for b in range(N_ACTORS)},
+                            'ops': ops})
+        batch[d] = changes
+    return batch, 'table_rows_ops_per_sec'
+
+
+# ---------------------------------------------------------------------------
+# drivers
+# ---------------------------------------------------------------------------
+
+def run_batch_config(build, rng):
+    """Shared driver for configs 1-4: one causal catch-up batch."""
     import msgpack
 
     from automerge_tpu import backend as Backend
+    from automerge_tpu import trace
     from automerge_tpu.native import NativeDocPool, ShardedNativePool
 
-    rng = random.Random(SEED)
-    batch = {d: make_doc_changes(d, rng) for d in range(N_DOCS)}
+    batch, metric = build(rng)
+    doc_ids = list(batch)
     total_ops = sum(len(c['ops']) for chs in batch.values() for c in chs)
-    per_doc_ops = total_ops // N_DOCS
-    print('workload: %d docs x %d ops = %d total ops'
-          % (N_DOCS, per_doc_ops, total_ops), file=sys.stderr)
+    per_doc_ops = {d: sum(len(c['ops']) for c in batch[d])
+                   for d in doc_ids}
+    print('workload: %d docs, %d total ops'
+          % (len(doc_ids), total_ops), file=sys.stderr)
 
-    # ---- baseline: single-thread scalar backend on a doc subset ----------
-    oracle_docs = list(range(min(ORACLE_DOCS, N_DOCS)))
+    n_shards = min(N_SHARDS, len(doc_ids))
+
+    def make_pool():
+        return (ShardedNativePool(n_shards) if n_shards > 1
+                else NativeDocPool())
+
+    # ---- baseline: single-thread scalar backend on a >=10% subset -------
+    n_oracle = ORACLE_DOCS or max(1, len(doc_ids) // 10)
+    oracle_docs = doc_ids[:min(n_oracle, len(doc_ids))]
     oracle_states = {}
     t0 = time.perf_counter()
     for d in oracle_docs:
@@ -107,7 +251,7 @@ def main():
         state, _patch = Backend.apply_changes(state, batch[d])
         oracle_states[d] = state
     oracle_s = time.perf_counter() - t0
-    oracle_ops = per_doc_ops * len(oracle_docs)
+    oracle_ops = sum(per_doc_ops[d] for d in oracle_docs)
     oracle_rate = oracle_ops / oracle_s
     print('baseline (scalar backend, %d docs): %.2fs -> %.0f ops/sec'
           % (len(oracle_docs), oracle_s, oracle_rate), file=sys.stderr)
@@ -116,29 +260,23 @@ def main():
     keyed = {NativeDocPool._doc_key(d): chs for d, chs in batch.items()}
     payload = msgpack.packb(keyed, use_bin_type=True)
 
-    # ---- warmup: compile cache + transport steady state ------------------
-    # two passes: the first pays jit compiles, the second settles dispatch
-    # and transfer paths; the timed run then measures steady state
+    # ---- warmup ----------------------------------------------------------
     t0 = time.perf_counter()
-    ShardedNativePool(N_SHARDS).apply_batch_bytes(payload)
+    make_pool().apply_batch_bytes(payload)
     warm_s = time.perf_counter() - t0
     t0 = time.perf_counter()
-    ShardedNativePool(N_SHARDS).apply_batch_bytes(payload)
+    make_pool().apply_batch_bytes(payload)
     warm2_s = time.perf_counter() - t0
     print('warmup (incl. jit compile): %.2fs + %.2fs'
           % (warm_s, warm2_s), file=sys.stderr)
 
-    # ---- timed runs: C++ host runtime + device kernels, bytes in/out -----
-    # median of 3 fresh-pool runs (the device link is shared; single runs
-    # jitter +-30%)
+    # ---- timed runs ------------------------------------------------------
     import gc
-
-    from automerge_tpu import trace
     times = []
     pool = None
     for run in range(3):
         trace.reset()
-        pool = ShardedNativePool(N_SHARDS)
+        pool = make_pool()
         t0 = time.perf_counter()
         pool.apply_batch_bytes(payload)
         times.append(time.perf_counter() - t0)
@@ -155,21 +293,123 @@ def main():
         got = pool.get_patch(d)
         want = Backend.get_patch(oracle_states[d])
         if got != want:
-            print('PARITY FAILURE on doc %d' % d, file=sys.stderr)
-            print(json.dumps({'metric': 'text_catchup_ops_per_sec',
-                              'value': 0.0, 'unit': 'ops/sec',
-                              'vs_baseline': 0.0, 'parity': False}))
-            return 1
+            print('PARITY FAILURE on doc %r' % (d,), file=sys.stderr)
+            return {'metric': metric, 'value': 0.0, 'unit': 'ops/sec',
+                    'vs_baseline': 0.0, 'parity': False}
     print('parity: ok (%d docs byte-identical)' % len(oracle_docs),
           file=sys.stderr)
+    return {'metric': metric, 'value': round(tpu_rate, 1),
+            'unit': 'ops/sec', 'vs_baseline': round(tpu_rate / oracle_rate,
+                                                    3)}
 
-    print(json.dumps({
-        'metric': 'text_catchup_ops_per_sec',
-        'value': round(tpu_rate, 1),
-        'unit': 'ops/sec',
-        'vs_baseline': round(tpu_rate / oracle_rate, 3),
-    }))
-    return 0
+
+def run_config_5(rng):
+    """64 replicas, ~100k-op backlog, full causal catch-up.  The measured
+    rate counts op-APPLICATIONS (every replica ingests every foreign op --
+    the work a full catch-up performs, identical to what the reference's
+    64 pairwise Connections would do)."""
+    from automerge_tpu import backend as Backend
+    from automerge_tpu.native import NativeDocPool
+    from automerge_tpu.sync.replica_set import BatchedReplicaSet, \
+        patch_to_tree
+
+    n_replicas = env_int('AMTPU_BENCH_C5_REPLICAS', 64)
+    n_docs = env_int('AMTPU_BENCH_C5_DOCS', 8)
+    n_changes = env_int('AMTPU_BENCH_C5_CHANGES', 13)
+    ops_per_change = env_int('AMTPU_BENCH_C5_OPS', 15)
+
+    # backlog: each replica authors one actor's stream per doc
+    by_replica = [dict() for _ in range(n_replicas)]
+    union = {d: [] for d in range(n_docs)}
+    for d in range(n_docs):
+        for r in range(n_replicas):
+            actor = 'a%03d' % r
+            for seq in range(1, n_changes + 1):
+                ops = [{'action': 'set', 'obj': ROOT_ID,
+                        'key': 'k%d' % rng.randrange(64),
+                        'value': '%s-%d-%d' % (actor, seq, i)}
+                       for i in range(ops_per_change)]
+                ch = {'actor': actor, 'seq': seq, 'deps': {}, 'ops': ops}
+                by_replica[r].setdefault(d, []).append(ch)
+                union[d].append(ch)
+    backlog_ops = sum(len(c['ops']) for chs in union.values()
+                      for c in chs)
+    # full catch-up applies every foreign op at every replica
+    total_applications = backlog_ops * (n_replicas - 1)
+    print('workload: %d replicas x %d docs, backlog %d ops -> %d '
+          'op-applications' % (n_replicas, n_docs, backlog_ops,
+                               total_applications), file=sys.stderr)
+
+    # ---- baseline: scalar backend ingesting one doc's union --------------
+    t0 = time.perf_counter()
+    state = Backend.init()
+    state, _ = Backend.apply_changes(state, union[0])
+    oracle_s = time.perf_counter() - t0
+    oracle_rate = len(union[0]) * ops_per_change / oracle_s
+    print('baseline (scalar, 1-doc union): %.2fs -> %.0f ops/sec'
+          % (oracle_s, oracle_rate), file=sys.stderr)
+
+    def load_set():
+        rs = BatchedReplicaSet(n_replicas, pool_factory=NativeDocPool)
+        for r, by_doc in enumerate(by_replica):
+            rs.apply_batch(r, by_doc)
+        return rs
+
+    # warmup (jit compiles for plan + apply kernels)
+    t0 = time.perf_counter()
+    load_set().catch_up()
+    print('warmup: %.2fs' % (time.perf_counter() - t0), file=sys.stderr)
+
+    times = []
+    rs = None
+    for _ in range(3):
+        rs = load_set()
+        t0 = time.perf_counter()
+        rounds = rs.catch_up()
+        times.append(time.perf_counter() - t0)
+    sync_s = sorted(times)[1]
+    rate = total_applications / sync_s
+    print('catch-up runs: %s (rounds: %s) -> median %.0f ops/sec'
+          % (['%.2fs' % t for t in times], rounds, rate), file=sys.stderr)
+
+    # ---- parity: every replica's tree equals the oracle union ------------
+    if not rs.converged():
+        return {'metric': 'replica_catchup_ops_per_sec', 'value': 0.0,
+                'unit': 'ops/sec', 'vs_baseline': 0.0, 'parity': False}
+    for d in range(n_docs):
+        patch = rs.assert_identical(d)
+        st = Backend.init()
+        st, _ = Backend.apply_changes(st, union[d])
+        want = Backend.get_patch(st)
+        if patch['clock'] != want['clock'] or \
+                patch_to_tree(patch) != patch_to_tree(want):
+            print('PARITY FAILURE on doc %d' % d, file=sys.stderr)
+            return {'metric': 'replica_catchup_ops_per_sec', 'value': 0.0,
+                    'unit': 'ops/sec', 'vs_baseline': 0.0, 'parity': False}
+    print('parity: ok (%d docs, %d replicas convergent + oracle-equal)'
+          % (n_docs, n_replicas), file=sys.stderr)
+    return {'metric': 'replica_catchup_ops_per_sec',
+            'value': round(rate, 1), 'unit': 'ops/sec',
+            'vs_baseline': round(rate / oracle_rate, 3)}
+
+
+BUILDERS = {1: build_config_1, 2: build_config_2, 3: build_config_3,
+            4: build_config_4}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument('--config', type=int,
+                    default=env_int('AMTPU_BENCH_CONFIG', 3),
+                    choices=[1, 2, 3, 4, 5])
+    args = ap.parse_args(argv)
+    rng = random.Random(SEED)
+    if args.config == 5:
+        result = run_config_5(rng)
+    else:
+        result = run_batch_config(BUILDERS[args.config], rng)
+    print(json.dumps(result))
+    return 0 if result.get('vs_baseline') else 1
 
 
 if __name__ == '__main__':

@@ -731,6 +731,13 @@ struct Batch {
   // overflow fallback
   std::unordered_map<i64, Register> host_registers;  // op_idx -> register
 
+  // member-window mode (groups wider than the sliding window): per-row
+  // candidate predecessor indexes + host-computed overflow flags
+  bool use_members = false;
+  bool any_ovf = false;
+  std::vector<i32> mem_idx;    // [Tp * WINDOW]
+  std::vector<u8> host_ovf;    // [Tp]
+
   // per-op arena index resolved by prepass in application order:
   // -2 = not a list assign, -1 = dropped del on an absent element
   std::vector<i32> pre_eidx;
@@ -1254,10 +1261,75 @@ static void encode(Pool& pool, Batch& b) {
     const i64 n_groups = static_cast<i64>(gid_order.size());
     std::vector<i32> bucket_pos(n_groups + 2, 0);
     for (i64 i = 0; i < b.Tp; ++i) bucket_pos[b.g_col[i] + 2]++;
+    i32 max_count = 0;
+    for (i64 g = 2; g < n_groups + 2; ++g)
+      if (bucket_pos[g] > max_count) max_count = bucket_pos[g];
     for (i64 g = 1; g < n_groups + 2; ++g) bucket_pos[g] += bucket_pos[g - 1];
     b.sort_idx.resize(b.Tp);
     for (i64 i = 0; i < b.Tp; ++i)
       b.sort_idx[bucket_pos[b.g_col[i] + 1]++] = static_cast<i32>(i);
+
+    // Hot keys: when any group holds more rows than the sliding window,
+    // the window fills with dead sequential versions and the conservative
+    // overflow rule would punt most of the batch to the host oracle.
+    // Build explicit member windows instead: each row's candidates are
+    // the LATEST row per actor stream on its key (only those can survive
+    // -- an op with a newer same-actor successor is always superseded).
+    // Overflow then means >WINDOW genuinely concurrent streams, or a
+    // change assigning one key twice (same actor+seq rows, which the
+    // window cannot hold) -- both routed to the exact host fallback.
+    const int W = 8;   // ops/registers.WINDOW
+    if (max_count > W) {
+      b.use_members = true;
+      b.mem_idx.assign(b.Tp * W, -1);
+      b.host_ovf.assign(b.Tp, 0);
+      std::vector<i32> gslot(n_groups, -1);
+      std::vector<i32> counts(n_groups, 0);
+      for (i64 i = 0; i < b.T; ++i)
+        if (b.g_col[i] >= 0) counts[b.g_col[i]]++;
+      i64 n_multi = 0;
+      for (i64 g = 0; g < n_groups; ++g)
+        if (counts[g] >= 2) gslot[g] = static_cast<i32>(n_multi++);
+      std::vector<i32> wrow(n_multi * W);
+      std::vector<i32> wactor(n_multi * W), wseq(n_multi * W);
+      std::vector<u8> wn(n_multi, 0);
+      std::vector<u8> govf(n_groups, 0);
+      // rows are per-group time-ordered in array order (state rows per
+      // gid first with negative times, batch rows in op order)
+      for (i64 r = 0; r < b.T; ++r) {
+        i32 g = b.g_col[r];
+        if (g < 0) continue;
+        i32 sl = gslot[g];
+        if (sl < 0) continue;            // single-row group: empty window
+        i32* rows = &wrow[sl * W];
+        i32* acts = &wactor[sl * W];
+        i32* seqs = &wseq[sl * W];
+        u8 n = wn[sl];
+        for (u8 k = 0; k < n; ++k) b.mem_idx[r * W + k] = rows[k];
+        i32 a = b.a_col[r], s = b.s_col[r];
+        u8 k = 0;
+        for (; k < n; ++k)
+          if (acts[k] == a) break;
+        if (k < n) {
+          if (seqs[k] == s) govf[g] = 1;   // same-change dup assign
+          else { rows[k] = static_cast<i32>(r); seqs[k] = s; }
+        } else if (n < W) {
+          rows[n] = static_cast<i32>(r);
+          acts[n] = a;
+          seqs[n] = s;
+          wn[sl] = n + 1;
+        } else {
+          govf[g] = 1;                     // >W concurrent streams
+        }
+      }
+      for (i64 r = 0; r < b.T; ++r) {
+        i32 g = b.g_col[r];
+        if (g >= 0 && govf[g]) {
+          b.host_ovf[r] = 1;
+          b.any_ovf = true;
+        }
+      }
+    }
   } else {
     b.Tp = 0;
   }
@@ -1412,6 +1484,7 @@ static void dom_layout(Pool& pool, Batch& b) {
     b.fused_ok = false;
   }
   if (b.Tp >= (1 << 24)) b.fused_ok = false;
+  if (b.any_ovf) b.fused_ok = false;
 }
 
 // Shared begin pipeline.  Every error any phase can raise fires before the
@@ -1479,9 +1552,10 @@ static void mid_phase(Pool& pool, Batch& b) {
         // oracle rule: keep concurrent priors, append op unless del,
         // sort by actor string descending
         Register remaining;
+        // newest-first tie rule -- see backend/op_set.py apply_assign
+        if (op.action != A_DEL) remaining.push_back(op);
         for (auto& o : sit->second)
           if (rec_concurrent(st, o, op)) remaining.push_back(o);
-        if (op.action != A_DEL) remaining.push_back(op);
         std::stable_sort(remaining.begin(), remaining.end(),
                          [&](const OpRec& x, const OpRec& y) {
                            return pool.intern.str(x.actor) >
@@ -2288,7 +2362,8 @@ void* amtpu_begin_local(void* pool_ptr, const char* doc_id,
 
 void amtpu_batch_free(void* b) { delete static_cast<BatchHandle*>(b); }
 
-// dims: [T, Tp, A, Ap, L, Lp, n_dom_blocks, max_arena_len, CTp]
+// dims: [T, Tp, A, Ap, L, Lp, n_dom_blocks, max_arena_len, CTp,
+//        use_members, any_ovf]
 void amtpu_batch_dims(void* bp, int64_t* out) {
   Batch& b = static_cast<BatchHandle*>(bp)->batch;
   out[0] = b.T; out[1] = b.Tp; out[2] = b.A; out[3] = b.Ap;
@@ -2296,7 +2371,12 @@ void amtpu_batch_dims(void* bp, int64_t* out) {
   out[6] = static_cast<int64_t>(b.dom_blocks.size());
   out[7] = b.max_arena_len;
   out[8] = b.CTp;
+  out[9] = b.use_members ? 1 : 0;
+  out[10] = b.any_ovf ? 1 : 0;
 }
+
+const int32_t* amtpu_col_memidx(void* bp) { return static_cast<BatchHandle*>(bp)->batch.mem_idx.data(); }
+const uint8_t* amtpu_col_hostovf(void* bp) { return static_cast<BatchHandle*>(bp)->batch.host_ovf.data(); }
 
 // register columns (valid when Tp > 0)
 const int32_t* amtpu_col_g(void* bp) { return static_cast<BatchHandle*>(bp)->batch.g_col.data(); }
@@ -2484,6 +2564,28 @@ uint8_t* amtpu_get_patch(void* pool_ptr, const char* doc_id, int64_t* len) {
     out.str("diffs");
     out.array(count);
     out.raw(diffs.buf);
+    *len = static_cast<int64_t>(out.buf.size());
+    uint8_t* res = static_cast<uint8_t*>(std::malloc(out.buf.size()));
+    std::memcpy(res, out.buf.data(), out.buf.size());
+    return res;
+  } catch (const std::exception& e) {
+    g_error = e.what(); g_error_kind = 0;
+    *len = -1;
+    return nullptr;
+  }
+}
+
+// clock + deps only (no materialization): the cheap per-round query that
+// batched replica catch-up gossips (reference advertises clocks the same
+// way, connection.js:51-56, without shipping document state)
+uint8_t* amtpu_get_clock(void* pool_ptr, const char* doc_id, int64_t* len) {
+  Pool& pool = *static_cast<Pool*>(pool_ptr);
+  try {
+    DocState& st = find_doc(pool, doc_id);
+    Writer out;
+    out.map(2);
+    out.str("clock"); write_clock(out, pool, st.clock);
+    out.str("deps"); write_clock(out, pool, st.deps);
     *len = static_cast<int64_t>(out.buf.size());
     uint8_t* res = static_cast<uint8_t*>(std::malloc(out.buf.size()));
     std::memcpy(res, out.buf.data(), out.buf.size());
