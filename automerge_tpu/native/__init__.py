@@ -20,6 +20,8 @@ import numpy as np
 
 from .. import trace
 from ..utils.common import doc_key
+from ..utils.wire import map_header as _map_header
+from ..utils.wire import read_map_header as _read_map_header
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(os.path.dirname(os.path.dirname(_DIR)), 'native')
@@ -102,6 +104,9 @@ def _load():
     lib.amtpu_get_patch.restype = ctypes.POINTER(ctypes.c_uint8)
     lib.amtpu_get_patch.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                     ctypes.POINTER(ctypes.c_int64)]
+    lib.amtpu_save.restype = ctypes.POINTER(ctypes.c_uint8)
+    lib.amtpu_save.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64)]
     lib.amtpu_get_clock.restype = ctypes.POINTER(ctypes.c_uint8)
     lib.amtpu_get_clock.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64)]
@@ -160,28 +165,53 @@ def _take_buf(ptr, length):
         lib().amtpu_buf_free(ptr)
 
 
-class NativeError(Exception):
-    pass
+def apply_payloads_pipelined(pools_payloads):
+    """Applies (NativeDocPool, payload_bytes) pairs with host/device
+    overlap: every pool's begin + kernel dispatch runs first (phase a),
+    then results collect and emit in order (phase b) -- pool k's device
+    work overlaps pool k+1's host begin, the same pattern
+    ShardedNativePool uses across shards.  The PUBLIC entry for fanning a
+    round of independent deliveries (replica catch-up) over many pools.
+
+    Pools that already began successfully still run to completion when a
+    later one fails; the first error is re-raised afterwards."""
+    ctxs = []
+    errors = []
+    for pool, payload in pools_payloads:
+        try:
+            ctxs.append((pool, pool._phase_a(payload)))
+        except Exception as e:
+            errors.append(e)
+    for pool, ctx in ctxs:
+        try:
+            pool._phase_b(ctx)
+        except Exception as e:
+            errors.append(e)
+        finally:
+            lib().amtpu_batch_free(ctx['bh'])
+    if errors:
+        raise errors[0]
 
 
-def _read_map_header(buf):
-    """Returns (n_entries, header_len) of a msgpack map."""
-    b = buf[0]
-    if (b & 0xf0) == 0x80:
-        return b & 0x0f, 1
-    if b == 0xde:
-        return int.from_bytes(buf[1:3], 'big'), 3
-    if b == 0xdf:
-        return int.from_bytes(buf[1:5], 'big'), 5
-    raise NativeError('expected msgpack map, got 0x%02x' % b)
+#: fixed byte prefix of a v1 checkpoint; the remainder is the raw
+#: changes array
+_CKPT_PREFIX = (b'\x82' + msgpack.packb('format') +
+                msgpack.packb('amtpu-doc-v1') + msgpack.packb('changes'))
 
 
-def _map_header(n):
-    if n <= 15:
-        return bytes([0x80 | n])
-    if n <= 0xffff:
-        return b'\xde' + n.to_bytes(2, 'big')
-    return b'\xdf' + n.to_bytes(4, 'big')
+def _load_batch(pool, blobs):
+    """Splices many save() checkpoints into ONE {doc: [changes]} payload
+    and applies it as a single batch -- per-doc loads each pay a full
+    device round trip; a whole DocSet restore should pay one."""
+    from ..errors import RangeError
+    parts = [_map_header(len(blobs))]
+    for doc_id, data in blobs.items():
+        if not data.startswith(_CKPT_PREFIX):
+            raise RangeError('not an amtpu-doc-v1 checkpoint: %r'
+                             % (doc_id,))
+        parts.append(msgpack.packb(doc_key(doc_id), use_bin_type=True))
+        parts.append(memoryview(data)[len(_CKPT_PREFIX):])
+    pool.apply_batch_bytes(b''.join(parts))
 
 
 def _apply_batch_dicts(pool, changes_by_doc):
@@ -655,6 +685,35 @@ class NativeDocPool:
             _raise_last()
         return msgpack.unpackb(_take_buf(ptr, out_len.value), raw=False)
 
+    def save(self, doc_id):
+        """Checkpoint one doc as msgpack bytes: the full change history in
+        application order (the reference's save serializes opSet.history,
+        src/automerge.js:45-52).  Load with `load()` on any pool."""
+        out_len = ctypes.c_int64()
+        ptr = lib().amtpu_save(
+            self._pool, self._doc_key(doc_id).encode(),
+            ctypes.byref(out_len))
+        if not ptr:
+            _raise_last()
+        return _take_buf(ptr, out_len.value)
+
+    def load(self, doc_id, data):
+        """Restores a `save()` checkpoint as ONE batched replay (the
+        reference replays scalar, O(history) through a fresh backend --
+        here the whole history resolves in a single kernel pass).
+        Returns the doc's whole-state patch."""
+        if not data.startswith(_CKPT_PREFIX):
+            from ..errors import RangeError
+            raise RangeError('not an amtpu-doc-v1 checkpoint')
+        _load_batch(self, {doc_id: data})
+        return self.get_patch(doc_id)
+
+    def load_batch(self, blobs):
+        """Restores MANY save() checkpoints in one batched replay
+        ({doc_id: bytes}); the whole DocSet resolves in a single kernel
+        pass instead of one device round trip per doc."""
+        _load_batch(self, blobs)
+
     def get_missing_deps(self, doc_id):
         out_len = ctypes.c_int64()
         ptr = lib().amtpu_get_missing_deps(
@@ -854,6 +913,17 @@ class ShardedNativePool:
 
     def get_clock(self, doc_id):
         return self.pools[self._shard_of(doc_id)].get_clock(doc_id)
+
+    def save(self, doc_id):
+        return self.pools[self._shard_of(doc_id)].save(doc_id)
+
+    def load(self, doc_id, data):
+        return self.pools[self._shard_of(doc_id)].load(doc_id, data)
+
+    def load_batch(self, blobs):
+        """One batched replay for many checkpoints (the payload splitter
+        routes docs to their shards)."""
+        _load_batch(self, blobs)
 
     def get_missing_deps(self, doc_id):
         return self.pools[self._shard_of(doc_id)].get_missing_deps(doc_id)

@@ -83,6 +83,8 @@ class DocState:
         self.undo_stack = []
         self.undo_pos = 0
         self.redo_stack = []
+        # application-order log of (actor, seq) for save() replay
+        self.history = []
 
 
 class TPUDocPool:
@@ -209,6 +211,7 @@ class TPUDocPool:
                 all_deps[da] = max(all_deps.get(da, 0), ds)
             state.states.setdefault(actor, []).append(
                 {'change': change, 'allDeps': all_deps})
+            state.history.append((actor, seq))
             state.clock[actor] = seq
             remaining = {a: s for a, s in state.deps.items()
                          if s > all_deps.get(a, 0)}
@@ -245,6 +248,27 @@ class TPUDocPool:
         the cheap per-round query replica catch-up gossips."""
         state = self.doc(doc_id)
         return {'clock': dict(state.clock), 'deps': dict(state.deps)}
+
+    def save(self, doc_id):
+        """Checkpoint one doc (wire-compatible with NativeDocPool.save:
+        msgpack {'format': 'amtpu-doc-v1', 'changes': [...]} in
+        application order)."""
+        import msgpack
+        state = self.doc(doc_id)
+        changes = [state.states[a][s - 1]['change']
+                   for a, s in state.history]
+        return msgpack.packb({'format': 'amtpu-doc-v1',
+                              'changes': changes}, use_bin_type=True)
+
+    def load(self, doc_id, data):
+        """Restores a save() checkpoint as one batched replay; returns
+        the doc's whole-state patch."""
+        import msgpack
+        header = msgpack.unpackb(data, raw=False)
+        if header.get('format') != 'amtpu-doc-v1':
+            raise RangeError('not an amtpu-doc-v1 checkpoint')
+        self.apply_batch({doc_id: header['changes']})
+        return self.get_patch(doc_id)
 
     def get_missing_deps(self, doc_id):
         """(parity: op_set.js:359-370)"""

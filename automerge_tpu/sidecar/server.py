@@ -65,6 +65,15 @@ class SidecarBackend:
     def get_patch(self, doc):
         return self.pool.get_patch(doc)
 
+    def save(self, doc):
+        """Checkpoint bytes for one doc (application-order history;
+        reference: src/automerge.js:45-52)."""
+        return self.pool.save(doc)
+
+    def load(self, doc, data):
+        """Batched-replay restore of a save() checkpoint."""
+        return self.pool.load(doc, data)
+
     def get_missing_deps(self, doc):
         return self.pool.get_missing_deps(doc)
 
@@ -90,6 +99,10 @@ class SidecarBackend:
                 result = self.apply_local_change(req['doc'], req['request'])
             elif cmd == 'get_patch':
                 result = self.get_patch(req['doc'])
+            elif cmd == 'save':
+                result = self.save(req['doc'])
+            elif cmd == 'load':
+                result = self.load(req['doc'], req['data'])
             elif cmd == 'get_missing_deps':
                 result = self.get_missing_deps(req['doc'])
             elif cmd == 'get_missing_changes':

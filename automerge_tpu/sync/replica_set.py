@@ -21,6 +21,9 @@ import numpy as np
 from ..parallel.replica import batched_plan
 from ..utils.common import ROOT_ID
 from ..utils.common import doc_key as _doc_key
+from ..utils.wire import array_header as _array_header
+from ..utils.wire import map_header as _map_header
+from ..utils.wire import read_array_header as _read_array_header
 
 
 class BatchedReplicaSet:
@@ -120,9 +123,11 @@ class BatchedReplicaSet:
             max_rounds = 4 * len(self.replicas) + 8
         rounds = []
         for _ in range(max_rounds):
-            shipped = self._one_round()
+            planned, shipped = self._one_round()
             rounds.append(shipped)
-            if shipped == 0:
+            # termination keys on PLANNED work: a round whose shipments
+            # were all dropped by the fault hook retries next round
+            if planned == 0:
                 return rounds
         raise RuntimeError(
             'replica catch-up did not converge in %d rounds' % max_rounds)
@@ -138,9 +143,10 @@ class BatchedReplicaSet:
             hasattr(p, 'apply_batch_bytes') for p in self.replicas)
         if use_bytes:
             return self._one_round_bytes()
-        shipped = 0
+        planned = shipped = 0
         inbox = {}   # receiver -> {doc_id: [changes]}
         for doc_id, ships in self.plan_all().items():
+            planned += len(ships)
             for s, r, actor, after_seq in ships:
                 if self._drop is not None and self._drop(s, r, doc_id):
                     continue
@@ -153,14 +159,15 @@ class BatchedReplicaSet:
                     changes)
         for r, by_doc in inbox.items():
             self.replicas[r].apply_batch(by_doc)
-        return shipped
+        return planned, shipped
 
     def _one_round_bytes(self):
         import msgpack
 
-        shipped = 0
+        planned = shipped = 0
         inbox = {}   # receiver -> {doc_id: [(count, body_view)]}
         for doc_id, ships in self.plan_all().items():
+            planned += len(ships)
             for s, r, actor, after_seq in ships:
                 if self._drop is not None and self._drop(s, r, doc_id):
                     continue
@@ -187,30 +194,14 @@ class BatchedReplicaSet:
         # pipelined delivery: replicas are independent pools, so replica
         # k's device work overlaps replica k+1's host begin (the same
         # async-dispatch overlap ShardedNativePool uses across shards)
-        if deliveries and all(hasattr(p, '_phase_a') and
-                              hasattr(p, '_phase_b')
+        from ..native import NativeDocPool, apply_payloads_pipelined
+        if deliveries and all(isinstance(p, NativeDocPool)
                               for p, _ in deliveries):
-            from ..native import lib
-            ctxs = []
-            errors = []
-            for pool, payload in deliveries:
-                try:
-                    ctxs.append((pool, pool._phase_a(payload)))
-                except Exception as e:   # collected; healthy pools finish
-                    errors.append(e)
-            for pool, ctx in ctxs:
-                try:
-                    pool._phase_b(ctx)
-                except Exception as e:
-                    errors.append(e)
-                finally:
-                    lib().amtpu_batch_free(ctx['bh'])
-            if errors:
-                raise errors[0]
+            apply_payloads_pipelined(deliveries)
         else:
             for pool, payload in deliveries:
                 pool.apply_batch_bytes(payload)
-        return shipped
+        return planned, shipped
 
     # -- verification ---------------------------------------------------
 
@@ -235,34 +226,6 @@ class BatchedReplicaSet:
                 raise AssertionError(
                     'replica %d diverged on %r' % (i, doc_id))
         return patches[0]
-
-
-def _read_array_header(buf):
-    """(n_elements, header_len) of a msgpack array."""
-    b = buf[0]
-    if (b & 0xf0) == 0x90:
-        return b & 0x0f, 1
-    if b == 0xdc:
-        return int.from_bytes(buf[1:3], 'big'), 3
-    if b == 0xdd:
-        return int.from_bytes(buf[1:5], 'big'), 5
-    raise ValueError('expected msgpack array, got 0x%02x' % b)
-
-
-def _array_header(n):
-    if n <= 15:
-        return bytes([0x90 | n])
-    if n <= 0xffff:
-        return b'\xdc' + n.to_bytes(2, 'big')
-    return b'\xdd' + n.to_bytes(4, 'big')
-
-
-def _map_header(n):
-    if n <= 15:
-        return bytes([0x80 | n])
-    if n <= 0xffff:
-        return b'\xde' + n.to_bytes(2, 'big')
-    return b'\xdf' + n.to_bytes(4, 'big')
 
 
 def patch_to_tree(patch):

@@ -1,0 +1,48 @@
+"""msgpack wire-format splice helpers.
+
+The pools move change/patch payloads as raw msgpack and splice headers by
+hand (merging shard results, stitching shipped change arrays, wrapping
+checkpoints).  These four helpers are the ONE definition of that byte
+surgery -- per-module mirrors drift (and a drifted map header corrupts a
+spliced payload silently).
+"""
+
+
+def read_map_header(buf):
+    """(n_entries, header_len) of a msgpack map."""
+    b = buf[0]
+    if (b & 0xf0) == 0x80:
+        return b & 0x0f, 1
+    if b == 0xde:
+        return int.from_bytes(buf[1:3], 'big'), 3
+    if b == 0xdf:
+        return int.from_bytes(buf[1:5], 'big'), 5
+    raise ValueError('expected msgpack map, got 0x%02x' % b)
+
+
+def map_header(n):
+    if n <= 15:
+        return bytes([0x80 | n])
+    if n <= 0xffff:
+        return b'\xde' + n.to_bytes(2, 'big')
+    return b'\xdf' + n.to_bytes(4, 'big')
+
+
+def read_array_header(buf):
+    """(n_elements, header_len) of a msgpack array."""
+    b = buf[0]
+    if (b & 0xf0) == 0x90:
+        return b & 0x0f, 1
+    if b == 0xdc:
+        return int.from_bytes(buf[1:3], 'big'), 3
+    if b == 0xdd:
+        return int.from_bytes(buf[1:5], 'big'), 5
+    raise ValueError('expected msgpack array, got 0x%02x' % b)
+
+
+def array_header(n):
+    if n <= 15:
+        return bytes([0x90 | n])
+    if n <= 0xffff:
+        return b'\xdc' + n.to_bytes(2, 'big')
+    return b'\xdd' + n.to_bytes(4, 'big')

@@ -403,6 +403,9 @@ def main(argv=None):
                     default=env_int('AMTPU_BENCH_CONFIG', 3),
                     choices=[1, 2, 3, 4, 5])
     args = ap.parse_args(argv)
+    if args.config not in (1, 2, 3, 4, 5):
+        ap.error('invalid config %r (AMTPU_BENCH_CONFIG must be 1..5)'
+                 % (args.config,))
     rng = random.Random(SEED)
     if args.config == 5:
         result = run_config_5(rng)

@@ -369,6 +369,10 @@ struct DocState {
   std::unordered_map<u32, ObjMeta> objects;
   FlatMap<Register> registers;  // rkey(obj, key) -> live field ops
   std::unordered_map<u32, Arena> arenas;
+  // application-order log of (actor, seq): save() replays changes in
+  // exactly this order so a loaded doc materializes byte-identically
+  // (the reference's opSet.history list, op_set.js:270-276)
+  std::vector<std::pair<u32, u32>> history;
   // bumped whenever the inbound-link index changes; pure-map path
   // renderings are cacheable while it holds still
   u64 path_epoch = 0;
@@ -855,6 +859,7 @@ struct BeginJournal {
   std::vector<std::tuple<u32, u32, i64, i64>> arenas;   // (doc,obj,n,max)
   // update_states: clock/deps snapshots at first touch + appended entries
   std::vector<u8> snapped;                              // per batch doc
+  std::vector<std::pair<u32, size_t>> histories;        // (doc, old size)
   std::vector<std::pair<u32, std::pair<Clock, Clock>>> clocks;
   std::vector<std::pair<u32, u32>> state_pushes;        // (doc, actor sid)
   std::vector<std::pair<u32, size_t>> actor_orders;     // (doc, old size)
@@ -868,6 +873,8 @@ struct BeginJournal {
     // reverse: per-doc sizes were recorded increasing, the earliest wins
     for (auto it = actor_orders.rbegin(); it != actor_orders.rend(); ++it)
       b.bdocs[it->first]->state_actor_order.resize(it->second);
+    for (auto& [d, sz] : histories)
+      b.bdocs[d]->history.resize(sz);
     for (auto& [d, cd] : clocks) {
       b.bdocs[d]->clock = std::move(cd.first);
       b.bdocs[d]->deps = std::move(cd.second);
@@ -902,7 +909,9 @@ static void update_states(Pool& pool, Batch& b, BeginJournal& j) {
     if (!j.snapped[ac.doc]) {
       j.snapped[ac.doc] = 1;
       j.clocks.emplace_back(ac.doc, std::make_pair(st.clock, st.deps));
+      j.histories.emplace_back(ac.doc, st.history.size());
     }
+    st.history.emplace_back(actor, seq);
     Clock base = ch.deps;
     clock_set_max(base, actor, 0);  // ensure present
     // pin authoring actor at seq-1
@@ -2564,6 +2573,35 @@ uint8_t* amtpu_get_patch(void* pool_ptr, const char* doc_id, int64_t* len) {
     out.str("diffs");
     out.array(count);
     out.raw(diffs.buf);
+    *len = static_cast<int64_t>(out.buf.size());
+    uint8_t* res = static_cast<uint8_t*>(std::malloc(out.buf.size()));
+    std::memcpy(res, out.buf.data(), out.buf.size());
+    return res;
+  } catch (const std::exception& e) {
+    g_error = e.what(); g_error_kind = 0;
+    *len = -1;
+    return nullptr;
+  }
+}
+
+// checkpoint: {"format": "amtpu-doc-v1", "changes": [raw change...]} with
+// changes in APPLICATION order -- a batched replay of this array through
+// apply_batch reproduces the doc byte-identically (the reference's save
+// serializes opSet.history the same way, src/automerge.js:45-52; load
+// here is ONE kernel-speed batch instead of a scalar O(history) replay)
+uint8_t* amtpu_save(void* pool_ptr, const char* doc_id, int64_t* len) {
+  Pool& pool = *static_cast<Pool*>(pool_ptr);
+  try {
+    DocState& st = find_doc(pool, doc_id);
+    Writer out;
+    out.map(2);
+    out.str("format"); out.str("amtpu-doc-v1");
+    out.str("changes");
+    out.array(st.history.size());
+    for (auto& [actor, seq] : st.history) {
+      const ChangeRec& ch = st.states[actor][seq - 1].change;
+      out.raw(ch.raw.data(), ch.raw.size());
+    }
     *len = static_cast<int64_t>(out.buf.size());
     uint8_t* res = static_cast<uint8_t*>(std::malloc(out.buf.size()));
     std::memcpy(res, out.buf.data(), out.buf.size());
