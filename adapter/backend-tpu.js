@@ -1,0 +1,178 @@
+// backend-tpu.js -- drop-in Backend for the reference Automerge frontend,
+// backed by the batched TPU resolver sidecar.
+//
+// The reference is explicitly architected so the backend can live
+// elsewhere (frontend/backend split, CHANGELOG "this allows some of the
+// work to be moved to a background thread"; injection seam:
+// frontend/index.js:98 `options.backend`, surface backend/index.js:312-315).
+// This module implements that surface over the sidecar protocol
+// (automerge_tpu/sidecar/server.py, JSON lines on stdio), so:
+//
+//   const Automerge = require('automerge')
+//   const TpuBackend = require('./backend-tpu')
+//   let doc = Automerge.init({backend: TpuBackend})
+//
+// keeps the whole JS frontend unchanged while op resolution runs in the
+// TPU pool.  Backend state values are immutable {docId, clock} tokens;
+// document state lives server-side in the pool (one doc per init()).
+//
+// The reference Backend API is synchronous, so requests block on the
+// sidecar via the standard worker_threads + Atomics rendezvous (the same
+// pattern sync-rpc style libraries use): a worker owns the child process
+// and async IO; the caller waits on a SharedArrayBuffer signal and drains
+// the reply with receiveMessageOnPort.  Requires Node >= 12.17.
+//
+// Protocol parity is CI-tested from the Python side: the golden corpus
+// mechanically derived from the reference's own backend_test.js replays
+// against the sidecar byte-identically (tests/test_golden_corpus.py), so
+// this adapter's wire surface is covered even where Node is unavailable.
+
+'use strict'
+
+const path = require('path')
+const {
+  Worker, MessageChannel, receiveMessageOnPort
+} = require('worker_threads')
+
+// ---------------------------------------------------------------------------
+// sync sidecar connection (shared by every backend state in this process)
+// ---------------------------------------------------------------------------
+
+const WORKER_SOURCE = `
+'use strict'
+const {parentPort, workerData} = require('worker_threads')
+const {spawn} = require('child_process')
+const readline = require('readline')
+
+const child = spawn(workerData.python, ['-m', 'automerge_tpu.sidecar.server'],
+                    {cwd: workerData.repoRoot, stdio: ['pipe', 'pipe', 'inherit']})
+const lines = readline.createInterface({input: child.stdout})
+const pending = []
+lines.on('line', (line) => {
+  const cb = pending.shift()
+  if (cb) cb(JSON.parse(line))
+})
+parentPort.on('message', ({port, signal, request}) => {
+  pending.push((response) => {
+    port.postMessage(response)
+    Atomics.store(signal, 0, 1)
+    Atomics.notify(signal, 0)
+  })
+  child.stdin.write(JSON.stringify(request) + '\\n')
+})
+`
+
+class SidecarConnection {
+  constructor (options = {}) {
+    this.python = options.python || process.env.AMTPU_PYTHON || 'python3'
+    this.repoRoot = options.repoRoot || process.env.AMTPU_REPO ||
+      path.join(__dirname, '..')
+    this.worker = new Worker(WORKER_SOURCE, {
+      eval: true,
+      workerData: {python: this.python, repoRoot: this.repoRoot}
+    })
+    this.worker.unref()
+    this.nextId = 1
+    this.nextDoc = 1
+  }
+
+  request (cmd, fields) {
+    const id = this.nextId++
+    const {port1, port2} = new MessageChannel()
+    const signal = new Int32Array(new SharedArrayBuffer(4))
+    this.worker.postMessage(
+      {port: port2, signal, request: Object.assign({id, cmd}, fields)},
+      [port2])
+    Atomics.wait(signal, 0, 0)
+    const msg = receiveMessageOnPort(port1)
+    port1.close()
+    const response = msg.message
+    if (response.error) {
+      const err = response.errorType === 'TypeError'
+        ? new TypeError(response.error)
+        : response.errorType === 'RangeError'
+          ? new RangeError(response.error)
+          : new Error(response.error)
+      throw err
+    }
+    return response.result
+  }
+}
+
+let sharedConnection = null
+function connection () {
+  if (!sharedConnection) sharedConnection = new SidecarConnection()
+  return sharedConnection
+}
+
+// ---------------------------------------------------------------------------
+// Backend surface (reference: backend/index.js:312-315)
+// ---------------------------------------------------------------------------
+
+// Backend states are immutable value tokens; the pool holds the document.
+function token (docId, clock) {
+  return Object.freeze({docId, clock: Object.freeze(clock)})
+}
+
+function init () {
+  const conn = connection()
+  return token('doc-' + conn.nextDoc++, {})
+}
+
+function applyChanges (state, changes) {
+  const patch = connection().request('apply_changes',
+                                     {doc: state.docId, changes})
+  return [token(state.docId, patch.clock), patch]
+}
+
+function applyLocalChange (state, change) {
+  const patch = connection().request('apply_local_change',
+                                     {doc: state.docId, request: change})
+  return [token(state.docId, patch.clock), patch]
+}
+
+function getPatch (state) {
+  return connection().request('get_patch', {doc: state.docId})
+}
+
+function getChanges (oldState, newState) {
+  if (oldState.docId !== newState.docId) {
+    throw new RangeError('Cannot diff two states from different documents')
+  }
+  return connection().request('get_missing_changes',
+                              {doc: newState.docId,
+                               have_deps: oldState.clock})
+}
+
+function getChangesForActor (state, actorId) {
+  return connection().request('get_changes_for_actor',
+                              {doc: state.docId, actor: actorId})
+}
+
+function getMissingChanges (state, clock) {
+  return connection().request('get_missing_changes',
+                              {doc: state.docId, have_deps: clock || {}})
+}
+
+function getMissingDeps (state) {
+  return connection().request('get_missing_deps', {doc: state.docId})
+}
+
+function merge (local, remote) {
+  const changes = connection().request('get_missing_changes',
+                                       {doc: remote.docId,
+                                        have_deps: local.clock})
+  return applyChanges(local, changes)
+}
+
+module.exports = {
+  init,
+  applyChanges,
+  applyLocalChange,
+  getPatch,
+  getChanges,
+  getChangesForActor,
+  getMissingChanges,
+  getMissingDeps,
+  merge
+}

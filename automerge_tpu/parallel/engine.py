@@ -264,8 +264,12 @@ class TPUDocPool:
         """Restores a save() checkpoint as one batched replay; returns
         the doc's whole-state patch."""
         import msgpack
-        header = msgpack.unpackb(data, raw=False)
-        if header.get('format') != 'amtpu-doc-v1':
+        try:
+            header = msgpack.unpackb(data, raw=False)
+        except Exception:
+            header = None
+        if not isinstance(header, dict) or \
+                header.get('format') != 'amtpu-doc-v1':
             raise RangeError('not an amtpu-doc-v1 checkpoint')
         self.apply_batch({doc_id: header['changes']})
         return self.get_patch(doc_id)

@@ -20,6 +20,13 @@ Requests (fields beyond `cmd`/`id` per command):
   {"id": 5, "cmd": "get_missing_deps",   "doc": d}
   {"id": 6, "cmd": "get_missing_changes","doc": d, "have_deps": {...}}
   {"id": 7, "cmd": "ping"}
+  {"id": 8, "cmd": "save",               "doc": d}
+  {"id": 9, "cmd": "load",               "doc": d, "data": <checkpoint>}
+
+Checkpoints are binary; on the wire they travel base64-encoded
+({"checkpoint_b64": ...} from save, and load's "data" field accepts the
+base64 string or, under msgpack framing, raw bytes) so both framings can
+carry them.
 
 Responses: {"id": ..., "result": ...} or {"id": ..., "error": msg,
 "errorType": "AutomergeError"|"RangeError"|"TypeError"}.
@@ -66,12 +73,22 @@ class SidecarBackend:
         return self.pool.get_patch(doc)
 
     def save(self, doc):
-        """Checkpoint bytes for one doc (application-order history;
-        reference: src/automerge.js:45-52)."""
-        return self.pool.save(doc)
+        """Checkpoint for one doc (application-order history; reference:
+        src/automerge.js:45-52), base64-wrapped so the JSON framing can
+        carry it."""
+        import base64
+        return {'checkpoint_b64':
+                base64.b64encode(self.pool.save(doc)).decode('ascii')}
 
     def load(self, doc, data):
-        """Batched-replay restore of a save() checkpoint."""
+        """Batched-replay restore of a save() checkpoint; `data` is the
+        base64 string from save (or raw bytes under msgpack framing)."""
+        if isinstance(data, str):
+            import base64
+            try:
+                data = base64.b64decode(data, validate=True)
+            except Exception:
+                raise RangeError('checkpoint data is not valid base64')
         return self.pool.load(doc, data)
 
     def get_missing_deps(self, doc):

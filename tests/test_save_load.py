@@ -105,3 +105,48 @@ def test_load_batch_restores_many_docs_in_one_pass():
         assert fresh.get_patch(d) == pool.get_patch(d)
     with pytest.raises(RangeError, match='checkpoint'):
         fresh.load_batch({'x': b'garbage'})
+
+
+@pytest.mark.parametrize('make_pool', [NativeDocPool, TPUDocPool])
+@pytest.mark.parametrize('garbage', [b'\x90', b'garbage', b'\xc0'])
+def test_load_rejects_all_malformed_shapes(make_pool, garbage):
+    with pytest.raises(RangeError, match='checkpoint'):
+        make_pool().load('d', garbage)
+
+
+def test_sidecar_save_load_survives_json_framing():
+    """Checkpoints are binary; the sidecar base64-wraps them so the
+    default JSON-lines framing can carry them round trip."""
+    import io
+    import json as _json
+    from automerge_tpu.sidecar.server import SidecarBackend, serve_stream
+    reqs = [
+        {'id': 1, 'cmd': 'apply_changes', 'doc': 'd', 'changes': [
+            {'actor': 'A', 'seq': 1, 'deps': {},
+             'ops': [{'action': 'set', 'obj': ROOT, 'key': 'k',
+                      'value': 7}]}]},
+        {'id': 2, 'cmd': 'save', 'doc': 'd'},
+    ]
+    rfile = io.BytesIO(('\n'.join(_json.dumps(r) for r in reqs))
+                       .encode() + b'\n')
+    wfile = io.BytesIO()
+    backend = SidecarBackend()
+    serve_stream(rfile, wfile, use_msgpack=False, backend=backend)
+    lines = [_json.loads(x) for x in wfile.getvalue().splitlines()]
+    assert all('error' not in r for r in lines), lines
+    blob = lines[1]['result']['checkpoint_b64']
+    # restore through the same JSON framing into a fresh doc
+    req3 = {'id': 3, 'cmd': 'load', 'doc': 'd2', 'data': blob}
+    rfile = io.BytesIO((_json.dumps(req3) + '\n').encode())
+    wfile = io.BytesIO()
+    serve_stream(rfile, wfile, use_msgpack=False, backend=backend)
+    out = _json.loads(wfile.getvalue().splitlines()[0])
+    assert 'error' not in out, out
+    assert out['result'] == backend.pool.get_patch('d')
+    # malformed base64 maps to a protocol error, not a crashed loop
+    req4 = {'id': 4, 'cmd': 'load', 'doc': 'd3', 'data': '!!not-base64!!'}
+    rfile = io.BytesIO((_json.dumps(req4) + '\n').encode())
+    wfile = io.BytesIO()
+    serve_stream(rfile, wfile, use_msgpack=False, backend=backend)
+    out = _json.loads(wfile.getvalue().splitlines()[0])
+    assert out.get('errorType') == 'RangeError'

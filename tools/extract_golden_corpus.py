@@ -1,0 +1,260 @@
+"""Mechanically derives a golden (changes-in -> patch-out) corpus from the
+reference's own backend test fixtures
+(`/root/reference/test/backend_test.js`).
+
+The reference suite can't run here (no Node), but its fixtures are plain
+object literals driven through a tiny statement vocabulary
+(`Backend.applyChanges` / `applyLocalChange` / `getPatch` +
+`assert.deepEqual` / `assert.throws`).  This script translates each
+`it(...)` block into a JSON test case whose EXPECTED patches come from the
+reference's own assertions -- independent evidence, not our oracle's
+output.  Cases using the high-level `Automerge.*` API are skipped and
+listed in the corpus metadata.
+
+Run:  python tools/extract_golden_corpus.py  (rewrites
+tests/golden/backend_corpus.json; the replayer is
+tests/test_golden_corpus.py)
+"""
+
+import json
+import os
+import re
+import sys
+
+REF = '/root/reference/test/backend_test.js'
+OUT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), 'tests', 'golden', 'backend_corpus.json')
+ROOT_ID = '00000000-0000-0000-0000-000000000000'
+
+
+class Date:
+    """Stand-in for the fixtures' `new Date()`: a fixed timestamp keeps
+    the corpus deterministic (the tests only ever use .getTime())."""
+
+    def __init__(self, ms=1234567890123):
+        self.ms = ms
+
+    def getTime(self):
+        return self.ms
+
+
+def balanced_span(src, start, open_ch, close_ch):
+    """End index (exclusive) of the bracketed span opening at `start`."""
+    depth = 0
+    in_str = None
+    i = start
+    while i < len(src):
+        c = src[i]
+        if in_str:
+            if c == '\\':
+                i += 2
+                continue
+            if c == in_str:
+                in_str = None
+        elif c in '\'"`':
+            in_str = c
+        elif c == open_ch:
+            depth += 1
+        elif c == close_ch:
+            depth -= 1
+            if depth == 0:
+                return i + 1
+        i += 1
+    raise ValueError('unbalanced from %d' % start)
+
+
+def js_expr_to_python(expr):
+    """Translates the fixtures' JS expression subset to eval-able Python."""
+    s = expr
+    # stash template literals first: the object-literal regex passes below
+    # must not touch the braces inside them
+    stash = []
+
+    def template(m):
+        inner = m.group(1).replace('${', '{')
+        stash.append("f'%s'" % inner)
+        return '__TPL_%d__' % (len(stash) - 1)
+    s = re.sub(r'`([^`]*)`', template, s)
+    # new Date(...) -> Date(...)
+    s = re.sub(r'\bnew\s+Date\b', 'Date', s)
+    # shorthand properties: {actor, ...} / , actor} -> "actor": actor
+    for _ in range(3):   # a few passes: adjacent shorthands share delims
+        s = re.sub(r'([{,]\s*)([A-Za-z_]\w*)\s*(?=[,}])',
+                   r'\1"\2": \2', s)
+    # computed keys [expr]: -> sentinel (must survive key quoting)
+    s = re.sub(r'([{,]\s*)\[([A-Za-z_]\w*)\]\s*:', r'\1__CK_\2__:', s)
+    # quote remaining bare keys
+    s = re.sub(r'([{,]\s*)([A-Za-z_]\w*)\s*:', r'\1"\2":', s)
+    # un-sentinel computed keys back to variable references
+    s = re.sub(r'"?__CK_([A-Za-z_]\w*)__"?\s*:', r'\1:', s)
+    s = re.sub(r'\btrue\b', 'True', s)
+    s = re.sub(r'\bfalse\b', 'False', s)
+    s = re.sub(r'\bnull\b', 'None', s)
+    for n, tpl in enumerate(stash):
+        # the key-quoting pass may have wrapped a stashed token used in
+        # key position; unwrap before substituting the f-string back
+        s = s.replace('"__TPL_%d__"' % n, tpl).replace('__TPL_%d__' % n,
+                                                       tpl)
+    return s
+
+
+def eval_js(expr, env):
+    return eval(js_expr_to_python(expr), {'__builtins__': {}}, env)
+
+
+def extract_case(name, body):
+    """Translates one it-block body into a corpus case (or a skip
+    reason)."""
+    if 'Automerge.' in body:
+        return None, 'uses the high-level Automerge API'
+    uuid_n = [0]
+
+    def uuid():
+        uuid_n[0] += 1
+        return 'uuid-%d' % uuid_n[0]
+
+    env = {'ROOT_ID': ROOT_ID, 'uuid': uuid, 'Date': Date}
+    patches = {}   # patch var -> step index
+    steps = []
+
+    i = 0
+    while i < len(body):
+        m = re.compile(r'\bconst\s+').search(body, i)
+        stmt_m = re.compile(
+            r'\b(?:const\s+\[\s*(\w+)\s*,\s*(\w+)\s*\]\s*=\s*)?'
+            r'Backend\.(applyChanges|applyLocalChange)\s*\(').search(body, i)
+        assert_m = re.compile(
+            r'assert\.(deepEqual|throws)\s*\(').search(body, i)
+        # next statement in source order
+        # order matters on ties: a destructuring Backend call also matches
+        # the bare-const pattern at the same offset
+        candidates = [x for x in (stmt_m, assert_m, m) if x]
+        if not candidates:
+            break
+        nxt = min(candidates, key=lambda x: x.start())
+
+        if nxt is stmt_m:
+            _state, patch_var, fn = stmt_m.group(1, 2, 3)
+            astart = stmt_m.end() - 1
+            aend = balanced_span(body, astart, '(', ')')
+            args = body[astart + 1:aend - 1]
+            # first arg is the state var; the rest is the payload expr
+            payload = args.split(',', 1)[1].strip()
+            value = eval_js(payload, env)
+            if fn == 'applyChanges':
+                steps.append({'op': 'apply_changes', 'changes': value})
+            else:
+                steps.append({'op': 'apply_local_change', 'request': value})
+            if patch_var:
+                patches[patch_var] = len(steps) - 1
+            i = aend
+        elif nxt is assert_m:
+            kind = assert_m.group(1)
+            astart = assert_m.end() - 1
+            aend = balanced_span(body, astart, '(', ')')
+            args = body[astart + 1:aend - 1].strip()
+            if kind == 'throws':
+                call = re.search(
+                    r'Backend\.applyLocalChange\(\s*\w+\s*,\s*(\w+)\s*\)',
+                    args)
+                err = re.search(r'/(.+)/\s*$', args)
+                if not call or not err:
+                    return None, 'unsupported assert.throws form'
+                steps.append({'op': 'apply_local_change_error',
+                              'request': env[call.group(1)],
+                              'error_match': err.group(1)})
+            else:
+                target, expected = args.split(',', 1)
+                target = target.strip()
+                value = eval_js(expected.strip(), env)
+                gp = re.match(r'Backend\.getPatch\(\s*\w+\s*\)$', target)
+                if gp:
+                    steps.append({'op': 'get_patch', 'expected': value})
+                elif target in patches:
+                    steps[patches[target]]['expected'] = value
+                else:
+                    return None, 'assertion on unsupported target %r' % target
+            i = aend
+        else:   # const bindings (possibly several decls, incl. objects)
+            line_end = m.end()
+            # find statement end: scan until a newline at bracket depth 0
+            depth = 0
+            j = m.end()
+            while j < len(body):
+                c = body[j]
+                if c in '([{':
+                    j = balanced_span(body, j, c, {'(': ')', '[': ']',
+                                                   '{': '}'}[c])
+                    continue
+                if c == '\n' and depth == 0:
+                    # statement continues if the line ends with , or =
+                    stripped = body[line_end:j].rstrip()
+                    if stripped.endswith((',', '=', '[', '{', '(')):
+                        j += 1
+                        continue
+                    break
+                j += 1
+            decls = body[m.end():j]
+            # split top-level "name = expr" pairs on commas at depth 0
+            parts = []
+            depth = 0
+            last = 0
+            k = 0
+            while k < len(decls):
+                c = decls[k]
+                if c in '([{':
+                    k = balanced_span(decls, k, c, {'(': ')', '[': ']',
+                                                    '{': '}'}[c])
+                    continue
+                if c == ',' and depth == 0 and \
+                        re.match(r'\s*[A-Za-z_]\w*\s*=', decls[k + 1:]):
+                    parts.append(decls[last:k])
+                    last = k + 1
+                k += 1
+            parts.append(decls[last:])
+            for part in parts:
+                dm = re.match(r'\s*([A-Za-z_]\w*)\s*=\s*(.+)$', part,
+                              re.DOTALL)
+                if dm and 'Backend.' not in dm.group(2):
+                    env[dm.group(1)] = eval_js(dm.group(2).strip(), env)
+            i = j
+    if not steps:
+        return None, 'no recognized statements'
+    return {'name': name, 'steps': steps}, None
+
+
+def main():
+    src = open(REF).read()
+    cases = []
+    skipped = []
+    for m in re.finditer(r"it\('([^']+)',\s*\(\)\s*=>\s*", src):
+        name = m.group(1)
+        bstart = src.index('{', m.end() - 1)
+        bend = balanced_span(src, bstart, '{', '}')
+        body = src[bstart + 1:bend - 1]
+        case, why = extract_case(name, body)
+        if case:
+            cases.append(case)
+        else:
+            skipped.append({'name': name, 'reason': why})
+    corpus = {
+        'source': 'test/backend_test.js (reference repo)',
+        'note': 'expected patches are the reference suite\'s own '
+                'assertions, mechanically translated; regenerate with '
+                'tools/extract_golden_corpus.py',
+        'skipped': skipped,
+        'cases': cases,
+    }
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, 'w') as f:
+        json.dump(corpus, f, indent=1, sort_keys=False)
+        f.write('\n')
+    print('extracted %d cases (%d skipped) -> %s'
+          % (len(cases), len(skipped), OUT))
+    for s in skipped:
+        print('  skipped: %(name)s (%(reason)s)' % s)
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
