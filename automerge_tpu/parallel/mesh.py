@@ -11,15 +11,24 @@ axis of long lists/Texts becomes the **sp** (sequence-parallel) axis
         knowledge frontier (vector-clock union across every replica,
         reference `src/connection.js:9-14` clockUnion) is one `lax.pmax`
         over this axis.
-  sp  - per-op list indexes are dominance counts
+  sp  - the element axis of long lists/Texts.  Arena columns
+        (eo/ep/ec/ea/ev/vis0) live SHARDED on sp -- resident state per
+        device is O(L/sp).  Per-op list indexes are dominance counts
         (`ops/list_rank.dominance_indexes`) whose visible-mask products
         reduce over the element axis: each sp device computes partial
-        counts over its block of the arena and a `lax.psum` over sp
-        completes them.  The index computation is the skip-list-probe
-        replacement and the dominant cost for long Texts, so that is the
-        stage sp parallelizes; the arena *inputs* are currently replicated
-        across sp (each device slices its block locally), and the cheaper
-        schedule/resolve/linearize stages run replicated on the sp axis.
+        counts over its local arena block and a `lax.psum` over sp
+        completes them; this is the skip-list-probe replacement and the
+        dominant cost for long Texts.  RGA linearization (pointer
+        doubling) needs the whole insertion forest, so the step
+        all-gathers the arena columns over sp transiently (peak O(L),
+        resident O(L/sp)) before doubling; op metadata is then gathered
+        locally from the full rank vector.
+
+Visibility deltas are DERIVED on device from the register kernel's own
+alive/visible outputs via each list op's register row (`op_row`), the
+same formulation the fused single-chip dispatch uses
+(`ops/registers.resolve_rank_dominate`) -- so real workloads run
+end-to-end without a host-computed timeline.
 
 Everything is a single `shard_map`-wrapped, jitted step: XLA inserts the
 collectives and overlaps them with compute over ICI.
@@ -83,9 +92,12 @@ def _op_metadata(elem_obj, elem_rank, op_elem, op_valid):
     return oobj, orank
 
 
-def _doc_pipeline(batch, n_linearize_iters):
+def _doc_pipeline(batch, n_linearize_iters, eo=None, ep=None, ec=None,
+                  ea=None, ev=None):
     """schedule + register-resolve + linearize for a [D, ...] doc batch.
-    Pure per-doc vmap -- no cross-doc communication."""
+    Pure per-doc vmap -- no cross-doc communication.  The arena columns
+    may be passed explicitly (the sharded step all-gathers them over sp
+    first); by default they come from the batch."""
     order, doc_clock = jax.vmap(clock_ops.schedule_queue)(
         batch['clock'], batch['ch_actor'], batch['ch_seq'],
         batch['ch_deps'], batch['ch_valid'])
@@ -95,10 +107,26 @@ def _doc_pipeline(batch, n_linearize_iters):
         batch['rg'], batch['rt'], batch['ra'], batch['rs'],
         batch['rc'], batch['rd'])
 
+    if eo is None:
+        eo, ep, ec, ea, ev = (batch['eo'], batch['ep'], batch['ec'],
+                              batch['ea'], batch['ev'])
     rank = jax.vmap(lambda o, p, c, a, v: list_rank.linearize(
-        o, p, c, a, v, n_iters=n_linearize_iters))(
-        batch['eo'], batch['ep'], batch['ec'], batch['ea'], batch['ev'])
+        o, p, c, a, v, n_iters=n_linearize_iters))(eo, ep, ec, ea, ev)
     return order, doc_clock, reg, rank
+
+
+def _op_deltas(reg, op_row, op_valid):
+    """Visibility delta per list op from the register kernel outputs:
+    +1 insert, -1 remove, 0 no visibility change -- the reference toggles
+    element visibility the same way per applied assign
+    (op_set.js:107-163); derived on device like the fused path."""
+    T = reg['alive_after'].shape[1]
+    row = jnp.clip(op_row, 0, T - 1)
+    alive = jnp.take_along_axis(reg['alive_after'], row, axis=1) > 0
+    before = jnp.take_along_axis(reg['visible_before'], row, axis=1)
+    return jnp.where((op_row >= 0) & op_valid,
+                     alive.astype(jnp.int32) - before.astype(jnp.int32),
+                     0)
 
 
 # ---------------------------------------------------------------------------
@@ -113,11 +141,11 @@ _BATCH_SPECS = {
     'ch_valid': P('dp', None),
     'rg': P('dp', None), 'rt': P('dp', None), 'ra': P('dp', None),
     'rs': P('dp', None), 'rc': P('dp', None, None), 'rd': P('dp', None),
-    'eo': P('dp', None), 'ep': P('dp', None), 'ec': P('dp', None),
-    'ea': P('dp', None), 'ev': P('dp', None),
-    'vis0': P('dp', None),
+    'eo': P('dp', 'sp'), 'ep': P('dp', 'sp'), 'ec': P('dp', 'sp'),
+    'ea': P('dp', 'sp'), 'ev': P('dp', 'sp'),
+    'vis0': P('dp', 'sp'),
     'op_elem': P('dp', None),
-    'op_delta': P('dp', None),
+    'op_row': P('dp', None),
     'op_valid': P('dp', None),
 }
 
@@ -142,7 +170,7 @@ def build_sharded_step(mesh, n_linearize_iters, chunk=64):
       clock [D, A]; ch_actor/ch_seq/ch_valid [D, C]; ch_deps [D, C, A]
       rg/rt/ra/rs/rd [D, T] (+ rc [D, T, A])      -- register rows
       eo/ep/ec/ea/ev [D, L]                        -- element arenas
-      vis0 [D, L]; op_elem/op_delta/op_valid [D, Tops]
+      vis0 [D, L]; op_elem/op_row/op_valid [D, Tops]
 
     The dp axis size must divide D, and the sp axis size must divide L
     (asserted at trace time -- a non-dividing L would silently drop the
@@ -151,41 +179,46 @@ def build_sharded_step(mesh, n_linearize_iters, chunk=64):
     Returns a jitted fn producing: order [D, C], doc_clock [D, A],
     frontier [A] (pmax over every doc of every replica shard),
     register outputs [D, T...], rank [D, L], indexes [D, Tops]."""
-    n_sp = mesh.shape['sp']
 
     @partial(shard_map, mesh=mesh,
              in_specs=(_BATCH_SPECS,), out_specs=_OUT_SPECS)
     def step(batch):
-        L = batch['eo'].shape[1]
-        if L % n_sp != 0:
-            raise ValueError(
-                'element axis %d must be divisible by sp=%d' % (L, n_sp))
-        order, doc_clock, reg, rank = _doc_pipeline(batch, n_linearize_iters)
+        # arena columns arrive sp-SHARDED (resident state O(L/sp) per
+        # device); linearization needs the whole insertion forest, so
+        # gather them transiently over sp before pointer doubling
+        def gather_sp(x):
+            return jax.lax.all_gather(x, 'sp', axis=1, tiled=True)
+
+        eo_f, ep_f, ec_f, ea_f, ev_f = (
+            gather_sp(batch['eo']), gather_sp(batch['ep']),
+            gather_sp(batch['ec']), gather_sp(batch['ea']),
+            gather_sp(batch['ev']))
+        order, doc_clock, reg, rank = _doc_pipeline(
+            batch, n_linearize_iters, eo_f, ep_f, ec_f, ea_f, ev_f)
 
         # replica clock gossip: union = elementwise max over the dp axis
         # (reference clockUnion, src/connection.js:9-14, batched)
         frontier = replica.frontier_pmax(jnp.max(doc_clock, axis=0), 'dp')
 
-        # sp-sharded dominance indexes: slice the local element block
-        Ll = L // n_sp
+        # visibility deltas from the register outputs (fused-path rule)
+        od = _op_deltas(reg, batch['op_row'], batch['op_valid'])
+
+        # sp-sharded dominance: the LOCAL arena block is this device's
+        # input shard; only the rank block is sliced from the gathered
+        # full vector
+        Ll = batch['eo'].shape[1]
         off = jax.lax.axis_index('sp') * Ll
+        er_b = jax.lax.dynamic_slice_in_dim(rank, off, Ll, axis=1)
 
-        def slice_block(x):
-            return jax.lax.dynamic_slice_in_dim(x, off, Ll, axis=1)
-
-        eo_b = slice_block(batch['eo'])
-        er_b = slice_block(rank)
-        vis_b = slice_block(batch['vis0'])
-
-        def per_doc(eo, er, vis, rank_full, eo_full, oe, od, ov):
+        def per_doc(eo, er, vis, rank_full, eo_full, oe, odd, ov):
             oobj, orank = _op_metadata(eo_full, rank_full, oe, ov)
             return list_rank.dominance_indexes(
-                eo, er, vis, oe, oobj, orank, od, ov,
+                eo, er, vis, oe, oobj, orank, odd, ov,
                 chunk=chunk, axis_name='sp', l_offset=off)
 
         indexes = jax.vmap(per_doc)(
-            eo_b, er_b, vis_b, rank, batch['eo'],
-            batch['op_elem'], batch['op_delta'], batch['op_valid'])
+            batch['eo'], er_b, batch['vis0'], rank, eo_f,
+            batch['op_elem'], od, batch['op_valid'])
 
         return {
             'order': order,
@@ -203,21 +236,21 @@ def build_sharded_step(mesh, n_linearize_iters, chunk=64):
     return jax.jit(step)
 
 
-def single_step(batch, n_linearize_iters):
+def single_step(batch, n_linearize_iters, chunk=128):
     """Unsharded reference of the same step (single chip / oracle for the
     sharded path).  jittable."""
     order, doc_clock, reg, rank = _doc_pipeline(batch, n_linearize_iters)
     frontier = jnp.max(doc_clock, axis=0)
-    L = batch['eo'].shape[1]
+    od = _op_deltas(reg, batch['op_row'], batch['op_valid'])
 
-    def per_doc(eo, er, vis, oe, od, ov):
+    def per_doc(eo, er, vis, oe, odd, ov):
         oobj, orank = _op_metadata(eo, er, oe, ov)
         return list_rank.dominance_indexes(
-            eo, er, vis, oe, oobj, orank, od, ov)
+            eo, er, vis, oe, oobj, orank, odd, ov, chunk=chunk)
 
     indexes = jax.vmap(per_doc)(
         batch['eo'], rank, batch['vis0'],
-        batch['op_elem'], batch['op_delta'], batch['op_valid'])
+        batch['op_elem'], od, batch['op_valid'])
     return {
         'order': order, 'doc_clock': doc_clock, 'frontier': frontier,
         'alive_after': reg['alive_after'], 'winner': reg['winner'],
@@ -275,7 +308,9 @@ def demo_batch(n_docs=8, n_changes=4, n_actors=4, n_regs=8, n_elems=8,
 
     vis0 = np.zeros((D, L), np.float32)
     op_elem = np.tile(np.arange(To, dtype=np.int32) % L, (D, 1))
-    op_delta = np.ones((D, To), np.int32)
+    # each list op points at a register row; its visibility delta derives
+    # from the register kernel outputs on device (the fused-path rule)
+    op_row = np.tile(np.arange(To, dtype=np.int32) % T, (D, 1))
     op_valid = np.ones((D, To), bool)
 
     return {
@@ -283,6 +318,6 @@ def demo_batch(n_docs=8, n_changes=4, n_actors=4, n_regs=8, n_elems=8,
         'ch_deps': ch_deps, 'ch_valid': ch_valid,
         'rg': rg, 'rt': rt, 'ra': ra, 'rs': rs, 'rc': rc, 'rd': rd,
         'eo': eo, 'ep': ep, 'ec': ec, 'ea': ea, 'ev': ev,
-        'vis0': vis0, 'op_elem': op_elem, 'op_delta': op_delta,
+        'vis0': vis0, 'op_elem': op_elem, 'op_row': op_row,
         'op_valid': op_valid,
     }

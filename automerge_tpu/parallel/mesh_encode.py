@@ -1,0 +1,320 @@
+"""Encoder from real change payloads to the mesh batch format.
+
+`build_sharded_step` consumes fixed-shape columnar arrays; this module
+turns an actual `{doc: [change, ...]}` workload (the bench / replica
+payload form, causally ordered) into that batch, so the multi-chip path
+runs REAL documents instead of synthetic demo data.  The target workload
+class is the one the sp axis exists for -- long Text/list histories
+(makeText/makeList, ins, set/del on elements, plus root-level links) on
+fresh documents; anything outside that class raises.
+
+Key encodings (mirroring the C++ runtime's columnar layout):
+  * actors intern into one GLOBAL rank table (frontier pmax over the dp
+    axis requires aligned actor columns across docs).
+  * register rows: one per assign op, in application order; clocks are
+    the change's transitive allDeps densified per row.
+  * arenas: one element per ins op (application order), parent index
+    resolved within the doc.
+  * list-op timeline: per list assign, the touched element and its own
+    register ROW -- visibility deltas are derived on device from the
+    register kernel's outputs, exactly like the fused single-chip path
+    (`ops/registers.resolve_rank_dominate`).
+"""
+
+import numpy as np
+
+ROOT_ID = '00000000-0000-0000-0000-000000000000'
+_MAKES = ('makeMap', 'makeList', 'makeText', 'makeTable')
+_LIST_MAKES = ('makeList', 'makeText')
+#: sliding-window width of ops/registers.resolve_registers; the mesh
+#: pipeline is exact only below it (no oracle fallback on this path)
+_WINDOW = 8
+
+
+def demo_text_workload(n_docs, n_actors=4, n_rounds=2, ops_per_change=8,
+                       delete_every=4):
+    """Concurrent interleaved Text edits -- the BASELINE config-3 shape,
+    tiny; wire-format changes, causally ordered.  The shared fixture
+    generator for dryrun_multichip and the mesh tests."""
+    batch = {}
+    for d in range(n_docs):
+        tid = 'text-%d' % d
+        changes = [{'actor': 'a0', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'makeText', 'obj': tid},
+            {'action': 'ins', 'obj': tid, 'key': '_head', 'elem': 1},
+            {'action': 'set', 'obj': tid, 'key': 'a0:1', 'value': 'x'},
+            {'action': 'link', 'obj': ROOT_ID, 'key': 'text',
+             'value': tid}]}]
+        max_elem = 1
+        last = {}
+        for r in range(1, n_rounds + 1):
+            for a in range(n_actors):
+                actor = 'a%d' % a
+                seq = r + 1 if a == 0 else r
+                ops = []
+                for i in range(ops_per_change // 2):
+                    max_elem += 1
+                    prev = last.get(a) or 'a0:1'
+                    ops.append({'action': 'ins', 'obj': tid, 'key': prev,
+                                'elem': max_elem})
+                    if i % delete_every == delete_every - 1 and a in last:
+                        ops.append({'action': 'del', 'obj': tid,
+                                    'key': last[a]})
+                    else:
+                        ops.append({'action': 'set', 'obj': tid,
+                                    'key': '%s:%d' % (actor, max_elem),
+                                    'value': chr(97 + max_elem % 26)})
+                    last[a] = '%s:%d' % (actor, max_elem)
+                changes.append({'actor': actor, 'seq': seq,
+                                'deps': {'a0': 1}, 'ops': ops})
+        batch[d] = changes
+    return batch
+
+
+def _bucket(n, floor=8):
+    size = floor
+    while size < n:
+        size *= 2
+    return size
+
+
+def encode_batch(changes_by_doc, sp=1):
+    """Encodes a causally-ordered {doc: [change...]} payload of fresh
+    documents into the mesh batch dict (+ a sidecar `meta` dict used by
+    tests to map kernel outputs back to ops).
+
+    The element axis pads to a multiple of `sp` so the arena columns
+    shard evenly across the sequence-parallel mesh axis."""
+    docs = list(changes_by_doc)
+    D = len(docs)
+
+    actor_rank = {}
+
+    def rank_of(actor):
+        if actor not in actor_rank:
+            actor_rank[actor] = None   # two-pass: collect, then sort
+        return actor
+
+    for doc in docs:
+        for ch in changes_by_doc[doc]:
+            rank_of(ch['actor'])
+    actors = sorted(actor_rank)
+    actor_rank = {a: i for i, a in enumerate(actors)}
+    A = _bucket(len(actors), 2)
+
+    per_doc = []
+    C = T = L = To = 1
+    for doc in docs:
+        enc = _encode_doc(changes_by_doc[doc], actor_rank, A)
+        per_doc.append(enc)
+        C = max(C, len(enc['ch_actor']))
+        T = max(T, len(enc['rg']))
+        L = max(L, len(enc['eo']))
+        To = max(To, len(enc['op_elem']))
+    C, T, To = _bucket(C), _bucket(T), _bucket(To)
+    # pad the element axis to a multiple of sp (bucketing gives a power of
+    # two, which an odd sp would never divide)
+    L = _bucket(L)
+    L = ((L + sp - 1) // sp) * sp
+
+    def stack(key, shape, dtype, fill):
+        out = np.full((D,) + shape, fill, dtype)
+        for i, enc in enumerate(per_doc):
+            v = np.asarray(enc[key])
+            if v.ndim == 1:
+                out[i, :len(v)] = v
+            else:
+                out[i, :v.shape[0], :v.shape[1]] = v
+        return out
+
+    batch = {
+        'clock': np.zeros((D, A), np.int32),
+        'ch_actor': stack('ch_actor', (C,), np.int32, 0),
+        'ch_seq': stack('ch_seq', (C,), np.int32, 0),
+        'ch_deps': stack('ch_deps', (C, A), np.int32, 0),
+        'ch_valid': stack('ch_valid', (C,), bool, False),
+        'rg': stack('rg', (T,), np.int32, -1),
+        'rt': stack('rt', (T,), np.int32, 0),
+        'ra': stack('ra', (T,), np.int32, 0),
+        'rs': stack('rs', (T,), np.int32, 0),
+        'rc': stack('rc', (T, A), np.int32, 0),
+        'rd': stack('rd', (T,), bool, False),
+        'eo': stack('eo', (L,), np.int32, 0),
+        'ep': stack('ep', (L,), np.int32, -1),
+        'ec': stack('ec', (L,), np.int32, 0),
+        'ea': stack('ea', (L,), np.int32, 0),
+        'ev': stack('ev', (L,), bool, False),
+        'vis0': np.zeros((D, L), np.float32),
+        'op_elem': stack('op_elem', (To,), np.int32, -1),
+        'op_row': stack('op_row', (To,), np.int32, -1),
+        'op_valid': stack('op_valid', (To,), bool, False),
+    }
+    meta = {'docs': docs, 'actors': actors,
+            'ops': [enc['meta_ops'] for enc in per_doc],
+            'max_arena': max(len(enc['eo']) for enc in per_doc)}
+    return batch, meta
+
+
+def _encode_doc(changes, actor_rank, A):
+    """Columnar encoding of one fresh doc's causally-ordered changes."""
+    states = {}          # actor -> [allDeps per seq]
+    ch_actor, ch_seq, ch_deps, ch_valid = [], [], [], []
+
+    objects = {ROOT_ID: 'map'}
+    obj_local = {}       # list object id -> local dense id
+    elem_index = {}      # elemId str -> arena index
+    eo, ep, ec, ea, ev = [], [], [], [], []
+
+    group_ids = {}
+    group_rows = {}
+    rg, rt, ra, rs, rc, rd = [], [], [], [], [], []
+
+    op_elem, op_row, op_valid = [], [], []
+    meta_ops = []        # (op_idx-in-doc, kind) for test mapping
+
+    time = 0
+    for ch in changes:
+        actor, seq = ch['actor'], ch['seq']
+        deps = dict(ch.get('deps', {}))
+        base = dict(deps)
+        base[actor] = seq - 1
+        all_deps = {}
+        for da, ds in base.items():
+            if ds <= 0:
+                continue
+            entries = states.get(da, [])
+            if ds - 1 >= len(entries):
+                raise ValueError('workload is not causally ordered')
+            for ta, ts in entries[ds - 1].items():
+                if ts > all_deps.get(ta, 0):
+                    all_deps[ta] = ts
+            all_deps[da] = max(all_deps.get(da, 0), ds)
+        states.setdefault(actor, [])
+        if len(states[actor]) != seq - 1:
+            raise ValueError('workload is not causally ordered')
+        states[actor].append(all_deps)
+
+        arank = actor_rank[actor]
+        ch_actor.append(arank)
+        ch_seq.append(seq)
+        dep_row = np.zeros((A,), np.int32)
+        for da, ds in deps.items():
+            dep_row[actor_rank[da]] = ds
+        ch_deps.append(dep_row)
+        ch_valid.append(True)
+        clock_row = np.zeros((A,), np.int32)
+        for da, ds in all_deps.items():
+            clock_row[actor_rank[da]] = ds
+
+        for op in ch['ops']:
+            action = op['action']
+            if action in _MAKES:
+                if op['obj'] in objects:
+                    raise ValueError('duplicate object')
+                objects[op['obj']] = action
+                if action in _LIST_MAKES:
+                    obj_local[op['obj']] = len(obj_local)
+                continue
+            if action == 'ins':
+                if objects.get(op['obj']) not in _LIST_MAKES:
+                    raise ValueError('ins on non-list object')
+                elem_id = '%s:%s' % (actor, op['elem'])
+                if elem_id in elem_index:
+                    raise ValueError('duplicate list element %s' % elem_id)
+                if op['key'] == '_head':
+                    parent = -1
+                else:
+                    parent = elem_index[op['key']]
+                elem_index[elem_id] = len(eo)
+                eo.append(obj_local[op['obj']])
+                ep.append(parent)
+                ec.append(int(op['elem']))
+                ea.append(arank)
+                ev.append(True)
+                continue
+            if action not in ('set', 'del', 'link'):
+                raise ValueError('unsupported action %r' % action)
+            gkey = (op['obj'], op['key'])
+            gid = group_ids.setdefault(gkey, len(group_ids))
+            group_rows[gid] = group_rows.get(gid, 0) + 1
+            if group_rows[gid] > _WINDOW:
+                # the mesh pipeline has no host-oracle fallback for
+                # window overflow (the pool path does); refuse loudly
+                # instead of computing silently wrong deltas
+                raise ValueError(
+                    'register group %r has more than %d rows; this '
+                    'workload needs the pool path' % (gkey, _WINDOW))
+            row = len(rg)
+            rg.append(gid)
+            rt.append(time)
+            ra.append(arank)
+            rs.append(seq)
+            rc.append(clock_row)
+            rd.append(action == 'del')
+            is_list = objects.get(op['obj']) in _LIST_MAKES
+            if is_list:
+                eidx = elem_index.get(op['key'])
+                if eidx is None:
+                    if action != 'del':
+                        raise ValueError('assign to unknown element')
+                else:
+                    op_elem.append(eidx)
+                    op_row.append(row)
+                    op_valid.append(True)
+                    meta_ops.append((row, eidx))
+            time += 1
+
+    return {
+        'ch_actor': ch_actor, 'ch_seq': ch_seq,
+        'ch_deps': np.asarray(ch_deps).reshape(len(ch_actor), A),
+        'ch_valid': ch_valid,
+        'rg': rg, 'rt': rt, 'ra': ra, 'rs': rs,
+        'rc': np.asarray(rc).reshape(len(rg), A) if rg else
+        np.zeros((0, A), np.int32),
+        'rd': rd,
+        'eo': eo, 'ep': ep, 'ec': ec, 'ea': ea, 'ev': ev,
+        'op_elem': op_elem, 'op_row': op_row, 'op_valid': op_valid,
+        'meta_ops': meta_ops,
+    }
+
+
+def verify_against_pool(workload, meta, out):
+    """Pins mesh-step outputs against the pool's public patches for the
+    same workload: per-doc clocks, and for every visibility-changing (or
+    visible-set) list op its index and diff action, in op order.  Raises
+    AssertionError on any mismatch."""
+    from .engine import TPUDocPool
+
+    pool = TPUDocPool()
+    patches = pool.apply_batch(workload)
+    actors = meta['actors']
+    alive = np.asarray(out['alive_after'])
+    before = np.asarray(out['visible_before'])
+    indexes = np.asarray(out['indexes'])
+    clocks = np.asarray(out['doc_clock'])
+    for i, doc in enumerate(meta['docs']):
+        patch = patches[doc]
+        want_clock = np.zeros((clocks.shape[1],), np.int32)
+        for a, s in patch['clock'].items():
+            want_clock[actors.index(a)] = s
+        if not np.array_equal(clocks[i], want_clock):
+            raise AssertionError('clock mismatch on %r' % (doc,))
+        diffs = iter(d for d in patch['diffs']
+                     if d.get('type') in ('list', 'text') and 'index' in d)
+        for k, (row, _eidx) in enumerate(meta['ops'][i]):
+            is_alive = alive[i, row] > 0
+            was_visible = bool(before[i, row])
+            if not is_alive and not was_visible:
+                continue   # dropped del: no diff
+            diff = next(diffs)
+            if diff['index'] != indexes[i, k]:
+                raise AssertionError(
+                    'index mismatch on %r op %d: pool %r vs mesh %r'
+                    % (doc, k, diff['index'], int(indexes[i, k])))
+            want = ('set' if (is_alive and was_visible) else
+                    'insert' if is_alive else 'remove')
+            if diff['action'] != want:
+                raise AssertionError('action mismatch on %r op %d'
+                                     % (doc, k))
+        if next(diffs, None) is not None:
+            raise AssertionError('unconsumed pool diffs on %r' % (doc,))
