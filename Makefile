@@ -1,0 +1,20 @@
+# Top-level developer entry points.
+
+.PHONY: all native test bench clean wheel
+
+all: native
+
+native:
+	$(MAKE) -C native
+
+test: native
+	python -m pytest tests/ -q
+
+bench: native
+	python bench.py
+
+wheel: native
+	python -m pip wheel --no-deps -w dist .
+
+clean:
+	$(MAKE) -C native clean

@@ -23,52 +23,61 @@ Key encodings (mirroring the C++ runtime's columnar layout):
 
 import numpy as np
 
-ROOT_ID = '00000000-0000-0000-0000-000000000000'
+from ..ops.registers import WINDOW as _WINDOW
+from ..utils.common import ROOT_ID
+
 _MAKES = ('makeMap', 'makeList', 'makeText', 'makeTable')
 _LIST_MAKES = ('makeList', 'makeText')
-#: sliding-window width of ops/registers.resolve_registers; the mesh
-#: pipeline is exact only below it (no oracle fallback on this path)
-_WINDOW = 8
+
+
+def text_doc_changes(tid, n_actors, n_rounds, ops_per_change,
+                     should_delete):
+    """One doc's concurrent interleaved Text edit history -- the
+    BASELINE config-3 shape; wire-format changes, causally ordered.
+    `should_delete(i, actor_n, has_last)` decides per slot whether to
+    delete the actor's previous element instead of setting the new one
+    (bench injects an rng policy; the demo fixture a deterministic one).
+    The ONE generator behind bench config 3, the mesh tests, and
+    dryrun_multichip."""
+    changes = [{'actor': 'a0', 'seq': 1, 'deps': {}, 'ops': [
+        {'action': 'makeText', 'obj': tid},
+        {'action': 'ins', 'obj': tid, 'key': '_head', 'elem': 1},
+        {'action': 'set', 'obj': tid, 'key': 'a0:1', 'value': 'x'},
+        {'action': 'link', 'obj': ROOT_ID, 'key': 'text', 'value': tid}]}]
+    max_elem = 1
+    last = {}
+    for r in range(1, n_rounds + 1):
+        for a in range(n_actors):
+            actor = 'a%d' % a
+            seq = r + 1 if a == 0 else r
+            ops = []
+            for i in range(ops_per_change // 2):
+                max_elem += 1
+                prev = last.get(a) or 'a0:1'
+                ops.append({'action': 'ins', 'obj': tid, 'key': prev,
+                            'elem': max_elem})
+                if should_delete(i, a, a in last):
+                    ops.append({'action': 'del', 'obj': tid,
+                                'key': last[a]})
+                else:
+                    ops.append({'action': 'set', 'obj': tid,
+                                'key': '%s:%d' % (actor, max_elem),
+                                'value': chr(97 + max_elem % 26)})
+                last[a] = '%s:%d' % (actor, max_elem)
+            changes.append({'actor': actor, 'seq': seq,
+                            'deps': {'a0': 1}, 'ops': ops})
+    return changes
 
 
 def demo_text_workload(n_docs, n_actors=4, n_rounds=2, ops_per_change=8,
                        delete_every=4):
-    """Concurrent interleaved Text edits -- the BASELINE config-3 shape,
-    tiny; wire-format changes, causally ordered.  The shared fixture
-    generator for dryrun_multichip and the mesh tests."""
-    batch = {}
-    for d in range(n_docs):
-        tid = 'text-%d' % d
-        changes = [{'actor': 'a0', 'seq': 1, 'deps': {}, 'ops': [
-            {'action': 'makeText', 'obj': tid},
-            {'action': 'ins', 'obj': tid, 'key': '_head', 'elem': 1},
-            {'action': 'set', 'obj': tid, 'key': 'a0:1', 'value': 'x'},
-            {'action': 'link', 'obj': ROOT_ID, 'key': 'text',
-             'value': tid}]}]
-        max_elem = 1
-        last = {}
-        for r in range(1, n_rounds + 1):
-            for a in range(n_actors):
-                actor = 'a%d' % a
-                seq = r + 1 if a == 0 else r
-                ops = []
-                for i in range(ops_per_change // 2):
-                    max_elem += 1
-                    prev = last.get(a) or 'a0:1'
-                    ops.append({'action': 'ins', 'obj': tid, 'key': prev,
-                                'elem': max_elem})
-                    if i % delete_every == delete_every - 1 and a in last:
-                        ops.append({'action': 'del', 'obj': tid,
-                                    'key': last[a]})
-                    else:
-                        ops.append({'action': 'set', 'obj': tid,
-                                    'key': '%s:%d' % (actor, max_elem),
-                                    'value': chr(97 + max_elem % 26)})
-                    last[a] = '%s:%d' % (actor, max_elem)
-                changes.append({'actor': actor, 'seq': seq,
-                                'deps': {'a0': 1}, 'ops': ops})
-        batch[d] = changes
-    return batch
+    """Deterministic multi-doc fixture for dryrun_multichip and tests."""
+    return {
+        d: text_doc_changes(
+            'text-%d' % d, n_actors, n_rounds, ops_per_change,
+            lambda i, a, has: i % delete_every == delete_every - 1 and has)
+        for d in range(n_docs)
+    }
 
 
 def _bucket(n, floor=8):
@@ -88,17 +97,8 @@ def encode_batch(changes_by_doc, sp=1):
     docs = list(changes_by_doc)
     D = len(docs)
 
-    actor_rank = {}
-
-    def rank_of(actor):
-        if actor not in actor_rank:
-            actor_rank[actor] = None   # two-pass: collect, then sort
-        return actor
-
-    for doc in docs:
-        for ch in changes_by_doc[doc]:
-            rank_of(ch['actor'])
-    actors = sorted(actor_rank)
+    actors = sorted({ch['actor'] for doc in docs
+                     for ch in changes_by_doc[doc]})
     actor_rank = {a: i for i, a in enumerate(actors)}
     A = _bucket(len(actors), 2)
 
@@ -234,6 +234,13 @@ def _encode_doc(changes, actor_rank, A):
                 continue
             if action not in ('set', 'del', 'link'):
                 raise ValueError('unsupported action %r' % action)
+            # NOTE on same-change duplicate assigns (one change setting a
+            # key twice): same-clock rows are mutually concurrent, so the
+            # reference keeps BOTH records; the sliding-window kernel
+            # holds them positionally and its newest-first tie order
+            # matches the batch tie rule -- exact on this path, no guard
+            # needed (the POOLS' member-window layout is what cannot
+            # represent them and falls back to the oracle there).
             gkey = (op['obj'], op['key'])
             gid = group_ids.setdefault(gkey, len(group_ids))
             group_rows[gid] = group_rows.get(gid, 0) + 1

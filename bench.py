@@ -62,37 +62,13 @@ N_SHARDS = env_int('AMTPU_BENCH_SHARDS', 10)
 # ---------------------------------------------------------------------------
 
 def _text_doc_changes(doc, rng, n_actors, n_rounds, ops_per_change):
-    """Interleaved concurrent Text insert/delete (config 3 shape)."""
-    tid = 'text-%d' % doc
-    changes = [{'actor': 'a0', 'seq': 1, 'deps': {}, 'ops': [
-        {'action': 'makeText', 'obj': tid},
-        {'action': 'ins', 'obj': tid, 'key': '_head', 'elem': 1},
-        {'action': 'set', 'obj': tid, 'key': 'a0:1', 'value': 'x'},
-        {'action': 'link', 'obj': ROOT_ID, 'key': 'text', 'value': tid}]}]
-    max_elem = 1
-    last = {}
-    for r in range(1, n_rounds + 1):
-        for a in range(n_actors):
-            actor = 'a%d' % a
-            seq = r + 1 if a == 0 else r
-            ops = []
-            for _ in range(ops_per_change // 2):
-                max_elem += 1
-                elem = max_elem
-                prev = last.get(a) or 'a0:1'
-                ops.append({'action': 'ins', 'obj': tid, 'key': prev,
-                            'elem': elem})
-                if rng.random() < 0.15 and a in last:
-                    ops.append({'action': 'del', 'obj': tid,
-                                'key': last[a]})
-                else:
-                    ops.append({'action': 'set', 'obj': tid,
-                                'key': '%s:%d' % (actor, elem),
-                                'value': chr(97 + elem % 26)})
-                last[a] = '%s:%d' % (actor, elem)
-            changes.append({'actor': actor, 'seq': seq,
-                            'deps': {'a0': 1}, 'ops': ops})
-    return changes
+    """Interleaved concurrent Text insert/delete (config 3 shape); the
+    shared generator with bench's rng delete policy (the rng draw happens
+    for every slot, keeping the stream identical to earlier rounds)."""
+    from automerge_tpu.parallel.mesh_encode import text_doc_changes
+    return text_doc_changes(
+        'text-%d' % doc, n_actors, n_rounds, ops_per_change,
+        lambda i, a, has: rng.random() < 0.15 and has)
 
 
 def build_config_1(rng):

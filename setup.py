@@ -1,0 +1,21 @@
+"""Builds the C++ host runtime into the wheel.
+
+`pip install .` / `python -m build` compile native/core.cpp via the
+project Makefile so the wheel ships libamtpu_core.so; the runtime loader
+(automerge_tpu/native/__init__.py) also rebuilds on demand from a source
+checkout, so development installs work without this hook.
+"""
+
+import subprocess
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+
+class BuildWithNative(build_py):
+    def run(self):
+        subprocess.run(['make', '-C', 'native'], check=True)
+        super().run()
+
+
+setup(cmdclass={'build_py': BuildWithNative})

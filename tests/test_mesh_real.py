@@ -53,3 +53,24 @@ def test_encoder_rejects_non_causal_payloads():
                          'value': 1}]}]}
     with pytest.raises(ValueError, match='causally ordered'):
         mesh_encode.encode_batch(bad)
+
+
+def test_same_change_duplicate_assigns_are_exact_on_mesh_path():
+    """One change setting a key twice keeps BOTH records in the reference
+    (same-clock rows are mutually concurrent); the sliding-window kernel
+    reproduces that exactly, so the mesh path needs no oracle fallback."""
+    workload = {0: [{'actor': 'A', 'seq': 1, 'deps': {}, 'ops': [
+        {'action': 'makeText', 'obj': 'T'},
+        {'action': 'ins', 'obj': 'T', 'key': '_head', 'elem': 1},
+        {'action': 'set', 'obj': 'T', 'key': 'A:1', 'value': 'x'},
+        {'action': 'set', 'obj': 'T', 'key': 'A:1', 'value': 'y'},
+        {'action': 'del', 'obj': 'T', 'key': 'A:1'},
+        {'action': 'link', 'obj': ROOT, 'key': 't', 'value': 'T'}]}]}
+    batch, meta = mesh_encode.encode_batch(workload)
+    n_iters = M.list_rank.ceil_log2(meta['max_arena']) + 1
+    out = M.single_step(batch, n_linearize_iters=n_iters, chunk=16)
+    mesh_encode.verify_against_pool(workload, meta, out)
+    # both set records survive (same-clock rows are concurrent) and the
+    # same-change del kills neither
+    alive = np.asarray(out['alive_after'])
+    assert alive[0, meta['ops'][0][-1][0]] == 2
