@@ -94,6 +94,11 @@ def _load():
         ctypes.c_int,
         ctypes.POINTER(ctypes.c_int32),
         ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int32)]
+    lib.amtpu_mid_packed.restype = ctypes.c_int
+    lib.amtpu_mid_packed.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int64, ctypes.POINTER(ctypes.c_int32)]
     lib.amtpu_finish.restype = ctypes.c_int
     lib.amtpu_finish.argtypes = [ctypes.c_void_p]
     lib.amtpu_batch_trace.argtypes = [ctypes.c_void_p,
@@ -420,19 +425,22 @@ class NativeDocPool:
         if ctx['mode'] == 'fused':
             with trace.span('device.collect'):
                 if ctx['combo'] is None:
-                    winner = conflicts = alive = np.zeros(0, np.int32)
-                    overflow = np.zeros(0, np.uint8)
-                    dom_idx = np.zeros(0, np.int32)
+                    packed = dom_idx = np.zeros(0, np.int32)
                     fallback = False
+                    conf_rows = np.zeros(0, np.int32)
+                    conf_vals = np.zeros(0, np.int32)
                 else:
                     combo = np.asarray(ctx['combo'])
-                    packed = combo[:Tp]
+                    packed = np.ascontiguousarray(combo[:Tp])
                     dom_idx = np.ascontiguousarray(combo[Tp:], np.int32)
-                    winner, alive, overflow = self._unpack_packed(packed)
-                    fallback = bool(overflow.any())
+                    fallback = bool((packed >> 28 & 1).any())
                     if not fallback:
-                        conflicts = self._gather_conflicts(
-                            ctx['reg_out'], alive, Tp)
+                        # conflicts stay SPARSE: only rows whose register
+                        # kept >1 member carry a conflict list
+                        conf_rows = np.nonzero(
+                            (packed >> 24 & 0xf) > 1)[0].astype(np.int32)
+                        conf_vals = self._gather_conflict_rows(
+                            ctx['reg_out'], conf_rows)
             if fallback:
                 # >window concurrent writers on some register: re-fetch the
                 # full outputs + rank and take the exact host path
@@ -443,6 +451,8 @@ class NativeDocPool:
                                                  np.int32)
                 alive = np.ascontiguousarray(reg_out['alive_after'],
                                              np.int32)
+                overflow = np.ascontiguousarray(reg_out['overflow'],
+                                                np.uint8)
                 rank_arr = (np.ascontiguousarray(ctx['rank'], np.int32)
                             if ctx['rank'] is not None
                             else np.zeros(0, np.int32))
@@ -455,9 +465,10 @@ class NativeDocPool:
                     self._run_dominance(L, bh)
             else:
                 with trace.span('host.mid'):
-                    if L.amtpu_mid_fused(
-                            bh, ip(winner), ip(conflicts), self.WINDOW,
-                            ip(alive), up(overflow), ip(dom_idx)) != 0:
+                    if L.amtpu_mid_packed(
+                            bh, ip(packed), self.WINDOW, ip(conf_rows),
+                            ip(conf_vals), len(conf_rows),
+                            ip(dom_idx)) != 0:
                         _raise_last()
         else:
             with trace.span('device.collect'):
@@ -495,20 +506,27 @@ class NativeDocPool:
         return ctypes.string_at(ptr, out_len.value) \
             if out_len.value else b'\x80'
 
-    def _gather_conflicts(self, reg_out, alive, Tp):
+    def _gather_conflict_rows(self, reg_out, rows):
         """Lazy conflicts fetch: only registers that kept >1 member have
-        conflict rows worth transferring."""
+        conflict rows worth transferring.  Returns [n, WINDOW] i32."""
         from ..ops import registers as register_ops
+        if not rows.size:
+            return np.zeros(0, np.int32)
+        pad = 1
+        while pad < rows.size:
+            pad *= 2
+        rows_p = np.zeros((pad,), np.int32)
+        rows_p[:rows.size] = rows
+        got = np.asarray(register_ops.gather_rows(
+            reg_out['conflicts'], rows_p))[:rows.size]
+        return np.ascontiguousarray(got, np.int32)
+
+    def _gather_conflicts(self, reg_out, alive, Tp):
+        """Dense [Tp, WINDOW] conflicts (fallback paths)."""
         conflicts = np.full((Tp, self.WINDOW), -1, np.int32)
-        rows = np.nonzero(alive > 1)[0]
+        rows = np.nonzero(alive > 1)[0].astype(np.int32)
+        got = self._gather_conflict_rows(reg_out, rows)
         if rows.size:
-            pad = 1
-            while pad < rows.size:
-                pad *= 2
-            rows_p = np.zeros((pad,), np.int32)
-            rows_p[:rows.size] = rows
-            got = np.asarray(register_ops.gather_rows(
-                reg_out['conflicts'], rows_p))[:rows.size]
             conflicts[rows] = got
         return conflicts
 

@@ -41,7 +41,7 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-ROOT_ID = '00000000-0000-0000-0000-000000000000'
+from automerge_tpu.utils.common import ROOT_ID  # noqa: E402
 
 
 def env_int(name, default):

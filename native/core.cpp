@@ -17,6 +17,7 @@
 // Build: see native/Makefile (g++ -O2 -shared -fPIC).
 
 #include <algorithm>
+#include <array>
 #include <chrono>
 #include <cstdint>
 #include <cstdlib>
@@ -403,6 +404,10 @@ struct Error : std::runtime_error {
 struct Pool {
   Interner intern;
   Interner vals;     // raw msgpack value spans, interned (vid)
+  // single-character string values (every Text op carries one) bypass
+  // the interner hash entirely via this table
+  u32 char_sid[256];
+  u32 char_rid[256];
   u32 root_sid;
   std::unordered_map<std::string, DocState> docs;
   std::vector<std::string> doc_order;   // first-seen order
@@ -410,6 +415,7 @@ struct Pool {
 
   Pool() {
     root_sid = intern.id_of(ROOT_ID);
+    for (int i = 0; i < 256; ++i) char_sid[i] = char_rid[i] = NONE;
   }
 
   DocState& doc(const std::string& id) {
@@ -490,31 +496,47 @@ static OpRec decode_op(Reader& r, Pool& pool, u32 actor, u32 seq,
   size_t n = r.read_map();
   for (size_t i = 0; i < n; ++i) {
     std::string_view k = r.read_str_view();
-    if (k == "action") op.action = parse_action_sv(r.read_str_view());
-    else if (k == "obj") {
+    // first-char dispatch: the op vocabulary is fixed and tiny, and this
+    // loop runs once per op field of every change in a 1M-op batch
+    const char k0 = k.empty() ? 0 : k[0];
+    if (k0 == 'a' && k == "action") {
+      op.action = parse_action_sv(r.read_str_view());
+    } else if (k0 == 'o' && k == "obj") {
       std::string_view s = r.read_str_view();
       if (dc.obj_sid == NONE || s != dc.obj_sv) {
         dc.obj_sid = pool.intern.id_of(s);
         dc.obj_sv = s;
       }
       op.obj = dc.obj_sid;
-    } else if (k == "key") op.key = pool.intern.id_of(r.read_str_view());
-    else if (k == "elem") op.elem = r.read_int();
-    else if (k == "datatype")
+    } else if (k0 == 'k' && k == "key") {
+      op.key = pool.intern.id_of(r.read_str_view());
+    } else if (k0 == 'e' && k == "elem") {
+      op.elem = r.read_int();
+    } else if (k0 == 'd' && k == "datatype") {
       op.datatype = pool.intern.id_of(r.read_str_view());
-    else if (k == "value") {
+    } else if (k0 == 'v' && k == "value") {
       if (r.peek_type() == Type::Str) {
         const uint8_t* start = r.pos();
         std::string_view s = r.read_str_view();
         std::string_view raw(reinterpret_cast<const char*>(start),
                              r.pos() - start);
-        if (dc.val_sid == NONE || raw != dc.val_sv) {
-          dc.val_sid = pool.intern.id_of(s);
-          dc.val_rid = pool.vals.id_of(raw);
-          dc.val_sv = raw;
+        if (s.size() == 1) {
+          u8 c = static_cast<u8>(s[0]);
+          if (pool.char_sid[c] == NONE) {
+            pool.char_sid[c] = pool.intern.id_of(s);
+            pool.char_rid[c] = pool.vals.id_of(raw);
+          }
+          op.value_sid = pool.char_sid[c];
+          op.value_rid = pool.char_rid[c];
+        } else {
+          if (dc.val_sid == NONE || raw != dc.val_sv) {
+            dc.val_sid = pool.intern.id_of(s);
+            dc.val_rid = pool.vals.id_of(raw);
+            dc.val_sv = raw;
+          }
+          op.value_sid = dc.val_sid;
+          op.value_rid = dc.val_rid;
         }
-        op.value_sid = dc.val_sid;
-        op.value_rid = dc.val_rid;
       } else {
         auto span = r.raw_value();
         op.value_rid = pool.vals.id_of(std::string_view(
@@ -729,6 +751,12 @@ struct Batch {
   // register kernel outputs (copied in at mid())
   std::vector<i32> k_winner, k_conflicts, k_alive;
   std::vector<u8> k_overflow;
+  // packed-mode alternative: the kernel's packed word per row (24-bit
+  // winner | 4-bit alive | overflow bit) + conflicts only for the rare
+  // rows that kept >1 member
+  std::vector<i32> k_packed;
+  FlatMap<std::array<i32, 8>> sparse_conflicts;
+  bool packed_mode = false;
   std::vector<i32> rank;        // [L]
   int window = 8;
 
@@ -1631,6 +1659,21 @@ static void collect_indexes(Batch& b) {
 
 static void register_from_kernel(Batch& b, i64 row, Register& reg) {
   reg.clear();
+  if (b.packed_mode) {
+    const i32 packed = b.k_packed[row];
+    const i32 w = packed & 0xffffff;
+    if (w != 0xffffff) reg.push_back(*b.src_records[w]);
+    if (((packed >> 24) & 0xf) > 1) {
+      auto* conf = b.sparse_conflicts.find(static_cast<u64>(row));
+      if (conf) {
+        for (int c = 0; c < b.window && c < 8; ++c) {
+          i32 s = (*conf)[c];
+          if (s >= 0) reg.push_back(*b.src_records[s]);
+        }
+      }
+    }
+    return;
+  }
   i32 w = b.k_winner[row];
   if (w >= 0) reg.push_back(*b.src_records[w]);
   for (int c = 0; c < b.window; ++c) {
@@ -1734,16 +1777,48 @@ static void write_path(Writer& w, Pool& pool, bool ok,
   }
 }
 
+// Precomputed msgpack fixstr literals for the constant patch vocabulary:
+// one memcpy instead of strlen + header branch per emission.  fixstr
+// header is 0xa0 | len (all of these are < 32 bytes).
+#define MP_LIT(name, text) \
+  static const std::string name = std::string(1, char(0xa0 | (sizeof(text) - 1))) + text
+MP_LIT(L_ACTION, "action");
+MP_LIT(L_TYPE, "type");
+MP_LIT(L_OBJ, "obj");
+MP_LIT(L_KEY, "key");
+MP_LIT(L_PATH, "path");
+MP_LIT(L_INDEX, "index");
+MP_LIT(L_ELEMID, "elemId");
+MP_LIT(L_VALUE, "value");
+MP_LIT(L_LINK, "link");
+MP_LIT(L_DATATYPE, "datatype");
+MP_LIT(L_CONFLICTS, "conflicts");
+MP_LIT(L_ACTOR, "actor");
+MP_LIT(L_SET, "set");
+MP_LIT(L_REMOVE, "remove");
+MP_LIT(L_INSERT, "insert");
+MP_LIT(L_CREATE, "create");
+MP_LIT(L_CLOCK, "clock");
+MP_LIT(L_DEPS, "deps");
+MP_LIT(L_CANUNDO, "canUndo");
+MP_LIT(L_CANREDO, "canRedo");
+MP_LIT(L_DIFFS, "diffs");
+MP_LIT(L_SEQ, "seq");
+#undef MP_LIT
+static const std::string L_TYPES[4] = {
+    std::string("\xa3") + "map", std::string("\xa4") + "list",
+    std::string("\xa4") + "text", std::string("\xa5") + "table"};
+
 static void write_conflicts(Writer& w, Pool& pool, const Register& reg) {
   w.array(reg.size() - 1);
   for (size_t i = 1; i < reg.size(); ++i) {
     const OpRec& o = reg[i];
     size_t n = 2 + (o.action == A_LINK ? 1 : 0);
     w.map(n);
-    w.str("actor"); w.str(pool.intern.str(o.actor));
-    w.str("value");
+    w.raw(L_ACTOR); w.str(pool.intern.str(o.actor));
+    w.raw(L_VALUE);
     if (o.value_rid != NONE) w.raw(val_bytes(pool, o)); else w.nil();
-    if (o.action == A_LINK) { w.str("link"); w.boolean(true); }
+    if (o.action == A_LINK) { w.raw(L_LINK); w.boolean(true); }
   }
 }
 
@@ -1751,34 +1826,34 @@ static void write_conflicts(Writer& w, Pool& pool, const Register& reg) {
 static void emit_map_diff(Writer& w, Pool& pool, DocState& st,
                           const OpRec& op, const Register& reg, u8 obj_type,
                           const std::vector<u8>& path_bytes) {
-  const char* type_ =
-      (op.obj == pool.root_sid) ? "map" : type_name(obj_type);
+  const std::string& type_ =
+      (op.obj == pool.root_sid) ? L_TYPES[T_MAP] : L_TYPES[obj_type];
   if (reg.empty()) {
     w.map(5);
-    w.str("action"); w.str("remove");
-    w.str("type"); w.str(type_);
-    w.str("obj"); w.str(pool.intern.str(op.obj));
-    w.str("key"); w.str(pool.intern.str(op.key));
-    w.str("path"); w.raw(path_bytes);
+    w.raw(L_ACTION); w.raw(L_REMOVE);
+    w.raw(L_TYPE); w.raw(type_);
+    w.raw(L_OBJ); w.str(pool.intern.str(op.obj));
+    w.raw(L_KEY); w.str(pool.intern.str(op.key));
+    w.raw(L_PATH); w.raw(path_bytes);
     return;
   }
   const OpRec& first = reg[0];
   size_t n = 6 + (first.action == A_LINK ? 1 : 0) +
              (first.datatype != NONE ? 1 : 0) + (reg.size() > 1 ? 1 : 0);
   w.map(n);
-  w.str("action"); w.str("set");
-  w.str("type"); w.str(type_);
-  w.str("obj"); w.str(pool.intern.str(op.obj));
-  w.str("key"); w.str(pool.intern.str(op.key));
-  w.str("path"); w.raw(path_bytes);
-  w.str("value");
+  w.raw(L_ACTION); w.raw(L_SET);
+  w.raw(L_TYPE); w.raw(type_);
+  w.raw(L_OBJ); w.str(pool.intern.str(op.obj));
+  w.raw(L_KEY); w.str(pool.intern.str(op.key));
+  w.raw(L_PATH); w.raw(path_bytes);
+  w.raw(L_VALUE);
   if (first.value_rid != NONE) w.raw(val_bytes(pool, first));
   else w.nil();
-  if (first.action == A_LINK) { w.str("link"); w.boolean(true); }
+  if (first.action == A_LINK) { w.raw(L_LINK); w.boolean(true); }
   if (first.datatype != NONE) {
-    w.str("datatype"); w.str(pool.intern.str(first.datatype));
+    w.raw(L_DATATYPE); w.str(pool.intern.str(first.datatype));
   }
-  if (reg.size() > 1) { w.str("conflicts"); write_conflicts(w, pool, reg); }
+  if (reg.size() > 1) { w.raw(L_CONFLICTS); write_conflicts(w, pool, reg); }
 }
 
 // emits one list/text diff and maintains visibility mirrors;
@@ -1819,21 +1894,22 @@ static bool emit_list_diff(Writer& w, Pool& pool, DocState& st,
          (first->datatype != NONE ? 1 : 0) + (reg.size() > 1 ? 1 : 0);
   }
   w.map(n);
-  w.str("action"); w.str(action);
-  w.str("type"); w.str(type_name(obj_type));
-  w.str("obj"); w.str(pool.intern.str(op.obj));
-  w.str("index"); w.integer(index);
-  w.str("path"); w.raw(path_bytes);
-  if (ins) { w.str("elemId"); w.str(kstr); }
+  w.raw(L_ACTION);
+  w.raw(action[0] == 's' ? L_SET : ins ? L_INSERT : L_REMOVE);
+  w.raw(L_TYPE); w.raw(L_TYPES[obj_type]);
+  w.raw(L_OBJ); w.str(pool.intern.str(op.obj));
+  w.raw(L_INDEX); w.integer(index);
+  w.raw(L_PATH); w.raw(path_bytes);
+  if (ins) { w.raw(L_ELEMID); w.str(kstr); }
   if (setlike) {
-    w.str("value");
+    w.raw(L_VALUE);
     if (first->value_rid != NONE) w.raw(val_bytes(pool, *first));
     else w.nil();
-    if (first->action == A_LINK) { w.str("link"); w.boolean(true); }
+    if (first->action == A_LINK) { w.raw(L_LINK); w.boolean(true); }
     if (first->datatype != NONE) {
-      w.str("datatype"); w.str(pool.intern.str(first->datatype));
+      w.raw(L_DATATYPE); w.str(pool.intern.str(first->datatype));
     }
-    if (reg.size() > 1) { w.str("conflicts"); write_conflicts(w, pool, reg); }
+    if (reg.size() > 1) { w.raw(L_CONFLICTS); write_conflicts(w, pool, reg); }
   }
   return true;
 }
@@ -1906,9 +1982,9 @@ static void emit(Pool& pool, Batch& b) {
 
     if (op.action >= A_MAKE_MAP) {
       w.map(3);
-      w.str("action"); w.str("create");
-      w.str("obj"); w.str(pool.intern.str(op.obj));
-      w.str("type"); w.str(type_name(make_type(op.action)));
+      w.raw(L_ACTION); w.raw(L_CREATE);
+      w.raw(L_OBJ); w.str(pool.intern.str(op.obj));
+      w.raw(L_TYPE); w.raw(L_TYPES[make_type(op.action)]);
       diff_counts[f.doc]++;
       continue;
     }
@@ -1982,16 +2058,16 @@ static void emit(Pool& pool, Batch& b) {
     DocState& st = *b.bdocs[d];
     out.str(b.bdoc_ids[d]);
     out.map(b.local_kind ? 7 : 5);
-    out.str("clock"); write_clock(out, pool, st.clock);
-    out.str("deps"); write_clock(out, pool, st.deps);
-    out.str("canUndo"); out.boolean(st.undo_pos > 0);
-    out.str("canRedo"); out.boolean(!st.redo_stack.empty());
-    out.str("diffs");
+    out.raw(L_CLOCK); write_clock(out, pool, st.clock);
+    out.raw(L_DEPS); write_clock(out, pool, st.deps);
+    out.raw(L_CANUNDO); out.boolean(st.undo_pos > 0);
+    out.raw(L_CANREDO); out.boolean(!st.redo_stack.empty());
+    out.raw(L_DIFFS);
     out.array(diff_counts[d]);
     out.raw(diff_bufs[d].buf);
     if (b.local_kind) {
-      out.str("actor"); out.str(pool.intern.str(b.local_actor));
-      out.str("seq"); out.integer(b.local_seq);
+      out.raw(L_ACTOR); out.str(pool.intern.str(b.local_actor));
+      out.raw(L_SEQ); out.integer(b.local_seq);
     }
   }
   b.result = std::move(out.buf);
@@ -2453,6 +2529,46 @@ int amtpu_mid_fused(void* bp, const int32_t* winner, const int32_t* conflicts,
       b.k_conflicts.assign(conflicts, conflicts + b.Tp * window);
       b.k_alive.assign(alive, alive + b.Tp);
       b.k_overflow.assign(overflow, overflow + b.Tp);
+    }
+    i64 off = 0;
+    for (auto& blk : b.dom_blocks) {
+      blk.indexes.assign(dom_idx + off, dom_idx + off + blk.W * blk.Tp);
+      off += blk.W * blk.Tp;
+    }
+    b.tr_mid = mono_now() - t0;
+  } catch (const Error& e) {
+    g_error = e.what(); g_error_kind = e.kind;
+    return -1;
+  } catch (const std::exception& e) {
+    g_error = e.what(); g_error_kind = 0;
+    return -1;
+  }
+  return 0;
+}
+
+// packed fused-path entry: the register summary stays in its packed form
+// (C++ unpacks winner/alive lazily per row) and conflicts arrive SPARSE --
+// only rows whose register kept >1 member (rare outside hot-key
+// workloads), as (row, 8 x member) pairs.  Caller must have verified no
+// overflow bit is set and b.Tp < 2^24.
+int amtpu_mid_packed(void* bp, const int32_t* packed, int window,
+                     const int32_t* conf_rows, const int32_t* conf_vals,
+                     int64_t n_conf, const int32_t* dom_idx) {
+  BatchHandle& h = *static_cast<BatchHandle*>(bp);
+  Batch& b = h.batch;
+  try {
+    if (window > 8)
+      throw Error(0, "packed conflicts carry 8 slots; window too wide");
+    double t0 = mono_now();
+    b.window = window;
+    b.packed_mode = true;
+    if (b.Tp > 0) b.k_packed.assign(packed, packed + b.Tp);
+    b.sparse_conflicts.reserve(static_cast<size_t>(n_conf) + 1);
+    for (int64_t i = 0; i < n_conf; ++i) {
+      std::array<i32, 8> row_vals;
+      for (int c = 0; c < 8; ++c) row_vals[c] = conf_vals[i * 8 + c];
+      *b.sparse_conflicts.insert(
+          static_cast<u64>(conf_rows[i])).first = row_vals;
     }
     i64 off = 0;
     for (auto& blk : b.dom_blocks) {

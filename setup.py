@@ -10,6 +10,7 @@ import subprocess
 
 from setuptools import setup
 from setuptools.command.build_py import build_py
+from setuptools.dist import Distribution
 
 
 class BuildWithNative(build_py):
@@ -18,4 +19,12 @@ class BuildWithNative(build_py):
         super().run()
 
 
-setup(cmdclass={'build_py': BuildWithNative})
+class BinaryDistribution(Distribution):
+    # the wheel ships libamtpu_core.so: it is platform-specific, not
+    # py3-none-any, even though no setuptools ext_modules are declared
+    def has_ext_modules(self):
+        return True
+
+
+setup(cmdclass={'build_py': BuildWithNative},
+      distclass=BinaryDistribution)
