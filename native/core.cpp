@@ -1825,14 +1825,15 @@ static void write_conflicts(Writer& w, Pool& pool, const Register& reg) {
 // emits one map/table diff; mirrors engine._emit_map_diff
 static void emit_map_diff(Writer& w, Pool& pool, DocState& st,
                           const OpRec& op, const Register& reg, u8 obj_type,
-                          const std::vector<u8>& path_bytes) {
+                          const std::vector<u8>& path_bytes,
+                          const std::string& obj_bytes) {
   const std::string& type_ =
       (op.obj == pool.root_sid) ? L_TYPES[T_MAP] : L_TYPES[obj_type];
   if (reg.empty()) {
     w.map(5);
     w.raw(L_ACTION); w.raw(L_REMOVE);
     w.raw(L_TYPE); w.raw(type_);
-    w.raw(L_OBJ); w.str(pool.intern.str(op.obj));
+    w.raw(L_OBJ); w.raw(obj_bytes);
     w.raw(L_KEY); w.str(pool.intern.str(op.key));
     w.raw(L_PATH); w.raw(path_bytes);
     return;
@@ -1843,7 +1844,7 @@ static void emit_map_diff(Writer& w, Pool& pool, DocState& st,
   w.map(n);
   w.raw(L_ACTION); w.raw(L_SET);
   w.raw(L_TYPE); w.raw(type_);
-  w.raw(L_OBJ); w.str(pool.intern.str(op.obj));
+  w.raw(L_OBJ); w.raw(obj_bytes);
   w.raw(L_KEY); w.str(pool.intern.str(op.key));
   w.raw(L_PATH); w.raw(path_bytes);
   w.raw(L_VALUE);
@@ -1861,7 +1862,8 @@ static void emit_map_diff(Writer& w, Pool& pool, DocState& st,
 static bool emit_list_diff(Writer& w, Pool& pool, DocState& st,
                            const OpRec& op, const Register& reg, i64 op_idx,
                            Batch& b, u8 obj_type,
-                           const std::vector<u8>& path_bytes) {
+                           const std::vector<u8>& path_bytes,
+                           const std::string& obj_bytes) {
   Arena& ar = st.arenas[op.obj];
   auto iit = b.list_index_of_op.find(op_idx);
   const std::string& kstr = pool.intern.str(op.key);
@@ -1897,7 +1899,7 @@ static bool emit_list_diff(Writer& w, Pool& pool, DocState& st,
   w.raw(L_ACTION);
   w.raw(action[0] == 's' ? L_SET : ins ? L_INSERT : L_REMOVE);
   w.raw(L_TYPE); w.raw(L_TYPES[obj_type]);
-  w.raw(L_OBJ); w.str(pool.intern.str(op.obj));
+  w.raw(L_OBJ); w.raw(obj_bytes);
   w.raw(L_INDEX); w.integer(index);
   w.raw(L_PATH); w.raw(path_bytes);
   if (ins) { w.raw(L_ELEMID); w.str(kstr); }
@@ -1953,6 +1955,30 @@ static void emit(Pool& pool, Batch& b) {
     u64 epoch = 0;
     std::vector<u8> bytes;
   } pc;
+  // encoded-object-id cache: consecutive ops target the same object, so
+  // the fixstr header + id bytes render once per run
+  struct {
+    u32 obj = NONE;
+    std::string bytes;
+  } oc;
+  auto render_obj = [&](u32 obj) -> const std::string& {
+    if (oc.obj != obj) {
+      const std::string& s = pool.intern.str(obj);
+      oc.bytes.clear();
+      if (s.size() < 32) {
+        oc.bytes.push_back(static_cast<char>(0xa0 | s.size()));
+        oc.bytes.append(s);
+      } else {
+        // rare long ids take the generic writer (str8/16/32 headers)
+        Writer tmp;
+        tmp.str(s);
+        oc.bytes.assign(tmp.buf.begin(), tmp.buf.end());
+      }
+      oc.obj = obj;
+    }
+    return oc.bytes;
+  };
+
   std::vector<PathElem> path_scratch;
   auto render_path = [&](u32 doc, DocState& st,
                          u32 obj) -> const std::vector<u8>& {
@@ -1983,7 +2009,7 @@ static void emit(Pool& pool, Batch& b) {
     if (op.action >= A_MAKE_MAP) {
       w.map(3);
       w.raw(L_ACTION); w.raw(L_CREATE);
-      w.raw(L_OBJ); w.str(pool.intern.str(op.obj));
+      w.raw(L_OBJ); w.raw(render_obj(op.obj));
       w.raw(L_TYPE); w.raw(L_TYPES[make_type(op.action)]);
       diff_counts[f.doc]++;
       continue;
@@ -2021,12 +2047,13 @@ static void emit(Pool& pool, Batch& b) {
     // inside updateMapKey/updateListElement, post inbound maintenance)
     // but BEFORE this op's visibility mutation
     const std::vector<u8>& path_bytes = render_path(f.doc, st, op.obj);
+    const std::string& obj_bytes = render_obj(op.obj);
     if (is_list_type(obj_type)) {
       if (emit_list_diff(w, pool, st, op, reg, static_cast<i64>(op_idx), b,
-                         obj_type, path_bytes))
+                         obj_type, path_bytes, obj_bytes))
         diff_counts[f.doc]++;
     } else {
-      emit_map_diff(w, pool, st, op, reg, obj_type, path_bytes);
+      emit_map_diff(w, pool, st, op, reg, obj_type, path_bytes, obj_bytes);
       diff_counts[f.doc]++;
     }
   }
