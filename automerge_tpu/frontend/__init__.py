@@ -112,7 +112,10 @@ def apply_patch_to_doc(doc, patch, state, from_backend):
     actor = get_actor_id(doc)
     inbound = dict(doc._inbound)
     updated = {}
-    apply_diffs(patch['diffs'], doc._cache, updated, inbound)
+    # the optimistic replay of pending requests (from_backend=False) may
+    # carry approximate-OT indexes; JS-array leniency applies there only
+    apply_diffs(patch['diffs'], doc._cache, updated, inbound,
+                lenient=not from_backend)
     update_parent_objects(doc._cache, updated, inbound)
 
     if from_backend:

@@ -206,8 +206,16 @@ def clone_list_object(original, object_id):
     return lst
 
 
-def update_list_object(diff, cache, updated, inbound):
-    """(reference: apply_patch.js:240-282)"""
+def update_list_object(diff, cache, updated, inbound, lenient=False):
+    """(reference: apply_patch.js:240-282)
+
+    `lenient` applies JS-array index tolerance for the pending-request
+    replay path ONLY: the frontend's operational transform is
+    deliberately approximate (frontend/index.js:146-151 admits it), and
+    the reference's transient optimistic state survives because JS
+    splice/assignment silently clamp out-of-range indexes; the backend's
+    patch replaces the transient state anyway.  Backend patches always
+    carry valid indexes and use the strict mode."""
     object_id = diff['obj']
     if object_id not in updated:
         updated[object_id] = clone_list_object(cache.get(object_id), object_id)
@@ -222,25 +230,39 @@ def update_list_object(diff, cache, updated, inbound):
             conflict = {c['actor']: get_value(c, cache, updated)
                         for c in diff['conflicts']}
 
+    index = diff.get('index')
+    if lenient and index is not None:
+        if action == 'remove' and index >= len(lst):
+            return
+        if action == 'set' and index >= len(lst):
+            action = 'insert'
+        if index > len(lst):
+            index = len(lst)
+        # the approximate OT can rewrite set->insert (remote remove at the
+        # same index) without an elemId; the transient state just needs a
+        # placeholder until the backend's patch replaces it
+        if action == 'insert' and 'elemId' not in diff:
+            diff = dict(diff, elemId='_transient:0')
+
     refs_before, refs_after = {}, {}
     if action == 'create':
         pass
     elif action == 'insert':
         lst._max_elem = max(lst._max_elem, parse_elem_id(diff['elemId'])[0])
-        list.insert(lst, diff['index'], value)
-        conflicts.insert(diff['index'], conflict)
-        elem_ids.insert(diff['index'], diff['elemId'])
-        refs_after = child_references(lst, diff['index'])
+        list.insert(lst, index, value)
+        conflicts.insert(index, conflict)
+        elem_ids.insert(index, diff['elemId'])
+        refs_after = child_references(lst, index)
     elif action == 'set':
-        refs_before = child_references(lst, diff['index'])
-        list.__setitem__(lst, diff['index'], value)
-        conflicts[diff['index']] = conflict
-        refs_after = child_references(lst, diff['index'])
+        refs_before = child_references(lst, index)
+        list.__setitem__(lst, index, value)
+        conflicts[index] = conflict
+        refs_after = child_references(lst, index)
     elif action == 'remove':
-        refs_before = child_references(lst, diff['index'])
-        list.__delitem__(lst, diff['index'])
-        del conflicts[diff['index']]
-        del elem_ids[diff['index']]
+        refs_before = child_references(lst, index)
+        list.__delitem__(lst, index)
+        del conflicts[index]
+        del elem_ids[index]
     else:
         raise RangeError('Unknown action type: ' + action)
 
@@ -354,9 +376,11 @@ def update_parent_objects(cache, updated, inbound):
                 parent_map_object(object_id, cache, updated)
 
 
-def apply_diffs(diffs, cache, updated, inbound):
+def apply_diffs(diffs, cache, updated, inbound, lenient=False):
     """Dispatches a diff list to the per-type updaters; text diffs for one
-    object are handled as a run (reference: apply_patch.js:427-450)."""
+    object are handled as a run (reference: apply_patch.js:427-450).
+    `lenient` is set only for the pending-request optimistic replay (see
+    update_list_object)."""
     start_index = 0
     for end_index in range(len(diffs)):
         diff = diffs[end_index]
@@ -368,7 +392,7 @@ def apply_diffs(diffs, cache, updated, inbound):
             update_table_object(diff, cache, updated, inbound)
             start_index = end_index + 1
         elif type_ == 'list':
-            update_list_object(diff, cache, updated, inbound)
+            update_list_object(diff, cache, updated, inbound, lenient)
             start_index = end_index + 1
         elif type_ == 'text':
             if (end_index == len(diffs) - 1
