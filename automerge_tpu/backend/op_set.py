@@ -326,7 +326,12 @@ def apply_assign(op_set, op, top_level):
         # frontend/index.js:53) order most-recently-applied first.  This is
         # the one deliberate deviation from the JS sortBy(actor).reverse(),
         # whose tie order oscillates per application; the batched register
-        # kernel's window order matches this rule exactly.
+        # kernel's window order matches this rule exactly.  NOTE: for such
+        # degenerate changes the tie order remains HISTORY-dependent
+        # (replicas that applied different delivery orders can disagree on
+        # conflict order) -- true of the reference as well; only
+        # frontend-shaped changes (one assign per key per change) carry a
+        # convergence guarantee.
         remaining.insert(0, op)
     remaining.sort(key=lambda o: o['actor'], reverse=True)
     obj = _owned_object(op_set, object_id)
