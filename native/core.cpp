@@ -776,7 +776,9 @@ struct Batch {
 
   // dominance
   std::vector<DomBlock> dom_blocks;
-  std::unordered_map<i64, std::pair<i32, i64>> list_index_of_op;
+  // op_idx -> kernel list index; INT32_MIN = no entry (dense: op ids are
+  // 0..n_ops, and ~half the headline workload's ops are list assigns)
+  std::vector<i32> list_index_of_op;
   std::unordered_map<u64, std::vector<DomEntry>> obj_ops;
   std::vector<i32> eidx_of_op;                    // op_idx -> eidx or -1
   bool fused_ok = false;
@@ -1645,14 +1647,15 @@ static void mid_phase(Pool& pool, Batch& b) {
 
 static void collect_indexes(Batch& b) {
   // map per-block kernel outputs back to op ids
+  if (b.dom_blocks.empty()) return;
+  b.list_index_of_op.assign(b.ops.size(), INT32_MIN);
   for (auto& blk : b.dom_blocks) {
     for (size_t o = 0; o < blk.akeys.size(); ++o) {
       u64 ak = blk.akeys[o];
       auto& entries = b.obj_ops[ak];
-      for (size_t t = 0; t < entries.size(); ++t) {
-        b.list_index_of_op[entries[t].op_idx] = {
-            blk.indexes[o * blk.Tp + t], entries[t].reg_row};
-      }
+      for (size_t t = 0; t < entries.size(); ++t)
+        b.list_index_of_op[entries[t].op_idx] =
+            blk.indexes[o * blk.Tp + t];
     }
   }
 }
@@ -1859,17 +1862,17 @@ static void emit_map_diff(Writer& w, Pool& pool, DocState& st,
 
 // emits one list/text diff and maintains visibility mirrors;
 // returns false when no diff is produced
-static bool emit_list_diff(Writer& w, Pool& pool, DocState& st,
+static bool emit_list_diff(Writer& w, Pool& pool, Arena& ar,
                            const OpRec& op, const Register& reg, i64 op_idx,
                            Batch& b, u8 obj_type,
                            const std::vector<u8>& path_bytes,
                            const std::string& obj_bytes) {
-  Arena& ar = st.arenas[op.obj];
-  auto iit = b.list_index_of_op.find(op_idx);
-  const std::string& kstr = pool.intern.str(op.key);
   i32 eidx = b.eidx_of_op[op_idx];  // cached by dom_layout at begin
-  if (iit == b.list_index_of_op.end() || eidx < 0) return false;
-  i32 index = iit->second.first;
+  if (eidx < 0 || op_idx >= static_cast<i64>(b.list_index_of_op.size()))
+    return false;
+  i32 index = b.list_index_of_op[op_idx];
+  if (index == INT32_MIN) return false;
+  const std::string& kstr = pool.intern.str(op.key);
   bool visible_before = ar.visible[eidx] != 0;
   bool alive = !reg.empty();
 
@@ -1961,6 +1964,11 @@ static void emit(Pool& pool, Batch& b) {
     u32 obj = NONE;
     std::string bytes;
   } oc;
+  struct {
+    u32 doc = ~0u, obj = NONE;
+    u8 type = 0;
+    Arena* arena = nullptr;
+  } tc;
   auto render_obj = [&](u32 obj) -> const std::string& {
     if (oc.obj != obj) {
       const std::string& s = pool.intern.str(obj);
@@ -2017,9 +2025,12 @@ static void emit(Pool& pool, Batch& b) {
     if (op.action == A_INS) continue;
 
     i64 row = b.assign_row_of_op[op_idx];
-    auto hit = b.host_registers.find(static_cast<i64>(op_idx));
-    if (hit != b.host_registers.end()) reg = hit->second;
-    else register_from_kernel(b, row, reg);
+    bool from_host = false;
+    if (!b.host_registers.empty()) {
+      auto hit = b.host_registers.find(static_cast<i64>(op_idx));
+      if (hit != b.host_registers.end()) { reg = hit->second; from_host = true; }
+    }
+    if (!from_host) register_from_kernel(b, row, reg);
 
     // undo capture reads the register BEFORE this op's mirror update --
     // the same interleaved order as the reference (op_set.js:193-200);
@@ -2042,7 +2053,18 @@ static void emit(Pool& pool, Batch& b) {
     }
 
     update_register_mirror(pool, st, op, reg);
-    u8 obj_type = st.objects[op.obj].type;
+    // object-type run cache: consecutive ops overwhelmingly target the
+    // same object, and an object's type never changes once created
+    u8 obj_type;
+    Arena* arp = nullptr;
+    if (f.doc == tc.doc && op.obj == tc.obj) {
+      obj_type = tc.type;
+      arp = tc.arena;
+    } else {
+      obj_type = st.objects[op.obj].type;
+      if (is_list_type(obj_type)) arp = &st.arenas[op.obj];
+      tc.doc = f.doc; tc.obj = op.obj; tc.type = obj_type; tc.arena = arp;
+    }
     // path rendered AFTER the mirror update (the reference computes it
     // inside updateMapKey/updateListElement, post inbound maintenance)
     // but BEFORE this op's visibility mutation

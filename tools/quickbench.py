@@ -1,0 +1,82 @@
+"""Fast perf-iteration harness for the host pipeline.
+
+Runs the BASELINE config-3 workload shape through ShardedNativePool once
+(after one warmup) and prints the wall time plus the AMTPU_TRACE phase
+split.  Intended for tight optimize-measure loops on the HOST phases
+(cxx.decode/schedule/encode/emit + python layer); run with
+JAX_PLATFORMS=cpu when the TPU link is down -- host-phase timings are
+device-independent.
+
+Usage:  AMTPU_TRACE=1 [JAX_PLATFORMS=cpu] python tools/quickbench.py [n_runs]
+Env:    AMTPU_BENCH_DOCS / _ACTORS / _ROUNDS / _OPS_PER_CHANGE / _SHARDS
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault('AMTPU_TRACE', '1')
+
+if os.environ.get('JAX_PLATFORMS') == 'cpu':
+    # sitecustomize may have prepended an accelerator platform ahead of the
+    # env var; pin the config back (same dance as tests/conftest.py)
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+
+import msgpack  # noqa: E402
+
+from automerge_tpu import trace  # noqa: E402
+from automerge_tpu.native import NativeDocPool, ShardedNativePool  # noqa: E402
+
+
+def env_int(name, default):
+    return int(os.environ.get(name, default))
+
+
+def main():
+    n_runs = int(sys.argv[1]) if len(sys.argv) > 1 else 3
+    n_docs = env_int('AMTPU_BENCH_DOCS', 4096)
+    n_actors = env_int('AMTPU_BENCH_ACTORS', 8)
+    n_rounds = env_int('AMTPU_BENCH_ROUNDS', 2)
+    opc = env_int('AMTPU_BENCH_OPS_PER_CHANGE', 16)
+    n_shards = env_int('AMTPU_BENCH_SHARDS', 10)
+
+    import random
+    rng = random.Random(7)
+    from automerge_tpu.parallel.mesh_encode import text_doc_changes
+    t0 = time.perf_counter()
+    batch = {}
+    for d in range(n_docs):
+        batch['text-%d' % d] = text_doc_changes(
+            'text-%d' % d, n_actors, n_rounds, opc,
+            lambda i, a, has: rng.random() < 0.15 and has)
+    total_ops = sum(len(c['ops']) for chs in batch.values() for c in chs)
+    payload = msgpack.packb(batch, use_bin_type=True)
+    print('workload: %d docs, %d ops, payload %.1f MB (built in %.1fs)'
+          % (n_docs, total_ops, len(payload) / 1e6,
+             time.perf_counter() - t0), file=sys.stderr)
+
+    # warmup (jit compile)
+    t0 = time.perf_counter()
+    ShardedNativePool(n_shards).apply_batch_bytes(payload)
+    print('warmup: %.2fs' % (time.perf_counter() - t0), file=sys.stderr)
+
+    times = []
+    for run in range(n_runs):
+        trace.reset()
+        pool = ShardedNativePool(n_shards)
+        t0 = time.perf_counter()
+        pool.apply_batch_bytes(payload)
+        dt = time.perf_counter() - t0
+        times.append(dt)
+        print('run %d: %.3fs  (%.0f ops/s)' % (run, dt, total_ops / dt),
+              file=sys.stderr)
+        if run == 0:
+            print(trace.report(), file=sys.stderr)
+    med = sorted(times)[len(times) // 2]
+    print('median: %.3fs  %.0f ops/s' % (med, total_ops / med))
+
+
+if __name__ == '__main__':
+    main()
