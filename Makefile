@@ -1,6 +1,6 @@
 # Top-level developer entry points.
 
-.PHONY: all native test bench clean wheel
+.PHONY: all native test bench check clean wheel
 
 all: native
 
@@ -12,6 +12,19 @@ test: native
 
 bench: native
 	python bench.py
+
+# The pre-commit gate: native build + full test suite + a 30s bench smoke
+# + the driver's multi-chip dryrun, all CPU-pinned so a wedged device
+# tunnel can't hang it.  Run before EVERY snapshot commit; nothing ships
+# unless this is green (the reference's analogue: `npm test`,
+# /root/reference/package.json:7).
+check: native
+	python -m pytest tests/ -q
+	JAX_PLATFORMS=cpu AMTPU_BENCH_DOCS=192 AMTPU_BENCH_ORACLE_DOCS=24 \
+	  python bench.py --config 3
+	JAX_PLATFORMS=cpu python -c "import __graft_entry__ as g; \
+	  g.dryrun_multichip(8); print('dryrun ok')"
+	@echo "CHECK GREEN"
 
 wheel: native
 	python -m pip wheel --no-deps -w dist .

@@ -42,6 +42,8 @@ def _load():
     lib = ctypes.CDLL(_LIB_PATH)
     lib.amtpu_pool_new.restype = ctypes.c_void_p
     lib.amtpu_pool_free.argtypes = [ctypes.c_void_p]
+    lib.amtpu_doc_count.restype = ctypes.c_int64
+    lib.amtpu_doc_count.argtypes = [ctypes.c_void_p]
     lib.amtpu_last_error.restype = ctypes.c_char_p
     lib.amtpu_last_error_kind.restype = ctypes.c_int
     lib.amtpu_begin.restype = ctypes.c_void_p
@@ -239,6 +241,30 @@ def _raise_last():
     raise (RangeError if kind == 1 else AutomergeError)(msg)
 
 
+def _raise_shard_errors(errors):
+    """Per-shard error reporting: a single failure re-raises with its
+    shard identified; multiple failures aggregate every shard's message
+    so no diagnosis is lost (healthy shards have already committed)."""
+    if not errors:
+        return
+    if len(errors) == 1:
+        shard, err = errors[0]
+        err.args = ('[shard %d] %s' % (shard, err.args[0] if err.args
+                                       else err),) + err.args[1:]
+        raise err
+    # aggregate, but keep the concrete exception class when every shard
+    # failed the same way so callers' except clauses still fire
+    from ..errors import AutomergeError
+    types = {type(e) for _, e in errors}
+    cls = types.pop() if len(types) == 1 else AutomergeError
+    if not issubclass(cls, (AutomergeError, TypeError)):
+        cls = AutomergeError
+    raise cls(
+        '%d shards failed: ' % len(errors) +
+        '; '.join('[shard %d] %s: %s' % (s, type(e).__name__, e)
+                  for s, e in errors)) from errors[0][1]
+
+
 class NativeDocPool:
     """C++ host runtime + JAX kernels; drop-in for TPUDocPool."""
 
@@ -257,6 +283,11 @@ class NativeDocPool:
         if getattr(self, '_pool', None) and _lib is not None:
             _lib.amtpu_pool_free(self._pool)
             self._pool = None
+
+    def doc_count(self):
+        """Number of materialized docs (tests assert queries on unknown
+        ids never create phantom state)."""
+        return lib().amtpu_doc_count(self._pool)
 
     # -- wire path ------------------------------------------------------
 
@@ -879,18 +910,17 @@ class ShardedNativePool:
             try:
                 ctxs[s] = self.pools[s]._phase_a(subs[s])
             except Exception as e:
-                errors.append(e)
+                errors.append((s, e))
         for s in range(self.n_shards):
             if ctxs[s] is None:
                 continue
             try:
                 results[s] = self.pools[s]._phase_b(ctxs[s])
             except Exception as e:
-                errors.append(e)
+                errors.append((s, e))
             finally:
                 L.amtpu_batch_free(ctxs[s]['bh'])
-        if errors:
-            raise errors[0]
+        _raise_shard_errors(errors)
         return results
 
     def _run_threaded(self, subs):
@@ -902,7 +932,7 @@ class ShardedNativePool:
                 if subs[s] is not None:
                     results[s] = self.pools[s].apply_batch_bytes(subs[s])
             except Exception as e:         # re-raised on the caller thread
-                errors.append(e)
+                errors.append((s, e))
 
         import threading
         threads = [threading.Thread(target=run, args=(s,))
@@ -911,8 +941,7 @@ class ShardedNativePool:
             t.start()
         for t in threads:
             t.join()
-        if errors:
-            raise errors[0]
+        _raise_shard_errors(errors)
         return results
 
     def apply_batch(self, changes_by_doc):

@@ -99,6 +99,14 @@ class TPUDocPool:
             self.docs[doc_id] = state
         return state
 
+    def peek(self, doc_id):
+        """Read-only lookup: unknown doc ids must NOT materialize pool
+        state (a typo'd id in a query would otherwise create a permanent
+        phantom doc).  Queries fall back to a fresh empty state instead
+        (mirrors the native runtime's find_doc, native/core.cpp)."""
+        state = self.docs.get(doc_id)
+        return state if state is not None else DocState()
+
     # ------------------------------------------------------------------
     # public API
     # ------------------------------------------------------------------
@@ -246,7 +254,7 @@ class TPUDocPool:
     def get_clock(self, doc_id):
         """{'clock': ..., 'deps': ...} without materializing the doc --
         the cheap per-round query replica catch-up gossips."""
-        state = self.doc(doc_id)
+        state = self.peek(doc_id)
         return {'clock': dict(state.clock), 'deps': dict(state.deps)}
 
     def save(self, doc_id):
@@ -254,7 +262,7 @@ class TPUDocPool:
         msgpack {'format': 'amtpu-doc-v1', 'changes': [...]} in
         application order)."""
         import msgpack
-        state = self.doc(doc_id)
+        state = self.peek(doc_id)
         changes = [state.states[a][s - 1]['change']
                    for a, s in state.history]
         return msgpack.packb({'format': 'amtpu-doc-v1',
@@ -276,7 +284,7 @@ class TPUDocPool:
 
     def get_missing_deps(self, doc_id):
         """(parity: op_set.js:359-370)"""
-        state = self.doc(doc_id)
+        state = self.peek(doc_id)
         missing = {}
         for change in state.queue:
             deps = dict(change.get('deps', {}))
@@ -288,7 +296,7 @@ class TPUDocPool:
 
     def get_missing_changes(self, doc_id, have_deps):
         """(parity: op_set.js:339-346)"""
-        state = self.doc(doc_id)
+        state = self.peek(doc_id)
         all_deps = {}
         for da, ds in have_deps.items():
             if ds <= 0:
@@ -308,7 +316,7 @@ class TPUDocPool:
 
     def get_changes_for_actor(self, doc_id, actor, after_seq=0):
         from ..backend.op_set import copy_change
-        state = self.doc(doc_id)
+        state = self.peek(doc_id)
         return [copy_change(e['change'])
                 for e in state.states.get(actor, [])[after_seq:]]
 
@@ -316,7 +324,7 @@ class TPUDocPool:
         """Whole-doc materialization patch, child-first, byte-compatible
         with the oracle's MaterializationContext
         (parity: backend/index.js:5-119)."""
-        state = self.doc(doc_id)
+        state = self.peek(doc_id)
         diffs = []
         self._materialize(state, ROOT_ID, diffs, set())
         return {

@@ -1,0 +1,29 @@
+"""JAX platform pinning shared by every CPU-only entry point.
+
+The image's sitecustomize registers an accelerator plugin and PREPENDS it
+to ``jax_platforms``, overriding a ``JAX_PLATFORMS=cpu`` environment
+variable.  Any entry point that must never touch the (possibly wedged)
+tunneled device link therefore has to pin the config back after importing
+jax -- and BEFORE the first ``jax.devices()`` call, because merely
+enumerating devices initializes the default backend.
+"""
+
+import os
+
+
+def pin_cpu(force=False):
+    """Pin jax to the CPU platform.
+
+    With ``force=False`` (the default) the pin only happens when the
+    caller's environment already requested CPU (``JAX_PLATFORMS=cpu``),
+    so production entry points keep using the real device.  ``force=True``
+    pins unconditionally (test conftest, multi-chip dryruns).
+
+    Returns True when the pin was applied.
+    """
+    if not force and os.environ.get('JAX_PLATFORMS') != 'cpu':
+        return False
+    os.environ['JAX_PLATFORMS'] = 'cpu'
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    return True

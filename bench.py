@@ -41,6 +41,10 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+# a CPU-only run (make check) must never touch a wedged device tunnel
+from automerge_tpu.utils.jaxenv import pin_cpu  # noqa: E402
+pin_cpu()
+
 from automerge_tpu.utils.common import ROOT_ID  # noqa: E402
 
 

@@ -2071,7 +2071,7 @@ static void emit(Pool& pool, Batch& b) {
     const std::vector<u8>& path_bytes = render_path(f.doc, st, op.obj);
     const std::string& obj_bytes = render_obj(op.obj);
     if (is_list_type(obj_type)) {
-      if (emit_list_diff(w, pool, st, op, reg, static_cast<i64>(op_idx), b,
+      if (emit_list_diff(w, pool, *arp, op, reg, static_cast<i64>(op_idx), b,
                          obj_type, path_bytes, obj_bytes))
         diff_counts[f.doc]++;
     } else {
@@ -2318,6 +2318,12 @@ extern "C" {
 
 void* amtpu_pool_new() { return new Pool(); }
 void amtpu_pool_free(void* p) { delete static_cast<Pool*>(p); }
+
+// number of materialized docs; lets tests assert that read-only queries
+// on unknown ids never create phantom state
+int64_t amtpu_doc_count(void* p) {
+  return static_cast<int64_t>(static_cast<Pool*>(p)->docs.size());
+}
 
 const char* amtpu_last_error() { return g_error.c_str(); }
 int amtpu_last_error_kind() { return g_error_kind; }

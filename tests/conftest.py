@@ -13,10 +13,10 @@ flags = re.sub(r'--xla_force_host_platform_device_count=\d+', '',
 os.environ['XLA_FLAGS'] = (
     flags + ' --xla_force_host_platform_device_count=8').strip()
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 # sitecustomize may have registered an accelerator platform and prepended it
 # to jax_platforms before this file runs; pin the config back to cpu (backend
 # init is lazy, so this takes effect as long as no test imported jax first)
-import jax  # noqa: E402
-jax.config.update('jax_platforms', 'cpu')
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from automerge_tpu.utils.jaxenv import pin_cpu  # noqa: E402
+pin_cpu(force=True)

@@ -335,3 +335,72 @@ class TestSingleLargeDoc:
         st, _ = Backend.apply_changes(st, chs)
         nat.apply_changes('big', chs)
         assert nat.get_patch('big') == Backend.get_patch(st)
+
+
+class TestQueryConstLookup:
+    """Queries on unknown doc ids must not materialize pool state
+    (round-2 advisor finding: phantom DocState on typo'd ids)."""
+
+    QUERIES = [
+        lambda p: p.get_patch('no-such-doc'),
+        lambda p: p.get_clock('no-such-doc'),
+        lambda p: p.get_missing_deps('no-such-doc'),
+        lambda p: p.get_missing_changes('no-such-doc', {'a0': 1}),
+        lambda p: p.get_changes_for_actor('no-such-doc', 'a0'),
+        lambda p: p.save('no-such-doc'),
+    ]
+
+    def _exercise(self, pool, doc_count):
+        pool.apply_changes('real', [{'actor': 'a0', 'seq': 1, 'deps': {},
+                                     'ops': [{'action': 'set',
+                                              'obj': ROOT_ID, 'key': 'x',
+                                              'value': 1}]}])
+        for q in self.QUERIES:
+            q(pool)
+        assert doc_count(pool) == 1
+        # and the real doc still answers correctly
+        patch = pool.get_patch('real')
+        assert patch['clock'] == {'a0': 1}
+
+    def test_python_pool(self):
+        pool = TPUDocPool()
+        self._exercise(pool, lambda p: len(p.docs))
+
+    def test_native_pool(self):
+        from automerge_tpu.native import NativeDocPool
+        pool = NativeDocPool()
+        self._exercise(pool, lambda p: p.doc_count())
+
+    def test_sharded_pool(self):
+        from automerge_tpu.native import ShardedNativePool
+        pool = ShardedNativePool(4)
+        self._exercise(pool, lambda p: sum(s.doc_count()
+                                           for s in p.pools))
+
+    def test_unknown_doc_patch_is_empty(self):
+        pool = TPUDocPool()
+        patch = pool.get_patch('ghost')
+        assert patch['clock'] == {} and patch['deps'] == {}
+        assert 'ghost' not in pool.docs
+
+
+class TestShardErrorReporting:
+    def test_error_names_failing_shard(self):
+        from automerge_tpu.native import ShardedNativePool
+        pool = ShardedNativePool(4)
+        bad = {'d%d' % i: [{'actor': 'a0', 'seq': 1, 'deps': {},
+                            'ops': [{'action': 'set', 'obj': ROOT_ID,
+                                     'key': 'k', 'value': i}]}]
+               for i in range(8)}
+        # one doc carries an inconsistent seq reuse -> its shard errors
+        victim = 'd3'
+        bad[victim] = [
+            {'actor': 'a0', 'seq': 1, 'deps': {}, 'ops': []},
+            {'actor': 'a0', 'seq': 1, 'deps': {},
+             'ops': [{'action': 'set', 'obj': ROOT_ID, 'key': 'k',
+                      'value': 9}]},
+        ]
+        shard = pool._shard_of(victim)
+        with pytest.raises(Exception) as ei:
+            pool.apply_batch(bad)
+        assert '[shard %d]' % shard in str(ei.value)

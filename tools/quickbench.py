@@ -18,16 +18,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 os.environ.setdefault('AMTPU_TRACE', '1')
 
-if os.environ.get('JAX_PLATFORMS') == 'cpu':
-    # sitecustomize may have prepended an accelerator platform ahead of the
-    # env var; pin the config back (same dance as tests/conftest.py)
-    import jax
-    jax.config.update('jax_platforms', 'cpu')
+from automerge_tpu.utils.jaxenv import pin_cpu  # noqa: E402
+pin_cpu()
 
 import msgpack  # noqa: E402
 
 from automerge_tpu import trace  # noqa: E402
-from automerge_tpu.native import NativeDocPool, ShardedNativePool  # noqa: E402
+from automerge_tpu.native import ShardedNativePool  # noqa: E402
 
 
 def env_int(name, default):
