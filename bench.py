@@ -45,6 +45,30 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from automerge_tpu.utils.jaxenv import pin_cpu  # noqa: E402
 pin_cpu()
 
+
+def probe_device(timeout_s=90):
+    """The tunneled accelerator link can wedge indefinitely inside
+    backend init (observed: make_c_api_client blocking >8 min).  Probe
+    device enumeration in a THROWAWAY subprocess first; if it hangs or
+    dies, pin this process to CPU so the bench always produces a result
+    (a CPU number beats an rc=124 timeout artifact)."""
+    import subprocess
+    if os.environ.get('JAX_PLATFORMS') == 'cpu':
+        return 'cpu (pinned by env)'
+    try:
+        out = subprocess.run(
+            [sys.executable, '-c',
+             'import jax; d = jax.devices(); print(d[0].platform, len(d))'],
+            timeout=timeout_s, capture_output=True, text=True)
+        if out.returncode == 0 and out.stdout.strip():
+            return out.stdout.strip()
+    except subprocess.TimeoutExpired:
+        pass
+    print('device probe failed/hung -> falling back to CPU',
+          file=sys.stderr)
+    pin_cpu(force=True)
+    return 'cpu (device link down)'
+
 from automerge_tpu.utils.common import ROOT_ID  # noqa: E402
 
 
@@ -472,6 +496,7 @@ def main(argv=None):
     if args.config not in (1, 2, 3, 4, 5):
         ap.error('invalid config %r (AMTPU_BENCH_CONFIG must be 1..5)'
                  % (args.config,))
+    print('device: %s' % probe_device(), file=sys.stderr)
     rng = random.Random(SEED)
     if args.config == 5:
         result = run_config_5(rng)

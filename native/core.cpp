@@ -486,9 +486,120 @@ struct DecodeCache {
   u32 val_sid = NONE, val_rid = NONE;
 };
 
+// Fixed-layout decode fast path.  The dominant op shapes in real change
+// streams are exactly {action, obj, key, elem} ("ins") and {action, obj,
+// key, value} ("set"), emitted in that key order by the frontend's op
+// builders (reference shapes: frontend/context.js:27-34; our encoders
+// preserve the same order).  One 12-byte literal memcmp replaces the
+// per-key dispatch loop; any deviation falls back to the generic decoder.
+static const u8 FP_HDR_INS[12] = {0x84, 0xa6, 'a','c','t','i','o','n',
+                                  0xa3, 'i','n','s'};
+static const u8 FP_HDR_SET[12] = {0x84, 0xa6, 'a','c','t','i','o','n',
+                                  0xa3, 's','e','t'};
+static const u8 FP_OBJ[4] = {0xa3, 'o','b','j'};
+static const u8 FP_KEY[4] = {0xa3, 'k','e','y'};
+static const u8 FP_ELEM[5] = {0xa4, 'e','l','e','m'};
+static const u8 FP_VALUE[6] = {0xa5, 'v','a','l','u','e'};
+
+static bool decode_op_fast(Reader& r, Pool& pool, u32 actor, u32 seq,
+                           DecodeCache& dc, OpRec& op) {
+  const u8* p = r.pos();
+  const u8* end = r.end();
+  if (end - p < 24) return false;
+  bool is_ins;
+  if (std::memcmp(p, FP_HDR_INS, 12) == 0) is_ins = true;
+  else if (std::memcmp(p, FP_HDR_SET, 12) == 0) is_ins = false;
+  else return false;
+  p += 12;
+  if (std::memcmp(p, FP_OBJ, 4) != 0) return false;
+  p += 4;
+  // obj value: short fixstr only on the fast path
+  u8 ob = *p;
+  if (ob < 0xa0 || ob > 0xbf) return false;
+  size_t olen = ob & 0x1f;
+  if (static_cast<size_t>(end - p) < 1 + olen + 4 + 1) return false;
+  std::string_view osv(reinterpret_cast<const char*>(p + 1), olen);
+  p += 1 + olen;
+  if (std::memcmp(p, FP_KEY, 4) != 0) return false;
+  p += 4;
+  u8 kb = *p;
+  if (kb < 0xa0 || kb > 0xbf) return false;
+  size_t klen = kb & 0x1f;
+  if (static_cast<size_t>(end - p) < 1 + klen + 6 + 1) return false;
+  std::string_view ksv(reinterpret_cast<const char*>(p + 1), klen);
+  p += 1 + klen;
+
+  op.action = is_ins ? A_INS : A_SET;
+  op.elem = -1;
+  op.actor = actor; op.seq = seq;
+  op.datatype = NONE; op.value_rid = NONE; op.value_sid = NONE;
+  if (dc.obj_sid == NONE || osv != dc.obj_sv) {
+    dc.obj_sid = pool.intern.id_of(osv);
+    dc.obj_sv = osv;
+  }
+  op.obj = dc.obj_sid;
+  op.key = pool.intern.id_of(ksv);
+
+  if (is_ins) {
+    if (std::memcmp(p, FP_ELEM, 5) != 0) return false;
+    p += 5;
+    u8 eb = *p;
+    if (eb <= 0x7f) { op.elem = eb; p += 1; }
+    else if (eb == 0xcc && end - p >= 2) { op.elem = p[1]; p += 2; }
+    else if (eb == 0xcd && end - p >= 3) {
+      op.elem = (u32(p[1]) << 8) | p[2]; p += 3;
+    } else if (eb == 0xce && end - p >= 5) {
+      op.elem = (u64(p[1]) << 24) | (u32(p[2]) << 16) |
+                (u32(p[3]) << 8) | p[4];
+      p += 5;
+    } else return false;
+  } else {
+    if (std::memcmp(p, FP_VALUE, 6) != 0) return false;
+    p += 6;
+    u8 vb = *p;
+    if (vb >= 0xa0 && vb <= 0xbf) {
+      // short string value: intern via the single-char / run caches
+      size_t vlen = vb & 0x1f;
+      if (static_cast<size_t>(end - p) < 1 + vlen) return false;
+      std::string_view s(reinterpret_cast<const char*>(p + 1), vlen);
+      std::string_view raw(reinterpret_cast<const char*>(p), 1 + vlen);
+      if (vlen == 1) {
+        u8 c = static_cast<u8>(s[0]);
+        if (pool.char_sid[c] == NONE) {
+          pool.char_sid[c] = pool.intern.id_of(s);
+          pool.char_rid[c] = pool.vals.id_of(raw);
+        }
+        op.value_sid = pool.char_sid[c];
+        op.value_rid = pool.char_rid[c];
+      } else {
+        if (dc.val_sid == NONE || raw != dc.val_sv) {
+          dc.val_sid = pool.intern.id_of(s);
+          dc.val_rid = pool.vals.id_of(raw);
+          dc.val_sv = raw;
+        }
+        op.value_sid = dc.val_sid;
+        op.value_rid = dc.val_rid;
+      }
+      p += 1 + vlen;
+    } else {
+      // non-string or long-string value: generic raw-span capture
+      Reader rv(p, end - p);
+      auto span = rv.raw_value();
+      op.value_rid = pool.vals.id_of(std::string_view(
+          reinterpret_cast<const char*>(span.first), span.second));
+      p = rv.pos();
+    }
+  }
+  r.advance_to(p);
+  return true;
+}
+
 static OpRec decode_op(Reader& r, Pool& pool, u32 actor, u32 seq,
                        DecodeCache& dc) {
   OpRec op;
+  {
+    if (decode_op_fast(r, pool, actor, seq, dc, op)) return op;
+  }
   op.action = 0xff;
   op.obj = NONE; op.key = NONE; op.elem = -1;
   op.actor = actor; op.seq = seq;
@@ -946,9 +1057,16 @@ static void update_states(Pool& pool, Batch& b, BeginJournal& j) {
     clock_set_max(base, actor, 0);  // ensure present
     // pin authoring actor at seq-1
     for (auto& p : base) if (p.first == actor) p.second = seq - 1;
+    // Exact-closure fast seed: (actor, seq-1) is always in base, and its
+    // all_deps entry is already transitively closed, so start from a copy
+    // of it.  Any other dep (da, ds) whose ds is already covered by the
+    // seed contributes nothing (closed clocks are monotone: allDeps(da,ds)
+    // is a subset of any closed clock containing da at >= ds) -- the
+    // common linear-history / gossip case skips most merges entirely.
     Clock all_deps;
+    if (seq > 1) all_deps = all_deps_of(st, actor, seq - 1);
     for (auto& [da, ds] : base) {
-      if (ds == 0) continue;
+      if (ds == 0 || clock_get(all_deps, da) >= ds) continue;
       const Clock& trans = all_deps_of(st, da, ds);
       for (auto& [ta, ts] : trans) clock_set_max(all_deps, ta, ts);
       clock_set_max(all_deps, da, ds);
@@ -1686,7 +1804,8 @@ static void register_from_kernel(Batch& b, i64 row, Register& reg) {
 }
 
 static void update_register_mirror(Pool& pool, DocState& st, const OpRec& op,
-                                   const Register& new_register) {
+                                   const Register& new_register,
+                                   ObjMeta* obj_meta, bool is_list) {
   u64 rk = DocState::rkey(op.obj, op.key);
   Register* rit = st.registers.find(rk);
   if (rit) {
@@ -1726,8 +1845,9 @@ static void update_register_mirror(Pool& pool, DocState& st, const OpRec& op,
     }
   }
   if (!rit) {
-    auto oit = st.objects.find(op.obj);
-    if (oit != st.objects.end()) oit->second.key_order.push_back(op.key);
+    // key_order drives map/table materialization only; list elements
+    // materialize via visible_order, so skip the per-elemId bookkeeping
+    if (!is_list && obj_meta) obj_meta->key_order.push_back(op.key);
     *st.registers.insert(rk).first = new_register;
   } else {
     *rit = new_register;
@@ -1826,37 +1946,120 @@ static void write_conflicts(Writer& w, Pool& pool, const Register& reg) {
 }
 
 // emits one map/table diff; mirrors engine._emit_map_diff
+// Stack-resident diff assembler: one bounds check up front, raw pointer
+// bumps for every field, ONE append into the per-doc Writer at the end.
+// The generic Writer pays a capacity check + memmove call per raw();
+// a diff is ~12 such calls of 3-10 bytes each, so the per-call overhead
+// dominates actual byte movement on the emit hot loop.
+struct DiffBuf {
+  static constexpr size_t CAP = 4096;
+  u8 tmp[CAP];
+  u8* p = tmp;
+  size_t used() const { return static_cast<size_t>(p - tmp); }
+  inline void lit(const std::string& s) {  // preencoded literal
+    std::memcpy(p, s.data(), s.size());
+    p += s.size();
+  }
+  inline void bytes(const void* d, size_t n) {
+    std::memcpy(p, d, n);
+    p += n;
+  }
+  inline void map_hdr(size_t n) { *p++ = static_cast<u8>(0x80 | n); }
+  inline void str(const std::string& s) {
+    // fast-path short strings (fixstr); longer keys take 3-byte headers
+    size_t n = s.size();
+    if (n <= 31) {
+      *p++ = static_cast<u8>(0xa0 | n);
+    } else if (n <= 0xff) {
+      *p++ = 0xd9; *p++ = static_cast<u8>(n);
+    } else {
+      *p++ = 0xda; *p++ = static_cast<u8>(n >> 8);
+      *p++ = static_cast<u8>(n & 0xff);
+    }
+    std::memcpy(p, s.data(), n);
+    p += n;
+  }
+  inline void integer(i64 v) {
+    if (v >= 0 && v <= 0x7f) { *p++ = static_cast<u8>(v); return; }
+    if (v >= 0 && v <= 0xffff) {
+      if (v <= 0xff) { *p++ = 0xcc; *p++ = static_cast<u8>(v); return; }
+      *p++ = 0xcd; *p++ = static_cast<u8>(v >> 8);
+      *p++ = static_cast<u8>(v & 0xff);
+      return;
+    }
+    Writer t;  // rare: huge indexes
+    t.integer(v);
+    bytes(t.buf.data(), t.buf.size());
+  }
+  inline void nil() { *p++ = 0xc0; }
+  inline void boolean(bool v) { *p++ = v ? 0xc3 : 0xc2; }
+};
+
 static void emit_map_diff(Writer& w, Pool& pool, DocState& st,
                           const OpRec& op, const Register& reg, u8 obj_type,
                           const std::vector<u8>& path_bytes,
                           const std::string& obj_bytes) {
   const std::string& type_ =
       (op.obj == pool.root_sid) ? L_TYPES[T_MAP] : L_TYPES[obj_type];
+  const std::string& kstr = pool.intern.str(op.key);
   if (reg.empty()) {
+    if (72 + obj_bytes.size() + kstr.size() + path_bytes.size() <=
+        DiffBuf::CAP) {
+      DiffBuf d;
+      d.map_hdr(5);
+      d.lit(L_ACTION); d.lit(L_REMOVE);
+      d.lit(L_TYPE); d.lit(type_);
+      d.lit(L_OBJ); d.lit(obj_bytes);
+      d.lit(L_KEY); d.str(kstr);
+      d.lit(L_PATH); d.bytes(path_bytes.data(), path_bytes.size());
+      w.raw(d.tmp, d.used());
+      return;
+    }
     w.map(5);
     w.raw(L_ACTION); w.raw(L_REMOVE);
     w.raw(L_TYPE); w.raw(type_);
     w.raw(L_OBJ); w.raw(obj_bytes);
-    w.raw(L_KEY); w.str(pool.intern.str(op.key));
+    w.raw(L_KEY); w.str(kstr);
     w.raw(L_PATH); w.raw(path_bytes);
     return;
   }
   const OpRec& first = reg[0];
   size_t n = 6 + (first.action == A_LINK ? 1 : 0) +
              (first.datatype != NONE ? 1 : 0) + (reg.size() > 1 ? 1 : 0);
+  const std::string* vb =
+      first.value_rid != NONE ? &val_bytes(pool, first) : nullptr;
+  const std::string* dt =
+      first.datatype != NONE ? &pool.intern.str(first.datatype) : nullptr;
+  if (reg.size() == 1 &&
+      96 + obj_bytes.size() + kstr.size() + path_bytes.size() +
+              (vb ? vb->size() : 1) + (dt ? dt->size() : 0) <=
+          DiffBuf::CAP) {
+    DiffBuf d;
+    d.map_hdr(n);
+    d.lit(L_ACTION); d.lit(L_SET);
+    d.lit(L_TYPE); d.lit(type_);
+    d.lit(L_OBJ); d.lit(obj_bytes);
+    d.lit(L_KEY); d.str(kstr);
+    d.lit(L_PATH); d.bytes(path_bytes.data(), path_bytes.size());
+    d.lit(L_VALUE);
+    if (vb) d.bytes(vb->data(), vb->size());
+    else d.nil();
+    if (first.action == A_LINK) { d.lit(L_LINK); d.boolean(true); }
+    if (dt) { d.lit(L_DATATYPE); d.str(*dt); }
+    w.raw(d.tmp, d.used());
+    return;
+  }
   w.map(n);
   w.raw(L_ACTION); w.raw(L_SET);
   w.raw(L_TYPE); w.raw(type_);
   w.raw(L_OBJ); w.raw(obj_bytes);
-  w.raw(L_KEY); w.str(pool.intern.str(op.key));
+  w.raw(L_KEY); w.str(kstr);
   w.raw(L_PATH); w.raw(path_bytes);
   w.raw(L_VALUE);
-  if (first.value_rid != NONE) w.raw(val_bytes(pool, first));
+  if (vb) w.raw(*vb);
   else w.nil();
   if (first.action == A_LINK) { w.raw(L_LINK); w.boolean(true); }
-  if (first.datatype != NONE) {
-    w.raw(L_DATATYPE); w.str(pool.intern.str(first.datatype));
-  }
+  if (dt) { w.raw(L_DATATYPE); w.str(*dt); }
   if (reg.size() > 1) { w.raw(L_CONFLICTS); write_conflicts(w, pool, reg); }
 }
 
@@ -1898,6 +2101,33 @@ static bool emit_list_diff(Writer& w, Pool& pool, Arena& ar,
     n += 1 + (first->action == A_LINK ? 1 : 0) +
          (first->datatype != NONE ? 1 : 0) + (reg.size() > 1 ? 1 : 0);
   }
+  const std::string* vb = (setlike && first->value_rid != NONE)
+                              ? &val_bytes(pool, *first) : nullptr;
+  const std::string* dt = (setlike && first->datatype != NONE)
+                              ? &pool.intern.str(first->datatype) : nullptr;
+  if (reg.size() <= 1 &&
+      96 + obj_bytes.size() + kstr.size() + path_bytes.size() +
+              (vb ? vb->size() : 1) + (dt ? dt->size() : 0) <=
+          DiffBuf::CAP) {
+    DiffBuf d;
+    d.map_hdr(n);
+    d.lit(L_ACTION);
+    d.lit(action[0] == 's' ? L_SET : ins ? L_INSERT : L_REMOVE);
+    d.lit(L_TYPE); d.lit(L_TYPES[obj_type]);
+    d.lit(L_OBJ); d.lit(obj_bytes);
+    d.lit(L_INDEX); d.integer(index);
+    d.lit(L_PATH); d.bytes(path_bytes.data(), path_bytes.size());
+    if (ins) { d.lit(L_ELEMID); d.str(kstr); }
+    if (setlike) {
+      d.lit(L_VALUE);
+      if (vb) d.bytes(vb->data(), vb->size());
+      else d.nil();
+      if (first->action == A_LINK) { d.lit(L_LINK); d.boolean(true); }
+      if (dt) { d.lit(L_DATATYPE); d.str(*dt); }
+    }
+    w.raw(d.tmp, d.used());
+    return true;
+  }
   w.map(n);
   w.raw(L_ACTION);
   w.raw(action[0] == 's' ? L_SET : ins ? L_INSERT : L_REMOVE);
@@ -1908,12 +2138,10 @@ static bool emit_list_diff(Writer& w, Pool& pool, Arena& ar,
   if (ins) { w.raw(L_ELEMID); w.str(kstr); }
   if (setlike) {
     w.raw(L_VALUE);
-    if (first->value_rid != NONE) w.raw(val_bytes(pool, *first));
+    if (vb) w.raw(*vb);
     else w.nil();
     if (first->action == A_LINK) { w.raw(L_LINK); w.boolean(true); }
-    if (first->datatype != NONE) {
-      w.raw(L_DATATYPE); w.str(pool.intern.str(first->datatype));
-    }
+    if (dt) { w.raw(L_DATATYPE); w.str(*dt); }
     if (reg.size() > 1) { w.raw(L_CONFLICTS); write_conflicts(w, pool, reg); }
   }
   return true;
@@ -1968,6 +2196,7 @@ static void emit(Pool& pool, Batch& b) {
     u32 doc = ~0u, obj = NONE;
     u8 type = 0;
     Arena* arena = nullptr;
+    ObjMeta* meta = nullptr;
   } tc;
   auto render_obj = [&](u32 obj) -> const std::string& {
     if (oc.obj != obj) {
@@ -2052,19 +2281,27 @@ static void emit(Pool& pool, Batch& b) {
       }
     }
 
-    update_register_mirror(pool, st, op, reg);
     // object-type run cache: consecutive ops overwhelmingly target the
-    // same object, and an object's type never changes once created
+    // same object, and an object's type never changes once created.
+    // Resolved BEFORE the mirror update so the mirror reuses the cached
+    // ObjMeta instead of re-probing st.objects per op.  (ObjMeta pointers
+    // are stable: st.objects is node-based and emit never erases.)
     u8 obj_type;
     Arena* arp = nullptr;
+    ObjMeta* om = nullptr;
     if (f.doc == tc.doc && op.obj == tc.obj) {
       obj_type = tc.type;
       arp = tc.arena;
+      om = tc.meta;
     } else {
-      obj_type = st.objects[op.obj].type;
+      om = &st.objects[op.obj];
+      obj_type = om->type;
       if (is_list_type(obj_type)) arp = &st.arenas[op.obj];
       tc.doc = f.doc; tc.obj = op.obj; tc.type = obj_type; tc.arena = arp;
+      tc.meta = om;
     }
+    update_register_mirror(pool, st, op, reg, om,
+                           is_list_type(obj_type));
     // path rendered AFTER the mirror update (the reference computes it
     // inside updateMapKey/updateListElement, post inbound maintenance)
     // but BEFORE this op's visibility mutation
@@ -2361,6 +2598,18 @@ void* amtpu_begin(void* pool_ptr, const uint8_t* data, int64_t len) {
     }
     b.tr_decode = mono_now() - t0;
     begin_phases(pool, h->batch, incoming);
+    if (getenv("AMTPU_TRACE_BEGIN")) {
+      double t_phases = mono_now();
+      incoming.clear();  // measure ChangeRec teardown separately
+      double t_td = mono_now();
+      fprintf(stderr,
+              "[begin] total=%.4f decode=%.4f sched=%.4f enc=%.4f "
+              "dom=%.4f teardown=%.4f gap=%.4f\n",
+              t_phases - t0, b.tr_decode, b.tr_schedule, b.tr_encode,
+              b.tr_domlay, t_td - t_phases,
+              (t_phases - t0) - b.tr_decode - b.tr_schedule -
+                  b.tr_encode - b.tr_domlay);
+    }
     // unpin the payload slab when most of it was NOT retained (duplicate-
     // heavy sync payloads re-send already-applied changes): re-adopt
     // private copies of the few retained spans so long-lived states/queue

@@ -35,6 +35,9 @@ class Reader {
 
   bool done() const { return p_ >= end_; }
   const uint8_t* pos() const { return p_; }
+  const uint8_t* end() const { return end_; }
+  // used by validated fast paths that scan ahead with raw pointers
+  void advance_to(const uint8_t* p) { p_ = p; }
 
   Type peek_type() const {
     uint8_t b = peek();

@@ -69,7 +69,8 @@ def main():
         times.append(dt)
         print('run %d: %.3fs  (%.0f ops/s)' % (run, dt, total_ops / dt),
               file=sys.stderr)
-        if run == 0:
+        if run == n_runs - 1:
+            # last run: steady state (run 0 carries warmup artifacts)
             print(trace.report(), file=sys.stderr)
     med = sorted(times)[len(times) // 2]
     print('median: %.3fs  %.0f ops/s' % (med, total_ops / med))
