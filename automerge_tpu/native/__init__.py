@@ -105,6 +105,8 @@ def _load():
     lib.amtpu_finish.argtypes = [ctypes.c_void_p]
     lib.amtpu_batch_trace.argtypes = [ctypes.c_void_p,
                                       ctypes.POINTER(ctypes.c_double)]
+    lib.amtpu_sched_counts.argtypes = [ctypes.c_void_p,
+                                       ctypes.POINTER(ctypes.c_int64)]
     lib.amtpu_result.restype = ctypes.POINTER(ctypes.c_uint8)
     lib.amtpu_result.argtypes = [ctypes.c_void_p,
                                  ctypes.POINTER(ctypes.c_int64)]
@@ -532,6 +534,10 @@ class NativeDocPool:
             for name, val in zip(('decode', 'schedule', 'encode',
                                   'mid', 'emit', 'domlay'), tr):
                 trace.add('cxx.' + name, float(val))
+            sc = (ctypes.c_int64 * 2)()
+            L.amtpu_sched_counts(bh, sc)
+            trace.count('sched.fast_path', int(sc[0]))
+            trace.count('sched.queued', int(sc[1]))
         out_len = ctypes.c_int64()
         ptr = L.amtpu_result(bh, ctypes.byref(out_len))
         return ctypes.string_at(ptr, out_len.value) \

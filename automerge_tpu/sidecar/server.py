@@ -42,6 +42,12 @@ import struct
 import sys
 
 from ..errors import AutomergeError, RangeError
+from ..utils.jaxenv import pin_cpu
+
+# honor a JAX_PLATFORMS=cpu environment (the sitecustomize-registered
+# accelerator plugin would otherwise override it and a wedged device
+# tunnel would hang the sidecar at first kernel dispatch)
+pin_cpu()
 
 
 class SidecarBackend:

@@ -513,21 +513,27 @@ static bool decode_op_fast(Reader& r, Pool& pool, u32 actor, u32 seq,
   p += 12;
   if (std::memcmp(p, FP_OBJ, 4) != 0) return false;
   p += 4;
-  // obj value: short fixstr only on the fast path
-  u8 ob = *p;
-  if (ob < 0xa0 || ob > 0xbf) return false;
-  size_t olen = ob & 0x1f;
-  if (static_cast<size_t>(end - p) < 1 + olen + 4 + 1) return false;
-  std::string_view osv(reinterpret_cast<const char*>(p + 1), olen);
-  p += 1 + olen;
+  // string header: fixstr or str8 (covers UUID object ids / 'uuid:ctr'
+  // elemIds, which msgpack encodes as str8); anything longer falls back
+  auto read_short_str = [&](std::string_view& out, size_t trailing) {
+    u8 hb = *p;
+    size_t n, hdr;
+    if (hb >= 0xa0 && hb <= 0xbf) { n = hb & 0x1f; hdr = 1; }
+    else if (hb == 0xd9) {
+      if (static_cast<size_t>(end - p) < 2) return false;
+      n = p[1]; hdr = 2;
+    } else return false;
+    if (static_cast<size_t>(end - p) < hdr + n + trailing + 1) return false;
+    out = std::string_view(reinterpret_cast<const char*>(p + hdr), n);
+    p += hdr + n;
+    return true;
+  };
+  std::string_view osv;
+  if (!read_short_str(osv, 4)) return false;
   if (std::memcmp(p, FP_KEY, 4) != 0) return false;
   p += 4;
-  u8 kb = *p;
-  if (kb < 0xa0 || kb > 0xbf) return false;
-  size_t klen = kb & 0x1f;
-  if (static_cast<size_t>(end - p) < 1 + klen + 6 + 1) return false;
-  std::string_view ksv(reinterpret_cast<const char*>(p + 1), klen);
-  p += 1 + klen;
+  std::string_view ksv;
+  if (!read_short_str(ksv, is_ins ? 5 : 6)) return false;
 
   op.action = is_ins ? A_INS : A_SET;
   op.elem = -1;
@@ -912,6 +918,10 @@ struct Batch {
   // phase wall times (seconds), read back via amtpu_batch_trace
   double tr_decode = 0, tr_schedule = 0, tr_encode = 0, tr_mid = 0,
          tr_emit = 0, tr_domlay = 0;
+  // scheduler coverage counters (wavefront measurement, docs/PERF.md):
+  // changes admitted by the in-order fast path vs through the causal
+  // queue fixpoint
+  i64 n_sched_fast = 0, n_sched_queued = 0;
 };
 
 // thread CPU time, not wall: phase costs stay truthful when sharded pools
@@ -960,9 +970,11 @@ static void schedule(Pool& pool, Batch& b,
       // fast path (the common in-order case): nothing buffered and the
       // change is causally ready -- no queue machinery at all
       if (queue.empty() && is_ready(ch)) {
+        ++b.n_sched_fast;
         admit(ch);
         continue;
       }
+      ++b.n_sched_queued;
       queue.push_back(std::move(ch));
       bool progress = true;
       while (progress) {
@@ -2953,6 +2965,12 @@ void amtpu_batch_trace(void* bp, double* out) {
   Batch& b = static_cast<BatchHandle*>(bp)->batch;
   out[0] = b.tr_decode; out[1] = b.tr_schedule; out[2] = b.tr_encode;
   out[3] = b.tr_mid; out[4] = b.tr_emit; out[5] = b.tr_domlay;
+}
+
+// scheduler coverage: [fast-path admits, queue-machinery admits]
+void amtpu_sched_counts(void* bp, int64_t* out) {
+  Batch& b = static_cast<BatchHandle*>(bp)->batch;
+  out[0] = b.n_sched_fast; out[1] = b.n_sched_queued;
 }
 
 const uint8_t* amtpu_result(void* bp, int64_t* len) {

@@ -1,0 +1,329 @@
+"""Transcript-replay coverage for `adapter/backend-tpu.js`.
+
+Node is not available in this image, so the adapter's code path is
+exercised from Python instead (VERDICT r2 #6):
+
+1. The adapter source is PARSED and its wire protocol extracted -- every
+   `request('<cmd>', {fields})` call site and the worker's framing
+   (JSON line on stdin, JSON line on stdout, FIFO reply order).  If the
+   adapter drifts, the mirror assertions below fail.
+2. `AdapterMirror` re-implements the adapter's Backend surface
+   (init/applyChanges/applyLocalChange/getPatch/getChanges/
+   getChangesForActor/getMissingChanges/getMissingDeps/merge) issuing
+   byte-identical request envelopes to a REAL sidecar server subprocess
+   (`python -m automerge_tpu.sidecar.server`), the same process the
+   worker thread spawns.
+3. A reference-frontend-shaped session runs with the mirror as the
+   frontend's immediate backend (`options.backend`, the injection seam
+   the reference designed: frontend/index.js:98): init -> change ->
+   applyChanges -> undo -> redo -> save/load -- and the materialized
+   results must equal an in-process oracle run.
+4. The worker/Atomics rendezvous serializes callers: replies come back
+   in request order (`pending.shift()` per stdout line).  The pipelined
+   test writes several requests before draining replies and asserts the
+   FIFO pairing the rendezvous depends on.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+import automerge_tpu as am
+from automerge_tpu import backend as OracleBackend
+from automerge_tpu import frontend as Frontend
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ADAPTER_JS = os.path.join(REPO, 'adapter', 'backend-tpu.js')
+
+# The adapter's cmd -> request-field mapping, mirrored by hand; the
+# drift test below re-derives this from the .js source.
+ADAPTER_PROTOCOL = {
+    'apply_changes': ['doc', 'changes'],
+    'apply_local_change': ['doc', 'request'],
+    'get_patch': ['doc'],
+    'get_missing_changes': ['doc', 'have_deps'],
+    'get_changes_for_actor': ['doc', 'actor'],
+    'get_missing_deps': ['doc'],
+}
+
+
+def test_adapter_source_matches_mirrored_protocol():
+    """Parse request('cmd', {field: ...}) call sites out of the adapter
+    and compare with the mirror's table, so adapter drift fails here."""
+    src = open(ADAPTER_JS).read()
+    sites = re.findall(
+        r"request\('([a-z_]+)',\s*\n?\s*\{([^}]*)\}", src)
+    assert sites, 'no request() call sites found in adapter'
+    seen = {}
+    for cmd, fields in sites:
+        keys = [k.strip().split(':')[0].strip()
+                for k in fields.split(',') if k.strip()]
+        seen.setdefault(cmd, keys)
+    assert seen == ADAPTER_PROTOCOL
+    # worker framing: JSON line request, FIFO pending queue, stdio spawn
+    assert r"JSON.stringify(request) + '\\n'" in src
+    assert 'pending.shift()' in src
+    assert "spawn(workerData.python, ['-m', 'automerge_tpu.sidecar.server']"\
+        in src
+    # rendezvous: SharedArrayBuffer signal + Atomics wait/notify
+    for token in ('Atomics.wait(signal, 0, 0)', 'Atomics.notify(signal, 0)',
+                  'receiveMessageOnPort'):
+        assert token in src, token
+
+
+class SidecarProcess:
+    """The exact process + framing the adapter's worker owns."""
+
+    def __init__(self):
+        env = dict(os.environ, JAX_PLATFORMS='cpu')
+        self.proc = subprocess.Popen(
+            [sys.executable, '-m', 'automerge_tpu.sidecar.server'],
+            cwd=REPO, env=env, stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE, stderr=sys.stderr.fileno()
+            if hasattr(sys.stderr, 'fileno') else None, text=True)
+        self.next_id = 1
+
+    def write_request(self, cmd, fields):
+        req = dict({'id': self.next_id, 'cmd': cmd}, **fields)
+        self.next_id += 1
+        self.proc.stdin.write(json.dumps(req) + '\n')
+        self.proc.stdin.flush()
+        return req['id']
+
+    def next_doc_id(self):
+        # the adapter keeps the doc counter on the SHARED connection
+        # (conn.nextDoc++), not per backend instance
+        n = getattr(self, '_next_doc', 1)
+        self._next_doc = n + 1
+        return 'doc-%d' % n
+
+    def read_response(self):
+        line = self.proc.stdout.readline()
+        assert line, 'sidecar died'
+        return json.loads(line)
+
+    def request(self, cmd, fields):
+        """The adapter's SidecarConnection.request: write one line, block
+        for one reply, raise typed errors."""
+        self.write_request(cmd, fields)
+        response = self.read_response()
+        if 'error' in response and response['error']:
+            kind = response.get('errorType')
+            if kind == 'TypeError':
+                raise TypeError(response['error'])
+            if kind == 'RangeError':
+                raise am.errors.RangeError(response['error'])
+            raise am.errors.AutomergeError(response['error'])
+        return response['result']
+
+    def close(self):
+        self.proc.stdin.close()
+        self.proc.wait(timeout=30)
+
+
+class Token(dict):
+    """The adapter's frozen {docId, clock} backend-state value."""
+
+    def __init__(self, doc_id, clock):
+        super().__init__(docId=doc_id, clock=dict(clock))
+
+
+class AdapterMirror:
+    """backend-tpu.js's exported surface, request-for-request."""
+
+    def __init__(self, conn):
+        self.conn = conn
+
+    def init(self):
+        return Token(self.conn.next_doc_id(), {})
+
+    def apply_changes(self, state, changes):
+        patch = self.conn.request('apply_changes',
+                                  {'doc': state['docId'],
+                                   'changes': changes})
+        return Token(state['docId'], patch['clock']), patch
+
+    def apply_local_change(self, state, change):
+        patch = self.conn.request('apply_local_change',
+                                  {'doc': state['docId'],
+                                   'request': change})
+        return Token(state['docId'], patch['clock']), patch
+
+    def get_patch(self, state):
+        return self.conn.request('get_patch', {'doc': state['docId']})
+
+    def get_changes(self, old_state, new_state):
+        if old_state['docId'] != new_state['docId']:
+            raise am.errors.RangeError(
+                'Cannot diff two states from different documents')
+        return self.conn.request('get_missing_changes',
+                                 {'doc': new_state['docId'],
+                                  'have_deps': old_state['clock']})
+
+    def get_changes_for_actor(self, state, actor_id):
+        return self.conn.request('get_changes_for_actor',
+                                 {'doc': state['docId'],
+                                  'actor': actor_id})
+
+    def get_missing_changes(self, state, clock):
+        return self.conn.request('get_missing_changes',
+                                 {'doc': state['docId'],
+                                  'have_deps': clock or {}})
+
+    def get_missing_deps(self, state):
+        return self.conn.request('get_missing_deps',
+                                 {'doc': state['docId']})
+
+    def merge(self, local, remote):
+        changes = self.conn.request('get_missing_changes',
+                                    {'doc': remote['docId'],
+                                     'have_deps': local['clock']})
+        return self.apply_changes(local, changes)
+
+
+@pytest.fixture(scope='module')
+def sidecar():
+    conn = SidecarProcess()
+    yield conn
+    conn.close()
+
+
+def materialize(patch):
+    from automerge_tpu.sync.replica_set import patch_to_tree
+    return patch_to_tree(patch)
+
+
+class TestReferenceShapedSession:
+    """init -> change -> applyChanges -> undo -> redo -> save/load, with
+    the adapter mirror as the frontend's immediate backend."""
+
+    def test_full_session(self, sidecar):
+        adapter = AdapterMirror(sidecar)
+
+        # --- init + local changes (applyLocalChange through the wire) --
+        doc = Frontend.init({'actorId': 'frontend-actor',
+                             'backend': adapter})
+        doc, _ = Frontend.change(doc, None,
+                                 lambda d: d.update({'title': 'hello'}))
+        doc, _ = Frontend.change(doc, None,
+                                 lambda d: d.__setitem__('n', 1))
+        assert doc['title'] == 'hello' and doc['n'] == 1
+
+        # oracle runs the identical session in-process
+        odoc = am.init('frontend-actor')
+        odoc = am.change(odoc, lambda d: d.update({'title': 'hello'}))
+        odoc = am.change(odoc, lambda d: d.__setitem__('n', 1))
+
+        # --- remote ingestion (applyChanges through the wire) ----------
+        remote = am.init('remote-actor')
+        remote = am.change(remote, lambda d: d.__setitem__('remote', True))
+        remote_changes = am.get_changes(am.init('x'), remote)
+
+        state = Frontend.get_backend_state(doc)
+        state, patch = adapter.apply_changes(state, remote_changes)
+        patch['state'] = state
+        doc = Frontend.apply_patch(doc, patch)
+        assert doc['remote'] is True
+
+        oracle_state, opatch = OracleBackend.apply_changes(
+            Frontend.get_backend_state(odoc), remote_changes)
+        opatch['state'] = oracle_state
+        odoc = am.apply_changes(odoc, remote_changes)
+
+        # wire patch diffs equal the oracle's for the same ingestion
+        assert patch['diffs'] == opatch['diffs']
+        assert patch['clock'] == opatch['clock']
+
+        # --- undo / redo (requestType through the wire) ----------------
+        assert Frontend.can_undo(doc)
+        doc, _ = Frontend.undo(doc, None)
+        assert 'n' not in doc or doc['n'] is None
+        doc, _ = Frontend.redo(doc, None)
+        assert doc['n'] == 1
+        odoc = am.undo(odoc)
+        odoc = am.redo(odoc)
+
+        # --- whole-doc parity through getPatch -------------------------
+        wire_tree = materialize(adapter.get_patch(
+            Frontend.get_backend_state(doc)))
+        oracle_tree = materialize(OracleBackend.get_patch(
+            Frontend.get_backend_state(odoc)))
+        assert wire_tree == oracle_tree
+
+        # --- getMissingDeps / getChangesForActor -----------------------
+        assert adapter.get_missing_deps(
+            Frontend.get_backend_state(doc)) == {}
+        mine = adapter.get_changes_for_actor(
+            Frontend.get_backend_state(doc), 'frontend-actor')
+        assert [c['seq'] for c in mine] == [1, 2, 3, 4]
+
+        # --- save / load through the sidecar ---------------------------
+        token = Frontend.get_backend_state(doc)
+        saved = sidecar.request('save', {'doc': token['docId']})
+        assert 'checkpoint_b64' in saved
+        restored = 'restored-doc'
+        sidecar.request('load', {'doc': restored,
+                                 'data': saved['checkpoint_b64']})
+        tree = materialize(sidecar.request('get_patch', {'doc': restored}))
+        assert tree == wire_tree
+
+    def test_merge_between_two_wire_docs(self, sidecar):
+        adapter = AdapterMirror(sidecar)
+        a = Frontend.init({'actorId': 'aaaa', 'backend': adapter})
+        b = Frontend.init({'actorId': 'bbbb', 'backend': adapter})
+        a, _ = Frontend.change(a, None, lambda d: d.__setitem__('x', 1))
+        b, _ = Frontend.change(b, None, lambda d: d.__setitem__('y', 2))
+        sa = Frontend.get_backend_state(a)
+        sb = Frontend.get_backend_state(b)
+        merged_state, patch = adapter.merge(sa, sb)
+        assert patch['clock'] == {'aaaa': 1, 'bbbb': 1}
+        tree = materialize(adapter.get_patch(merged_state))
+        # oracle: same two changes into one in-process backend
+        ost = OracleBackend.init()
+        for src in (sa, sb):
+            changes = adapter.get_changes_for_actor(
+                src, 'aaaa' if src is sa else 'bbbb')
+            ost, _ = OracleBackend.apply_changes(ost, changes)
+        assert tree == materialize(OracleBackend.get_patch(ost))
+
+    def test_typed_errors_cross_the_wire(self, sidecar):
+        adapter = AdapterMirror(sidecar)
+        state = adapter.init()
+        with pytest.raises(TypeError):
+            adapter.apply_local_change(state, {'requestType': 'change',
+                                               'ops': []})
+        state, _ = adapter.apply_local_change(
+            state, {'requestType': 'change', 'actor': 'e', 'seq': 1,
+                    'deps': {}, 'ops': []})
+        with pytest.raises(am.errors.RangeError):
+            adapter.apply_local_change(
+                state, {'requestType': 'change', 'actor': 'e', 'seq': 1,
+                        'deps': {}, 'ops': []})
+
+
+class TestRendezvousFIFO:
+    """The worker pairs replies to callers strictly FIFO
+    (pending.push on request, pending.shift per stdout line); several
+    requests written before any reply is drained must come back in
+    request order with matching ids."""
+
+    def test_pipelined_replies_in_request_order(self, sidecar):
+        ids = []
+        for i in range(5):
+            ids.append(sidecar.write_request(
+                'apply_changes',
+                {'doc': 'fifo-doc',
+                 'changes': [{'actor': 'f', 'seq': i + 1, 'deps': {},
+                              'ops': [{'action': 'set',
+                                       'obj': '00000000-0000-0000-0000-'
+                                              '000000000000',
+                                       'key': 'k%d' % i,
+                                       'value': i}]}]}))
+        replies = [sidecar.read_response() for _ in range(5)]
+        assert [r['id'] for r in replies] == ids
+        clocks = [r['result']['clock']['f'] for r in replies]
+        assert clocks == [1, 2, 3, 4, 5]

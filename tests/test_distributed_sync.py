@@ -1,0 +1,24 @@
+"""Multi-process replica sync (VERDICT r2 #7): change shipping crosses
+process boundaries (TCP mesh, the DCN stand-in) while clock gossip rides
+jax.distributed collectives; each worker verifies convergence of every
+replica in every process against the scalar oracle before reporting OK.
+"""
+
+import re
+
+import pytest
+
+from automerge_tpu.sync.distributed import launch
+
+
+@pytest.mark.parametrize('n_processes', [2, 3])
+def test_cross_process_convergence(n_processes):
+    outs = launch(n_processes, timeout=300)
+    assert len(outs) == n_processes
+    for pid, out in enumerate(outs):
+        m = re.search(r'DISTRIBUTED-OK pid=%d rounds=\[([0-9, ]+)\]' % pid,
+                      out)
+        assert m, 'worker %d did not report OK:\n%s' % (pid, out)
+        rounds = [int(x) for x in m.group(1).split(',')]
+        # converges (last round plans nothing) and actually shipped work
+        assert rounds[-1] == 0 and sum(rounds) > 0

@@ -1,0 +1,254 @@
+"""Dedicated proxy-surface tests: the proxies inside change() must behave
+like plain Python dicts/lists, mirroring the reference's expectation that
+its ES proxies behave like plain JS objects/arrays
+(reference: /root/reference/test/proxies_test.js, 459 LoC).
+"""
+
+import json
+
+import pytest
+
+import automerge_tpu as am
+from automerge_tpu.errors import RangeError
+
+
+def change(doc, fn):
+    return am.change(doc, fn)
+
+
+class TestMapProxy:
+    def test_instanceof_like_shape(self):
+        def cb(doc):
+            assert doc._type == 'map'
+            assert doc._objectId == '00000000-0000-0000-0000-000000000000'
+        change(am.init(), cb)
+
+    def test_getitem_and_attribute_access(self):
+        def cb(doc):
+            doc['key1'] = 'value1'
+            assert doc['key1'] == 'value1'
+            assert doc.key1 == 'value1'
+        change(am.init(), cb)
+
+    def test_unknown_key_returns_none(self):
+        def cb(doc):
+            assert doc.get('missing') is None
+            assert doc.get('missing', 'dflt') == 'dflt'
+        change(am.init(), cb)
+
+    def test_underscore_attributes_raise(self):
+        def cb(doc):
+            with pytest.raises(AttributeError):
+                doc._nonexistent_private
+        change(am.init(), cb)
+
+    def test_in_operator(self):
+        def cb(doc):
+            doc['key1'] = 'value1'
+            assert 'key1' in doc
+            assert 'key2' not in doc
+        change(am.init(), cb)
+
+    def test_keys_values_items_iteration(self):
+        def cb(doc):
+            doc['key1'] = 'v1'
+            doc['key2'] = 'v2'
+            assert doc.keys() == ['key1', 'key2']
+            assert doc.values() == ['v1', 'v2']
+            assert doc.items() == [('key1', 'v1'), ('key2', 'v2')]
+            assert list(iter(doc)) == ['key1', 'key2']
+            assert len(doc) == 2
+        change(am.init(), cb)
+
+    def test_set_del_attribute_style(self):
+        def cb(doc):
+            doc.key1 = 'value1'
+            assert doc['key1'] == 'value1'
+            del doc.key1
+            assert 'key1' not in doc
+
+        def cb2(doc):
+            doc['key2'] = 'value2'
+            del doc['key2']
+            assert doc.get('key2') is None
+        change(am.init(), cb)
+        change(am.init(), cb2)
+
+    def test_update_bulk_assign(self):
+        d = change(am.init(), lambda doc: doc.update(
+            {'a': 1, 'b': 'two', 'c': None}))
+        assert d['a'] == 1 and d['b'] == 'two' and d['c'] is None
+
+    def test_nested_object_creation_returns_proxy(self):
+        def cb(doc):
+            doc['nested'] = {'deep': {'leaf': 7}}
+            assert doc['nested']._type == 'map'
+            assert doc['nested']['deep']['leaf'] == 7
+            doc['nested']['deep']['leaf'] = 8
+            assert doc['nested']['deep']['leaf'] == 8
+        d = change(am.init(), cb)
+        assert d['nested']['deep']['leaf'] == 8
+
+    def test_json_round_trip_of_materialized_doc(self):
+        d = change(am.init(), lambda doc: doc.update(
+            {'s': 'x', 'n': 3, 'list': [1, 2, {'k': 'v'}]}))
+        # the frozen materialized doc serializes like plain data
+        as_json = json.loads(json.dumps(
+            {'s': d['s'], 'n': d['n'],
+             'list': [d['list'][0], d['list'][1], dict(d['list'][2])]}))
+        assert as_json == {'s': 'x', 'n': 3, 'list': [1, 2, {'k': 'v'}]}
+
+    def test_overwrite_and_delete_missing_is_noop_like(self):
+        def cb(doc):
+            doc['k'] = 1
+            doc['k'] = 2
+            assert doc['k'] == 2
+        change(am.init(), cb)
+
+
+class TestListProxy:
+    def make(self, items=('a', 'b', 'c')):
+        return change(am.init(), lambda doc: doc.__setitem__(
+            'list', list(items)))
+
+    def test_type_and_object_id(self):
+        def cb(doc):
+            doc['list'] = [1]
+            assert doc['list']._type == 'list'
+            assert isinstance(doc['list']._objectId, str)
+        change(am.init(), cb)
+
+    def test_getitem_len_iter_contains(self):
+        def cb(doc):
+            lst = doc['list']
+            assert lst[0] == 'a' and lst[2] == 'c'
+            assert len(lst) == 3 and lst.length == 3
+            assert list(lst) == ['a', 'b', 'c']
+            assert 'b' in lst and 'z' not in lst
+        change(self.make(), cb)
+
+    def test_slice_and_negative_free_indexing(self):
+        def cb(doc):
+            lst = doc['list']
+            assert lst[0:2] == ['a', 'b']
+            assert lst.slice(1) == ['b', 'c']
+            assert lst.slice(0, 2) == ['a', 'b']
+        change(self.make(), cb)
+
+    def test_string_indexes_accepted(self):
+        def cb(doc):
+            assert doc['list']['1'] == 'b'
+            doc['list']['1'] = 'B'
+            assert doc['list'][1] == 'B'
+        change(self.make(), cb)
+
+    def test_bad_indexes_raise(self):
+        def cb(doc):
+            with pytest.raises(TypeError):
+                doc['list'][1.5]
+            with pytest.raises(RangeError):
+                doc['list'][-1]
+            with pytest.raises(TypeError):
+                doc['list'][True]
+        change(self.make(), cb)
+
+    def test_read_only_helpers(self):
+        def cb(doc):
+            lst = doc['list']
+            assert lst.index_of('b') == 1
+            assert lst.index_of('zz') == -1
+            assert lst.includes('c') and not lst.includes('q')
+            assert lst.join('-') == 'a-b-c'
+            assert lst.map(str.upper) == ['A', 'B', 'C']
+            assert lst.filter(lambda v: v != 'b') == ['a', 'c']
+        change(self.make(), cb)
+
+    def test_setitem_delitem(self):
+        d = change(self.make(), lambda doc: doc['list'].__setitem__(1, 'B'))
+        assert list(d['list']) == ['a', 'B', 'c']
+        d = change(d, lambda doc: doc['list'].__delitem__(0))
+        assert list(d['list']) == ['B', 'c']
+
+    def test_delete_at_multi(self):
+        d = change(self.make('abcdef'),
+                   lambda doc: doc['list'].delete_at(1, 3))
+        assert list(d['list']) == ['a', 'e', 'f']
+
+    def test_insert_at_and_insert(self):
+        d = change(self.make(), lambda doc: doc['list'].insert_at(1, 'x', 'y'))
+        assert list(d['list']) == ['a', 'x', 'y', 'b', 'c']
+        d = change(d, lambda doc: doc['list'].insert(0, 'z'))
+        assert list(d['list']) == ['z', 'a', 'x', 'y', 'b', 'c']
+
+    def test_push_append_extend(self):
+        def cb(doc):
+            doc['list'].push('d', 'e')
+            doc['list'].append('f')
+            doc['list'].extend(['g', 'h'])
+        d = change(self.make(), cb)
+        assert list(d['list']) == list('abcdefgh')
+
+    def test_pop_and_shift_return_values(self):
+        def cb(doc):
+            assert doc['list'].pop() == 'c'
+            assert doc['list'].shift() == 'a'
+            assert list(doc['list']) == ['b']
+        change(self.make(), cb)
+
+    def test_pop_empty_returns_none(self):
+        def cb(doc):
+            doc['empty'] = []
+            assert doc['empty'].pop() is None
+            assert doc['empty'].shift() is None
+        change(am.init(), cb)
+
+    def test_unshift(self):
+        d = change(self.make(), lambda doc: doc['list'].unshift('x', 'y'))
+        assert list(d['list']) == ['x', 'y', 'a', 'b', 'c']
+
+    def test_splice_returns_deleted(self):
+        def cb(doc):
+            deleted = doc['list'].splice(1, 2, 'X')
+            assert deleted == ['b', 'c']
+            assert list(doc['list']) == ['a', 'X']
+        change(self.make(), cb)
+
+    def test_splice_default_deletes_to_end(self):
+        def cb(doc):
+            deleted = doc['list'].splice(1)
+            assert deleted == ['b', 'c']
+            assert list(doc['list']) == ['a']
+        change(self.make(), cb)
+
+    def test_fill(self):
+        d = change(self.make('abcde'),
+                   lambda doc: doc['list'].fill('z', 1, 4))
+        assert list(d['list']) == ['a', 'z', 'z', 'z', 'e']
+        d = change(d, lambda doc: doc['list'].fill('q'))
+        assert list(d['list']) == ['q'] * 5
+
+    def test_nested_objects_in_lists(self):
+        def cb(doc):
+            doc['list'] = [{'k': 1}, [2, 3]]
+            assert doc['list'][0]._type == 'map'
+            assert doc['list'][0]['k'] == 1
+            assert doc['list'][1]._type == 'list'
+            doc['list'][0]['k'] = 9
+        d = change(am.init(), cb)
+        assert d['list'][0]['k'] == 9
+        assert list(d['list'][1]) == [2, 3]
+
+    def test_mutations_persist_across_changes(self):
+        d = self.make()
+        d = change(d, lambda doc: doc['list'].push('d'))
+        d = change(d, lambda doc: doc['list'].delete_at(0))
+        assert list(d['list']) == ['b', 'c', 'd']
+
+    def test_camelcase_aliases(self):
+        def cb(doc):
+            lst = doc['list']
+            assert lst.indexOf('b') == 1
+            lst.insertAt(0, 'z')
+            lst.deleteAt(0)
+            assert list(lst) == ['a', 'b', 'c']
+        change(self.make(), cb)
