@@ -107,6 +107,20 @@ def _load():
                                       ctypes.POINTER(ctypes.c_double)]
     lib.amtpu_sched_counts.argtypes = [ctypes.c_void_p,
                                        ctypes.POINTER(ctypes.c_int64)]
+    lib.amtpu_dom_obj_meta.restype = ctypes.c_int64
+    lib.amtpu_dom_obj_meta.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                       ctypes.POINTER(ctypes.c_int64)]
+    lib.amtpu_batch_doc_id.restype = ctypes.c_char_p
+    lib.amtpu_batch_doc_id.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.amtpu_intern_str.restype = ctypes.c_char_p
+    lib.amtpu_intern_str.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+    lib.amtpu_arena_raw.restype = ctypes.c_int64
+    lib.amtpu_arena_raw.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_int32)),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint32)),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_int32)),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8))]
     lib.amtpu_result.restype = ctypes.POINTER(ctypes.c_uint8)
     lib.amtpu_result.argtypes = [ctypes.c_void_p,
                                  ctypes.POINTER(ctypes.c_int64)]
@@ -278,6 +292,8 @@ class NativeDocPool:
 
     def __init__(self):
         self._pool = lib().amtpu_pool_new()
+        from .resident import ResidentCache
+        self._resident = ResidentCache()
 
     def __del__(self):
         # read the module global directly: at interpreter shutdown the
@@ -335,9 +351,11 @@ class NativeDocPool:
             L.amtpu_batch_dims(bh, dims)
             (T, Tp, A, Ap, Larena, Lp, n_blocks, max_obj, CTp,
              use_members, any_ovf) = [int(x) for x in dims]
-            fdims = (ctypes.c_int64 * 4)()
+            # 6 slots -- must match what amtpu_fused_dims writes exactly
+            # (an undersized ctypes buffer is silent heap corruption)
+            fdims = (ctypes.c_int64 * 6)()
             L.amtpu_fused_dims(bh, fdims)
-            fused_ok, W, dLp, dTp = [int(x) for x in fdims]
+            fused_ok, W, dLp, dTp, resident_ok, _ = [int(x) for x in fdims]
             trace.count('ops.register_rows', T)
             trace.count('ops.arena_elems', Larena)
             # member-window mode (hot keys): explicit candidate indexes +
@@ -349,7 +367,8 @@ class NativeDocPool:
                 hovf = np.ctypeslib.as_array(L.amtpu_col_hostovf(bh),
                                              shape=(Tp,))
             ctx.update(dims=(T, Tp, A, Ap, Larena, Lp, n_blocks, max_obj,
-                             CTp), mem=mem, hovf=hovf)
+                             CTp), mem=mem, hovf=hovf,
+                       resident_ok=bool(resident_ok))
 
             if fused_ok:
                 with trace.span('device.dispatch'):
@@ -422,6 +441,10 @@ class NativeDocPool:
             ctx.update(mode='fused', combo=combo, reg_out=reg_out,
                        rank=None)
             return
+        if ctx.get('resident_ok') and mem is None and \
+                self._dispatch_resident(L, ctx, Tp, Ap, CTp, max_obj,
+                                        dLp, dTp):
+            return
         e = self._arena_views(L, bh, Lp)
         n_iters = list_rank.ceil_log2(max(max_obj, 1)) + 1
         v0 = np.ctypeslib.as_array(L.amtpu_dom_v0(bh, 0), shape=(W, dLp))
@@ -442,6 +465,80 @@ class NativeDocPool:
             window=self.WINDOW, mem_idx=mem)
         combo.copy_to_host_async()
         ctx.update(mode='fused', combo=combo, reg_out=reg_out, rank=rank)
+
+    def _dispatch_resident(self, L, ctx, Tp, Ap, CTp, max_obj, dLp, dTp):
+        """Fused dispatch over the DEVICE-RESIDENT arena (single big
+        list object): uploads only per-batch deltas; the arena columns,
+        visibility vector, and in-graph sibling sort live on device
+        between batches (SURVEY hard part 5).  Returns False to fall
+        back to the standard fused path (C++ refills the skipped
+        layout arrays lazily)."""
+        from ..ops import list_rank
+        from .resident import _jit_kernel
+        # Residency trades per-batch H2D of the whole arena for an
+        # in-graph sibling sort: a clear win over a real device link,
+        # a loss on the CPU backend where "transfers" are memcpys.
+        # Default: on for accelerators, off for CPU; AMTPU_RESIDENT=1/0
+        # overrides either way (C++ skips its O(arena) layout fills
+        # optimistically and refills lazily when Python declines).
+        env = os.environ.get('AMTPU_RESIDENT')
+        if env is None:
+            import jax
+            if jax.default_backend() == 'cpu':
+                return False
+        bh = ctx['bh']
+        meta = (ctypes.c_int64 * 4)()
+        L.amtpu_dom_obj_meta(bh, 0, meta)
+        doc_idx, obj_sid, base, n_now = [int(x) for x in meta]
+        if base != 0 or n_now <= 0 or n_now > dLp:
+            return False
+        doc_id = L.amtpu_batch_doc_id(bh, doc_idx)
+        entry = self._resident.get_entry(L, self._pool, doc_id, obj_sid,
+                                         n_now, dLp)
+        if entry is None:
+            return False
+        r = self._register_views(L, bh, Tp, Ap, CTp)
+        oe = np.ctypeslib.as_array(L.amtpu_dom_oe(bh, 0), shape=(1, dTp))
+        dom_src = np.ctypeslib.as_array(L.amtpu_fdom_domsrc(bh),
+                                        shape=(1, dTp))
+        ov = np.ctypeslib.as_array(L.amtpu_dom_ov(bh, 0), shape=(1, dTp))
+        n_iters = list_rank.ceil_log2(max(max_obj, 1)) + 1
+        # entry.dirty until the post-emit visibility sync lands: a batch
+        # that errors in between leaves the device ev unsynced
+        entry.dirty = True
+        fn = _jit_kernel(n_iters, self.WINDOW, 64)
+        reg_out, rank, combo = fn(
+            r['g'], r['t'], r['a'], r['s'], r['ctab'], r['cidx'],
+            r['d'].astype(bool), np.ones((Tp,), bool), r['si'],
+            entry.par, entry.ctr, entry.act, entry.ev,
+            np.int32(n_now), oe, dom_src, ov.astype(bool))
+        combo.copy_to_host_async()
+        touched = np.unique(oe[0][(ov[0] != 0) & (oe[0] >= 0)])
+        ctx.update(mode='fused', combo=combo, reg_out=reg_out, rank=rank,
+                   resident=(entry, doc_id, obj_sid, n_now,
+                             touched.astype(np.int32)))
+        trace.count('resident.dispatch')
+        return True
+
+    def _mark_resident_stale(self, L, ctx):
+        """Invalidates resident entries for every list object this
+        (non-resident) batch touched -- its emit updated C++ visibility
+        without a device sync."""
+        bh = ctx['bh']
+        n_blocks = ctx['dims'][6]
+        for blk in range(n_blocks):
+            bdims = (ctypes.c_int64 * 3)()
+            L.amtpu_dom_dims(bh, blk, bdims)
+            W = int(bdims[0])
+            meta = (ctypes.c_int64 * (4 * W))()
+            n_objs = int(L.amtpu_dom_obj_meta(bh, blk, meta))
+            for o in range(n_objs):
+                doc_idx, obj_sid = int(meta[o * 4]), int(meta[o * 4 + 1])
+                doc_id = L.amtpu_batch_doc_id(bh, doc_idx)
+                entry = self._resident.entries.get((doc_id, obj_sid))
+                if entry is not None:
+                    entry.dirty = True
+                    trace.count('resident.cross_path_invalidation')
 
     def _phase_b(self, ctx):
         """Collect device results, run host mid+emit, return patch bytes."""
@@ -528,6 +625,16 @@ class NativeDocPool:
         with trace.span('host.finish'):
             if L.amtpu_finish(bh) != 0:
                 _raise_last()
+        if ctx.get('resident') is not None:
+            # post-emit visibility sync from the C++ arena ground truth
+            entry, doc_id, obj_sid, n_now, touched = ctx['resident']
+            self._resident.sync_after_emit(L, self._pool, entry, doc_id,
+                                           obj_sid, n_now, touched)
+        elif self._resident.entries:
+            # a NON-resident batch may have flipped visibility on arenas
+            # the cache holds (multi-object batches, member-window mode,
+            # overflow); mark every overlapping entry stale
+            self._mark_resident_stale(L, ctx)
         if trace.ENABLED:
             tr = (ctypes.c_double * 6)()
             L.amtpu_batch_trace(bh, tr)

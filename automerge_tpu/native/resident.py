@@ -1,0 +1,220 @@
+"""Device-resident arena cache -- incremental state across batches.
+
+SURVEY hard part 5: the reference keeps its opSet state incrementally
+between calls (`/root/reference/backend/op_set.js:310-322`); the TPU
+analogue is arena columns that LIVE ON DEVICE between `apply_batch`
+calls, with the host uploading only per-batch deltas (appended elements,
+per-op arrays, register rows) instead of re-encoding and re-uploading
+O(arena) bytes every batch.
+
+The cache keys on (doc id, object sid).  Entries hold four long-lived
+device arrays -- parent/ctr/actor-rank (i32) and visibility (f32) -- at
+the dom block's padded capacity.  Consistency contract:
+
+* Appends are detected by length: rows [cached_n, current_n) upload as
+  one scatter; a shrink (batch rollback) or capacity change (pow2 bucket
+  growth) triggers a full re-upload.
+* Visibility is synced AFTER emit from the C++ arena's own `visible`
+  column (only the batch's touched elements -- O(batch)); the C++ state
+  is ground truth, so overflow fallbacks and undo flows stay exact.
+* Element actor ranks must preserve actor-STRING order across batches
+  (linearize tie-breaks siblings by actor descending), so ranks come
+  from a pool-lifetime sorted registry; an actor whose name sorts into
+  the middle of the known set shifts existing ranks and drops the cache
+  (rare -- one full re-upload).
+* An entry whose batch failed between dispatch and sync is `dirty` and
+  re-uploads in full on next touch.
+"""
+
+import bisect
+import ctypes
+from functools import lru_cache, partial
+
+import numpy as np
+
+from .. import trace
+
+
+class ResidentArena:
+    __slots__ = ('capacity', 'n', 'par', 'ctr', 'act', 'ev', 'dirty')
+
+    def __init__(self, capacity):
+        self.capacity = capacity
+        self.n = 0
+        self.par = None
+        self.ctr = None
+        self.act = None
+        self.ev = None
+        self.dirty = False
+
+
+@lru_cache(maxsize=None)
+def _jit_scatter():
+    import jax
+
+    @jax.jit
+    def scatter(col, idx, vals):
+        # pad slots carry idx == capacity (out of bounds) and drop
+        return col.at[idx].set(vals, mode='drop')
+    return scatter
+
+
+@lru_cache(maxsize=None)
+def _jit_kernel(n_iters, window, chunk):
+    import jax
+
+    from ..ops import registers as register_ops
+    return jax.jit(partial(register_ops.resolve_rank_dominate_resident,
+                           n_iters=n_iters, window=window, chunk=chunk))
+
+
+def _bucket_pow2(n, floor=16):
+    size = floor
+    while size < n:
+        size *= 2
+    return size
+
+
+class ResidentCache:
+    def __init__(self):
+        self.entries = {}        # (doc_id bytes, obj_sid) -> ResidentArena
+        self.actor_order = []    # sorted actor strings (bytes)
+        self.sid_str = {}        # sid -> actor string
+
+    # -- actor ranks ----------------------------------------------------
+
+    def _rank_of_sids(self, L, pool, sids):
+        """Vector of string-order ranks for actor sids; registering a
+        middle-sorting new actor invalidates every cached eact column.
+
+        Two passes: ALL new sids register first, THEN ranks compute --
+        interleaving them would hand out ranks that a later insert in
+        the same call shifts (colliding eact values, divergent sibling
+        tie-breaks)."""
+        for sid in sids:
+            if sid in self.sid_str:
+                continue
+            s = L.amtpu_intern_str(pool, sid)
+            self.sid_str[sid] = s
+            pos = bisect.bisect_left(self.actor_order, s)
+            if pos != len(self.actor_order):
+                # ranks of later actors shift: resident eact stale
+                self.entries.clear()
+                trace.count('resident.actor_invalidation')
+            self.actor_order.insert(pos, s)
+        out = np.empty(len(sids), np.int32)
+        for i, sid in enumerate(sids):
+            out[i] = bisect.bisect_left(self.actor_order,
+                                        self.sid_str[sid])
+        return out
+
+    # -- entry acquisition ---------------------------------------------
+
+    def _read_raw(self, L, pool, doc_id, obj_sid):
+        ctr = ctypes.POINTER(ctypes.c_int32)()
+        act = ctypes.POINTER(ctypes.c_uint32)()
+        par = ctypes.POINTER(ctypes.c_int32)()
+        vis = ctypes.POINTER(ctypes.c_uint8)()
+        n = L.amtpu_arena_raw(pool, doc_id, obj_sid,
+                              ctypes.byref(ctr), ctypes.byref(act),
+                              ctypes.byref(par), ctypes.byref(vis))
+        if n == 0:
+            return 0, None, None, None, None
+        shape = (n,)
+        return (n,
+                np.ctypeslib.as_array(ctr, shape=shape),
+                np.ctypeslib.as_array(act, shape=shape),
+                np.ctypeslib.as_array(par, shape=shape),
+                np.ctypeslib.as_array(vis, shape=shape))
+
+    def get_entry(self, L, pool, doc_id, obj_sid, n_now, capacity):
+        """Returns a ResidentArena whose device columns reflect the
+        arena's current rows [0, n_now), uploading as little as the
+        consistency contract allows; None when the raw arena is
+        unavailable."""
+        import jax.numpy as jnp
+
+        n_raw, ctr, act, par, vis = self._read_raw(L, pool, doc_id,
+                                                   obj_sid)
+        if n_raw < n_now:
+            return None
+        key = (doc_id, obj_sid)
+        entry = self.entries.get(key)
+        need_full = (entry is None or entry.dirty or
+                     entry.capacity != capacity or entry.n > n_now)
+
+        if need_full:
+            lo = 0
+        else:
+            lo = entry.n
+        if need_full or n_now > lo:
+            # rank mapping may clear self.entries (middle-sorting actor);
+            # compute ranks FIRST, then re-check the entry
+            ranks = self._rank_of_sids(L, pool,
+                                       act[lo:n_now].tolist())
+            entry2 = self.entries.get(key)
+            if entry2 is not entry or (entry2 is not None and
+                                       entry2.dirty):
+                need_full = True
+                lo = 0
+                ranks = self._rank_of_sids(L, pool, act[:n_now].tolist())
+            entry = entry2 if not need_full else None
+
+        if need_full:
+            entry = ResidentArena(capacity)
+            pad = capacity - n_now
+
+            def up(a, dtype, fill):
+                return jnp.asarray(np.pad(
+                    np.ascontiguousarray(a[:n_now], dtype),
+                    (0, pad), constant_values=fill))
+            entry.par = up(par, np.int32, -1)
+            entry.ctr = up(ctr, np.int32, 0)
+            entry.act = jnp.asarray(np.pad(ranks, (0, pad),
+                                           constant_values=0))
+            entry.ev = up(vis, np.float32, 0.0)
+            entry.n = n_now
+            self.entries[key] = entry
+            trace.count('resident.full_upload_rows', n_now)
+        elif n_now > lo:
+            k = n_now - lo
+            kp = _bucket_pow2(k)
+            idx = np.full(kp, capacity, np.int32)   # capacity = dropped
+            idx[:k] = np.arange(lo, n_now, dtype=np.int32)
+            scatter = _jit_scatter()
+
+            def pad(a, dtype):
+                out = np.zeros(kp, dtype)
+                out[:k] = a
+                return out
+            entry.par = scatter(entry.par, idx,
+                                pad(par[lo:n_now], np.int32))
+            entry.ctr = scatter(entry.ctr, idx,
+                                pad(ctr[lo:n_now], np.int32))
+            entry.act = scatter(entry.act, idx, pad(ranks, np.int32))
+            entry.ev = scatter(entry.ev, idx,
+                               pad(vis[lo:n_now], np.float32))
+            entry.n = n_now
+            trace.count('resident.delta_upload_rows', k)
+        else:
+            trace.count('resident.no_upload')
+        return entry
+
+    def sync_after_emit(self, L, pool, entry, doc_id, obj_sid, n_now,
+                        touched_eidx):
+        """Post-emit visibility refresh from the C++ ground truth: only
+        the batch's touched elements re-upload (O(batch))."""
+        n_raw, _ctr, _act, _par, vis = self._read_raw(L, pool, doc_id,
+                                                      obj_sid)
+        if n_raw < n_now:          # rollback after dispatch: drop
+            entry.dirty = True
+            return
+        if touched_eidx.size:
+            kp = _bucket_pow2(touched_eidx.size)
+            idx = np.full(kp, entry.capacity, np.int32)
+            idx[:touched_eidx.size] = touched_eidx
+            vals = np.zeros(kp, np.float32)
+            vals[:touched_eidx.size] = vis[touched_eidx]
+            entry.ev = _jit_scatter()(entry.ev, idx, vals)
+        entry.n = n_now
+        entry.dirty = False

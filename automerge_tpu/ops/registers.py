@@ -307,6 +307,51 @@ def resolve_and_rank(group, time, actor, seq, clock_table, clock_idx,
     return reg, rank
 
 
+def resolve_rank_dominate_resident(group, time, actor, seq, clock_table,
+                                   clock_idx, is_del, alive_in, sort_idx,
+                                   epar, ectr, eact, ev, n_elems,
+                                   oe, dom_src, ov,
+                                   n_iters=1, window=WINDOW, chunk=64):
+    """The fused resolver over a DEVICE-RESIDENT single-object arena
+    (SURVEY hard part 5: incremental state across batches).
+
+    Unlike `resolve_rank_dominate`, the arena columns (epar/ectr/eact)
+    and the element-visibility vector (ev, f32) are long-lived device
+    arrays owned by the pool's resident cache -- the host uploads only
+    per-batch deltas (appended rows, register rows, per-op arrays).
+    Derivations the host used to precompute per batch happen in-graph:
+
+      * the sibling sort (lin_sort) runs as linearize's in-graph lexsort,
+      * v0 IS the resident ev,
+      * er_src is the identity (single object at arena base 0),
+      * orank gathers from the freshly computed rank.
+
+    Args mirror resolve_rank_dominate where shared; epar/ectr/eact/ev are
+    [C] (C = the block's padded arena size), n_elems the live count,
+    oe/dom_src/ov are [1, Tp] per-op arrays.  Returns the same
+    (reg, rank, combo) contract, so the packed-transfer consumer in the
+    native driver is unchanged.
+    """
+    from .list_rank import dominance_grouped, linearize
+    reg = _resolve(group, time, actor, seq, clock_table, clock_idx, is_del,
+                   alive_in, sort_idx, None, window)
+    C = epar.shape[0]
+    valid = jnp.arange(C, dtype=jnp.int32) < n_elems
+    obj0 = jnp.zeros((C,), jnp.int32)
+    rank = linearize(obj0, epar, ectr, eact, valid, n_iters)
+    er = jnp.where(valid, rank, -1)[None, :]
+    orank = jnp.where(ov, rank[jnp.clip(oe, 0, C - 1)[0]][None, :], -1)
+    T = reg['alive_after'].shape[0]
+    row = jnp.clip(dom_src, 0, T - 1)
+    od = jnp.where(dom_src >= 0,
+                   (reg['alive_after'][row] > 0).astype(jnp.int32)
+                   - reg['visible_before'][row].astype(jnp.int32),
+                   0)
+    idx = dominance_grouped(ev[None, :], er, oe, orank, od, ov, chunk=chunk)
+    combo = jnp.concatenate([reg['packed'], idx.reshape(-1)])
+    return reg, rank, combo
+
+
 @partial(jax.jit, static_argnames=('window', 'chunk'))
 def resolve_rank_dominate(group, time, actor, seq, clock_table, clock_idx,
                           is_del, alive_in, sort_idx,

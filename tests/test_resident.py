@@ -1,0 +1,194 @@
+"""Device-resident incremental state (SURVEY hard part 5, VERDICT r2 #2).
+
+The contract under test: once a big list arena is resident, a subsequent
+batch uploads O(batch) rows -- not O(arena) -- and patches stay
+byte-identical to the oracle through deletes, undo, and overflow-free
+editing.  The C++ env knobs latch per process, so scenarios run in a
+subprocess with AMTPU_RESIDENT=1 and a small AMTPU_RESIDENT_MIN.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SCENARIO = r"""
+import os, sys
+sys.path.insert(0, REPO_PATH)
+import jax; jax.config.update('jax_platforms', 'cpu')
+import numpy as np
+from automerge_tpu import trace, backend as Backend
+from automerge_tpu.native import NativeDocPool
+ROOT = '00000000-0000-0000-0000-000000000000'
+trace.ENABLED = True
+
+def counts(report, name):
+    for line in report.splitlines():
+        if name in line:
+            return int(line.rsplit('x', 1)[1])
+    return 0
+
+pool = NativeDocPool()
+st = Backend.init()
+
+# batch 1: build a 600-element text
+chs = [{'actor': 'a0', 'seq': 1, 'deps': {}, 'ops': [
+    {'action': 'makeText', 'obj': 't'},
+    {'action': 'link', 'obj': ROOT, 'key': 'text', 'value': 't'}]}]
+prev, e = '_head', 0
+ops = []
+for i in range(600):
+    e += 1
+    ops.append({'action': 'ins', 'obj': 't', 'key': prev, 'elem': e})
+    ops.append({'action': 'set', 'obj': 't', 'key': 'a0:%d' % e,
+                'value': chr(97 + e % 26)})
+    prev = 'a0:%d' % e
+chs.append({'actor': 'a0', 'seq': 2, 'deps': {}, 'ops': ops})
+trace.reset()
+pool.apply_changes('doc', chs)
+st, _ = Backend.apply_changes(st, chs)
+rep = trace.report()
+assert counts(rep, 'resident.dispatch') == 1, rep
+assert counts(rep, 'resident.full_upload_rows') == 600, rep
+
+# batches 2..4: small edits (inserts + deletes) -> delta uploads only
+seq = 2
+for b in range(3):
+    seq += 1
+    ops = []
+    for i in range(8):
+        e += 1
+        ops.append({'action': 'ins', 'obj': 't', 'key': prev, 'elem': e})
+        ops.append({'action': 'set', 'obj': 't', 'key': 'a0:%d' % e,
+                    'value': 'X'})
+        prev = 'a0:%d' % e
+    ops.append({'action': 'del', 'obj': 't', 'key': 'a0:%d' % (b + 3)})
+    batch = [{'actor': 'a0', 'seq': seq, 'deps': {}, 'ops': ops}]
+    trace.reset()
+    pool.apply_changes('doc', batch)
+    st, _ = Backend.apply_changes(st, batch)
+    rep = trace.report()
+    assert counts(rep, 'resident.dispatch') == 1, rep
+    # O(batch): exactly the 8 appended rows, NOT the 600-element arena
+    assert counts(rep, 'resident.delta_upload_rows') == 8, rep
+    assert counts(rep, 'resident.full_upload_rows') == 0, rep
+
+assert pool.get_patch('doc') == Backend.get_patch(st)
+
+# a second writer whose actor id sorts in the middle invalidates ranks
+# (correctness over cache retention), then editing resumes resident
+mid = [{'actor': 'a00', 'seq': 1,
+        'deps': {'a0': seq},
+        'ops': [{'action': 'ins', 'obj': 't', 'key': prev,
+                 'elem': e + 1},
+                {'action': 'set', 'obj': 't', 'key': 'a00:%d' % (e + 1),
+                 'value': 'Z'}]}]
+trace.reset()
+pool.apply_changes('doc', mid)
+st, _ = Backend.apply_changes(st, mid)
+assert pool.get_patch('doc') == Backend.get_patch(st)
+
+# save/load round trip of the resident doc
+blob = pool.save('doc')
+pool2 = NativeDocPool()
+pool2.load('doc', blob)
+assert pool2.get_patch('doc') == pool.get_patch('doc')
+print('RESIDENT-OK')
+""".replace('REPO_PATH', repr(REPO))
+
+
+def test_resident_delta_uploads_and_parity():
+    env = dict(os.environ, JAX_PLATFORMS='cpu', AMTPU_RESIDENT='1',
+               AMTPU_RESIDENT_MIN='16')
+    out = subprocess.run([sys.executable, '-c', SCENARIO], env=env,
+                         cwd=REPO, capture_output=True, text=True,
+                         timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert 'RESIDENT-OK' in out.stdout
+
+
+def test_resident_disabled_on_cpu_by_default():
+    script = r"""
+import sys
+sys.path.insert(0, %r)
+import jax; jax.config.update('jax_platforms', 'cpu')
+from automerge_tpu import trace
+from automerge_tpu.native import NativeDocPool
+ROOT = '00000000-0000-0000-0000-000000000000'
+trace.ENABLED = True
+pool = NativeDocPool()
+chs = [{'actor': 'a0', 'seq': 1, 'deps': {}, 'ops': [
+    {'action': 'makeText', 'obj': 't'},
+    {'action': 'link', 'obj': ROOT, 'key': 'text', 'value': 't'}]}]
+ops = []
+prev = '_head'
+for i in range(1, 101):
+    ops.append({'action': 'ins', 'obj': 't', 'key': prev, 'elem': i})
+    ops.append({'action': 'set', 'obj': 't', 'key': 'a0:%%d' %% i,
+                'value': 'x'})
+    prev = 'a0:%%d' %% i
+chs.append({'actor': 'a0', 'seq': 2, 'deps': {}, 'ops': ops})
+trace.reset()
+pool.apply_changes('doc', chs)
+assert 'resident.dispatch' not in trace.report()
+print('CPU-DEFAULT-OK')
+""" % (REPO,)
+    env = dict(os.environ, JAX_PLATFORMS='cpu', AMTPU_RESIDENT_MIN='16')
+    env.pop('AMTPU_RESIDENT', None)
+    out = subprocess.run([sys.executable, '-c', script], env=env,
+                         cwd=REPO, capture_output=True, text=True,
+                         timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert 'CPU-DEFAULT-OK' in out.stdout
+
+
+CROSS_PATH = r"""
+import sys
+sys.path.insert(0, REPO_PATH)
+import jax; jax.config.update('jax_platforms', 'cpu')
+from automerge_tpu import backend as Backend
+from automerge_tpu.native import NativeDocPool
+ROOT = '00000000-0000-0000-0000-000000000000'
+pool = NativeDocPool(); st = Backend.init()
+chs = [{'actor': 'a0', 'seq': 1, 'deps': {}, 'ops': [
+    {'action': 'makeText', 'obj': 't'},
+    {'action': 'link', 'obj': ROOT, 'key': 'text', 'value': 't'}]}]
+prev, e = '_head', 0
+ops = []
+for i in range(100):
+    e += 1
+    ops.append({'action': 'ins', 'obj': 't', 'key': prev, 'elem': e})
+    ops.append({'action': 'set', 'obj': 't', 'key': 'a0:%d' % e,
+                'value': 'x'})
+    prev = 'a0:%d' % e
+chs.append({'actor': 'a0', 'seq': 2, 'deps': {}, 'ops': ops})
+pool.apply_changes('doc', chs); st, _ = Backend.apply_changes(st, chs)
+# batch 2 touches the text AND a second list -> NON-resident path
+# deletes a char; the cached device ev must be invalidated
+b2 = [{'actor': 'a0', 'seq': 3, 'deps': {}, 'ops': [
+    {'action': 'makeList', 'obj': 'l2'},
+    {'action': 'link', 'obj': ROOT, 'key': 'other', 'value': 'l2'},
+    {'action': 'ins', 'obj': 'l2', 'key': '_head', 'elem': 1},
+    {'action': 'set', 'obj': 'l2', 'key': 'a0:1', 'value': 9},
+    {'action': 'del', 'obj': 't', 'key': 'a0:5'}]}]
+pool.apply_changes('doc', b2); st, _ = Backend.apply_changes(st, b2)
+# batch 3 is text-only again (resident; stale ev would misindex)
+b3 = [{'actor': 'a0', 'seq': 4, 'deps': {}, 'ops': [
+    {'action': 'ins', 'obj': 't', 'key': 'a0:10', 'elem': e + 1},
+    {'action': 'set', 'obj': 't', 'key': 'a0:%d' % (e + 1),
+     'value': 'Z'}]}]
+pool.apply_changes('doc', b3); st, _ = Backend.apply_changes(st, b3)
+assert pool.get_patch('doc') == Backend.get_patch(st)
+print('CROSS-PATH-OK')
+""".replace('REPO_PATH', repr(REPO))
+
+
+def test_non_resident_batch_invalidates_cached_visibility():
+    env = dict(os.environ, JAX_PLATFORMS='cpu', AMTPU_RESIDENT='1',
+               AMTPU_RESIDENT_MIN='16')
+    out = subprocess.run([sys.executable, '-c', CROSS_PATH], env=env,
+                         cwd=REPO, capture_output=True, text=True,
+                         timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert 'CROSS-PATH-OK' in out.stdout
