@@ -506,7 +506,16 @@ class NativeDocPool:
         # entry.dirty until the post-emit visibility sync lands: a batch
         # that errors in between leaves the device ev unsynced
         entry.dirty = True
-        fn = _jit_kernel(n_iters, self.WINDOW, 64)
+        from .resident import _jit_kernel_sharded, _sp_sharding
+        if _sp_sharding(dLp) is not None:
+            # multi-device with a capacity the mesh divides: element
+            # axis sharded over sp -- the quadratic dominance stage
+            # splits across devices (the promoted AMTPU_BENCH_C1_MESH
+            # path, now the default)
+            fn = _jit_kernel_sharded(n_iters, self.WINDOW, 64)
+            trace.count('resident.sharded_dispatch')
+        else:
+            fn = _jit_kernel(n_iters, self.WINDOW, 64)
         reg_out, rank, combo = fn(
             r['g'], r['t'], r['a'], r['s'], r['ctab'], r['cidx'],
             r['d'].astype(bool), np.ones((Tp,), bool), r['si'],

@@ -49,10 +49,10 @@ class ResidentArena:
 
 
 @lru_cache(maxsize=None)
-def _jit_scatter():
+def _jit_scatter(sharding=None):
     import jax
 
-    @jax.jit
+    @partial(jax.jit, out_shardings=sharding)
     def scatter(col, idx, vals):
         # pad slots carry idx == capacity (out of bounds) and drop
         return col.at[idx].set(vals, mode='drop')
@@ -66,6 +66,90 @@ def _jit_kernel(n_iters, window, chunk):
     from ..ops import registers as register_ops
     return jax.jit(partial(register_ops.resolve_rank_dominate_resident,
                            n_iters=n_iters, window=window, chunk=chunk))
+
+
+@lru_cache(maxsize=None)
+def _sp_mesh():
+    """A 1-D ('sp',) mesh over the largest power-of-two subset of local
+    devices, or None single-device.  The pool's resident dispatch shards
+    big arenas over it -- the promotion of the AMTPU_BENCH_C1_MESH
+    showcase path into the default pool entry point (VERDICT r2 #4).
+    Power-of-two so the pow2-bucketed arena capacities divide evenly."""
+    import jax
+    devices = jax.devices()
+    n = 1
+    while n * 2 <= len(devices):
+        n *= 2
+    if n < 2:
+        return None
+    from jax.sharding import Mesh
+    return Mesh(np.array(devices[:n]), ('sp',))
+
+
+def _sp_sharding(capacity=None):
+    """Element-axis sharding for a resident column of `capacity` rows,
+    or None when sharding is unavailable/indivisible (the caller then
+    keeps the column replicated and uses the unsharded kernel)."""
+    mesh = _sp_mesh()
+    if mesh is None:
+        return None
+    if capacity is not None and capacity % mesh.size != 0:
+        return None
+    from jax.sharding import NamedSharding, PartitionSpec
+    return NamedSharding(mesh, PartitionSpec('sp'))
+
+
+@lru_cache(maxsize=None)
+def _jit_kernel_sharded(n_iters, window, chunk):
+    """The resident resolver with the arena element axis SHARDED over the
+    sp mesh: linearize all-gathers the (tiny) parent/ctr/act columns for
+    pointer doubling, while the quadratic dominance stage -- the dominant
+    cost for long lists -- computes only each device's local partial
+    counts, completed with one psum (`ops/list_rank.dominance_indexes`
+    sequence-parallel mode, same formulation as parallel/mesh.py)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..ops import list_rank
+    from ..ops import registers as register_ops
+    from ..parallel.mesh import shard_map
+
+    mesh = _sp_mesh()
+    rep = P()
+    shd = P('sp')
+    reg_spec = {k: rep for k in ('winner', 'conflicts', 'alive_after',
+                                 'visible_before', 'overflow', 'packed')}
+
+    def step(g, t, a, s, ctab, cidx, d, alive, si, par, ctr, act, ev,
+             n_elems, oe, dom_src, ov):
+        reg = register_ops._resolve(g, t, a, s, ctab, cidx, d, alive,
+                                    si, None, window)
+        par_f = jax.lax.all_gather(par, 'sp', tiled=True)
+        ctr_f = jax.lax.all_gather(ctr, 'sp', tiled=True)
+        act_f = jax.lax.all_gather(act, 'sp', tiled=True)
+        C = par_f.shape[0]
+        valid_f = jnp.arange(C, dtype=jnp.int32) < n_elems
+        rank = list_rank.linearize(jnp.zeros((C,), jnp.int32), par_f,
+                                   ctr_f, act_f, valid_f, n_iters)
+        Ll = par.shape[0]
+        off = jax.lax.axis_index('sp') * Ll
+        er_local = jax.lax.dynamic_slice_in_dim(rank, off, Ll)
+        oe1, ds1, ov1 = oe[0], dom_src[0], ov[0]
+        orank, od = register_ops.dominance_op_inputs(reg, rank, oe1,
+                                                     ds1, ov1)
+        oobj = jnp.where(ov1, 0, -2)
+        idx = list_rank.dominance_indexes(
+            jnp.zeros((Ll,), jnp.int32), er_local, ev, oe1, oobj, orank,
+            od, ov1, chunk=chunk, axis_name='sp', l_offset=off)
+        combo = jnp.concatenate([reg['packed'], idx])
+        return reg, rank, combo
+
+    stepped = shard_map(
+        step, mesh,
+        in_specs=(rep,) * 9 + (shd, shd, shd, shd) + (rep,) * 4,
+        out_specs=(reg_spec, rep, rep))
+    return jax.jit(stepped)
 
 
 def _bucket_pow2(n, floor=16):
@@ -161,17 +245,20 @@ class ResidentCache:
             entry = entry2 if not need_full else None
 
         if need_full:
+            import jax
             entry = ResidentArena(capacity)
             pad = capacity - n_now
+            sharding = _sp_sharding(capacity)
 
             def up(a, dtype, fill):
-                return jnp.asarray(np.pad(
+                arr = jnp.asarray(np.pad(
                     np.ascontiguousarray(a[:n_now], dtype),
                     (0, pad), constant_values=fill))
+                return (jax.device_put(arr, sharding)
+                        if sharding is not None else arr)
             entry.par = up(par, np.int32, -1)
             entry.ctr = up(ctr, np.int32, 0)
-            entry.act = jnp.asarray(np.pad(ranks, (0, pad),
-                                           constant_values=0))
+            entry.act = up(ranks, np.int32, 0)
             entry.ev = up(vis, np.float32, 0.0)
             entry.n = n_now
             self.entries[key] = entry
@@ -181,7 +268,7 @@ class ResidentCache:
             kp = _bucket_pow2(k)
             idx = np.full(kp, capacity, np.int32)   # capacity = dropped
             idx[:k] = np.arange(lo, n_now, dtype=np.int32)
-            scatter = _jit_scatter()
+            scatter = _jit_scatter(_sp_sharding(capacity))
 
             def pad(a, dtype):
                 out = np.zeros(kp, dtype)
@@ -215,6 +302,7 @@ class ResidentCache:
             idx[:touched_eidx.size] = touched_eidx
             vals = np.zeros(kp, np.float32)
             vals[:touched_eidx.size] = vis[touched_eidx]
-            entry.ev = _jit_scatter()(entry.ev, idx, vals)
+            entry.ev = _jit_scatter(
+                _sp_sharding(entry.capacity))(entry.ev, idx, vals)
         entry.n = n_now
         entry.dirty = False

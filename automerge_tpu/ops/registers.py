@@ -307,6 +307,23 @@ def resolve_and_rank(group, time, actor, seq, clock_table, clock_idx,
     return reg, rank
 
 
+def dominance_op_inputs(reg, rank, oe, dom_src, ov):
+    """Per-op dominance inputs derived from the register outputs and a
+    fresh rank vector: orank gathers the touched element's rank, od is
+    the op's visibility delta (alive_after - visible_before of its
+    register row).  Shared by the unsharded and sp-sharded resident
+    kernels so the derivation cannot drift between them."""
+    C = rank.shape[0]
+    orank = jnp.where(ov, rank[jnp.clip(oe, 0, C - 1)], -1)
+    T = reg['alive_after'].shape[0]
+    row = jnp.clip(dom_src, 0, T - 1)
+    od = jnp.where(dom_src >= 0,
+                   (reg['alive_after'][row] > 0).astype(jnp.int32)
+                   - reg['visible_before'][row].astype(jnp.int32),
+                   0)
+    return orank, od
+
+
 def resolve_rank_dominate_resident(group, time, actor, seq, clock_table,
                                    clock_idx, is_del, alive_in, sort_idx,
                                    epar, ectr, eact, ev, n_elems,
@@ -340,13 +357,7 @@ def resolve_rank_dominate_resident(group, time, actor, seq, clock_table,
     obj0 = jnp.zeros((C,), jnp.int32)
     rank = linearize(obj0, epar, ectr, eact, valid, n_iters)
     er = jnp.where(valid, rank, -1)[None, :]
-    orank = jnp.where(ov, rank[jnp.clip(oe, 0, C - 1)[0]][None, :], -1)
-    T = reg['alive_after'].shape[0]
-    row = jnp.clip(dom_src, 0, T - 1)
-    od = jnp.where(dom_src >= 0,
-                   (reg['alive_after'][row] > 0).astype(jnp.int32)
-                   - reg['visible_before'][row].astype(jnp.int32),
-                   0)
+    orank, od = dominance_op_inputs(reg, rank, oe, dom_src, ov)
     idx = dominance_grouped(ev[None, :], er, oe, orank, od, ov, chunk=chunk)
     combo = jnp.concatenate([reg['packed'], idx.reshape(-1)])
     return reg, rank, combo
