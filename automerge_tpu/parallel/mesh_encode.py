@@ -2,11 +2,16 @@
 
 `build_sharded_step` consumes fixed-shape columnar arrays; this module
 turns an actual `{doc: [change, ...]}` workload (the bench / replica
-payload form, causally ordered) into that batch, so the multi-chip path
-runs REAL documents instead of synthetic demo data.  The target workload
-class is the one the sp axis exists for -- long Text/list histories
-(makeText/makeList, ins, set/del on elements, plus root-level links) on
-fresh documents; anything outside that class raises.
+payload form) into that batch, so the multi-chip path runs REAL
+documents instead of synthetic demo data.  Supported workload classes
+(broadened round 3): long Text/list histories (the sp axis's reason to
+exist), map/table documents (every assign encodes a register row;
+winner/conflict outcomes verify against the pool), out-of-order and
+duplicate delivery (causal buffering identical to the backends'), and
+continuation batches over prior history (`history_by_doc`).  The one
+class that still refuses is register window overflow (> WINDOW live
+concurrent writers on a key): `route_workload` diverts those docs to
+the pool path, which has the host-oracle fallback.
 
 Key encodings (mirroring the C++ runtime's columnar layout):
   * actors intern into one GLOBAL rank table (frontier pmax over the dp
@@ -80,6 +85,68 @@ def demo_text_workload(n_docs, n_actors=4, n_rounds=2, ops_per_change=8,
     }
 
 
+def demo_map_workload(n_docs=4, n_actors=4, n_rounds=2, keys=6):
+    """Config-2-shaped fixture: concurrent map writers on a shared key
+    space (kept under the register window so the mesh path is exact)."""
+    batch = {}
+    for d in range(n_docs):
+        changes = []
+        for r in range(1, n_rounds + 1):
+            for a in range(n_actors):
+                ops = [{'action': 'set', 'obj': ROOT_ID,
+                        'key': 'k%d' % ((a + i) % keys),
+                        'value': 'v%d-%d-%d' % (r, a, i)}
+                       for i in range(3)]
+                if r == n_rounds and a == 0:
+                    ops.append({'action': 'del', 'obj': ROOT_ID,
+                                'key': 'k0'})
+                deps = {'a%d' % b: r - 1 for b in range(n_actors)
+                        if r > 1 and b != a}
+                changes.append({'actor': 'a%d' % a, 'seq': r,
+                                'deps': deps, 'ops': ops})
+        batch[d] = changes
+    return batch
+
+
+def demo_table_workload(n_docs=4, n_actors=3, rows=3):
+    """Config-4-shaped fixture: a table, concurrent row adds (makeMap +
+    field sets + link into the table), then concurrent updates."""
+    batch = {}
+    for d in range(n_docs):
+        table = 'table-%d' % d
+        changes = [{'actor': 'a0', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'makeTable', 'obj': table},
+            {'action': 'link', 'obj': ROOT_ID, 'key': 'rows',
+             'value': table}]}]
+        row_ids = []
+        for a in range(n_actors):
+            ops = []
+            for i in range(rows):
+                row = 'row-%d-%d-%d' % (d, a, i)
+                ops.extend([
+                    {'action': 'makeMap', 'obj': row},
+                    {'action': 'set', 'obj': row, 'key': 'name',
+                     'value': 'r%d' % i},
+                    {'action': 'link', 'obj': table, 'key': row,
+                     'value': row}])
+                row_ids.append(row)
+            changes.append({'actor': 'a%d' % a,
+                            'seq': 2 if a == 0 else 1,
+                            'deps': {'a0': 1}, 'ops': ops})
+        for a in range(n_actors):
+            ops = [{'action': 'set',
+                    'obj': row_ids[(a + j) % len(row_ids)],
+                    'key': 'name', 'value': 'upd%d-%d' % (a, j)}
+                   for j in range(2)]
+            changes.append({'actor': 'a%d' % a,
+                            'seq': 3 if a == 0 else 2,
+                            'deps': {'a%d' % b: (2 if b == 0 else 1)
+                                     for b in range(n_actors) if b != a},
+                            'ops': ops})
+        batch[d] = changes
+    return batch
+
+
 def _bucket(n, floor=8):
     size = floor
     while size < n:
@@ -87,25 +154,116 @@ def _bucket(n, floor=8):
     return size
 
 
-def encode_batch(changes_by_doc, sp=1):
-    """Encodes a causally-ordered {doc: [change...]} payload of fresh
-    documents into the mesh batch dict (+ a sidecar `meta` dict used by
-    tests to map kernel outputs back to ops).
+def causal_order(changes):
+    """Application order under causal buffering: the same fixpoint the
+    backends run (reference applyQueuedOps, op_set.js:279-295), with
+    duplicate deliveries dropped (seq dedup, op_set.js:255-260).  Raises
+    when dependencies are genuinely missing."""
+    clock = {}
+    queue = []
+    ordered = []
+
+    def is_ready(ch):
+        return clock.get(ch['actor'], 0) >= ch['seq'] - 1 and all(
+            clock.get(a, 0) >= s for a, s in ch.get('deps', {}).items())
+
+    def admit(ch):
+        if ch['seq'] <= clock.get(ch['actor'], 0):
+            return                       # duplicate: tolerated no-op
+        clock[ch['actor']] = ch['seq']
+        ordered.append(ch)
+
+    # incremental admission, EXACTLY the backends' order: each incoming
+    # change applies immediately when ready, and every admission drains
+    # the buffered queue to a fixpoint before the next incoming change
+    # is considered -- application order (and therefore diff order) must
+    # match the pools byte for byte
+    for ch in changes:
+        if ch['seq'] <= clock.get(ch['actor'], 0):
+            continue
+        if not queue and is_ready(ch):
+            admit(ch)
+            continue
+        queue.append(ch)
+        progress = True
+        while progress:
+            progress = False
+            rest = []
+            for c in queue:
+                if c['seq'] <= clock.get(c['actor'], 0):
+                    progress = True
+                elif is_ready(c):
+                    admit(c)
+                    progress = True
+                else:
+                    rest.append(c)
+            queue = rest
+    if queue:
+        raise ValueError('%d changes have missing dependencies (a true '
+                         'causal gap, not just out-of-order delivery)'
+                         % len(queue))
+    return ordered
+
+
+def route_workload(changes_by_doc):
+    """Splits a workload into (mesh_docs, pool_docs): docs the mesh
+    pipeline can resolve exactly vs docs that need the pool path (its
+    host-oracle window-overflow fallback).  This IS the mesh path's
+    overflow fallback -- parity over speed, at per-document granularity
+    (each doc's op stream is independent, SURVEY section 2)."""
+    mesh_docs, pool_docs = {}, {}
+    for doc, changes in changes_by_doc.items():
+        rank = _probe_rank(changes)
+        try:
+            _encode_doc(causal_order(changes), rank,
+                        _bucket(len(rank), 2))
+        except ValueError:
+            pool_docs[doc] = changes
+        else:
+            mesh_docs[doc] = changes
+    return mesh_docs, pool_docs
+
+
+def _probe_rank(changes):
+    actors = sorted({ch['actor'] for ch in changes})
+    return {a: i for i, a in enumerate(actors)}
+
+
+def encode_batch(changes_by_doc, sp=1, history_by_doc=None):
+    """Encodes a {doc: [change...]} payload into the mesh batch dict
+    (+ a sidecar `meta` dict used by tests to map kernel outputs back
+    to ops).
+
+    Handled workload classes (broadened round 3): long Text/list
+    histories AND map/table documents (register rows encode for every
+    assign; list-op timelines only for list elements); out-of-order and
+    duplicate delivery (causal buffering via `causal_order`);
+    pre-existing state via `history_by_doc` (each doc's prior history is
+    replayed through the same encoding ahead of the new changes --
+    meta['first_new_row'] marks where the new batch begins).  Window
+    overflow (> WINDOW live concurrent writers on one key) raises; use
+    `route_workload` to divert such docs to the pool path, which has
+    the host-oracle fallback.
 
     The element axis pads to a multiple of `sp` so the arena columns
     shard evenly across the sequence-parallel mesh axis."""
     docs = list(changes_by_doc)
     D = len(docs)
+    history_by_doc = history_by_doc or {}
 
     actors = sorted({ch['actor'] for doc in docs
-                     for ch in changes_by_doc[doc]})
+                     for ch in (list(history_by_doc.get(doc, ())) +
+                                list(changes_by_doc[doc]))})
     actor_rank = {a: i for i, a in enumerate(actors)}
     A = _bucket(len(actors), 2)
 
     per_doc = []
     C = T = L = To = 1
     for doc in docs:
-        enc = _encode_doc(changes_by_doc[doc], actor_rank, A)
+        history = list(history_by_doc.get(doc, ()))
+        merged = history + list(changes_by_doc[doc])
+        enc = _encode_doc(causal_order(merged), actor_rank, A,
+                          history_ids={id(c) for c in history})
         per_doc.append(enc)
         C = max(C, len(enc['ch_actor']))
         T = max(T, len(enc['rg']))
@@ -151,12 +309,18 @@ def encode_batch(changes_by_doc, sp=1):
     }
     meta = {'docs': docs, 'actors': actors,
             'ops': [enc['meta_ops'] for enc in per_doc],
+            'map_ops': [enc['meta_map_ops'] for enc in per_doc],
+            'records': [enc['meta_records'] for enc in per_doc],
+            'first_new_row': [enc['first_new_row'] for enc in per_doc],
             'max_arena': max(len(enc['eo']) for enc in per_doc)}
     return batch, meta
 
 
-def _encode_doc(changes, actor_rank, A):
-    """Columnar encoding of one fresh doc's causally-ordered changes."""
+def _encode_doc(changes, actor_rank, A, history_ids=frozenset()):
+    """Columnar encoding of one doc's causally-ordered changes.
+    `history_ids` holds id()s of changes that are prior history (the
+    continuation-batch feature); membership is by identity because
+    causal buffering may have reordered or deduplicated the stream."""
     states = {}          # actor -> [allDeps per seq]
     ch_actor, ch_seq, ch_deps, ch_valid = [], [], [], []
 
@@ -171,9 +335,21 @@ def _encode_doc(changes, actor_rank, A):
 
     op_elem, op_row, op_valid = [], [], []
     meta_ops = []        # (op_idx-in-doc, kind) for test mapping
+    meta_map_ops = []    # (row, key, obj) for map/table assigns
+    meta_records = []    # per register row: (actor, seq, value, action)
+    # register row where the NEW batch begins: set at the first
+    # non-history change; -1 when buffering interleaved a history change
+    # after a new one (no clean boundary exists then)
+    first_new_row = [0 if not history_ids else None]
 
     time = 0
     for ch in changes:
+        if id(ch) in history_ids:
+            if first_new_row[0] is not None and first_new_row[0] >= 0 \
+                    and history_ids:
+                first_new_row[0] = -1     # history after new: unclean
+        elif first_new_row[0] is None:
+            first_new_row[0] = len(rg)
         actor, seq = ch['actor'], ch['seq']
         deps = dict(ch.get('deps', {}))
         base = dict(deps)
@@ -258,6 +434,7 @@ def _encode_doc(changes, actor_rank, A):
             rs.append(seq)
             rc.append(clock_row)
             rd.append(action == 'del')
+            meta_records.append((actor, seq, op.get('value'), action))
             is_list = objects.get(op['obj']) in _LIST_MAKES
             if is_list:
                 eidx = elem_index.get(op['key'])
@@ -269,6 +446,8 @@ def _encode_doc(changes, actor_rank, A):
                     op_row.append(row)
                     op_valid.append(True)
                     meta_ops.append((row, eidx))
+            else:
+                meta_map_ops.append((row, op['key'], op['obj']))
             time += 1
 
     return {
@@ -282,6 +461,11 @@ def _encode_doc(changes, actor_rank, A):
         'eo': eo, 'ep': ep, 'ec': ec, 'ea': ea, 'ev': ev,
         'op_elem': op_elem, 'op_row': op_row, 'op_valid': op_valid,
         'meta_ops': meta_ops,
+        'meta_map_ops': meta_map_ops,
+        'meta_records': meta_records,
+        # None here means every change was history (no new rows)
+        'first_new_row': (len(rg) if first_new_row[0] is None
+                          else first_new_row[0]),
     }
 
 
@@ -325,3 +509,42 @@ def verify_against_pool(workload, meta, out):
                                      % (doc, k))
         if next(diffs, None) is not None:
             raise AssertionError('unconsumed pool diffs on %r' % (doc,))
+
+        # map/table assigns: winner value + conflict (actor, value) sets
+        # against the register kernel outputs (round-3 broadening)
+        records = meta['records'][i]
+        winner = np.asarray(out['winner'])
+        conflicts = np.asarray(out['conflicts'])
+        mdiffs = iter(d for d in patch['diffs']
+                      if d.get('type') in ('map', 'table') and 'key' in d)
+        for row, key, _obj in meta['map_ops'][i]:
+            diff = next(mdiffs, None)
+            if diff is None:
+                raise AssertionError('missing map diff on %r row %d'
+                                     % (doc, row))
+            if diff['key'] != key:
+                raise AssertionError('map diff key mismatch on %r: %r '
+                                     'vs %r' % (doc, diff['key'], key))
+            is_alive = alive[i, row] > 0
+            want_action = 'set' if is_alive else 'remove'
+            if diff['action'] != want_action:
+                raise AssertionError('map action mismatch on %r key %r'
+                                     % (doc, key))
+            if not is_alive:
+                continue
+            w = int(winner[i, row])
+            wa, _ws, wv, _wact = records[w]
+            if diff.get('value') != wv:
+                raise AssertionError(
+                    'map winner value mismatch on %r key %r: pool %r vs '
+                    'mesh %r' % (doc, key, diff.get('value'), wv))
+            got_conf = [(records[int(c)][0], records[int(c)][2])
+                        for c in conflicts[i, row] if int(c) >= 0]
+            want_conf = [(c['actor'], c.get('value'))
+                         for c in diff.get('conflicts', [])]
+            if got_conf != want_conf:
+                raise AssertionError(
+                    'map conflicts mismatch on %r key %r: pool %r vs '
+                    'mesh %r' % (doc, key, want_conf, got_conf))
+        if next(mdiffs, None) is not None:
+            raise AssertionError('unconsumed map diffs on %r' % (doc,))
