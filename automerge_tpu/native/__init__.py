@@ -288,7 +288,7 @@ class NativeDocPool:
     WINDOW = 8
     #: entries amtpu_batch_dims writes -- must match core.cpp exactly
     #: (an undersized ctypes buffer is silent heap corruption)
-    N_DIMS = 11
+    N_DIMS = 12
 
     def __init__(self):
         self._pool = lib().amtpu_pool_new()
@@ -350,7 +350,7 @@ class NativeDocPool:
             dims = (ctypes.c_int64 * self.N_DIMS)()
             L.amtpu_batch_dims(bh, dims)
             (T, Tp, A, Ap, Larena, Lp, n_blocks, max_obj, CTp,
-             use_members, any_ovf) = [int(x) for x in dims]
+             use_members, any_ovf, max_group) = [int(x) for x in dims]
             # 6 slots -- must match what amtpu_fused_dims writes exactly
             # (an undersized ctypes buffer is silent heap corruption)
             fdims = (ctypes.c_int64 * 6)()
@@ -366,8 +366,22 @@ class NativeDocPool:
                                             shape=(Tp, self.WINDOW))
                 hovf = np.ctypeslib.as_array(L.amtpu_col_hostovf(bh),
                                              shape=(Tp,))
+            # Dynamic sliding-window width: the (W+1)^2 pairwise
+            # intermediates of the register kernel dominate its cost,
+            # and most batches never have more than 2-3 rows per
+            # register (text: one set + maybe one delete per elemId).
+            # A window covering the batch's widest group is EXACT --
+            # saturation (the overflow->oracle fallback) needs a group
+            # wider than the window, which cannot happen here.  Member
+            # mode keeps the C++-built width.
+            if use_members or max_group > self.WINDOW:
+                weff = self.WINDOW
+            else:
+                weff = 2
+                while weff < max_group:
+                    weff *= 2
             ctx.update(dims=(T, Tp, A, Ap, Larena, Lp, n_blocks, max_obj,
-                             CTp), mem=mem, hovf=hovf,
+                             CTp), mem=mem, hovf=hovf, weff=weff,
                        resident_ok=bool(resident_ok))
 
             if fused_ok:
@@ -378,7 +392,8 @@ class NativeDocPool:
                 trace.count('fused.fallback_layout')
                 with trace.span('device.dispatch'):
                     reg_out, rank = self._run_resolver(
-                        L, bh, Tp, Ap, CTp, Lp, max_obj, mem)
+                        L, bh, Tp, Ap, CTp, Lp, max_obj, mem,
+                        weff=ctx['weff'])
                 ctx.update(mode='old', reg_out=reg_out, rank=rank)
             return ctx
         except Exception:
@@ -428,12 +443,12 @@ class NativeDocPool:
             if mem is not None:
                 reg_out = register_ops.resolve_registers_members(
                     r['t'], r['a'], r['s'], mem, r['d'].astype(bool),
-                    r['ctab'], r['cidx'], window=self.WINDOW)
+                    r['ctab'], r['cidx'], window=ctx['weff'])
             else:
                 reg_out = register_ops.resolve_registers(
                     r['g'], r['t'], r['a'], r['s'],
                     is_del=r['d'].astype(bool),
-                    alive_in=np.ones((Tp,), bool), window=self.WINDOW,
+                    alive_in=np.ones((Tp,), bool), window=ctx['weff'],
                     sort_idx=r['si'], clock_table=r['ctab'],
                     clock_idx=r['cidx'])
             combo = reg_out['packed']
@@ -462,7 +477,7 @@ class NativeDocPool:
             e['obj'], e['par'], e['ctr'], e['act'], e['val'].astype(bool),
             e['lsi'], n_iters,
             v0, er_src, oe, orank_src, dom_src, ov.astype(bool),
-            window=self.WINDOW, mem_idx=mem)
+            window=ctx['weff'], mem_idx=mem)
         combo.copy_to_host_async()
         ctx.update(mode='fused', combo=combo, reg_out=reg_out, rank=rank)
 
@@ -512,10 +527,10 @@ class NativeDocPool:
             # axis sharded over sp -- the quadratic dominance stage
             # splits across devices (the promoted AMTPU_BENCH_C1_MESH
             # path, now the default)
-            fn = _jit_kernel_sharded(n_iters, self.WINDOW, 64)
+            fn = _jit_kernel_sharded(n_iters, ctx['weff'], 64)
             trace.count('resident.sharded_dispatch')
         else:
-            fn = _jit_kernel(n_iters, self.WINDOW, 64)
+            fn = _jit_kernel(n_iters, ctx['weff'], 64)
         reg_out, rank, combo = fn(
             r['g'], r['t'], r['a'], r['s'], r['ctab'], r['cidx'],
             r['d'].astype(bool), np.ones((Tp,), bool), r['si'],
@@ -597,7 +612,7 @@ class NativeDocPool:
                             else np.zeros(0, np.int32))
                 with trace.span('host.mid'):
                     if L.amtpu_mid(bh, ip(winner), ip(conflicts),
-                                   self.WINDOW, ip(alive), up(overflow),
+                                   ctx['weff'], ip(alive), up(overflow),
                                    ip(rank_arr)) != 0:
                         _raise_last()
                 with trace.span('device.dominance'):
@@ -605,7 +620,7 @@ class NativeDocPool:
             else:
                 with trace.span('host.mid'):
                     if L.amtpu_mid_packed(
-                            bh, ip(packed), self.WINDOW, ip(conf_rows),
+                            bh, ip(packed), ctx['weff'], ip(conf_rows),
                             ip(conf_vals), len(conf_rows),
                             ip(dom_idx)) != 0:
                         _raise_last()
@@ -624,7 +639,7 @@ class NativeDocPool:
                     overflow = np.zeros(0, np.uint8)
                 rank_arr = np.ascontiguousarray(rank, np.int32)
             with trace.span('host.mid'):
-                if L.amtpu_mid(bh, ip(winner), ip(conflicts), self.WINDOW,
+                if L.amtpu_mid(bh, ip(winner), ip(conflicts), ctx['weff'],
                                ip(alive), up(overflow),
                                ip(rank_arr)) != 0:
                     _raise_last()
@@ -675,8 +690,10 @@ class NativeDocPool:
         return np.ascontiguousarray(got, np.int32)
 
     def _gather_conflicts(self, reg_out, alive, Tp):
-        """Dense [Tp, WINDOW] conflicts (fallback paths)."""
-        conflicts = np.full((Tp, self.WINDOW), -1, np.int32)
+        """Dense [Tp, W] conflicts (fallback paths); width follows the
+        kernel's conflicts output (the dynamic window)."""
+        width = int(reg_out['conflicts'].shape[1])
+        conflicts = np.full((Tp, width), -1, np.int32)
         rows = np.nonzero(alive > 1)[0].astype(np.int32)
         got = self._gather_conflict_rows(reg_out, rows)
         if rows.size:
@@ -686,7 +703,7 @@ class NativeDocPool:
     # -- kernel dispatch ------------------------------------------------
 
     def _run_resolver(self, L, bh, Tp, Ap, CTp, Lp, max_obj_len,
-                      mem=None):
+                      mem=None, weff=None):
         """Register resolution + linearization, fused into one dispatch
         when both are needed (halves blocking round trips on the
         high-latency device link).  Returns (reg_out device dict | None,
@@ -704,18 +721,18 @@ class NativeDocPool:
                 r['d'].astype(bool), np.ones((Tp,), bool), r['si'],
                 e['obj'], e['par'], e['ctr'], e['act'],
                 e['val'].astype(bool), e['lsi'], n_iters,
-                window=self.WINDOW, mem_idx=mem)
+                window=weff, mem_idx=mem)
             return reg_out, np.asarray(rank)
         if Tp > 0:
             if mem is not None:
                 reg_out = register_ops.resolve_registers_members(
                     r['t'], r['a'], r['s'], mem, r['d'].astype(bool),
-                    r['ctab'], r['cidx'], window=self.WINDOW)
+                    r['ctab'], r['cidx'], window=weff)
             else:
                 reg_out = register_ops.resolve_registers(
                     r['g'], r['t'], r['a'], r['s'],
                     is_del=r['d'].astype(bool),
-                    alive_in=np.ones((Tp,), bool), window=self.WINDOW,
+                    alive_in=np.ones((Tp,), bool), window=weff,
                     sort_idx=r['si'], clock_table=r['ctab'],
                     clock_idx=r['cidx'])
             return reg_out, np.zeros((0,), np.int32)

@@ -213,10 +213,8 @@ def route_workload(changes_by_doc):
     (each doc's op stream is independent, SURVEY section 2)."""
     mesh_docs, pool_docs = {}, {}
     for doc, changes in changes_by_doc.items():
-        rank = _probe_rank(changes)
         try:
-            _encode_doc(causal_order(changes), rank,
-                        _bucket(len(rank), 2))
+            _probe_doc(causal_order(changes))
         except ValueError:
             pool_docs[doc] = changes
         else:
@@ -224,9 +222,40 @@ def route_workload(changes_by_doc):
     return mesh_docs, pool_docs
 
 
-def _probe_rank(changes):
-    actors = sorted({ch['actor'] for ch in changes})
-    return {a: i for i, a in enumerate(actors)}
+def _probe_doc(ordered):
+    """Lightweight eligibility scan -- raises the same ValueErrors as
+    `_encode_doc` without building any columns (route_workload would
+    otherwise pay the full host encode twice per mesh-eligible doc).
+    Must stay in lockstep with _encode_doc's validation."""
+    objects = {ROOT_ID: 'map'}
+    elems = set()
+    group_rows = {}
+    for ch in ordered:
+        actor = ch['actor']
+        for op in ch['ops']:
+            action = op['action']
+            if action in _MAKES:
+                if op['obj'] in objects:
+                    raise ValueError('duplicate object')
+                objects[op['obj']] = action
+            elif action == 'ins':
+                if objects.get(op['obj']) not in _LIST_MAKES:
+                    raise ValueError('ins on non-list object')
+                elem_id = '%s:%s' % (actor, op['elem'])
+                if elem_id in elems:
+                    raise ValueError('duplicate list element')
+                elems.add(elem_id)
+            elif action in ('set', 'del', 'link'):
+                gkey = (op['obj'], op['key'])
+                n = group_rows.get(gkey, 0) + 1
+                if n > _WINDOW:
+                    raise ValueError('register group overflow')
+                group_rows[gkey] = n
+                if objects.get(op['obj']) in _LIST_MAKES and \
+                        op['key'] not in elems and action != 'del':
+                    raise ValueError('assign to unknown element')
+            else:
+                raise ValueError('unsupported action %r' % action)
 
 
 def encode_batch(changes_by_doc, sp=1, history_by_doc=None):
@@ -483,6 +512,8 @@ def verify_against_pool(workload, meta, out):
     before = np.asarray(out['visible_before'])
     indexes = np.asarray(out['indexes'])
     clocks = np.asarray(out['doc_clock'])
+    winner = np.asarray(out['winner'])
+    conflicts = np.asarray(out['conflicts'])
     for i, doc in enumerate(meta['docs']):
         patch = patches[doc]
         want_clock = np.zeros((clocks.shape[1],), np.int32)
@@ -513,8 +544,6 @@ def verify_against_pool(workload, meta, out):
         # map/table assigns: winner value + conflict (actor, value) sets
         # against the register kernel outputs (round-3 broadening)
         records = meta['records'][i]
-        winner = np.asarray(out['winner'])
-        conflicts = np.asarray(out['conflicts'])
         mdiffs = iter(d for d in patch['diffs']
                       if d.get('type') in ('map', 'table') and 'key' in d)
         for row, key, _obj in meta['map_ops'][i]:

@@ -900,6 +900,9 @@ struct Batch {
   std::vector<i32> eidx_of_op;                    // op_idx -> eidx or -1
   bool fused_ok = false;
   bool resident_ok = false;
+  // widest register group in this batch (rows incl. pre-existing state);
+  // the Python driver sizes the sliding window from it
+  i64 max_group = 0;
 
   // local-change mode (apply_local_change / undo / redo):
   // kind 0 = not local, 1 = undoable change, 2 = undo, 3 = redo
@@ -1434,6 +1437,7 @@ static void encode(Pool& pool, Batch& b) {
     i32 max_count = 0;
     for (i64 g = 2; g < n_groups + 2; ++g)
       if (bucket_pos[g] > max_count) max_count = bucket_pos[g];
+    b.max_group = max_count;
     for (i64 g = 1; g < n_groups + 2; ++g) bucket_pos[g] += bucket_pos[g - 1];
     b.sort_idx.resize(b.Tp);
     for (i64 i = 0; i < b.Tp; ++i)
@@ -2813,7 +2817,7 @@ void* amtpu_begin_local(void* pool_ptr, const char* doc_id,
 void amtpu_batch_free(void* b) { delete static_cast<BatchHandle*>(b); }
 
 // dims: [T, Tp, A, Ap, L, Lp, n_dom_blocks, max_arena_len, CTp,
-//        use_members, any_ovf]
+//        use_members, any_ovf, max_group]
 void amtpu_batch_dims(void* bp, int64_t* out) {
   Batch& b = static_cast<BatchHandle*>(bp)->batch;
   out[0] = b.T; out[1] = b.Tp; out[2] = b.A; out[3] = b.Ap;
@@ -2823,6 +2827,7 @@ void amtpu_batch_dims(void* bp, int64_t* out) {
   out[8] = b.CTp;
   out[9] = b.use_members ? 1 : 0;
   out[10] = b.any_ovf ? 1 : 0;
+  out[11] = b.max_group;
 }
 
 const int32_t* amtpu_col_memidx(void* bp) { return static_cast<BatchHandle*>(bp)->batch.mem_idx.data(); }
@@ -2935,7 +2940,10 @@ int amtpu_mid_packed(void* bp, const int32_t* packed, int window,
     b.sparse_conflicts.reserve(static_cast<size_t>(n_conf) + 1);
     for (int64_t i = 0; i < n_conf; ++i) {
       std::array<i32, 8> row_vals;
-      for (int c = 0; c < 8; ++c) row_vals[c] = conf_vals[i * 8 + c];
+      // rows arrive at the caller's (dynamic) window width; missing
+      // slots are empty
+      for (int c = 0; c < 8; ++c)
+        row_vals[c] = c < window ? conf_vals[i * window + c] : -1;
       *b.sparse_conflicts.insert(
           static_cast<u64>(conf_rows[i])).first = row_vals;
     }
