@@ -590,11 +590,21 @@ class NativeDocPool:
                     fallback = bool((packed >> 28 & 1).any())
                     if not fallback:
                         # conflicts stay SPARSE: only rows whose register
-                        # kept >1 member carry a conflict list
+                        # kept >1 member carry a conflict list.  When the
+                        # workload is conflict-DENSE (hot-key maps: most
+                        # rows keep >1 member) the row-gather kernel
+                        # saves nothing -- transfer the whole matrix once
+                        # and slice host-side instead.
                         conf_rows = np.nonzero(
                             (packed >> 24 & 0xf) > 1)[0].astype(np.int32)
-                        conf_vals = self._gather_conflict_rows(
-                            ctx['reg_out'], conf_rows)
+                        if conf_rows.size * 4 > Tp:
+                            allconf = np.asarray(
+                                ctx['reg_out']['conflicts'])
+                            conf_vals = np.ascontiguousarray(
+                                allconf[conf_rows], np.int32)
+                        else:
+                            conf_vals = self._gather_conflict_rows(
+                                ctx['reg_out'], conf_rows)
             if fallback:
                 # >window concurrent writers on some register: re-fetch the
                 # full outputs + rank and take the exact host path

@@ -2058,7 +2058,41 @@ struct DiffBuf {
   }
   inline void nil() { *p++ = 0xc0; }
   inline void boolean(bool v) { *p++ = v ? 0xc3 : 0xc2; }
+  inline void array_hdr(size_t n) { *p++ = static_cast<u8>(0x90 | n); }
 };
+
+// worst-case byte size of the conflicts array for a register, so the
+// stack fast path can take conflict-carrying diffs too (hot-key map
+// workloads put a conflict set on most diffs); window <= 8 keeps the
+// entry count within a fixarray
+static size_t conflicts_bound(Pool& pool, const Register& reg) {
+  size_t n = 4;
+  for (size_t i = 1; i < reg.size(); ++i) {
+    const OpRec& o = reg[i];
+    n += 24 + pool.intern.str(o.actor).size() +
+         (o.value_rid != NONE ? pool.vals.str(o.value_rid).size() : 1);
+  }
+  return n;
+}
+
+static void write_conflicts_fast(DiffBuf& d, Pool& pool,
+                                 const Register& reg) {
+  d.array_hdr(reg.size() - 1);
+  for (size_t i = 1; i < reg.size(); ++i) {
+    const OpRec& o = reg[i];
+    bool link = o.action == A_LINK;
+    d.map_hdr(link ? 3 : 2);
+    d.lit(L_ACTOR); d.str(pool.intern.str(o.actor));
+    d.lit(L_VALUE);
+    if (o.value_rid != NONE) {
+      const std::string& vb = pool.vals.str(o.value_rid);
+      d.bytes(vb.data(), vb.size());
+    } else {
+      d.nil();
+    }
+    if (link) { d.lit(L_LINK); d.boolean(true); }
+  }
+}
 
 static void emit_map_diff(Writer& w, Pool& pool, DocState& st,
                           const OpRec& op, const Register& reg, u8 obj_type,
@@ -2095,9 +2129,13 @@ static void emit_map_diff(Writer& w, Pool& pool, DocState& st,
       first.value_rid != NONE ? &val_bytes(pool, first) : nullptr;
   const std::string* dt =
       first.datatype != NONE ? &pool.intern.str(first.datatype) : nullptr;
-  if (reg.size() == 1 &&
+  // reg.size() <= 16: conflicts emit as a 1-byte fixarray header (<= 15
+  // entries); overflow-oracle registers are unbounded and must take the
+  // generic Writer path, whose array() encodes any count
+  if (reg.size() <= 16 &&
       96 + obj_bytes.size() + kstr.size() + path_bytes.size() +
-              (vb ? vb->size() : 1) + (dt ? dt->size() : 0) <=
+              (vb ? vb->size() : 1) + (dt ? dt->size() : 0) +
+              (reg.size() > 1 ? conflicts_bound(pool, reg) : 0) <=
           DiffBuf::CAP) {
     DiffBuf d;
     d.map_hdr(n);
@@ -2111,6 +2149,10 @@ static void emit_map_diff(Writer& w, Pool& pool, DocState& st,
     else d.nil();
     if (first.action == A_LINK) { d.lit(L_LINK); d.boolean(true); }
     if (dt) { d.lit(L_DATATYPE); d.str(*dt); }
+    if (reg.size() > 1) {
+      d.lit(L_CONFLICTS);
+      write_conflicts_fast(d, pool, reg);
+    }
     w.raw(d.tmp, d.used());
     return;
   }
@@ -2170,9 +2212,10 @@ static bool emit_list_diff(Writer& w, Pool& pool, Arena& ar,
                               ? &val_bytes(pool, *first) : nullptr;
   const std::string* dt = (setlike && first->datatype != NONE)
                               ? &pool.intern.str(first->datatype) : nullptr;
-  if (reg.size() <= 1 &&
+  if (reg.size() <= 16 &&   // fixarray conflicts bound; see emit_map_diff
       96 + obj_bytes.size() + kstr.size() + path_bytes.size() +
-              (vb ? vb->size() : 1) + (dt ? dt->size() : 0) <=
+              (vb ? vb->size() : 1) + (dt ? dt->size() : 0) +
+              (reg.size() > 1 ? conflicts_bound(pool, reg) : 0) <=
           DiffBuf::CAP) {
     DiffBuf d;
     d.map_hdr(n);
@@ -2189,6 +2232,10 @@ static bool emit_list_diff(Writer& w, Pool& pool, Arena& ar,
       else d.nil();
       if (first->action == A_LINK) { d.lit(L_LINK); d.boolean(true); }
       if (dt) { d.lit(L_DATATYPE); d.str(*dt); }
+      if (reg.size() > 1) {
+        d.lit(L_CONFLICTS);
+        write_conflicts_fast(d, pool, reg);
+      }
     }
     w.raw(d.tmp, d.used());
     return true;

@@ -404,3 +404,23 @@ class TestShardErrorReporting:
         with pytest.raises(Exception) as ei:
             pool.apply_batch(bad)
         assert '[shard %d]' % shard in str(ei.value)
+
+
+def test_wide_overflow_register_conflicts_emit_correctly():
+    """20 concurrent writers on one key exceed both the register window
+    (host-oracle fallback) and the fixarray conflicts bound (>15
+    entries) -- the diff stream must stay valid msgpack and match the
+    oracle byte for byte (round-3 regression: the stack fast path must
+    reject such registers)."""
+    nat = native_pool()
+    st = Backend.init()
+    chs = [{'actor': 'w%02d' % a, 'seq': 1, 'deps': {},
+            'ops': [{'action': 'set', 'obj': ROOT_ID, 'key': 'hot',
+                     'value': 'v%d' % a}]}
+           for a in range(20)]
+    nat.apply_changes('doc', chs)
+    st, _ = Backend.apply_changes(st, chs)
+    patch = nat.get_patch('doc')
+    assert patch == Backend.get_patch(st)
+    final = [d for d in patch['diffs'] if d.get('key') == 'hot'][-1]
+    assert len(final['conflicts']) == 19
