@@ -82,7 +82,7 @@ N_ROUNDS = env_int('AMTPU_BENCH_ROUNDS', 2)
 OPS_PER_CHANGE = env_int('AMTPU_BENCH_OPS_PER_CHANGE', 16)
 ORACLE_DOCS = env_int('AMTPU_BENCH_ORACLE_DOCS', 0)   # 0 = 10% of docs
 SEED = env_int('AMTPU_BENCH_SEED', 7)
-N_SHARDS = env_int('AMTPU_BENCH_SHARDS', 10)
+N_SHARDS = env_int('AMTPU_BENCH_SHARDS', 20)
 
 
 # ---------------------------------------------------------------------------

@@ -37,7 +37,7 @@ def main():
     n_actors = env_int('AMTPU_BENCH_ACTORS', 8)
     n_rounds = env_int('AMTPU_BENCH_ROUNDS', 2)
     opc = env_int('AMTPU_BENCH_OPS_PER_CHANGE', 16)
-    n_shards = env_int('AMTPU_BENCH_SHARDS', 10)
+    n_shards = env_int('AMTPU_BENCH_SHARDS', 20)
 
     import random
     rng = random.Random(7)
