@@ -991,7 +991,12 @@ class ShardedNativePool:
 
     def __init__(self, n_shards=None, mode=None):
         if n_shards is None:
-            n_shards = min(8, os.cpu_count() or 1)
+            # pipelining overlaps async device work with host begin/emit,
+            # so MORE shards than cores helps (finer overlap granularity,
+            # smaller per-shard pads): a 1-core host measured best at 20
+            # shards on the headline bench (BASELINE.md round 3)
+            cores = os.cpu_count() or 1
+            n_shards = 20 if cores == 1 else max(8, cores)
         if n_shards < 1:
             raise ValueError('n_shards must be >= 1, got %r' % (n_shards,))
         self.n_shards = n_shards
