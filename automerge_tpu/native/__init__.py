@@ -990,24 +990,27 @@ class ShardedNativePool:
     """
 
     def __init__(self, n_shards=None, mode=None):
+        cores = os.cpu_count() or 1
+        if mode is None:
+            mode = os.environ.get('AMTPU_SHARD_MODE', '')
+        if not mode:
+            mode = 'pipeline' if cores == 1 else 'threads'
+        if mode not in ('pipeline', 'threads'):
+            raise ValueError('unknown shard mode %r' % (mode,))
+        self.mode = mode
         if n_shards is None:
-            # pipelining overlaps async device work with host begin/emit,
-            # so MORE shards than cores helps (finer overlap granularity,
-            # smaller per-shard pads): a 1-core host measured best at 20
-            # shards on the headline bench (BASELINE.md round 3)
-            cores = os.cpu_count() or 1
-            n_shards = 20 if cores == 1 else max(8, cores)
+            # the default keys on the RESOLVED mode: pipelining overlaps
+            # async device work with host begin/emit, so more shards than
+            # cores helps (finer overlap granularity, smaller per-shard
+            # pads; 20 measured best on the 1-core headline bench,
+            # BASELINE.md round 3).  Threads mode runs shards truly
+            # concurrently, so one per core (capped) avoids
+            # oversubscription and unbounded per-shard state.
+            n_shards = 20 if mode == 'pipeline' else min(8, cores)
         if n_shards < 1:
             raise ValueError('n_shards must be >= 1, got %r' % (n_shards,))
         self.n_shards = n_shards
         self.pools = [NativeDocPool() for _ in range(n_shards)]
-        if mode is None:
-            mode = os.environ.get('AMTPU_SHARD_MODE', '')
-        if not mode:
-            mode = 'pipeline' if (os.cpu_count() or 1) == 1 else 'threads'
-        if mode not in ('pipeline', 'threads'):
-            raise ValueError('unknown shard mode %r' % (mode,))
-        self.mode = mode
 
     def _shard_of(self, doc_id):
         key = NativeDocPool._doc_key(doc_id).encode()
