@@ -14,6 +14,7 @@ zero-Python wire path the sidecar serves.
 import ctypes
 import os
 import subprocess
+import time
 
 import msgpack
 import numpy as np
@@ -257,6 +258,12 @@ def _raise_last():
     raise (RangeError if kind == 1 else AutomergeError)(msg)
 
 
+def _devtime_on():
+    """AMTPU_DEVTIME=1 turns on synchronous per-dispatch device timing
+    (checked per call, not latched -- bench.py flips it for one pass)."""
+    return os.environ.get('AMTPU_DEVTIME', '0') not in ('', '0')
+
+
 def _raise_shard_errors(errors):
     """Per-shard error reporting: a single failure re-raises with its
     shard identified; multiple failures aggregate every shard's message
@@ -269,11 +276,17 @@ def _raise_shard_errors(errors):
                                        else err),) + err.args[1:]
         raise err
     # aggregate, but keep the concrete exception class when every shard
-    # failed the same way so callers' except clauses still fire
+    # failed the same way so callers' except clauses behave identically
+    # whether one shard or all of them raised (e.g. all-ValueError must
+    # surface as ValueError, same as the single-failure path above)
     from ..errors import AutomergeError
     types = {type(e) for _, e in errors}
     cls = types.pop() if len(types) == 1 else AutomergeError
-    if not issubclass(cls, (AutomergeError, TypeError)):
+    try:
+        probe = cls('probe')          # must accept a lone message arg
+    except Exception:
+        cls, probe = AutomergeError, None
+    if probe is not None and not isinstance(probe, Exception):
         cls = AutomergeError
     raise cls(
         '%d shards failed: ' % len(errors) +
@@ -384,17 +397,33 @@ class NativeDocPool:
                              CTp), mem=mem, hovf=hovf, weff=weff,
                        resident_ok=bool(resident_ok))
 
+            devtime = _devtime_on()
+            t0 = time.perf_counter() if devtime else 0.0
             if fused_ok:
                 with trace.span('device.dispatch'):
                     self._dispatch_fused(L, ctx, Tp, Ap, CTp, Lp, max_obj,
                                          n_blocks, W, dLp, dTp)
             else:
                 trace.count('fused.fallback_layout')
+                trace.metric('fallback.layout_batches')
                 with trace.span('device.dispatch'):
                     reg_out, rank = self._run_resolver(
                         L, bh, Tp, Ap, CTp, Lp, max_obj, mem,
                         weff=ctx['weff'])
                 ctx.update(mode='old', reg_out=reg_out, rank=rank)
+            if devtime:
+                # AMTPU_DEVTIME=1: block on the dispatched outputs and
+                # record the synchronous dispatch+compute time.  This
+                # serializes the shard pipeline, so bench.py measures it
+                # in a dedicated extra pass, never in the timed runs.
+                outs = [v for v in (ctx.get('combo'), ctx.get('reg_out'),
+                                    ctx.get('rank')) if v is not None]
+                if outs:                 # Tp == 0 batches dispatch nothing
+                    import jax
+                    jax.block_until_ready(outs)
+                    trace.metric('device.dispatch_sync_s',
+                                 time.perf_counter() - t0)
+                    trace.metric('device.dispatches')
             return ctx
         except Exception:
             L.amtpu_batch_free(bh)
@@ -609,6 +638,9 @@ class NativeDocPool:
                 # >window concurrent writers on some register: re-fetch the
                 # full outputs + rank and take the exact host path
                 trace.count('fused.fallback_overflow')
+                trace.metric('fallback.overflow_batches')
+                trace.metric('fallback.overflow_rows',
+                             int((packed >> 28 & 1).sum()))
                 reg_out = ctx['reg_out']
                 winner = np.ascontiguousarray(reg_out['winner'], np.int32)
                 conflicts = np.ascontiguousarray(reg_out['conflicts'],
@@ -625,8 +657,12 @@ class NativeDocPool:
                                    ctx['weff'], ip(alive), up(overflow),
                                    ip(rank_arr)) != 0:
                         _raise_last()
+                t0 = time.perf_counter() if _devtime_on() else 0.0
                 with trace.span('device.dominance'):
                     self._run_dominance(L, bh)
+                if t0:
+                    trace.metric('device.dispatch_sync_s',
+                                 time.perf_counter() - t0)
             else:
                 with trace.span('host.mid'):
                     if L.amtpu_mid_packed(
@@ -644,6 +680,11 @@ class NativeDocPool:
                         # member mode: overflow is host-decided (>WINDOW
                         # concurrent streams / same-change dup assigns)
                         overflow = np.ascontiguousarray(ctx['hovf'])
+                        n_ovf = int(overflow.sum())
+                        if n_ovf:
+                            trace.metric('fallback.member_overflow_rows',
+                                         n_ovf)
+                            trace.metric('fallback.overflow_batches')
                 else:
                     winner = conflicts = alive = np.zeros(0, np.int32)
                     overflow = np.zeros(0, np.uint8)
@@ -653,8 +694,12 @@ class NativeDocPool:
                                ip(alive), up(overflow),
                                ip(rank_arr)) != 0:
                     _raise_last()
+            t0 = time.perf_counter() if _devtime_on() else 0.0
             with trace.span('device.dominance'):
                 self._run_dominance(L, bh)
+            if t0:
+                trace.metric('device.dispatch_sync_s',
+                             time.perf_counter() - t0)
 
         with trace.span('host.finish'):
             if L.amtpu_finish(bh) != 0:
@@ -989,7 +1034,8 @@ class ShardedNativePool:
     doc groups within one shard (route by doc id).
     """
 
-    def __init__(self, n_shards=None, mode=None):
+    @staticmethod
+    def resolve_mode(mode=None):
         cores = os.cpu_count() or 1
         if mode is None:
             mode = os.environ.get('AMTPU_SHARD_MODE', '')
@@ -997,16 +1043,27 @@ class ShardedNativePool:
             mode = 'pipeline' if cores == 1 else 'threads'
         if mode not in ('pipeline', 'threads'):
             raise ValueError('unknown shard mode %r' % (mode,))
+        return mode
+
+    @classmethod
+    def default_shards(cls, mode=None):
+        """Mode-aware shard-count default, without building any pools.
+
+        Keys on the RESOLVED mode: pipelining overlaps async device work
+        with host begin/emit, so more shards than cores helps (finer
+        overlap granularity, smaller per-shard pads; 20 measured best on
+        the 1-core headline bench, BASELINE.md round 3).  Threads mode
+        runs shards truly concurrently, so one per core (capped) avoids
+        oversubscription and unbounded per-shard state.
+        """
+        mode = cls.resolve_mode(mode)
+        return 20 if mode == 'pipeline' else min(8, os.cpu_count() or 1)
+
+    def __init__(self, n_shards=None, mode=None):
+        mode = self.resolve_mode(mode)
         self.mode = mode
         if n_shards is None:
-            # the default keys on the RESOLVED mode: pipelining overlaps
-            # async device work with host begin/emit, so more shards than
-            # cores helps (finer overlap granularity, smaller per-shard
-            # pads; 20 measured best on the 1-core headline bench,
-            # BASELINE.md round 3).  Threads mode runs shards truly
-            # concurrently, so one per core (capped) avoids
-            # oversubscription and unbounded per-shard state.
-            n_shards = 20 if mode == 'pipeline' else min(8, cores)
+            n_shards = self.default_shards(mode)
         if n_shards < 1:
             raise ValueError('n_shards must be >= 1, got %r' % (n_shards,))
         self.n_shards = n_shards

@@ -615,7 +615,15 @@ class TPUDocPool:
         for (doc_id, obj, key), gid in group_ids.items():
             state = self.docs[doc_id]
             recs = state.registers.get((obj, key), [])
-            for i, rec in enumerate(recs):
+            # REVERSED: the mirror stores winner-first (= newest-first
+            # within an actor's ties), and the kernel orders ties by time
+            # descending -- emitting oldest-first keeps array order time-
+            # ascending (the sort_idx contract) while the newest mirror
+            # entry gets the largest state time, so re-resolution
+            # preserves the stored tie order.  Register survivors are a
+            # concurrent antichain, so relative state times cannot change
+            # supersession -- only output order.  (tests/test_tie_order.py)
+            for i, rec in enumerate(reversed(recs)):
                 g_col.append(gid)
                 t_col.append(-len(recs) + i)
                 a_col.append(int(rank_of[aid(rec['actor'])]))

@@ -328,11 +328,10 @@ class DistributedReplicaSet:
             r = head[b'to'] if isinstance(head, dict) else head['to']
             doc_key = head[b'docId'] if isinstance(head, dict) \
                 else head['docId']
-            clock = None  # advertised clock: union folds in via changes
             body = m[unp.tell():]
             per_receiver.setdefault(int(r), {}).setdefault(
                 doc_key if isinstance(doc_key, str)
-                else doc_key.decode(), []).append((clock, body))
+                else doc_key.decode(), []).append(body)
 
         for r, by_doc in per_receiver.items():
             pool = self.replicas[r % self.n_local]
@@ -341,14 +340,27 @@ class DistributedReplicaSet:
                 parts.append(msgpack.packb(_doc_key(doc_id),
                                            use_bin_type=True))
                 # splice: each message body is clock + array of changes;
-                # re-frame as ONE array of all changes
+                # re-frame as ONE array of all changes.  The advertised
+                # sender clock feeds receiver-side dedup, the same role
+                # the reference Connection's clock maps play
+                # (src/connection.js:75-90): when the receiver's clock
+                # already dominates the advertisement, every change in
+                # the message is known and the splice skips the body.
+                try:
+                    own = pool.get_clock(doc_id)['clock']
+                except Exception:
+                    own = {}             # receiver has no state yet
                 bodies = []
                 total = 0
-                for _clock, body in messages:
-                    unp = msgpack.Unpacker(raw=True)
+                for body in messages:
+                    unp = msgpack.Unpacker(raw=False)
                     unp.feed(body)
-                    unp.skip()           # sender clock
+                    advertised = unp.unpack()    # sender clock
                     off = unp.tell()
+                    if advertised and own and all(
+                            own.get(a, 0) >= s
+                            for a, s in advertised.items()):
+                        continue
                     cnt, hoff = read_array_header(body[off:])
                     total += cnt
                     bodies.append(body[off + hoff:])

@@ -18,6 +18,7 @@
 
 #include <algorithm>
 #include <array>
+#include <cassert>
 #include <chrono>
 #include <cstdint>
 #include <cstdlib>
@@ -1371,15 +1372,23 @@ static void encode(Pool& pool, Batch& b) {
     DocState& st = *b.bdocs[doc];
     if (gid_regs[gid] == nullptr) continue;
     auto& recs = *gid_regs[gid];
-    for (size_t i = 0; i < recs.size(); ++i) {
+    // REVERSED iteration: the mirror stores winner-first (= newest-first
+    // within an actor's ties) and the kernel orders ties by time
+    // descending, so the newest mirror entry must carry the LARGEST
+    // state time while array order stays time-ascending (the counting-
+    // sort contract below).  Survivors are a concurrent antichain, so
+    // state times only affect output order, never supersession.
+    // (tests/test_tie_order.py pins this.)
+    for (size_t j = recs.size(); j-- > 0;) {
+      size_t i = recs.size() - 1 - j;  // emission position, time -n..-1
       b.g_col.push_back(static_cast<i32>(gid));
       b.t_col.push_back(static_cast<i32>(i) - static_cast<i32>(recs.size()));
-      b.a_col.push_back(b.rank_of[recs[i].actor]);
-      b.s_col.push_back(static_cast<i32>(recs[i].seq));
+      b.a_col.push_back(b.rank_of[recs[j].actor]);
+      b.s_col.push_back(static_cast<i32>(recs[j].seq));
       b.d_col.push_back(0);
       b.clock_idx.push_back(static_cast<i32>(
-          clock_row_of(doc, st, recs[i].actor, recs[i].seq)));
-      b.state_rec_store.push_back(recs[i]);
+          clock_row_of(doc, st, recs[j].actor, recs[j].seq)));
+      b.state_rec_store.push_back(recs[j]);
       b.src_records.push_back(&b.state_rec_store.back());
     }
   }
@@ -2018,7 +2027,13 @@ static void write_conflicts(Writer& w, Pool& pool, const Register& reg) {
 // dominates actual byte movement on the emit hot loop.
 struct DiffBuf {
   static constexpr size_t CAP = 4096;
-  u8 tmp[CAP];
+  // Red zone: the entry checks bound every variable-size component, so
+  // the only overflow risk is the hand-computed fixed-overhead constant
+  // being a few bytes short.  Writes land in tmp[CAP + RED) long before
+  // commit()'s assert can fire, so the slack keeps a constant-sized
+  // mistake INSIDE the buffer until the assert reports it.
+  static constexpr size_t RED = 512;
+  u8 tmp[CAP + RED];
   u8* p = tmp;
   size_t used() const { return static_cast<size_t>(p - tmp); }
   inline void lit(const std::string& s) {  // preencoded literal
@@ -2059,6 +2074,15 @@ struct DiffBuf {
   inline void nil() { *p++ = 0xc0; }
   inline void boolean(bool v) { *p++ = v ? 0xc3 : 0xc2; }
   inline void array_hdr(size_t n) { *p++ = static_cast<u8>(0x90 | n); }
+  // Every fast-path emit must land through here: the entry checks are
+  // hand-computed headroom constants, so a future added diff field can
+  // silently exceed them -- this assert (live in production; no NDEBUG)
+  // plus the RED slack above turns that into a loud failure while the
+  // overshoot is still inside the buffer.
+  inline void commit(Writer& w) {
+    assert(used() <= CAP);
+    w.raw(tmp, used());
+  }
 };
 
 // worst-case byte size of the conflicts array for a register, so the
@@ -2102,7 +2126,7 @@ static void emit_map_diff(Writer& w, Pool& pool, DocState& st,
       (op.obj == pool.root_sid) ? L_TYPES[T_MAP] : L_TYPES[obj_type];
   const std::string& kstr = pool.intern.str(op.key);
   if (reg.empty()) {
-    if (72 + obj_bytes.size() + kstr.size() + path_bytes.size() <=
+    if (128 + obj_bytes.size() + kstr.size() + path_bytes.size() <=
         DiffBuf::CAP) {
       DiffBuf d;
       d.map_hdr(5);
@@ -2111,7 +2135,7 @@ static void emit_map_diff(Writer& w, Pool& pool, DocState& st,
       d.lit(L_OBJ); d.lit(obj_bytes);
       d.lit(L_KEY); d.str(kstr);
       d.lit(L_PATH); d.bytes(path_bytes.data(), path_bytes.size());
-      w.raw(d.tmp, d.used());
+      d.commit(w);
       return;
     }
     w.map(5);
@@ -2133,7 +2157,7 @@ static void emit_map_diff(Writer& w, Pool& pool, DocState& st,
   // entries); overflow-oracle registers are unbounded and must take the
   // generic Writer path, whose array() encodes any count
   if (reg.size() <= 16 &&
-      96 + obj_bytes.size() + kstr.size() + path_bytes.size() +
+      160 + obj_bytes.size() + kstr.size() + path_bytes.size() +
               (vb ? vb->size() : 1) + (dt ? dt->size() : 0) +
               (reg.size() > 1 ? conflicts_bound(pool, reg) : 0) <=
           DiffBuf::CAP) {
@@ -2153,7 +2177,7 @@ static void emit_map_diff(Writer& w, Pool& pool, DocState& st,
       d.lit(L_CONFLICTS);
       write_conflicts_fast(d, pool, reg);
     }
-    w.raw(d.tmp, d.used());
+    d.commit(w);
     return;
   }
   w.map(n);
@@ -2213,7 +2237,7 @@ static bool emit_list_diff(Writer& w, Pool& pool, Arena& ar,
   const std::string* dt = (setlike && first->datatype != NONE)
                               ? &pool.intern.str(first->datatype) : nullptr;
   if (reg.size() <= 16 &&   // fixarray conflicts bound; see emit_map_diff
-      96 + obj_bytes.size() + kstr.size() + path_bytes.size() +
+      160 + obj_bytes.size() + kstr.size() + path_bytes.size() +
               (vb ? vb->size() : 1) + (dt ? dt->size() : 0) +
               (reg.size() > 1 ? conflicts_bound(pool, reg) : 0) <=
           DiffBuf::CAP) {
@@ -2237,7 +2261,7 @@ static bool emit_list_diff(Writer& w, Pool& pool, Arena& ar,
         write_conflicts_fast(d, pool, reg);
       }
     }
-    w.raw(d.tmp, d.used());
+    d.commit(w);
     return true;
   }
   w.map(n);
