@@ -71,7 +71,8 @@ def _load():
         ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
         ctypes.c_int,
         ctypes.POINTER(ctypes.c_int32),
-        ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int32)]
+        ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int]
     lib.amtpu_dom_dims.argtypes = [ctypes.c_void_p, ctypes.c_int64,
                                    ctypes.POINTER(ctypes.c_int64)]
     lib.amtpu_dom_v0.restype = ctypes.POINTER(ctypes.c_float)
@@ -104,6 +105,8 @@ def _load():
         ctypes.c_int64, ctypes.POINTER(ctypes.c_int32)]
     lib.amtpu_finish.restype = ctypes.c_int
     lib.amtpu_finish.argtypes = [ctypes.c_void_p]
+    lib.amtpu_host_dominance.restype = ctypes.c_int
+    lib.amtpu_host_dominance.argtypes = [ctypes.c_void_p]
     lib.amtpu_batch_trace.argtypes = [ctypes.c_void_p,
                                       ctypes.POINTER(ctypes.c_double)]
     lib.amtpu_sched_counts.argtypes = [ctypes.c_void_p,
@@ -264,6 +267,22 @@ def _devtime_on():
     return os.environ.get('AMTPU_DEVTIME', '0') not in ('', '0')
 
 
+def _host_dom_on():
+    """Host-Fenwick dominance instead of the device kernel.
+
+    The [L]x[L,K] dominance mask products are the right formulation on
+    an accelerator (MXU work, stays fused with resolve+linearize) but
+    O(T*L) scalar work on the CPU backend, where they dominate
+    single-big-doc latency.  Default: host path on CPU, device path on
+    accelerators; AMTPU_HOST_DOM=1/0 forces either way (checked per
+    batch, not latched)."""
+    env = os.environ.get('AMTPU_HOST_DOM')
+    if env is not None:
+        return env not in ('', '0')
+    import jax
+    return jax.default_backend() == 'cpu'
+
+
 def _raise_shard_errors(errors):
     """Per-shard error reporting: a single failure re-raises with its
     shard identified; multiple failures aggregate every shard's message
@@ -393,6 +412,15 @@ class NativeDocPool:
                 weff = 2
                 while weff < max_group:
                     weff *= 2
+            wenv = os.environ.get('AMTPU_WEFF')
+            if wenv and not use_members:
+                # test-only: force a narrower window so the overflow ->
+                # oracle fallback branch is REACHABLE (the dynamic sizing
+                # above makes saturation impossible by construction);
+                # parity still holds because overflow falls back to the
+                # exact host oracle.  tests/test_native.py uses this to
+                # pin the fallback paths under both dominance modes.
+                weff = min(self.WINDOW, max(2, int(wenv)))
             ctx.update(dims=(T, Tp, A, Ap, Larena, Lp, n_blocks, max_obj,
                              CTp), mem=mem, hovf=hovf, weff=weff,
                        resident_ok=bool(resident_ok))
@@ -466,9 +494,12 @@ class NativeDocPool:
             return
         r = self._register_views(L, bh, Tp, Ap, CTp)
         mem = ctx.get('mem')
-        if n_blocks == 0:
-            # register work only (maps/tables, or inserts without list
-            # assigns): rank is consumed by nothing on the host
+
+        def dispatch_registers_only(hostdom=False):
+            # register resolution alone: either there is no list-assign
+            # work at all (n_blocks == 0) or dominance indexes come from
+            # the C++ Fenwick sweep (hostdom) -- rank is consumed by
+            # nothing on the host in both cases
             if mem is not None:
                 reg_out = register_ops.resolve_registers_members(
                     r['t'], r['a'], r['s'], mem, r['d'].astype(bool),
@@ -483,11 +514,22 @@ class NativeDocPool:
             combo = reg_out['packed']
             combo.copy_to_host_async()
             ctx.update(mode='fused', combo=combo, reg_out=reg_out,
-                       rank=None)
+                       rank=None, hostdom=hostdom)
+
+        if n_blocks == 0:
+            dispatch_registers_only()
             return
         if ctx.get('resident_ok') and mem is None and \
                 self._dispatch_resident(L, ctx, Tp, Ap, CTp, max_obj,
                                         dLp, dTp):
+            return
+        if _host_dom_on():
+            # CPU backend: dispatch ONLY register resolution; ranks and
+            # dominance indexes come from the C++ Fenwick sweep in
+            # phase b (amtpu_host_dominance) instead of the quadratic
+            # device kernel.  See _host_dom_on for the rationale.
+            dispatch_registers_only(hostdom=True)
+            trace.count('hostdom.dispatch')
             return
         e = self._arena_views(L, bh, Lp)
         n_iters = list_rank.ceil_log2(max(max_obj, 1)) + 1
@@ -652,24 +694,37 @@ class NativeDocPool:
                 rank_arr = (np.ascontiguousarray(ctx['rank'], np.int32)
                             if ctx['rank'] is not None
                             else np.zeros(0, np.int32))
+                hostdom = ctx.get('hostdom')
                 with trace.span('host.mid'):
                     if L.amtpu_mid(bh, ip(winner), ip(conflicts),
                                    ctx['weff'], ip(alive), up(overflow),
-                                   ip(rank_arr)) != 0:
+                                   None if hostdom else ip(rank_arr),
+                                   1 if hostdom else 0) != 0:
                         _raise_last()
-                t0 = time.perf_counter() if _devtime_on() else 0.0
-                with trace.span('device.dominance'):
-                    self._run_dominance(L, bh)
-                if t0:
-                    trace.metric('device.dispatch_sync_s',
-                                 time.perf_counter() - t0)
+                if hostdom:
+                    with trace.span('host.dominance'):
+                        if L.amtpu_host_dominance(bh) != 0:
+                            _raise_last()
+                else:
+                    t0 = time.perf_counter() if _devtime_on() else 0.0
+                    with trace.span('device.dominance'):
+                        self._run_dominance(L, bh)
+                    if t0:
+                        trace.metric('device.dispatch_sync_s',
+                                     time.perf_counter() - t0)
+                        trace.metric('device.dispatches')
             else:
+                hostdom = ctx.get('hostdom')
                 with trace.span('host.mid'):
                     if L.amtpu_mid_packed(
                             bh, ip(packed), ctx['weff'], ip(conf_rows),
                             ip(conf_vals), len(conf_rows),
-                            ip(dom_idx)) != 0:
+                            None if hostdom else ip(dom_idx)) != 0:
                         _raise_last()
+                if hostdom:
+                    with trace.span('host.dominance'):
+                        if L.amtpu_host_dominance(bh) != 0:
+                            _raise_last()
         else:
             with trace.span('device.collect'):
                 reg_out, rank = ctx['reg_out'], ctx['rank']
@@ -692,7 +747,7 @@ class NativeDocPool:
             with trace.span('host.mid'):
                 if L.amtpu_mid(bh, ip(winner), ip(conflicts), ctx['weff'],
                                ip(alive), up(overflow),
-                               ip(rank_arr)) != 0:
+                               ip(rank_arr), 0) != 0:
                     _raise_last()
             t0 = time.perf_counter() if _devtime_on() else 0.0
             with trace.span('device.dominance'):
@@ -700,6 +755,7 @@ class NativeDocPool:
             if t0:
                 trace.metric('device.dispatch_sync_s',
                              time.perf_counter() - t0)
+                trace.metric('device.dispatches')
 
         with trace.span('host.finish'):
             if L.amtpu_finish(bh) != 0:

@@ -927,6 +927,10 @@ struct Batch {
   // changes admitted by the in-order fast path vs through the causal
   // queue fixpoint
   i64 n_sched_fast = 0, n_sched_queued = 0;
+  // caller declared it will fill indexes via amtpu_host_dominance, so
+  // mid_phase must not fill the device-fallback mirrors (amtpu_mid's
+  // host_dom parameter)
+  bool host_dom = false;
 };
 
 // thread CPU time, not wall: phase costs stay truthful when sharded pools
@@ -1799,7 +1803,14 @@ static void mid_phase(Pool& pool, Batch& b) {
   }
 
   // fill the fallback-path mirrors (er/orank from the fetched rank, od
-  // from running host visibility); timelines/layout were built at begin
+  // from running host visibility); timelines/layout were built at begin.
+  // Host-dominance callers declared themselves via amtpu_mid's host_dom
+  // flag: the mirrors only feed the device fallback kernel, which never
+  // runs there.
+  if (b.host_dom) {
+    b.result.clear();
+    return;
+  }
   std::unordered_map<u64, char> vis_now;  // (arena base + eidx) -> bool
   for (auto& blk : b.dom_blocks) {
     blk.er.assign(blk.W * blk.Lp, -1);
@@ -1831,6 +1842,128 @@ static void mid_phase(Pool& pool, Batch& b) {
     }
   }
   b.result.clear();
+}
+
+// ---------------------------------------------------------------------------
+// host dominance: exact per-op list indexes without the device kernel.
+//
+// The fused device formulation computes index(op t on element e) =
+// #{e': obj(e')==obj(e), rank(e')<rank(e), visible just before t} as
+// [L]x[L,K] mask products -- MXU-shaped work that is the right design on
+// an accelerator but O(T*L) scalar work on the CPU backend, where it
+// dominates single-big-doc latency (config 1: ~85% of wall).  This host
+// twin computes the same indexes in O((L+T) log L): RGA ranks from a
+// pre-order walk of the sibling-sorted tree (the same total order the
+// pointer-doubling `linearize` kernel produces,
+// automerge_tpu/ops/list_rank.py:42), then a Fenwick-tree sweep over the
+// timeline with visibility deltas from the resolved registers.
+// Dispatched per-platform by the Python driver (AMTPU_HOST_DOM, default:
+// on for the CPU backend only); parity is pinned by the differential
+// suites run both ways (tests/test_native.py).
+// ---------------------------------------------------------------------------
+
+// Per-object RGA pre-order rank of every arena row, derived host-side
+// from lin_sort: within an arena segment the rows are sorted by
+// (parent, -ctr, -actor), so each parent's children are contiguous in
+// sibling order and one explicit-stack DFS yields the pre-order.
+static void host_rank(Batch& b, std::vector<i32>& rank) {
+  build_lin_sort(b);
+  rank.assign(static_cast<size_t>(b.L), -1);
+  if (b.L == 0) return;
+  // children ranges, indexed by global parent row (-1 handled per segment)
+  std::vector<i32> child_start(static_cast<size_t>(b.L), -1);
+  std::vector<i32> child_cnt(static_cast<size_t>(b.L), 0);
+  i64 seg = 0;
+  std::vector<i32> stack;
+  while (seg < b.L) {
+    i64 end = seg + 1;
+    const i32 o = b.obj_col[seg];
+    while (end < b.L && b.obj_col[end] == o) ++end;
+    i64 head_start = -1, head_cnt = 0;
+    for (i64 p = seg; p < end; ++p) {
+      i32 par = b.par_col[b.lin_sort[p]];
+      if (par < 0) {
+        if (head_start < 0) head_start = p;
+        ++head_cnt;
+      } else {
+        if (child_start[par] < 0) child_start[par] = static_cast<i32>(p);
+        ++child_cnt[par];
+      }
+    }
+    stack.clear();
+    for (i64 c = head_cnt - 1; c >= 0; --c)
+      stack.push_back(b.lin_sort[head_start + c]);
+    i32 r = 0;
+    while (!stack.empty()) {
+      i32 node = stack.back();
+      stack.pop_back();
+      rank[node] = r++;
+      i32 cs = child_start[node], cn = child_cnt[node];
+      for (i32 c = cn - 1; c >= 0; --c)
+        stack.push_back(b.lin_sort[cs + c]);
+    }
+    seg = end;
+  }
+}
+
+// prefix-sum Fenwick over rank positions (counts of visible elements)
+struct Fenwick {
+  std::vector<i32> t;
+  void reset(size_t n) { t.assign(n + 1, 0); }
+  void add(i32 i, i32 d) {
+    for (i32 x = i + 1; x < static_cast<i32>(t.size()); x += x & -x)
+      t[x] += d;
+  }
+  i32 prefix(i32 i) const {  // sum of positions [0, i)
+    i32 s = 0;
+    for (i32 x = i; x > 0; x -= x & -x) s += t[x];
+    return s;
+  }
+};
+
+static void host_dominance(Batch& b) {
+  if (b.dom_blocks.empty()) return;
+  std::vector<i32> rank;
+  host_rank(b, rank);
+  Fenwick fen;
+  std::vector<u8> vis;
+  for (auto& blk : b.dom_blocks) {
+    for (size_t o = 0; o < blk.akeys.size(); ++o) {
+      u64 ak = blk.akeys[o];
+      i64 base = b.arena_base[ak];
+      Arena& ar = b.bdocs[ak >> 32]->arenas[static_cast<u32>(ak)];
+      size_t n = ar.ctr.size();
+      fen.reset(n);
+      vis.assign(n, 0);
+      for (size_t i = 0; i < n; ++i) {
+        if (ar.visible[i]) {
+          vis[i] = 1;
+          fen.add(rank[base + i], 1);
+        }
+      }
+      auto& entries = b.obj_ops[ak];
+      for (size_t t = 0; t < entries.size(); ++t) {
+        const DomEntry& e = entries[t];
+        bool alive_now;
+        auto hit = b.host_registers.find(e.op_idx);
+        if (hit != b.host_registers.end()) {
+          alive_now = !hit->second.empty();
+        } else if (b.packed_mode) {
+          alive_now = ((b.k_packed[e.reg_row] >> 24) & 0xf) > 0;
+        } else {
+          alive_now = b.k_alive[e.reg_row] > 0;
+        }
+        i32 r = rank[base + e.eidx];
+        blk.indexes[o * blk.Tp + t] = fen.prefix(r);
+        i32 before = vis[e.eidx];
+        i32 delta = static_cast<i32>(alive_now) - before;
+        if (delta) {
+          fen.add(r, delta);
+          vis[e.eidx] = alive_now ? 1 : 0;
+        }
+      }
+    }
+  }
 }
 
 // ---------------------------------------------------------------------------
@@ -2931,11 +3064,16 @@ const int32_t* amtpu_col_linsort(void* bp) {
 // computes overflow fallbacks + dominance blocks
 int amtpu_mid(void* bp, const int32_t* winner, const int32_t* conflicts,
               int window, const int32_t* alive,
-              const uint8_t* overflow, const int32_t* rank) {
+              const uint8_t* overflow, const int32_t* rank, int host_dom) {
   BatchHandle& h = *static_cast<BatchHandle*>(bp);
   Batch& b = h.batch;
   try {
     b.window = window;
+    b.host_dom = host_dom != 0;
+    if (b.host_dom && rank)
+      throw Error(0, "amtpu_mid: host_dom callers must pass rank=NULL");
+    if (!b.host_dom && !rank && !b.dom_blocks.empty())
+      throw Error(0, "amtpu_mid: device-dominance callers must pass rank");
     if (b.Tp > 0) {
       b.k_winner.assign(winner, winner + b.Tp);
       b.k_conflicts.assign(conflicts, conflicts + b.Tp * window);
@@ -2943,8 +3081,9 @@ int amtpu_mid(void* bp, const int32_t* winner, const int32_t* conflicts,
       b.k_overflow.assign(overflow, overflow + b.Tp);
     }
     // rank is only consumed by the dominance-block mirror fill; callers
-    // with no dominance work pass an empty buffer
-    if (b.Lp > 0 && !b.dom_blocks.empty())
+    // with no dominance work pass an empty buffer, and host-dominance
+    // callers pass NULL (ranks are recomputed host-side there)
+    if (b.Lp > 0 && !b.dom_blocks.empty() && rank)
       b.rank.assign(rank, rank + b.Lp);
     double t0 = mono_now();
     mid_phase(*h.pool, b);
@@ -2976,9 +3115,11 @@ int amtpu_mid_fused(void* bp, const int32_t* winner, const int32_t* conflicts,
       b.k_overflow.assign(overflow, overflow + b.Tp);
     }
     i64 off = 0;
-    for (auto& blk : b.dom_blocks) {
-      blk.indexes.assign(dom_idx + off, dom_idx + off + blk.W * blk.Tp);
-      off += blk.W * blk.Tp;
+    if (dom_idx) {
+      for (auto& blk : b.dom_blocks) {
+        blk.indexes.assign(dom_idx + off, dom_idx + off + blk.W * blk.Tp);
+        off += blk.W * blk.Tp;
+      }
     }
     b.tr_mid = mono_now() - t0;
   } catch (const Error& e) {
@@ -3019,9 +3160,11 @@ int amtpu_mid_packed(void* bp, const int32_t* packed, int window,
           static_cast<u64>(conf_rows[i])).first = row_vals;
     }
     i64 off = 0;
-    for (auto& blk : b.dom_blocks) {
-      blk.indexes.assign(dom_idx + off, dom_idx + off + blk.W * blk.Tp);
-      off += blk.W * blk.Tp;
+    if (dom_idx) {      // NULL when the caller uses amtpu_host_dominance
+      for (auto& blk : b.dom_blocks) {
+        blk.indexes.assign(dom_idx + off, dom_idx + off + blk.W * blk.Tp);
+        off += blk.W * blk.Tp;
+      }
     }
     b.tr_mid = mono_now() - t0;
   } catch (const Error& e) {
@@ -3127,6 +3270,24 @@ const uint8_t* amtpu_dom_ov(void* bp, int64_t blk) { return static_cast<BatchHan
 void amtpu_dom_set_indexes(void* bp, int64_t blk, const int32_t* idx) {
   DomBlock& d = static_cast<BatchHandle*>(bp)->batch.dom_blocks[blk];
   d.indexes.assign(idx, idx + d.W * d.Tp);
+}
+
+// Fenwick-sweep dominance indexes on the host (CPU-backend fast path);
+// call after amtpu_mid/amtpu_mid_packed stored the register outputs.
+int amtpu_host_dominance(void* bp) {
+  BatchHandle& h = *static_cast<BatchHandle*>(bp);
+  try {
+    double t0 = mono_now();
+    host_dominance(h.batch);
+    h.batch.tr_mid += mono_now() - t0;
+  } catch (const Error& e) {
+    g_error = e.what(); g_error_kind = e.kind;
+    return -1;
+  } catch (const std::exception& e) {
+    g_error = e.what(); g_error_kind = 0;
+    return -1;
+  }
+  return 0;
 }
 
 // ---- phase 3 --------------------------------------------------------------

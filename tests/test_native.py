@@ -424,3 +424,134 @@ def test_wide_overflow_register_conflicts_emit_correctly():
     assert patch == Backend.get_patch(st)
     final = [d for d in patch['diffs'] if d.get('key') == 'hot'][-1]
     assert len(final['conflicts']) == 19
+
+
+class TestHostDominanceParity:
+    """A/B parity between the two dominance implementations: the device
+    kernel (`ops/pallas_dominance.py` / the fused dispatch) and the C++
+    Fenwick sweep (`amtpu_host_dominance`), which the driver selects
+    per-platform (AMTPU_HOST_DOM; default host on the CPU backend).  The
+    env knob is read per BATCH, so one process can drive both paths on
+    identical inputs and require byte-identical patch streams."""
+
+    def _run(self, batches, hostdom):
+        import os
+        prior = os.environ.get('AMTPU_HOST_DOM')
+        os.environ['AMTPU_HOST_DOM'] = hostdom
+        try:
+            pool = native_pool()
+            out = [pool.apply_batch(b) for b in batches]
+            out.append(pool.get_patch(0))
+            return out
+        finally:
+            if prior is None:
+                os.environ.pop('AMTPU_HOST_DOM', None)
+            else:
+                os.environ['AMTPU_HOST_DOM'] = prior
+
+    @pytest.mark.parametrize('seed,structure', [
+        (31, 'list'), (32, 'mixed'), (33, 'mixed'),
+    ])
+    def test_ab_identical_random(self, seed, structure):
+        changes = WorkloadGen(seed, structure=structure).generate(24)
+        rng = random.Random(seed)
+        batches = []
+        i = 0
+        while i < len(changes):
+            n = rng.randint(1, 6)
+            batches.append({0: changes[i:i + n]})
+            i += n
+        assert self._run(batches, '1') == self._run(batches, '0')
+
+    def test_ab_identical_interleaved_delete(self, ):
+        """Concurrent insert/delete on one text: visibility deltas hit
+        the Fenwick sweep's -1 path and the remove-index bookkeeping."""
+        chs = [{'actor': 'a0', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'makeText', 'obj': 't'},
+            {'action': 'link', 'obj': ROOT_ID, 'key': 'text',
+             'value': 't'}]}]
+        last = '_head'
+        e = 0
+        live = []
+        rng = random.Random(99)
+        for seq in range(2, 12):
+            ops = []
+            for _ in range(20):
+                if live and rng.random() < 0.3:
+                    victim = live.pop(rng.randrange(len(live)))
+                    ops.append({'action': 'del', 'obj': 't',
+                                'key': victim})
+                else:
+                    e += 1
+                    ops.append({'action': 'ins', 'obj': 't', 'key': last,
+                                'elem': e})
+                    ops.append({'action': 'set', 'obj': 't',
+                                'key': 'a0:%d' % e, 'value': 'x'})
+                    last = 'a0:%d' % e
+                    live.append(last)
+            chs.append({'actor': 'a0', 'seq': seq, 'deps': {},
+                        'ops': ops})
+        batches = [{0: [c]} for c in chs]
+        a = self._run(batches, '1')
+        b = self._run(batches, '0')
+        assert a == b
+        # and both equal the scalar oracle
+        st = Backend.init()
+        st, _ = Backend.apply_changes(st, chs)
+        assert a[-1] == Backend.get_patch(st)
+
+    @pytest.mark.parametrize('hostdom', ['1', '0'])
+    def test_overflow_fallback_under_both_dominance_modes(self, hostdom):
+        """The fused overflow -> oracle fallback with LIST dominance
+        work, under both dominance modes.  The dynamic window makes
+        saturation unreachable in normal operation, so AMTPU_WEFF=2
+        forces a 2-wide window against 5 concurrent writers per element:
+        the kernel flags overflow, amtpu_mid re-resolves the groups with
+        the host oracle, and indexes come from the device kernel
+        (hostdom=0) or the Fenwick sweep consuming host_registers
+        (hostdom=1).  Both must match the scalar oracle byte-for-byte."""
+        import os
+        chs = [{'actor': 'a0', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'makeList', 'obj': 'l'},
+            {'action': 'link', 'obj': ROOT_ID, 'key': 'list',
+             'value': 'l'},
+            {'action': 'ins', 'obj': 'l', 'key': '_head', 'elem': 1},
+            {'action': 'set', 'obj': 'l', 'key': 'a0:1', 'value': 'base'},
+            {'action': 'ins', 'obj': 'l', 'key': 'a0:1', 'elem': 2},
+            {'action': 'set', 'obj': 'l', 'key': 'a0:2', 'value': 'two'},
+        ]}]
+        # 5 concurrent writers on BOTH elements (wide register groups on
+        # list element keys -> window overflow at weff=2)
+        for a in range(1, 6):
+            chs.append({'actor': 'w%d' % a, 'seq': 1, 'deps': {'a0': 1},
+                        'ops': [
+                {'action': 'set', 'obj': 'l', 'key': 'a0:1',
+                 'value': 'w%d-1' % a},
+                {'action': 'set', 'obj': 'l', 'key': 'a0:2',
+                 'value': 'w%d-2' % a} if a != 3 else
+                {'action': 'del', 'obj': 'l', 'key': 'a0:2'},
+            ]})
+        st = Backend.init()
+        st, _ = Backend.apply_changes(st, chs)
+
+        prior = {k: os.environ.get(k)
+                 for k in ('AMTPU_WEFF', 'AMTPU_HOST_DOM')}
+        os.environ['AMTPU_WEFF'] = '2'
+        os.environ['AMTPU_HOST_DOM'] = hostdom
+        try:
+            from automerge_tpu import trace
+            trace.metrics_reset()
+            pool = native_pool()
+            # deliver concurrent writers as ONE batch so the register
+            # rows coexist in one dispatch
+            pool.apply_batch({0: [chs[0]]})
+            pool.apply_batch({0: chs[1:]})
+            assert pool.get_patch(0) == Backend.get_patch(st)
+            m = trace.metrics_snapshot()
+            assert m.get('fallback.overflow_batches', 0) >= 1, m
+        finally:
+            for k, v in prior.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
