@@ -107,6 +107,8 @@ def _load():
     lib.amtpu_finish.argtypes = [ctypes.c_void_p]
     lib.amtpu_host_dominance.restype = ctypes.c_int
     lib.amtpu_host_dominance.argtypes = [ctypes.c_void_p]
+    lib.amtpu_mid_hostreg.restype = ctypes.c_int
+    lib.amtpu_mid_hostreg.argtypes = [ctypes.c_void_p]
     lib.amtpu_batch_trace.argtypes = [ctypes.c_void_p,
                                       ctypes.POINTER(ctypes.c_double)]
     lib.amtpu_sched_counts.argtypes = [ctypes.c_void_p,
@@ -320,7 +322,7 @@ class NativeDocPool:
     WINDOW = 8
     #: entries amtpu_batch_dims writes -- must match core.cpp exactly
     #: (an undersized ctypes buffer is silent heap corruption)
-    N_DIMS = 12
+    N_DIMS = 13
 
     def __init__(self):
         self._pool = lib().amtpu_pool_new()
@@ -382,7 +384,8 @@ class NativeDocPool:
             dims = (ctypes.c_int64 * self.N_DIMS)()
             L.amtpu_batch_dims(bh, dims)
             (T, Tp, A, Ap, Larena, Lp, n_blocks, max_obj, CTp,
-             use_members, any_ovf, max_group) = [int(x) for x in dims]
+             use_members, any_ovf, max_group, pre_ovf) = \
+                [int(x) for x in dims]
             # 6 slots -- must match what amtpu_fused_dims writes exactly
             # (an undersized ctypes buffer is silent heap corruption)
             fdims = (ctypes.c_int64 * 6)()
@@ -424,6 +427,22 @@ class NativeDocPool:
             ctx.update(dims=(T, Tp, A, Ap, Larena, Lp, n_blocks, max_obj,
                              CTp), mem=mem, hovf=hovf, weff=weff,
                        resident_ok=bool(resident_ok))
+
+            # Host-register mode: when a map-only batch's register rows
+            # mostly sit in groups wider than the member window, the
+            # kernel's output would be discarded for every overflowed
+            # row and the host oracle re-resolves them anyway.  Skip the
+            # dispatch entirely; emit resolves each register against the
+            # live mirror in one O(w) merge (no sort).  The 64-writer
+            # replica catch-up shape (BASELINE config 5) is the
+            # canonical case.
+            if (use_members and n_blocks == 0 and 2 * pre_ovf >= T
+                    and os.environ.get('AMTPU_HOST_REG', '1')
+                    not in ('', '0')):
+                trace.count('hostreg.batches')
+                trace.metric('hostreg.batches')
+                ctx.update(mode='hostreg')
+                return ctx
 
             devtime = _devtime_on()
             t0 = time.perf_counter() if devtime else 0.0
@@ -647,7 +666,11 @@ class NativeDocPool:
         def up(a):
             return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
 
-        if ctx['mode'] == 'fused':
+        if ctx['mode'] == 'hostreg':
+            with trace.span('host.mid'):
+                if L.amtpu_mid_hostreg(bh) != 0:
+                    _raise_last()
+        elif ctx['mode'] == 'fused':
             with trace.span('device.collect'):
                 if ctx['combo'] is None:
                     packed = dom_idx = np.zeros(0, np.int32)

@@ -885,6 +885,11 @@ struct Batch {
   // candidate predecessor indexes + host-computed overflow flags
   bool use_members = false;
   bool any_ovf = false;
+  i64 n_pre_ovf = 0;    // rows pre-flagged host_ovf at member build
+  // resolve registers incrementally at emit against the live mirror --
+  // no kernel dispatch at all (amtpu_mid_hostreg; map-only batches
+  // whose groups are mostly wider than the member window)
+  bool host_reg_mode = false;
   std::vector<i32> mem_idx;    // [Tp * WINDOW]
   std::vector<u8> host_ovf;    // [Tp]
 
@@ -1514,6 +1519,7 @@ static void encode(Pool& pool, Batch& b) {
         if (g >= 0 && govf[g]) {
           b.host_ovf[r] = 1;
           b.any_ovf = true;
+          ++b.n_pre_ovf;
         }
       }
     }
@@ -1964,6 +1970,36 @@ static void host_dominance(Batch& b) {
       }
     }
   }
+}
+
+// In-emit incremental register resolution (host_reg_mode): st.registers
+// holds the running survivor set for each key -- actor descending, ties
+// newest-first, maintained by update_register_mirror right after each
+// emitted op -- so one O(w) merge applies the next op with oracle
+// semantics (op_set.js:202-220) and NO sort: priors are already ordered
+// and the new op slots in front of its own actor's run.  This replaces
+// both the device register kernel and the mid-phase scratch oracle for
+// batches where most groups are wider than the member window (the
+// kernel's output would be discarded for every overflowed row anyway).
+static void host_resolve_step(Pool& pool, DocState& st, const OpRec& op,
+                              Register& reg) {
+  reg.clear();
+  const Register* rit =
+      st.registers.find(DocState::rkey(op.obj, op.key));
+  const bool add = op.action != A_DEL;
+  bool placed = false;
+  if (rit && !rit->empty()) {
+    const std::string& oa = pool.intern.str(op.actor);
+    for (const OpRec& o : *rit) {
+      if (add && !placed &&
+          !(pool.intern.str(o.actor) > oa)) {  // first prior not above us
+        reg.push_back(op);
+        placed = true;
+      }
+      if (rec_concurrent(st, o, op)) reg.push_back(o);
+    }
+  }
+  if (add && !placed) reg.push_back(op);
 }
 
 // ---------------------------------------------------------------------------
@@ -2523,12 +2559,19 @@ static void emit(Pool& pool, Batch& b) {
     if (op.action == A_INS) continue;
 
     i64 row = b.assign_row_of_op[op_idx];
-    bool from_host = false;
-    if (!b.host_registers.empty()) {
-      auto hit = b.host_registers.find(static_cast<i64>(op_idx));
-      if (hit != b.host_registers.end()) { reg = hit->second; from_host = true; }
+    if (b.host_reg_mode) {
+      host_resolve_step(pool, st, op, reg);
+    } else {
+      bool from_host = false;
+      if (!b.host_registers.empty()) {
+        auto hit = b.host_registers.find(static_cast<i64>(op_idx));
+        if (hit != b.host_registers.end()) {
+          reg = hit->second;
+          from_host = true;
+        }
+      }
+      if (!from_host) register_from_kernel(b, row, reg);
     }
-    if (!from_host) register_from_kernel(b, row, reg);
 
     // undo capture reads the register BEFORE this op's mirror update --
     // the same interleaved order as the reference (op_set.js:193-200);
@@ -3032,6 +3075,7 @@ void amtpu_batch_dims(void* bp, int64_t* out) {
   out[9] = b.use_members ? 1 : 0;
   out[10] = b.any_ovf ? 1 : 0;
   out[11] = b.max_group;
+  out[12] = b.n_pre_ovf;
 }
 
 const int32_t* amtpu_col_memidx(void* bp) { return static_cast<BatchHandle*>(bp)->batch.mem_idx.data(); }
@@ -3270,6 +3314,27 @@ const uint8_t* amtpu_dom_ov(void* bp, int64_t blk) { return static_cast<BatchHan
 void amtpu_dom_set_indexes(void* bp, int64_t blk, const int32_t* idx) {
   DomBlock& d = static_cast<BatchHandle*>(bp)->batch.dom_blocks[blk];
   d.indexes.assign(idx, idx + d.W * d.Tp);
+}
+
+// Host-register mode: no kernel dispatch at all -- emit resolves each
+// register incrementally against the live mirror (host_resolve_step).
+// Caller gates on: map-only batch (no dominance blocks) with most
+// register rows pre-flagged host_ovf (the driver's _host_reg_on).
+int amtpu_mid_hostreg(void* bp) {
+  BatchHandle& h = *static_cast<BatchHandle*>(bp);
+  Batch& b = h.batch;
+  try {
+    if (!b.dom_blocks.empty())
+      throw Error(0, "hostreg mode requires a batch with no list work");
+    b.host_reg_mode = true;
+  } catch (const Error& e) {
+    g_error = e.what(); g_error_kind = e.kind;
+    return -1;
+  } catch (const std::exception& e) {
+    g_error = e.what(); g_error_kind = 0;
+    return -1;
+  }
+  return 0;
 }
 
 // Fenwick-sweep dominance indexes on the host (CPU-backend fast path);

@@ -555,3 +555,68 @@ class TestHostDominanceParity:
                     os.environ.pop(k, None)
                 else:
                     os.environ[k] = v
+
+
+class TestHostRegisterMode:
+    """Host-register mode (amtpu_mid_hostreg): map-only batches whose
+    register groups are mostly wider than the member window skip the
+    kernel dispatch entirely and resolve at emit against the live
+    mirror.  A/B vs the member-kernel + scratch-oracle path
+    (AMTPU_HOST_REG=0) and vs the scalar oracle."""
+
+    def _drive(self, batches, hostreg):
+        import os
+        prior = os.environ.get('AMTPU_HOST_REG')
+        os.environ['AMTPU_HOST_REG'] = hostreg
+        try:
+            from automerge_tpu import trace
+            trace.metrics_reset()
+            pool = native_pool()
+            out = [pool.apply_batch(b) for b in batches]
+            out.append(pool.get_patch(0))
+            engaged = trace.metrics_snapshot().get('hostreg.batches', 0)
+            if hostreg == '1':
+                # the gate must actually fire, else the A/B is vacuous
+                assert engaged > 0, 'hostreg gate never engaged'
+            else:
+                assert engaged == 0, 'hostreg ran despite AMTPU_HOST_REG=0'
+            return out
+        finally:
+            if prior is None:
+                os.environ.pop('AMTPU_HOST_REG', None)
+            else:
+                os.environ['AMTPU_HOST_REG'] = prior
+
+    def test_wide_groups_incremental_with_deletes(self):
+        rng = random.Random(41)
+        changes = []
+        # 14 concurrent writers x 4 sequential changes each over a
+        # shared 6-key space, with deletes -- every group wider than
+        # the W=8 member window
+        for seq in range(1, 5):
+            for a in range(14):
+                ops = []
+                for k in rng.sample(range(6), 4):
+                    if rng.random() < 0.2:
+                        ops.append({'action': 'del', 'obj': ROOT_ID,
+                                    'key': 'k%d' % k})
+                    else:
+                        ops.append({'action': 'set', 'obj': ROOT_ID,
+                                    'key': 'k%d' % k,
+                                    'value': 'w%02d-%d' % (a, seq)})
+                changes.append({'actor': 'w%02d' % a, 'seq': seq,
+                                'deps': {}, 'ops': ops})
+        # incremental delivery in writer-interleaved order
+        batches = []
+        i = 0
+        while i < len(changes):
+            n = rng.randint(3, 9)
+            batches.append({0: changes[i:i + n]})
+            i += n
+        on = self._drive(batches, '1')
+        off = self._drive(batches, '0')
+        assert on == off
+        st = Backend.init()
+        for b in batches:
+            st, _ = Backend.apply_changes(st, b[0])
+        assert on[-1] == Backend.get_patch(st)
