@@ -524,12 +524,13 @@ class NativeDocPool:
                     r['t'], r['a'], r['s'], mem, r['d'].astype(bool),
                     r['ctab'], r['cidx'], window=ctx['weff'])
             else:
-                reg_out = register_ops.resolve_registers(
-                    r['g'], r['t'], r['a'], r['s'],
-                    is_del=r['d'].astype(bool),
-                    alive_in=np.ones((Tp,), bool), window=ctx['weff'],
-                    sort_idx=r['si'], clock_table=r['ctab'],
-                    clock_idx=r['cidx'])
+                # Pallas stencil kernel on TPU (VMEM-resident pairwise
+                # temporaries), XLA twin elsewhere -- bit-equal outputs
+                from ..ops.pallas_registers import resolve_registers_auto
+                reg_out = resolve_registers_auto(
+                    r['g'], r['t'], r['a'], r['s'], r['d'].astype(bool),
+                    np.ones((Tp,), bool), r['si'], r['ctab'], r['cidx'],
+                    window=ctx['weff'])
             combo = reg_out['packed']
             combo.copy_to_host_async()
             ctx.update(mode='fused', combo=combo, reg_out=reg_out,

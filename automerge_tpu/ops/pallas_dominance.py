@@ -26,7 +26,6 @@ to the XLA kernel off-TPU or for tiny shapes.
 """
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
@@ -110,24 +109,9 @@ def dominance_grouped_pallas(vis0, elem_rank, op_elem, op_rank, op_delta,
       op_delta.astype(jnp.int32), op_valid.astype(jnp.int32))
 
 
-def _on_tpu():
-    try:
-        return jax.devices()[0].platform == 'tpu'
-    except Exception:
-        return False
-
-
-# platform cached once per process; the AMTPU_NO_PALLAS kill switch is
-# re-read per call so it works whenever it is set
-@functools.lru_cache(maxsize=1)
-def _on_tpu_cached():
-    return _on_tpu()
-
-
 def _use_pallas():
-    if os.environ.get('AMTPU_NO_PALLAS'):
-        return False
-    return _on_tpu_cached()
+    from .pallas_common import pallas_enabled
+    return pallas_enabled()
 
 
 def dominance_grouped_auto(vis0, elem_rank, op_elem, op_rank, op_delta,

@@ -1,0 +1,247 @@
+"""Pallas TPU kernel for sliding-window register resolution.
+
+Same contract as `registers.resolve_registers` (reference semantics:
+partition register ops into overwritten vs concurrent, winner = max
+actor, `/root/reference/backend/op_set.js:188-231`), restricted to the
+sorted sliding-window form: after the host's (group, time) sort, the
+candidate predecessors of sorted row i are exactly rows i-W..i-1, so
+member formation is a stencil -- no gathers anywhere.
+
+What Pallas buys over the XLA twin: the [B, W+1, A] one-hot and the
+[B, W+1, W+1] pairwise concurrency/supersession intermediates live and
+die in VMEM per 128-row block instead of materializing [T, W+1, A] /
+[T, W+1, W+1] through HBM -- on a v5e the XLA formulation's HBM traffic
+is ~(W+1)x the input volume, which is the whole cost of this
+bandwidth-bound kernel (the MXU work is one tiny clock*onehot product
+per block).
+
+Ordering without argsort (Mosaic has no stable sort): survivor output
+order is (actor desc, time desc) and times are unique, so each alive
+member's output position is a PAIRWISE COUNT --
+  pos(u) = #{v alive : actor_v > actor_u
+                       or (actor_v == actor_u and time_v > time_u)}
+-- and winner/conflicts scatter through a position one-hot.  Bit-equal
+to the XLA twin's two stable argsorts (pinned by
+tests/test_ops_kernels.py::TestPallasRegisters).
+
+Auto-dispatch: `resolve_registers_auto` uses the Pallas kernel on TPU
+when shapes fit (T % 128 == 0, VMEM budget, W <= 8) and falls back to
+the XLA kernel otherwise -- including on ANY compile/lowering failure,
+which latches the Pallas path off for the process (the tunneled-TPU
+image cannot be compile-probed at import time).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import registers as xla_registers
+
+_B = 128      # sorted rows per grid program
+_PADW = 128   # front pad so halo loads stay 128-aligned
+
+
+def _kernel(g_ref, t_ref, a_ref, q_ref, d_ref, c_ref, src_ref,
+            winner_ref, conflicts_ref, alive_ref, vb_ref, ovf_ref,
+            g_s, t_s, a_s, q_s, d_s, src_s, c_s, sems, *, W, A):
+    b = pl.program_id(0)
+    start = b * _B
+
+    # halo DMA: rows [start, start + PADW + B) of each padded column
+    cols = ((g_ref, g_s), (t_ref, t_s), (a_ref, a_s), (q_ref, q_s),
+            (d_ref, d_s), (src_ref, src_s))
+    dmas = []
+    for i, (ref, scratch) in enumerate(cols):
+        dmas.append(pltpu.make_async_copy(
+            ref.at[pl.ds(start, _PADW + _B)], scratch, sems.at[i]))
+    dmas.append(pltpu.make_async_copy(
+        c_ref.at[pl.ds(start, _PADW + _B)], c_s, sems.at[len(cols)]))
+    for d in dmas:
+        d.start()
+    for d in dmas:
+        d.wait()
+
+    def members(col):
+        """[B, W+1]: slot 0 = self, slot w = w-th predecessor."""
+        return jnp.stack(
+            [jax.lax.slice_in_dim(col, _PADW - w, _PADW - w + _B, axis=0)
+             for w in range(W + 1)], axis=1)
+
+    m_g = members(g_s[:])
+    m_t = members(t_s[:])
+    m_a = members(a_s[:])
+    m_q = members(q_s[:])
+    m_d = members(d_s[:])
+    m_src = members(src_s[:])
+    g_cur = m_g[:, 0]
+    m_valid = (m_g == g_cur[:, None]) & (g_cur >= 0)[:, None]   # [B, W+1]
+
+    # member clocks: [B, W+1, A] slices of the halo clock block
+    m_clk = jnp.stack(
+        [jax.lax.slice_in_dim(c_s[:], _PADW - w, _PADW - w + _B, axis=0)
+         for w in range(W + 1)], axis=1)
+
+    # P[b, u, v] = clock_u[actor_v] via one-hot multiply-reduce (Mosaic
+    # rejects batched dot_general; the temporaries stay in VMEM).  All
+    # arithmetic stays int32: float32 would silently round seqs/clock
+    # entries at 2^24, flipping supersession verdicts for long-lived
+    # actors -- the XLA twin compares in int32.
+    lanes = jax.lax.broadcasted_iota(jnp.int32, (_B, W + 1, A), 2)
+    onehot = (lanes == m_a[:, :, None]).astype(jnp.int32)
+    P = jnp.sum(m_clk[:, :, None, :] * onehot[:, None, :, :], axis=3)
+    u_seq = m_q[:, :, None]
+    v_seq = m_q[:, None, :]
+    concurrent = (P < v_seq) & (jnp.swapaxes(P, 1, 2) < u_seq)
+    later = (jax.lax.broadcasted_iota(jnp.int32, (W + 1, W + 1), 0) <
+             jax.lax.broadcasted_iota(jnp.int32, (W + 1, W + 1), 1))
+    supersedes = later[None] & ~concurrent \
+        & m_valid[:, :, None] & m_valid[:, None, :]
+
+    superseded = jnp.sum(supersedes.astype(jnp.int32), axis=1) > 0
+    m_alive = m_valid & ~superseded & (m_d == 0)
+    superseded_wo_self = \
+        jnp.sum(supersedes[:, 1:, :].astype(jnp.int32), axis=1) > 0
+    alive_before = m_valid & ~superseded_wo_self & (m_d == 0)
+    vb_ref[:] = (jnp.sum(alive_before[:, 1:].astype(jnp.int32), axis=1)
+                 > 0).astype(jnp.int32)
+    alive_ref[:] = jnp.sum(m_alive.astype(jnp.int32), axis=1)
+
+    # output position by pairwise count: (actor desc, time desc)
+    a_u = m_a[:, :, None]
+    a_v = m_a[:, None, :]
+    t_u = m_t[:, :, None]
+    t_v = m_t[:, None, :]
+    precede = m_alive[:, None, :] & \
+        ((a_v > a_u) | ((a_v == a_u) & (t_v > t_u)))           # v before u
+    pos = jnp.sum(precede.astype(jnp.int32), axis=2)           # [B, W+1]
+
+    winner_ref[:] = jnp.sum(
+        jnp.where((pos == 0) & m_alive, m_src + 1, 0), axis=1) - 1
+    kpos = jax.lax.broadcasted_iota(jnp.int32, (_B, W + 1, W), 2)
+    poh = (pos[:, :, None] == kpos + 1) & m_alive[:, :, None]
+    conflicts_ref[:] = jnp.sum(
+        jnp.where(poh, (m_src + 1)[:, :, None], 0), axis=1) - 1
+
+    window_full = jnp.sum(m_valid[:, 1:].astype(jnp.int32), axis=1) == W
+    ovf_ref[:] = (window_full & (g_cur >= 0)).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=('window', 'interpret'))
+def resolve_registers_pallas(group, time, actor, seq, is_del, sort_idx,
+                             clock_table, clock_idx, window=8,
+                             interpret=False):
+    """Drop-in for `registers.resolve_registers` (sliding-window mode).
+
+    Same arguments as the XLA twin's (clock_table, clock_idx) form;
+    `interpret=True` runs in the Pallas interpreter (CPU-testable).
+    """
+    T = group.shape[0]
+    W = window
+    A = clock_table.shape[1]
+    if T % _B != 0:
+        raise ValueError('T=%d must be a multiple of %d' % (T, _B))
+
+    clock = clock_table[jnp.asarray(clock_idx)]
+    g_s = jnp.asarray(group)[sort_idx]
+    t_s = jnp.asarray(time)[sort_idx]
+    a_s = jnp.asarray(actor)[sort_idx]
+    q_s = jnp.asarray(seq)[sort_idx]
+    c_s = clock[sort_idx]
+    d_s = jnp.asarray(is_del).astype(jnp.int32)[sort_idx]
+    src = jnp.asarray(sort_idx, jnp.int32)
+
+    def pad(x, fill):
+        return jnp.concatenate(
+            [jnp.full((_PADW,) + x.shape[1:], fill, x.dtype), x])
+
+    outs = pl.pallas_call(
+        functools.partial(_kernel, W=W, A=A),
+        grid=(T // _B,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 7,
+        out_specs=[pl.BlockSpec((_B,), lambda b: (b,)),
+                   pl.BlockSpec((_B, W), lambda b: (b, 0)),
+                   pl.BlockSpec((_B,), lambda b: (b,)),
+                   pl.BlockSpec((_B,), lambda b: (b,)),
+                   pl.BlockSpec((_B,), lambda b: (b,))],
+        out_shape=[jax.ShapeDtypeStruct((T,), jnp.int32),
+                   jax.ShapeDtypeStruct((T, W), jnp.int32),
+                   jax.ShapeDtypeStruct((T,), jnp.int32),
+                   jax.ShapeDtypeStruct((T,), jnp.int32),
+                   jax.ShapeDtypeStruct((T,), jnp.int32)],
+        scratch_shapes=[pltpu.VMEM((_PADW + _B,), jnp.int32)
+                        for _ in range(6)] +
+                       [pltpu.VMEM((_PADW + _B, A), jnp.int32),
+                        pltpu.SemaphoreType.DMA((7,))],
+        interpret=interpret,
+    )(pad(g_s, -2), pad(t_s, 0), pad(a_s, 0), pad(q_s, 0), pad(d_s, 0),
+      pad(c_s, 0), pad(src, -1))
+    winner_s, conflicts_s, alive_s, vb_s, ovf_s = outs
+
+    # scatter back to original row order + the packed transfer summary
+    # (same layout as the XLA twin)
+    out = {
+        'alive_after':
+            jnp.zeros((T,), jnp.int32).at[sort_idx].set(alive_s),
+        'winner': jnp.full((T,), -1, jnp.int32).at[sort_idx].set(winner_s),
+        'conflicts':
+            jnp.full((T, W), -1, jnp.int32).at[sort_idx].set(conflicts_s),
+        'visible_before':
+            jnp.zeros((T,), jnp.bool_).at[sort_idx].set(vb_s > 0),
+        'overflow':
+            jnp.zeros((T,), jnp.bool_).at[sort_idx].set(ovf_s > 0),
+    }
+    out['packed'] = (jnp.where(out['winner'] >= 0, out['winner'],
+                               0xffffff).astype(jnp.int32)
+                     | (out['alive_after'] << 24)
+                     | (out['overflow'].astype(jnp.int32) << 28))
+    return out
+
+
+_pallas_broken = False
+_pallas_validated = False
+
+
+def _use_pallas():
+    from .pallas_common import pallas_enabled
+    return not _pallas_broken and pallas_enabled()
+
+
+def resolve_registers_auto(group, time, actor, seq, is_del, alive_in,
+                           sort_idx, clock_table, clock_idx, window=8):
+    """Pallas on TPU when shapes fit; the XLA kernel otherwise.  Both
+    paths compute identical outputs (pinned by unit test).
+
+    Failure handling: the FIRST Pallas call per process blocks on its
+    outputs inside the try, so deterministic lowering/runtime faults
+    (Mosaic rejection, DMA fault, VMEM OOM) latch the path off and fall
+    back to XLA with an observable metric (`report_latch`) instead of
+    crashing every batch at the async collect site.  Once validated,
+    later calls return lazily for normal async overlap.
+    """
+    global _pallas_broken, _pallas_validated
+    T = group.shape[0]
+    A = clock_table.shape[1]
+    # VMEM budget: clock halo [256, A] + the [B, W+1, W+1, A] concurrency
+    # temporary dominate
+    vmem = 256 * A * 4 + _B * (window + 1) * (window + 1) * A * 4
+    if (_use_pallas() and T % _B == 0 and window <= 8
+            and vmem <= 10 * 2 ** 20):
+        try:
+            out = resolve_registers_pallas(
+                group, time, actor, seq, is_del, sort_idx,
+                clock_table, clock_idx, window=window)
+            if not _pallas_validated:
+                jax.block_until_ready(out)
+                _pallas_validated = True
+            return out
+        except Exception as e:
+            _pallas_broken = True
+            from .pallas_common import report_latch
+            report_latch('registers', e)
+    return xla_registers.resolve_registers(
+        group, time, actor, seq, is_del=is_del, alive_in=alive_in,
+        window=window, sort_idx=sort_idx, clock_table=clock_table,
+        clock_idx=clock_idx)
