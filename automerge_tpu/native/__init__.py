@@ -109,6 +109,7 @@ def _load():
     lib.amtpu_host_dominance.argtypes = [ctypes.c_void_p]
     lib.amtpu_mid_hostreg.restype = ctypes.c_int
     lib.amtpu_mid_hostreg.argtypes = [ctypes.c_void_p]
+    lib.amtpu_pool_set_hostfull.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.amtpu_batch_trace.argtypes = [ctypes.c_void_p,
                                       ctypes.POINTER(ctypes.c_double)]
     lib.amtpu_sched_counts.argtypes = [ctypes.c_void_p,
@@ -285,6 +286,28 @@ def _host_dom_on():
     return jax.default_backend() == 'cpu'
 
 
+def _host_full_on():
+    """Full host path: no kernel dispatch at all -- C++ resolves
+    registers in-emit and list indexes via an in-emit Fenwick sweep.
+
+    The right default on the CPU backend, where the XLA kernels share
+    the single host core the C++ engine runs on and every dispatch is
+    pure overhead.  Accelerators keep the kernel path (that is the
+    point of the framework); a forced AMTPU_RESIDENT=1 also keeps it,
+    so the resident tests and the multichip dryrun still drive the
+    device-resident dispatch on CPU.  AMTPU_HOST_FULL=1/0 forces."""
+    env = os.environ.get('AMTPU_HOST_FULL')
+    if env is not None:
+        return env not in ('', '0')
+    # any truthy AMTPU_RESIDENT forces the resident kernel path -- same
+    # parse as the C++ gate (atoi != 0), not just the literal '1'
+    res = os.environ.get('AMTPU_RESIDENT')
+    if res is not None and res not in ('', '0'):
+        return False
+    import jax
+    return jax.default_backend() == 'cpu'
+
+
 def _raise_shard_errors(errors):
     """Per-shard error reporting: a single failure re-raises with its
     shard identified; multiple failures aggregate every shard's message
@@ -322,12 +345,22 @@ class NativeDocPool:
     WINDOW = 8
     #: entries amtpu_batch_dims writes -- must match core.cpp exactly
     #: (an undersized ctypes buffer is silent heap corruption)
-    N_DIMS = 13
+    N_DIMS = 14
 
     def __init__(self):
         self._pool = lib().amtpu_pool_new()
+        self._mode_set = False
         from .resident import ResidentCache
         self._resident = ResidentCache()
+
+    def _ensure_mode_flags(self):
+        # resolved lazily at the first batch (jax backend init is heavy
+        # and pools are built in sharded bulk); re-checked never -- the
+        # backend cannot change within a process
+        if not self._mode_set:
+            lib().amtpu_pool_set_hostfull(
+                self._pool, 1 if _host_full_on() else 0)
+            self._mode_set = True
 
     def __del__(self):
         # read the module global directly: at interpreter shutdown the
@@ -369,6 +402,7 @@ class NativeDocPool:
             data, n = payload
         else:
             data, n = payload, len(payload)
+        self._ensure_mode_flags()
         with trace.span('host.begin'):
             bh = L.amtpu_begin(self._pool, data, n)
         if not bh:
@@ -384,7 +418,7 @@ class NativeDocPool:
             dims = (ctypes.c_int64 * self.N_DIMS)()
             L.amtpu_batch_dims(bh, dims)
             (T, Tp, A, Ap, Larena, Lp, n_blocks, max_obj, CTp,
-             use_members, any_ovf, max_group, pre_ovf) = \
+             use_members, any_ovf, max_group, pre_ovf, host_full) = \
                 [int(x) for x in dims]
             # 6 slots -- must match what amtpu_fused_dims writes exactly
             # (an undersized ctypes buffer is silent heap corruption)
@@ -427,6 +461,15 @@ class NativeDocPool:
             ctx.update(dims=(T, Tp, A, Ap, Larena, Lp, n_blocks, max_obj,
                              CTp), mem=mem, hovf=hovf, weff=weff,
                        resident_ok=bool(resident_ok))
+
+            if host_full:
+                # full host path (CPU backend): C++ skipped the register
+                # rows at begin; emit resolves registers + list indexes
+                # itself (host_resolve_step + in-emit Fenwick)
+                trace.count('hostfull.batches')
+                trace.metric('hostfull.batches')
+                ctx.update(mode='hostreg')
+                return ctx
 
             # Host-register mode: when a map-only batch's register rows
             # mostly sit in groups wider than the member window, the
@@ -976,6 +1019,7 @@ class NativeDocPool:
         canUndo/canRedo)."""
         key = self._doc_key(doc_id)
         payload = msgpack.packb(request, use_bin_type=True)
+        self._ensure_mode_flags()
         with trace.span('host.begin'):
             bh = lib().amtpu_begin_local(self._pool, key.encode(), payload,
                                          len(payload))

@@ -413,6 +413,9 @@ struct Pool {
   std::unordered_map<std::string, DocState> docs;
   std::vector<std::string> doc_order;   // first-seen order
   u64 epoch = 0;     // bumped per begin; arenas stamp their first touch
+  // full host path (amtpu_pool_set_hostfull): the Python driver sets
+  // this once per pool from the resolved jax backend (CPU -> on)
+  bool host_full = false;
 
   Pool() {
     root_sid = intern.id_of(ROOT_ID);
@@ -826,6 +829,22 @@ struct DomBlock {
   std::vector<i32> indexes;    // filled by python, [W*Tp]
 };
 
+// prefix-sum Fenwick over rank positions (counts of visible elements);
+// used by host dominance (mid) and the host-full in-emit index sweep
+struct Fenwick {
+  std::vector<i32> t;
+  void reset(size_t n) { t.assign(n + 1, 0); }
+  void add(i32 i, i32 d) {
+    for (i32 x = i + 1; x < static_cast<i32>(t.size()); x += x & -x)
+      t[x] += d;
+  }
+  i32 prefix(i32 i) const {  // sum of positions [0, i)
+    i32 s = 0;
+    for (i32 x = i; x > 0; x -= x & -x) s += t[x];
+    return s;
+  }
+};
+
 struct Batch {
   Pool* pool;
   // dense per-batch doc table: index -> (payload key, state)
@@ -890,6 +909,13 @@ struct Batch {
   // no kernel dispatch at all (amtpu_mid_hostreg; map-only batches
   // whose groups are mostly wider than the member window)
   bool host_reg_mode = false;
+  // full host path (CPU backend): encode skips register rows and member
+  // windows, no kernel dispatch; emit resolves registers via
+  // host_resolve_step and list indexes via an in-emit Fenwick sweep
+  bool host_full = false;
+  std::vector<i32> rank_host;             // host RGA ranks, lazy
+  struct HostFen { Fenwick fen; i64 base = 0; };
+  std::unordered_map<u64, HostFen> host_fens;   // akey -> running counts
   std::vector<i32> mem_idx;    // [Tp * WINDOW]
   std::vector<u8> host_ovf;    // [Tp]
 
@@ -1374,6 +1400,18 @@ static void encode(Pool& pool, Batch& b) {
     return idx;
   };
 
+  // Host-full mode: no kernel will run, so the whole register-row /
+  // member-window build is dead weight -- registers resolve in-emit
+  // via host_resolve_step and list indexes via the in-emit Fenwick.
+  // Arena columns below are still built (host_rank's sibling sort
+  // consumes them).
+  if (b.host_full) {
+    b.T = 0;
+    b.Tp = 0;
+    b.assign_row_of_op.assign(b.ops.size(), -1);
+    goto arena_columns;
+  }
+
   // state rows
   for (u32 gid = 0; gid < gid_order.size(); ++gid) {
     auto [doc, obj, key] = gid_order[gid];
@@ -1528,6 +1566,7 @@ static void encode(Pool& pool, Batch& b) {
   }
 
   // --- arena columns ------------------------------------------------------
+arena_columns:
   for (size_t k = 0; k < b.arena_keys.size(); ++k) {
     u64 akey = b.arena_keys[k];
     Arena& ar = b.bdocs[akey >> 32]->arenas[static_cast<u32>(akey)];
@@ -1601,6 +1640,19 @@ static bool rec_concurrent(DocState& st, const OpRec& o1, const OpRec& o2) {
 // host fallback path (amtpu_mid) fills the er/orank/od mirrors instead.
 static void dom_layout(Pool& pool, Batch& b) {
   b.eidx_of_op.assign(b.ops.size(), -1);
+  if (b.host_full) {
+    // in-emit Fenwick replaces the dominance blocks entirely; emit only
+    // needs the prepass-resolved element index per op
+    for (size_t op_idx = 0; op_idx < b.ops.size(); ++op_idx) {
+      if (!is_assign(b.ops[op_idx].op->action)) continue;
+      i32 eidx = b.pre_eidx[op_idx];
+      if (eidx >= 0) b.eidx_of_op[op_idx] = eidx;
+    }
+    b.list_index_of_op.assign(b.ops.size(), INT32_MIN);
+    b.fused_ok = true;
+    b.resident_ok = false;
+    return;
+  }
   std::vector<u64> obj_order;  // first-seen object order (layout-local)
 
   for (size_t op_idx = 0; op_idx < b.ops.size(); ++op_idx) {
@@ -1911,21 +1963,6 @@ static void host_rank(Batch& b, std::vector<i32>& rank) {
     seg = end;
   }
 }
-
-// prefix-sum Fenwick over rank positions (counts of visible elements)
-struct Fenwick {
-  std::vector<i32> t;
-  void reset(size_t n) { t.assign(n + 1, 0); }
-  void add(i32 i, i32 d) {
-    for (i32 x = i + 1; x < static_cast<i32>(t.size()); x += x & -x)
-      t[x] += d;
-  }
-  i32 prefix(i32 i) const {  // sum of positions [0, i)
-    i32 s = 0;
-    for (i32 x = i; x > 0; x -= x & -x) s += t[x];
-    return s;
-  }
-};
 
 static void host_dominance(Batch& b) {
   if (b.dom_blocks.empty()) return;
@@ -2521,6 +2558,10 @@ static void emit(Pool& pool, Batch& b) {
     return oc.bytes;
   };
 
+  // host-full Fenwick run cache (batch-lifetime: see use below)
+  u64 last_hak = ~0ull;
+  Batch::HostFen* last_hf = nullptr;
+
   std::vector<PathElem> path_scratch;
   auto render_path = [&](u32 doc, DocState& st,
                          u32 obj) -> const std::vector<u8>& {
@@ -2620,9 +2661,47 @@ static void emit(Pool& pool, Batch& b) {
     const std::vector<u8>& path_bytes = render_path(f.doc, st, op.obj);
     const std::string& obj_bytes = render_obj(op.obj);
     if (is_list_type(obj_type)) {
+      // host-full: the list index is the in-emit Fenwick prefix count
+      // (same contract as the dominance kernels: visible lower-ranked
+      // elements just before this op), computed against host RGA ranks
+      // and a per-arena running visibility count
+      i32 heidx = b.host_full ? b.eidx_of_op[op_idx] : -1;
+      Batch::HostFen* hf = nullptr;
+      u8 vis_pre = 0;
+      if (heidx >= 0) {
+        u64 hak = (static_cast<u64>(f.doc) << 32) | op.obj;
+        // run cache, same rationale as tc above: consecutive list ops
+        // overwhelmingly hit the same arena.  (unordered_map guarantees
+        // element-pointer stability across rehash, so growth on another
+        // arena's first touch cannot dangle this.)
+        if (last_hak == hak) {
+          hf = last_hf;
+        } else {
+          hf = &b.host_fens[hak];
+          last_hak = hak; last_hf = hf;
+        }
+        if (hf->fen.t.empty()) {
+          if (b.rank_host.empty() && b.L > 0) host_rank(b, b.rank_host);
+          hf->base = b.arena_base[hak];
+          hf->fen.reset(arp->ctr.size());
+          for (size_t i = 0; i < arp->ctr.size(); ++i)
+            if (arp->visible[i])
+              hf->fen.add(b.rank_host[hf->base + i], 1);
+        }
+        b.list_index_of_op[op_idx] =
+            hf->fen.prefix(b.rank_host[hf->base + heidx]);
+        vis_pre = arp->visible[heidx];
+      }
       if (emit_list_diff(w, pool, *arp, op, reg, static_cast<i64>(op_idx), b,
                          obj_type, path_bytes, obj_bytes))
         diff_counts[f.doc]++;
+      if (hf != nullptr) {
+        u8 vis_post = arp->visible[heidx];
+        if (vis_post != vis_pre)
+          hf->fen.add(b.rank_host[hf->base + heidx],
+                      static_cast<i32>(vis_post) -
+                          static_cast<i32>(vis_pre));
+      }
     } else {
       emit_map_diff(w, pool, st, op, reg, obj_type, path_bytes, obj_bytes);
       diff_counts[f.doc]++;
@@ -2895,6 +2974,7 @@ void* amtpu_begin(void* pool_ptr, const uint8_t* data, int64_t len) {
     Reader r(slab->data(), slab->size());
     size_t n_docs = r.read_map();
     Batch& b = h->batch;
+    b.host_full = pool.host_full;
     std::vector<std::vector<ChangeRec>> incoming;
     incoming.reserve(n_docs);
     for (size_t i = 0; i < n_docs; ++i) {
@@ -2978,6 +3058,7 @@ void* amtpu_begin_local(void* pool_ptr, const char* doc_id,
       throw Error(1, "Change request has already been applied");
 
     Batch& b = h->batch;
+    b.host_full = pool.host_full;
     b.local_actor = req.actor;
     b.local_seq = req.seq;
     ChangeRec change;
@@ -3076,6 +3157,13 @@ void amtpu_batch_dims(void* bp, int64_t* out) {
   out[10] = b.any_ovf ? 1 : 0;
   out[11] = b.max_group;
   out[12] = b.n_pre_ovf;
+  out[13] = b.host_full ? 1 : 0;
+}
+
+// full host path toggle (see Pool::host_full); set once per pool by the
+// Python driver from the resolved jax backend before the first batch
+void amtpu_pool_set_hostfull(void* pool_ptr, int on) {
+  static_cast<Pool*>(pool_ptr)->host_full = on != 0;
 }
 
 const int32_t* amtpu_col_memidx(void* bp) { return static_cast<BatchHandle*>(bp)->batch.mem_idx.data(); }

@@ -437,7 +437,11 @@ class TestHostDominanceParity:
     def _run(self, batches, hostdom):
         import os
         prior = os.environ.get('AMTPU_HOST_DOM')
+        prior_full = os.environ.get('AMTPU_HOST_FULL')
         os.environ['AMTPU_HOST_DOM'] = hostdom
+        # the A/B here is device-dominance vs Fenwick-mid: both need the
+        # KERNEL dispatch, which host-full (the CPU default) skips
+        os.environ['AMTPU_HOST_FULL'] = '0'
         try:
             pool = native_pool()
             out = [pool.apply_batch(b) for b in batches]
@@ -448,6 +452,10 @@ class TestHostDominanceParity:
                 os.environ.pop('AMTPU_HOST_DOM', None)
             else:
                 os.environ['AMTPU_HOST_DOM'] = prior
+            if prior_full is None:
+                os.environ.pop('AMTPU_HOST_FULL', None)
+            else:
+                os.environ['AMTPU_HOST_FULL'] = prior_full
 
     @pytest.mark.parametrize('seed,structure', [
         (31, 'list'), (32, 'mixed'), (33, 'mixed'),
@@ -535,9 +543,11 @@ class TestHostDominanceParity:
         st, _ = Backend.apply_changes(st, chs)
 
         prior = {k: os.environ.get(k)
-                 for k in ('AMTPU_WEFF', 'AMTPU_HOST_DOM')}
+                 for k in ('AMTPU_WEFF', 'AMTPU_HOST_DOM',
+                           'AMTPU_HOST_FULL')}
         os.environ['AMTPU_WEFF'] = '2'
         os.environ['AMTPU_HOST_DOM'] = hostdom
+        os.environ['AMTPU_HOST_FULL'] = '0'   # overflow needs the kernel
         try:
             from automerge_tpu import trace
             trace.metrics_reset()
@@ -567,7 +577,11 @@ class TestHostRegisterMode:
     def _drive(self, batches, hostreg):
         import os
         prior = os.environ.get('AMTPU_HOST_REG')
+        prior_full = os.environ.get('AMTPU_HOST_FULL')
         os.environ['AMTPU_HOST_REG'] = hostreg
+        # hostreg-vs-kernel A/B: both sides run the member build, which
+        # host-full (the CPU default) skips entirely
+        os.environ['AMTPU_HOST_FULL'] = '0'
         try:
             from automerge_tpu import trace
             trace.metrics_reset()
@@ -586,6 +600,10 @@ class TestHostRegisterMode:
                 os.environ.pop('AMTPU_HOST_REG', None)
             else:
                 os.environ['AMTPU_HOST_REG'] = prior
+            if prior_full is None:
+                os.environ.pop('AMTPU_HOST_FULL', None)
+            else:
+                os.environ['AMTPU_HOST_FULL'] = prior_full
 
     def test_wide_groups_incremental_with_deletes(self):
         rng = random.Random(41)
@@ -620,3 +638,92 @@ class TestHostRegisterMode:
         for b in batches:
             st, _ = Backend.apply_changes(st, b[0])
         assert on[-1] == Backend.get_patch(st)
+
+
+class TestHostFullParity:
+    """Full host path (the CPU-backend default) vs the kernel path:
+    byte-identical patch streams on identical inputs, including list
+    dominance (the in-emit Fenwick) and interleaved deletes."""
+
+    def _drive(self, batches, hostfull):
+        import os
+        prior = os.environ.get('AMTPU_HOST_FULL')
+        os.environ['AMTPU_HOST_FULL'] = hostfull
+        try:
+            from automerge_tpu import trace
+            trace.metrics_reset()
+            pool = native_pool()
+            out = [pool.apply_batch(b) for b in batches]
+            out.append(pool.get_patch(0))
+            engaged = trace.metrics_snapshot().get('hostfull.batches', 0)
+            if hostfull == '1':
+                assert engaged > 0, 'hostfull gate never engaged'
+            else:
+                assert engaged == 0, 'hostfull ran despite =0'
+            return out
+        finally:
+            if prior is None:
+                os.environ.pop('AMTPU_HOST_FULL', None)
+            else:
+                os.environ['AMTPU_HOST_FULL'] = prior
+
+    @pytest.mark.parametrize('seed,structure', [
+        (51, 'list'), (52, 'mixed'), (53, 'map'),
+    ])
+    def test_ab_identical(self, seed, structure):
+        changes = WorkloadGen(seed, structure=structure).generate(28)
+        rng = random.Random(seed)
+        batches = []
+        i = 0
+        while i < len(changes):
+            n = rng.randint(1, 6)
+            chunk = list(changes[i:i + n])
+            if rng.random() < 0.3:
+                rng.shuffle(chunk)
+            batches.append({0: chunk})
+            i += n
+        a = self._drive(batches, '1')
+        b = self._drive(batches, '0')
+        assert a == b
+        # and the scalar oracle agrees
+        st = Backend.init()
+        for batch in batches:
+            st, _ = Backend.apply_changes(st, [dict(c) for c in batch[0]])
+        assert a[-1] == Backend.get_patch(st)
+
+    def test_undo_redo_under_hostfull(self):
+        import os
+        prior = os.environ.get('AMTPU_HOST_FULL')
+        os.environ['AMTPU_HOST_FULL'] = '1'
+        try:
+            pool = native_pool()
+            st = Backend.init()
+            reqs = [
+                {'requestType': 'change', 'actor': 'me', 'seq': 1,
+                 'deps': {}, 'ops': [
+                     {'action': 'makeList', 'obj': 'l'},
+                     {'action': 'link', 'obj': ROOT_ID, 'key': 'xs',
+                      'value': 'l'},
+                     {'action': 'ins', 'obj': 'l', 'key': '_head',
+                      'elem': 1},
+                     {'action': 'set', 'obj': 'l', 'key': 'me:1',
+                      'value': 'a'}]},
+                {'requestType': 'change', 'actor': 'me', 'seq': 2,
+                 'deps': {}, 'ops': [
+                     {'action': 'set', 'obj': ROOT_ID, 'key': 'k',
+                      'value': 1}]},
+                {'requestType': 'undo', 'actor': 'me', 'seq': 3,
+                 'deps': {}},
+                {'requestType': 'redo', 'actor': 'me', 'seq': 4,
+                 'deps': {}},
+            ]
+            for r in reqs:
+                st, want = Backend.apply_local_change(st, dict(r))
+                got = pool.apply_local_change(0, dict(r))
+                assert got == want, r['requestType']
+            assert pool.get_patch(0) == Backend.get_patch(st)
+        finally:
+            if prior is None:
+                os.environ.pop('AMTPU_HOST_FULL', None)
+            else:
+                os.environ['AMTPU_HOST_FULL'] = prior
