@@ -37,7 +37,12 @@ def seed_base(default):
 
 
 def deliver_all(change_batches, n_docs=1):
-    """Oracle + both pools, patch-equal at every step and at the end."""
+    """Oracle + both pools, patch-equal at every step and at the end.
+
+    Runs the native pool in whatever mode the environment selects (the
+    full host path on the CPU test mesh); the `exec_mode` fixture
+    below re-runs every scenario with AMTPU_HOST_FULL=0 so the kernel
+    path faces the same adversarial schedules."""
     oracle = {d: Backend.init() for d in range(n_docs)}
     pools = [TPUDocPool(), NativeDocPool()]
     for batch in change_batches:
@@ -58,11 +63,27 @@ def deliver_all(change_batches, n_docs=1):
     return oracle, pools
 
 
+@pytest.fixture(params=['default', 'kernel'])
+def exec_mode(request):
+    """Both execution modes face the adversarial schedules: the CPU
+    default (full host path) and the forced kernel path."""
+    if request.param == 'kernel':
+        prior = os.environ.get('AMTPU_HOST_FULL')
+        os.environ['AMTPU_HOST_FULL'] = '0'
+        yield 'kernel'
+        if prior is None:
+            os.environ.pop('AMTPU_HOST_FULL', None)
+        else:
+            os.environ['AMTPU_HOST_FULL'] = prior
+    else:
+        yield 'default'
+
+
 class TestWideAntichains:
     """Register groups wider than every kernel window."""
 
     @pytest.mark.parametrize('n_writers', [12, 20])
-    def test_map_hot_keys(self, n_writers):
+    def test_map_hot_keys(self, n_writers, exec_mode):
         rng = random.Random(seed_base(501) + n_writers)
         changes = []
         for seq in range(1, 4):
@@ -89,7 +110,7 @@ class TestWideAntichains:
             i += n
         deliver_all(batches)
 
-    def test_list_element_antichain(self):
+    def test_list_element_antichain(self, exec_mode):
         """14 writers concurrently assign the SAME list element (and one
         deletes it): a wide antichain on an element register, which must
         route through the overflow fallback WITH dominance work."""
@@ -114,7 +135,7 @@ class TestWideAntichains:
 
 
 class TestReversedCausalChains:
-    def test_deep_chain_reversed(self):
+    def test_deep_chain_reversed(self, exec_mode):
         """120-deep cross-actor dependency chain delivered fully
         reversed: every change but the first buffers, then one fixpoint
         admits the whole chain."""
@@ -143,7 +164,7 @@ class TestReversedCausalChains:
             i += n
         deliver_all(batches)
 
-    def test_cross_doc_reversed_streams(self):
+    def test_cross_doc_reversed_streams(self, exec_mode):
         """Several docs' chains interleaved, each doc's stream reversed
         independently within one multi-doc batch sequence."""
         rng = random.Random(seed_base(602))
