@@ -1179,7 +1179,14 @@ class ShardedNativePool:
         the 1-core headline bench, BASELINE.md round 3).  Threads mode
         runs shards truly concurrently, so one per core (capped) avoids
         oversubscription and unbounded per-shard state.
+
+        Full host path (CPU backend, round 4): there is no device work
+        to overlap, so the pipeline's extra shards are pure per-shard
+        fixed cost -- ONE shard measured ~6% faster than 20 on the
+        headline config (and skips the payload splitter entirely).
         """
+        if _host_full_on():
+            return 1
         mode = cls.resolve_mode(mode)
         return 20 if mode == 'pipeline' else min(8, os.cpu_count() or 1)
 
