@@ -1193,12 +1193,27 @@ class ShardedNativePool:
     def __init__(self, n_shards=None, mode=None):
         mode = self.resolve_mode(mode)
         self.mode = mode
-        if n_shards is None:
-            n_shards = self.default_shards(mode)
-        if n_shards < 1:
+        if n_shards is not None and n_shards < 1:
             raise ValueError('n_shards must be >= 1, got %r' % (n_shards,))
-        self.n_shards = n_shards
-        self.pools = [NativeDocPool() for _ in range(n_shards)]
+        # None = resolve lazily at first use: default_shards() keys on
+        # _host_full_on(), which initializes the jax backend -- on a
+        # host with a wedged device tunnel that can block indefinitely,
+        # and merely CONSTRUCTING a pool must never hang (same lazy
+        # convention as NativeDocPool._ensure_mode_flags)
+        self._n_shards = n_shards
+        self._pools = None
+
+    @property
+    def n_shards(self):
+        if self._n_shards is None:
+            self._n_shards = self.default_shards(self.mode)
+        return self._n_shards
+
+    @property
+    def pools(self):
+        if self._pools is None:
+            self._pools = [NativeDocPool() for _ in range(self.n_shards)]
+        return self._pools
 
     def _shard_of(self, doc_id):
         key = NativeDocPool._doc_key(doc_id).encode()
@@ -1206,6 +1221,11 @@ class ShardedNativePool:
 
     def apply_batch_bytes(self, payload):
         L = lib()
+        # materialize the lazy pool list on THIS thread before any
+        # worker threads touch the property: two workers racing on
+        # `_pools is None` would each build a list and apply shards to
+        # pools the losing assignment discards
+        self.pools
         with trace.span('shard.split'):
             sp = L.amtpu_shard_split(payload, len(payload), self.n_shards)
             if not sp:

@@ -35,6 +35,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -201,7 +202,11 @@ def resolve_registers_pallas(group, time, actor, seq, is_del, sort_idx,
 
 
 _pallas_broken = False
-_pallas_validated = False
+# first-call validation is per compiled shape: a new (T, window, A)
+# triggers a fresh Mosaic compile whose runtime faults (DMA/VMEM at
+# execution, not lowering) must be caught here, not at the async
+# collect site
+_pallas_validated_shapes = set()
 
 
 def _use_pallas():
@@ -214,28 +219,36 @@ def resolve_registers_auto(group, time, actor, seq, is_del, alive_in,
     """Pallas on TPU when shapes fit; the XLA kernel otherwise.  Both
     paths compute identical outputs (pinned by unit test).
 
-    Failure handling: the FIRST Pallas call per process blocks on its
-    outputs inside the try, so deterministic lowering/runtime faults
-    (Mosaic rejection, DMA fault, VMEM OOM) latch the path off and fall
-    back to XLA with an observable metric (`report_latch`) instead of
-    crashing every batch at the async collect site.  Once validated,
-    later calls return lazily for normal async overlap.
+    Failure handling: the FIRST Pallas call per compiled shape
+    (T, window, A) blocks on its outputs inside the try, so
+    deterministic lowering/runtime faults (Mosaic rejection, DMA fault,
+    VMEM OOM) latch the path off and fall back to XLA with an
+    observable metric (`report_latch`) instead of crashing every batch
+    at the async collect site.  Once a shape is validated, later calls
+    with that shape return lazily for normal async overlap.
     """
-    global _pallas_broken, _pallas_validated
+    global _pallas_broken
     T = group.shape[0]
     A = clock_table.shape[1]
     # VMEM budget: clock halo [256, A] + the [B, W+1, W+1, A] concurrency
     # temporary dominate
     vmem = 256 * A * 4 + _B * (window + 1) * (window + 1) * A * 4
+    # the Pallas kernel hardcodes all-alive starting state; a caller
+    # with a non-trivial alive_in mask must route to the XLA twin.  The
+    # mask scan goes LAST in the conjunction: it may force a host sync
+    # on a device-resident mask, so only pay it when the Pallas path
+    # would otherwise engage.
     if (_use_pallas() and T % _B == 0 and window <= 8
-            and vmem <= 10 * 2 ** 20):
+            and vmem <= 10 * 2 ** 20
+            and bool(np.all(np.asarray(alive_in)))):
         try:
             out = resolve_registers_pallas(
                 group, time, actor, seq, is_del, sort_idx,
                 clock_table, clock_idx, window=window)
-            if not _pallas_validated:
+            shape_key = (T, window, A)
+            if shape_key not in _pallas_validated_shapes:
                 jax.block_until_ready(out)
-                _pallas_validated = True
+                _pallas_validated_shapes.add(shape_key)
             return out
         except Exception as e:
             _pallas_broken = True

@@ -835,6 +835,9 @@ struct Fenwick {
   std::vector<i32> t;
   void reset(size_t n) { t.assign(n + 1, 0); }
   void add(i32 i, i32 d) {
+    // i == -1 (an unranked arena row reaching a sweep) would loop
+    // forever: x starts at 0 and x & -x stays 0
+    assert(i >= 0);
     for (i32 x = i + 1; x < static_cast<i32>(t.size()); x += x & -x)
       t[x] += d;
   }
