@@ -1,6 +1,6 @@
 # Top-level developer entry points.
 
-.PHONY: all native test bench check clean wheel
+.PHONY: all native test bench bench-all bench-tpu check clean wheel
 
 all: native
 
@@ -13,15 +13,39 @@ test: native
 bench: native
 	python bench.py
 
-# The pre-commit gate: native build + full test suite + a 30s bench smoke
-# + the driver's multi-chip dryrun, all CPU-pinned so a wedged device
-# tunnel can't hang it.  Run before EVERY snapshot commit; nothing ships
-# unless this is green (the reference's analogue: `npm test`,
-# /root/reference/package.json:7).
+# One committed all-config artifact per round (VERDICT r4 #5): every
+# config, every execution mode, fresh subprocess each, JSON lines.
+bench-all: native
+	python bench.py --all --out BENCH_ALL.json
+
+# The hardware day (VERDICT r4 #6): the moment the tunneled TPU link
+# recovers, this one command captures the full device story -- all five
+# configs, platform-default (= kernel on TPU) + host sibling embedded
+# per line, plus the resident-arena lines for the long-list shapes,
+# with AMTPU_DEVTIME device busy fractions in every block.  No
+# JAX_PLATFORMS pin: bench.py's subprocess probe decides, so a wedged
+# link still degrades to CPU instead of hanging.
+bench-tpu: native
+	AMTPU_DEVTIME=1 python bench.py --all --out BENCH_TPU.json
+
+# The pre-commit gate: native build + full test suite + a bench smoke
+# covering BOTH execution modes (the default line embeds the
+# opposite-mode sibling block; rc fails on either mode's parity or a
+# missing kernel measurement) + the driver's multi-chip dryrun, all
+# CPU-pinned so a wedged device tunnel can't hang it.  Run before EVERY
+# snapshot commit; nothing ships unless this is green (the reference's
+# analogue: `npm test`, /root/reference/package.json:7).
 check: native
 	python -m pytest tests/ -q
 	JAX_PLATFORMS=cpu AMTPU_BENCH_DOCS=192 AMTPU_BENCH_ORACLE_DOCS=24 \
-	  python bench.py --config 3
+	  python bench.py --config 3 > .bench_smoke.json
+	python -c "import json; \
+	  r = json.load(open('.bench_smoke.json')); \
+	  k = r.get('kernel_path') or r.get('host_full_path'); \
+	  assert k and k.get('value'), 'no sibling-mode measurement'; \
+	  assert r['baseline'] == 'python-scalar-oracle', r.get('baseline'); \
+	  print('bench smoke: %s %.0f ops/s + sibling %.0f ops/s' \
+	        % (r['mode'], r['value'], k['value']))"
 	JAX_PLATFORMS=cpu python -c "import __graft_entry__ as g; \
 	  g.dryrun_multichip(8); print('dryrun ok')"
 	@echo "CHECK GREEN"

@@ -676,6 +676,10 @@ class NativeDocPool:
                    resident=(entry, doc_id, obj_sid, n_now,
                              touched.astype(np.int32)))
         trace.count('resident.dispatch')
+        # always-on (not AMTPU_TRACE-gated): a bench line labeled
+        # `mode: resident` must be able to show residency actually
+        # engaged, not silently fell back to the standard fused path
+        trace.metric('resident.dispatches')
         return True
 
     def _mark_resident_stale(self, L, ctx):

@@ -87,6 +87,15 @@ SEED = env_int('AMTPU_BENCH_SEED', 7)
 # specific to pipeline overlap and would oversubscribe threads mode)
 N_SHARDS = env_int('AMTPU_BENCH_SHARDS', 0)
 
+# Every multiplier this harness reports divides by the repo's own
+# single-thread Python scalar oracle (`automerge_tpu.backend`), byte-
+# compatible with the reference backend.  The north-star target
+# (BASELINE.json) names the Node.js backend as the denominator; Node is
+# not installed in this image, so the oracle is the stand-in -- named
+# in every JSON line so no multiplier is quoted without its
+# denominator (VERDICT r4 #4).
+BASELINE_NAME = 'python-scalar-oracle'
+
 
 # ---------------------------------------------------------------------------
 # workload builders: {doc: [change...]} per config
@@ -226,55 +235,53 @@ def build_config_4(rng):
 # drivers
 # ---------------------------------------------------------------------------
 
-def run_batch_config(build, rng):
-    """Shared driver for configs 1-4: one causal catch-up batch."""
-    import msgpack
+def _alt_mode_env(alt):
+    """Context manager flipping AMTPU_HOST_FULL for a sibling-mode
+    measurement, restoring the caller's env on exit."""
+    import contextlib
 
-    from automerge_tpu import backend as Backend
+    @contextlib.contextmanager
+    def cm():
+        prior = os.environ.get('AMTPU_HOST_FULL')
+        os.environ['AMTPU_HOST_FULL'] = '0' if alt == 'kernel' else '1'
+        try:
+            yield
+        finally:
+            if prior is None:
+                os.environ.pop('AMTPU_HOST_FULL', None)
+            else:
+                os.environ['AMTPU_HOST_FULL'] = prior
+    return cm()
+
+
+def _alt_block(rate, oracle_rate, stats, ok):
+    """Sibling-mode result block; parity failure zeroes the numbers so
+    the regression is loud in the artifact (and main() fails the rc)."""
+    block = {'value': round(rate, 1),
+             'vs_baseline': round(rate / oracle_rate, 3)}
+    block.update(stats)
+    if not ok:
+        block.update(parity=False, value=0.0, vs_baseline=0.0)
+    return block
+
+
+def _current_mode():
+    """Name of the execution mode the pools will resolve right now
+    (per-batch knobs + platform default)."""
+    from automerge_tpu.native import _host_full_on
+    res = os.environ.get('AMTPU_RESIDENT')
+    if res not in (None, '', '0'):
+        return 'resident'
+    return 'host_full' if _host_full_on() else 'kernel'
+
+
+def _measure_mode(make_pool, payload, total_ops, label):
+    """Warmup + 3 timed runs + fallback counters + one synchronous
+    device-time pass for whatever execution mode the current env
+    resolves to.  Returns (median_rate, pool_from_last_run, stats)."""
+    import gc
+
     from automerge_tpu import trace
-    from automerge_tpu.native import NativeDocPool, ShardedNativePool
-
-    batch, metric = build(rng)
-    doc_ids = list(batch)
-    total_ops = sum(len(c['ops']) for chs in batch.values() for c in chs)
-    per_doc_ops = {d: sum(len(c['ops']) for c in batch[d])
-                   for d in doc_ids}
-    print('workload: %d docs, %d total ops'
-          % (len(doc_ids), total_ops), file=sys.stderr)
-
-    if N_SHARDS:
-        n_shards = min(N_SHARDS, len(doc_ids))
-    else:
-        n_shards = min(ShardedNativePool.default_shards(), len(doc_ids))
-
-    def make_pool():
-        return (ShardedNativePool(n_shards) if n_shards > 1
-                else NativeDocPool())
-
-    # ---- baseline: single-thread scalar backend on a >=10% subset -------
-    # median of 3 passes: the shared host core's speed wobbles between
-    # windows, and a slow scalar window inflates vs_baseline dishonestly
-    n_oracle = ORACLE_DOCS or max(1, len(doc_ids) // 10)
-    oracle_docs = doc_ids[:min(n_oracle, len(doc_ids))]
-    oracle_times = []
-    for _ in range(3):
-        oracle_states = {}
-        t0 = time.perf_counter()
-        for d in oracle_docs:
-            state = Backend.init()
-            state, _patch = Backend.apply_changes(state, batch[d])
-            oracle_states[d] = state
-        oracle_times.append(time.perf_counter() - t0)
-    oracle_s = sorted(oracle_times)[1]
-    oracle_ops = sum(per_doc_ops[d] for d in oracle_docs)
-    oracle_rate = oracle_ops / oracle_s
-    print('baseline (scalar backend, %d docs): %s -> median %.0f ops/sec'
-          % (len(oracle_docs), ['%.2fs' % t for t in oracle_times],
-             oracle_rate), file=sys.stderr)
-
-    # ---- wire payload (the split-deployment protocol form) ---------------
-    keyed = {NativeDocPool._doc_key(d): chs for d, chs in batch.items()}
-    payload = msgpack.packb(keyed, use_bin_type=True)
 
     # ---- warmup ----------------------------------------------------------
     t0 = time.perf_counter()
@@ -283,11 +290,10 @@ def run_batch_config(build, rng):
     t0 = time.perf_counter()
     make_pool().apply_batch_bytes(payload)
     warm2_s = time.perf_counter() - t0
-    print('warmup (incl. jit compile): %.2fs + %.2fs'
-          % (warm_s, warm2_s), file=sys.stderr)
+    print('[%s] warmup (incl. jit compile): %.2fs + %.2fs'
+          % (label, warm_s, warm2_s), file=sys.stderr)
 
     # ---- timed runs ------------------------------------------------------
-    import gc
     times = []
     pool = None
     # devtime's per-dispatch block_until_ready serializes the pipeline;
@@ -304,16 +310,16 @@ def run_batch_config(build, rng):
         if trace.ENABLED and run == 0:
             print(trace.report(), file=sys.stderr)
         gc.collect()
-    tpu_s = sorted(times)[1]
-    tpu_rate = total_ops / tpu_s
-    print('native pool runs: %s -> median %.0f ops/sec'
-          % (['%.2fs' % t for t in times], tpu_rate), file=sys.stderr)
+    med_s = sorted(times)[1]
+    rate = total_ops / med_s
+    print('[%s] pool runs: %s -> median %.0f ops/sec'
+          % (label, ['%.2fs' % t for t in times], rate), file=sys.stderr)
     # oracle-fallback visibility: counts accumulated over the 3 timed
     # runs (a degraded run must be visible without AMTPU_TRACE)
     fallbacks = {k.split('.', 1)[1]: int(v) for k, v in
                  trace.metrics_snapshot().items()
                  if k.startswith('fallback.')}
-    print('fallbacks (3 runs): %s' % (fallbacks or 'none'),
+    print('[%s] fallbacks (3 runs): %s' % (label, fallbacks or 'none'),
           file=sys.stderr)
 
     # ---- device-time pass ------------------------------------------------
@@ -341,25 +347,106 @@ def run_batch_config(build, rng):
         'busy_frac': round(m.get('device.dispatch_sync_s', 0.0) /
                            dev_wall, 4) if dev_wall else 0.0,
     }
-    print('device (sync pass): %.3fs kernels / %.3fs wall = %.1f%% busy, '
-          '%d dispatches' % (device['sync_dispatch_s'], dev_wall,
-                             100 * device['busy_frac'],
-                             device['dispatches']), file=sys.stderr)
+    if m.get('resident.dispatches'):
+        device['resident_dispatches'] = int(m['resident.dispatches'])
+    print('[%s] device (sync pass): %.3fs kernels / %.3fs wall = %.1f%% '
+          'busy, %d dispatches' % (label, device['sync_dispatch_s'],
+                                   dev_wall, 100 * device['busy_frac'],
+                                   device['dispatches']), file=sys.stderr)
+    return rate, pool, {'fallbacks': fallbacks, 'device': device}
 
-    # ---- parity ----------------------------------------------------------
-    for d in oracle_docs:
-        got = pool.get_patch(d)
-        want = Backend.get_patch(oracle_states[d])
-        if got != want:
-            print('PARITY FAILURE on doc %r' % (d,), file=sys.stderr)
-            return {'metric': metric, 'value': 0.0, 'unit': 'ops/sec',
-                    'vs_baseline': 0.0, 'parity': False}
-    print('parity: ok (%d docs byte-identical)' % len(oracle_docs),
-          file=sys.stderr)
-    return {'metric': metric, 'value': round(tpu_rate, 1),
-            'unit': 'ops/sec',
-            'vs_baseline': round(tpu_rate / oracle_rate, 3),
-            'fallbacks': fallbacks, 'device': device}
+
+def run_batch_config(build, rng, both_modes=True):
+    """Shared driver for configs 1-4: one causal catch-up batch.
+
+    Measures the platform-default execution mode as the headline AND
+    (both_modes) the opposite mode as a sibling block in the same JSON
+    line -- the kernel path (AMTPU_HOST_FULL=0) when the default is the
+    full host path, the host path when the default is the kernels -- so
+    a regression in either mode fails loudly in every artifact
+    (VERDICT r4 #1)."""
+    import msgpack
+
+    from automerge_tpu import backend as Backend
+    from automerge_tpu.native import NativeDocPool, ShardedNativePool
+
+    batch, metric = build(rng)
+    doc_ids = list(batch)
+    total_ops = sum(len(c['ops']) for chs in batch.values() for c in chs)
+    per_doc_ops = {d: sum(len(c['ops']) for c in batch[d])
+                   for d in doc_ids}
+    print('workload: %d docs, %d total ops'
+          % (len(doc_ids), total_ops), file=sys.stderr)
+
+    def make_pool():
+        # shard count resolves per mode: host_full wants 1, the kernel
+        # pipeline wants overlap granularity (default 20)
+        if N_SHARDS:
+            n = min(N_SHARDS, len(doc_ids))
+        else:
+            n = min(ShardedNativePool.default_shards(), len(doc_ids))
+        return ShardedNativePool(n) if n > 1 else NativeDocPool()
+
+    # ---- baseline: single-thread scalar backend on a >=10% subset -------
+    # median of 3 passes: the shared host core's speed wobbles between
+    # windows, and a slow scalar window inflates vs_baseline dishonestly
+    n_oracle = ORACLE_DOCS or max(1, len(doc_ids) // 10)
+    oracle_docs = doc_ids[:min(n_oracle, len(doc_ids))]
+    oracle_times = []
+    for _ in range(3):
+        oracle_states = {}
+        t0 = time.perf_counter()
+        for d in oracle_docs:
+            state = Backend.init()
+            state, _patch = Backend.apply_changes(state, batch[d])
+            oracle_states[d] = state
+        oracle_times.append(time.perf_counter() - t0)
+    oracle_s = sorted(oracle_times)[1]
+    oracle_ops = sum(per_doc_ops[d] for d in oracle_docs)
+    oracle_rate = oracle_ops / oracle_s
+    print('baseline (scalar backend, %d docs): %s -> median %.0f ops/sec'
+          % (len(oracle_docs), ['%.2fs' % t for t in oracle_times],
+             oracle_rate), file=sys.stderr)
+
+    # ---- wire payload (the split-deployment protocol form) ---------------
+    keyed = {NativeDocPool._doc_key(d): chs for d, chs in batch.items()}
+    payload = msgpack.packb(keyed, use_bin_type=True)
+
+    def parity_ok(pool, label):
+        for d in oracle_docs:
+            if pool.get_patch(d) != Backend.get_patch(oracle_states[d]):
+                print('[%s] PARITY FAILURE on doc %r' % (label, d),
+                      file=sys.stderr)
+                return False
+        print('[%s] parity: ok (%d docs byte-identical)'
+              % (label, len(oracle_docs)), file=sys.stderr)
+        return True
+
+    # ---- headline: the platform-default mode -----------------------------
+    mode = _current_mode()
+    rate, pool, stats = _measure_mode(make_pool, payload, total_ops, mode)
+    if not parity_ok(pool, mode):
+        return {'metric': metric, 'value': 0.0, 'unit': 'ops/sec',
+                'vs_baseline': 0.0, 'baseline': BASELINE_NAME,
+                'mode': mode, 'parity': False}
+    result = {'metric': metric, 'value': round(rate, 1),
+              'unit': 'ops/sec',
+              'vs_baseline': round(rate / oracle_rate, 3),
+              'baseline': BASELINE_NAME, 'mode': mode}
+    result.update(stats)
+
+    # ---- sibling: the opposite execution mode ----------------------------
+    # resident mode can't be entered here (AMTPU_RESIDENT latches in the
+    # native lib's static init at the first batch above) -- `--mode
+    # resident` / `--all` run it in a fresh process instead
+    if both_modes and mode in ('host_full', 'kernel'):
+        alt = 'kernel' if mode == 'host_full' else 'host_full'
+        with _alt_mode_env(alt):
+            arate, apool, astats = _measure_mode(
+                make_pool, payload, total_ops, alt)
+            result['%s_path' % alt] = _alt_block(
+                arate, oracle_rate, astats, parity_ok(apool, alt))
+    return result
 
 
 def run_config_5(rng):
@@ -430,48 +517,73 @@ def run_config_5(rng):
     print('warmup: %.2fs' % (time.perf_counter() - t0), file=sys.stderr)
 
     from automerge_tpu import trace
-    times = []
-    rs = None
-    fallbacks = {}
-    for _ in range(3):
-        rs = load_set()
-        # metric window covers ONLY the measured catch-up -- fallbacks
-        # during the untimed backlog load must not flag the run
-        trace.metrics_reset()
-        t0 = time.perf_counter()
-        rounds = rs.catch_up()
-        times.append(time.perf_counter() - t0)
-        for k, v in trace.metrics_snapshot().items():
-            if k.startswith('fallback.'):
-                key = k.split('.', 1)[1]
-                fallbacks[key] = fallbacks.get(key, 0) + int(v)
-    sync_s = sorted(times)[1]
-    rate = total_applications / sync_s
-    print('fallbacks (3 runs): %s' % (fallbacks or 'none'),
-          file=sys.stderr)
-    print('catch-up runs: %s (rounds: %s) -> median %.0f ops/sec'
-          % (['%.2fs' % t for t in times], rounds, rate), file=sys.stderr)
 
-    # ---- parity: every replica's tree equals the oracle union ------------
-    if not rs.converged():
+    def measure_catchup(label):
+        times = []
+        rs = None
+        fallbacks = {}
+        rounds = None
+        for _ in range(3):
+            rs = load_set()
+            # metric window covers ONLY the measured catch-up --
+            # fallbacks during the untimed backlog load must not flag
+            # the run
+            trace.metrics_reset()
+            t0 = time.perf_counter()
+            rounds = rs.catch_up()
+            times.append(time.perf_counter() - t0)
+            for k, v in trace.metrics_snapshot().items():
+                if k.startswith('fallback.'):
+                    key = k.split('.', 1)[1]
+                    fallbacks[key] = fallbacks.get(key, 0) + int(v)
+        sync_s = sorted(times)[1]
+        rate = total_applications / sync_s
+        print('[%s] fallbacks (3 runs): %s' % (label, fallbacks or 'none'),
+              file=sys.stderr)
+        print('[%s] catch-up runs: %s (rounds: %s) -> median %.0f ops/sec'
+              % (label, ['%.2fs' % t for t in times], rounds, rate),
+              file=sys.stderr)
+        return rate, rs, fallbacks
+
+    def parity_ok(rs, label):
+        # every replica's tree equals the oracle union
+        if not rs.converged():
+            return False
+        for d in range(n_docs):
+            patch = rs.assert_identical(d)
+            st = Backend.init()
+            st, _ = Backend.apply_changes(st, union[d])
+            want = Backend.get_patch(st)
+            if patch['clock'] != want['clock'] or \
+                    patch_to_tree(patch) != patch_to_tree(want):
+                print('[%s] PARITY FAILURE on doc %d' % (label, d),
+                      file=sys.stderr)
+                return False
+        print('[%s] parity: ok (%d docs, %d replicas convergent + '
+              'oracle-equal)' % (label, n_docs, n_replicas),
+              file=sys.stderr)
+        return True
+
+    mode = _current_mode()
+    rate, rs, fallbacks = measure_catchup(mode)
+    if not parity_ok(rs, mode):
         return {'metric': 'replica_catchup_ops_per_sec', 'value': 0.0,
-                'unit': 'ops/sec', 'vs_baseline': 0.0, 'parity': False}
-    for d in range(n_docs):
-        patch = rs.assert_identical(d)
-        st = Backend.init()
-        st, _ = Backend.apply_changes(st, union[d])
-        want = Backend.get_patch(st)
-        if patch['clock'] != want['clock'] or \
-                patch_to_tree(patch) != patch_to_tree(want):
-            print('PARITY FAILURE on doc %d' % d, file=sys.stderr)
-            return {'metric': 'replica_catchup_ops_per_sec', 'value': 0.0,
-                    'unit': 'ops/sec', 'vs_baseline': 0.0, 'parity': False}
-    print('parity: ok (%d docs, %d replicas convergent + oracle-equal)'
-          % (n_docs, n_replicas), file=sys.stderr)
-    return {'metric': 'replica_catchup_ops_per_sec',
-            'value': round(rate, 1), 'unit': 'ops/sec',
-            'vs_baseline': round(rate / oracle_rate, 3),
-            'fallbacks': fallbacks}
+                'unit': 'ops/sec', 'vs_baseline': 0.0,
+                'baseline': BASELINE_NAME, 'mode': mode, 'parity': False}
+    result = {'metric': 'replica_catchup_ops_per_sec',
+              'value': round(rate, 1), 'unit': 'ops/sec',
+              'vs_baseline': round(rate / oracle_rate, 3),
+              'baseline': BASELINE_NAME, 'mode': mode,
+              'fallbacks': fallbacks}
+
+    if mode in ('host_full', 'kernel'):
+        alt = 'kernel' if mode == 'host_full' else 'host_full'
+        with _alt_mode_env(alt):
+            arate, ars, afb = measure_catchup(alt)
+            result['%s_path' % alt] = _alt_block(
+                arate, oracle_rate, {'fallbacks': afb},
+                parity_ok(ars, alt))
+    return result
 
 
 def run_config_1_mesh(rng):
@@ -536,16 +648,69 @@ def run_config_1_mesh(rng):
     except AssertionError as e:
         print('PARITY FAILURE: %s' % e, file=sys.stderr)
         return {'metric': 'text_single_doc_mesh_ops_per_sec', 'value': 0.0,
-                'unit': 'ops/sec', 'vs_baseline': 0.0, 'parity': False}
+                'unit': 'ops/sec', 'vs_baseline': 0.0,
+                'baseline': BASELINE_NAME, 'mode': 'mesh', 'parity': False}
     print('parity: ok (kernel outputs match pool patches)',
           file=sys.stderr)
     return {'metric': 'text_single_doc_mesh_ops_per_sec',
             'value': round(rate, 1), 'unit': 'ops/sec',
-            'vs_baseline': round(rate / oracle_rate, 3)}
+            'vs_baseline': round(rate / oracle_rate, 3),
+            'baseline': BASELINE_NAME, 'mode': 'mesh'}
 
 
 BUILDERS = {1: build_config_1, 2: build_config_2, 3: build_config_3,
             4: build_config_4}
+
+
+def run_all(args):
+    """--all: every config in every execution mode, one JSON-lines
+    artifact (VERDICT r4 #5: a committed all-config file per round).
+
+    Each line runs in a FRESH subprocess: the latched native knobs
+    (AMTPU_RESIDENT*) only bind at a process's first batch, jit caches
+    don't leak across configs, and one config's memory high-water can't
+    pollute the next config's timings on this single-core host.
+
+    Per config: one `--mode auto` line (which itself embeds the
+    opposite-mode sibling block), plus a `--mode resident` line for the
+    long-list shapes (configs 1 and 3) -- the device-resident arena
+    path the multichip dryrun shards."""
+    import subprocess
+    lines = []
+    runs = [(c, 'auto') for c in (1, 2, 3, 4, 5)]
+    runs += [(1, 'resident'), (3, 'resident')]
+    for config, bmode in runs:
+        cmd = [sys.executable, os.path.abspath(__file__),
+               '--config', str(config), '--mode', bmode]
+        print('== bench --config %d --mode %s ==' % (config, bmode),
+              file=sys.stderr)
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        sys.stderr.write(proc.stderr)
+        line = (proc.stdout.strip().splitlines() or [''])[-1]
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            rec = {'metric': 'config_%d' % config, 'value': 0.0,
+                   'unit': 'ops/sec', 'vs_baseline': 0.0,
+                   'baseline': BASELINE_NAME, 'mode': bmode,
+                   'error': 'rc=%d no-json' % proc.returncode}
+        # the subprocess rc carries failures the top-level fields don't:
+        # a sibling-mode parity regression zeroes only the *_path block
+        # (main()'s sibling_bad check fails the rc) -- bench-all must be
+        # exactly as loud
+        if proc.returncode != 0:
+            rec.setdefault('error', 'rc=%d' % proc.returncode)
+        rec['config'] = config
+        lines.append(rec)
+        print(json.dumps(rec))
+    if args.out:
+        with open(args.out, 'w') as f:
+            for rec in lines:
+                f.write(json.dumps(rec) + '\n')
+        print('wrote %d lines -> %s' % (len(lines), args.out),
+              file=sys.stderr)
+    bad = [r for r in lines if not r.get('vs_baseline') or 'error' in r]
+    return 1 if bad else 0
 
 
 def main(argv=None):
@@ -553,20 +718,55 @@ def main(argv=None):
     ap.add_argument('--config', type=int,
                     default=env_int('AMTPU_BENCH_CONFIG', 3),
                     choices=[1, 2, 3, 4, 5])
+    ap.add_argument('--mode', default='auto',
+                    choices=['auto', 'host', 'kernel', 'resident'],
+                    help='execution mode: auto = platform default '
+                         'headline + opposite-mode sibling block; '
+                         'host/kernel/resident pin one mode (resident '
+                         'requires a fresh process -- the knob latches '
+                         'at the first native batch)')
+    ap.add_argument('--all', action='store_true',
+                    help='run every config in every mode (fresh '
+                         'subprocess each) and write a JSON-lines '
+                         'artifact (--out)')
+    ap.add_argument('--out', default='',
+                    help='with --all: artifact path (JSON lines)')
     args = ap.parse_args(argv)
+    # argparse skips the choices check for non-string DEFAULTS, so an
+    # env-supplied AMTPU_BENCH_CONFIG needs explicit validation
     if args.config not in (1, 2, 3, 4, 5):
         ap.error('invalid config %r (AMTPU_BENCH_CONFIG must be 1..5)'
                  % (args.config,))
+    if args.all:
+        return run_all(args)
+    if args.mode == 'host':
+        os.environ['AMTPU_HOST_FULL'] = '1'
+    elif args.mode == 'kernel':
+        os.environ['AMTPU_HOST_FULL'] = '0'
+    elif args.mode == 'resident':
+        # only meaningful in a fresh process: the native lib latches
+        # AMTPU_RESIDENT in its static init at the first batch
+        os.environ['AMTPU_RESIDENT'] = '1'
+        # bind residency for the config-1 arena (10k elements) too, not
+        # just arenas past the default 16384 threshold
+        os.environ.setdefault('AMTPU_RESIDENT_MIN', '4096')
     print('device: %s' % probe_device(), file=sys.stderr)
     rng = random.Random(SEED)
+    both = args.mode == 'auto'
     if args.config == 5:
         result = run_config_5(rng)
     elif args.config == 1 and env_int('AMTPU_BENCH_C1_MESH', 0):
         result = run_config_1_mesh(rng)
     else:
-        result = run_batch_config(BUILDERS[args.config], rng)
+        result = run_batch_config(BUILDERS[args.config], rng, both_modes=both)
     print(json.dumps(result))
-    return 0 if result.get('vs_baseline') else 1
+    # a parity failure in EITHER mode fails the run: the sibling-mode
+    # block exists precisely so a kernel-path regression is loud even
+    # where the host path is the platform default
+    sibling_bad = any(
+        isinstance(v, dict) and v.get('parity') is False
+        for k, v in result.items() if k.endswith('_path'))
+    return 0 if result.get('vs_baseline') and not sibling_bad else 1
 
 
 if __name__ == '__main__':
