@@ -55,6 +55,13 @@ static const char* ROOT_ID = "00000000-0000-0000-0000-000000000000";
 // pointer chase, and inserting never allocates per node.
 // Key 0xffff..ff is reserved as the empty marker (never a valid key here:
 // composite keys are built from interner ids < 2^32).
+inline size_t flatmap_mix(u64 k) {
+  k ^= k >> 33;
+  k *= 0xff51afd7ed558ccdULL;
+  k ^= k >> 29;
+  return static_cast<size_t>(k);
+}
+
 template <typename V>
 struct FlatMap {
   std::vector<u64> keys;
@@ -63,12 +70,7 @@ struct FlatMap {
   static constexpr u64 EMPTY = ~0ull;
 
   FlatMap() { rehash(16); }
-  static inline size_t mix(u64 k) {
-    k ^= k >> 33;
-    k *= 0xff51afd7ed558ccdULL;
-    k ^= k >> 29;
-    return static_cast<size_t>(k);
-  }
+  static inline size_t mix(u64 k) { return flatmap_mix(k); }
   void rehash(size_t cap) {
     std::vector<u64> ok = std::move(keys);
     std::vector<V> ov = std::move(vals);
@@ -139,6 +141,74 @@ struct FlatMap {
     keys[hole] = EMPTY;
     vals[hole] = V{};
     --n;
+  }
+};
+
+// FlatMap variant for LARGE V: the table stores (key, dense index) and
+// values live in a dense append-only vector.  FlatMap<V>::rehash
+// default-constructs + zeroes a capacity-sized V array and moves every
+// element on growth; with V=Register (~100 B) that zero/move traffic
+// profiled as the largest single memory cost of table-heavy batches
+// (fresh pools rebuild every doc's register map per run).  Here rehash
+// touches 12 B/slot regardless of V.  No erase: register mirrors are
+// never removed from a doc (rollback journals never reach them -- they
+// are updated post-commit in emit).
+template <typename V>
+struct FlatMapDense {
+  std::vector<u64> keys;
+  std::vector<u32> slot;
+  std::vector<V> vals;
+  size_t mask = 0, n = 0;
+  static constexpr u64 EMPTY = ~0ull;
+
+  FlatMapDense() { rehash(16); }
+  void rehash(size_t cap) {
+    std::vector<u64> ok = std::move(keys);
+    std::vector<u32> os = std::move(slot);
+    keys.assign(cap, EMPTY);
+    slot.assign(cap, 0);
+    mask = cap - 1;
+    for (size_t i = 0; i < ok.size(); ++i) {
+      if (ok[i] == EMPTY) continue;
+      size_t j = flatmap_mix(ok[i]) & mask;
+      while (keys[j] != EMPTY) j = (j + 1) & mask;
+      keys[j] = ok[i];
+      slot[j] = os[i];
+    }
+  }
+  void reserve(size_t want) {
+    size_t cap = mask + 1;
+    while (want * 4 >= cap * 3) cap *= 2;
+    if (cap != mask + 1) rehash(cap);
+    vals.reserve(want);
+  }
+  V* find(u64 k) {
+    size_t i = flatmap_mix(k) & mask;
+    while (true) {
+      if (keys[i] == k) return &vals[slot[i]];
+      if (keys[i] == EMPTY) return nullptr;
+      i = (i + 1) & mask;
+    }
+  }
+  const V* find(u64 k) const {
+    return const_cast<FlatMapDense*>(this)->find(k);
+  }
+  // returns (slot, inserted); value pointers move when vals grows --
+  // same aliasing caution as FlatMap's rehash, see emit()'s INVARIANT
+  std::pair<V*, bool> insert(u64 k) {
+    if ((n + 1) * 4 >= (mask + 1) * 3) rehash((mask + 1) * 2);
+    size_t i = flatmap_mix(k) & mask;
+    while (true) {
+      if (keys[i] == k) return {&vals[slot[i]], false};
+      if (keys[i] == EMPTY) {
+        keys[i] = k;
+        slot[i] = static_cast<u32>(vals.size());
+        ++n;
+        vals.emplace_back();
+        return {&vals.back(), true};
+      }
+      i = (i + 1) & mask;
+    }
   }
 };
 
@@ -369,7 +439,7 @@ struct DocState {
   std::vector<u32> state_actor_order;   // actors in first-seen order
   std::vector<ChangeRec> queue;
   std::unordered_map<u32, ObjMeta> objects;
-  FlatMap<Register> registers;  // rkey(obj, key) -> live field ops
+  FlatMapDense<Register> registers;  // rkey(obj, key) -> live field ops
   std::unordered_map<u32, Arena> arenas;
   // application-order log of (actor, seq): save() replays changes in
   // exactly this order so a loaded doc materializes byte-identically
@@ -485,73 +555,115 @@ static const char* type_name(u8 t) {
 // (object ids within a change, single-char text values): a short memcmp
 // beats a hash+probe
 struct DecodeCache {
-  std::string_view obj_sv, val_sv;
+  std::string_view obj_sv, val_sv, key_sv;
   u32 obj_sid = NONE;
   u32 val_sid = NONE, val_rid = NONE;
+  // last-key cache: text streams alternate {ins key=prev-elemId} /
+  // {set key=new-elemId}, so every elemId decodes as a key TWICE in a
+  // row (set, then the next op's ins) -- one intern hash instead of two
+  u32 key_sid = NONE;
 };
 
-// Fixed-layout decode fast path.  The dominant op shapes in real change
-// streams are exactly {action, obj, key, elem} ("ins") and {action, obj,
-// key, value} ("set"), emitted in that key order by the frontend's op
-// builders (reference shapes: frontend/context.js:27-34; our encoders
-// preserve the same order).  One 12-byte literal memcmp replaces the
-// per-key dispatch loop; any deviation falls back to the generic decoder.
-static const u8 FP_HDR_INS[12] = {0x84, 0xa6, 'a','c','t','i','o','n',
-                                  0xa3, 'i','n','s'};
-static const u8 FP_HDR_SET[12] = {0x84, 0xa6, 'a','c','t','i','o','n',
-                                  0xa3, 's','e','t'};
+// Fixed-layout decode fast path.  The frontend's op builders (reference
+// shapes: frontend/context.js:27-34; our encoders preserve the same key
+// order) emit every op in canonical layout: {action, obj[, key[, value
+// | elem][, datatype]]}.  This parser covers the WHOLE op vocabulary --
+// ins/set/del/link/make* -- with literal memcmps instead of the per-key
+// dispatch loop; any deviation (reordered keys, unknown fields, long
+// headers) falls back to the generic decoder.
+static const u8 FP_ACTION[7] = {0xa6, 'a','c','t','i','o','n'};
 static const u8 FP_OBJ[4] = {0xa3, 'o','b','j'};
 static const u8 FP_KEY[4] = {0xa3, 'k','e','y'};
 static const u8 FP_ELEM[5] = {0xa4, 'e','l','e','m'};
 static const u8 FP_VALUE[6] = {0xa5, 'v','a','l','u','e'};
+static const u8 FP_DATATYPE[9] = {0xa8, 'd','a','t','a','t','y','p','e'};
 
 static bool decode_op_fast(Reader& r, Pool& pool, u32 actor, u32 seq,
                            DecodeCache& dc, OpRec& op) {
   const u8* p = r.pos();
   const u8* end = r.end();
-  if (end - p < 24) return false;
-  bool is_ins;
-  if (std::memcmp(p, FP_HDR_INS, 12) == 0) is_ins = true;
-  else if (std::memcmp(p, FP_HDR_SET, 12) == 0) is_ins = false;
-  else return false;
-  p += 12;
+  if (end - p < 16) return false;
+  const u8 m = p[0];
+  if (m < 0x82 || m > 0x85) return false;
+  const size_t nkeys = m & 0x0f;
+  if (std::memcmp(p + 1, FP_ACTION, 7) != 0) return false;
+  p += 8;
+  const u8 ab = *p;
+  if ((ab & 0xe0) != 0xa0) return false;
+  const size_t alen = ab & 0x1f;
+  if (static_cast<size_t>(end - p) < 1 + alen + 5) return false;
+  std::string_view asv(reinterpret_cast<const char*>(p + 1), alen);
+  // vocabulary probe without throwing: an unknown action string falls
+  // back to the generic decoder, which raises the reference's error
+  u8 action = 0xff;
+  switch (alen) {
+    case 3: action = asv == "set" ? A_SET : asv == "del" ? A_DEL
+                     : asv == "ins" ? A_INS : 0xff; break;
+    case 4: action = asv == "link" ? A_LINK : 0xff; break;
+    case 7: action = asv == "makeMap" ? A_MAKE_MAP : 0xff; break;
+    case 8: action = asv == "makeList" ? A_MAKE_LIST
+                     : asv == "makeText" ? A_MAKE_TEXT : 0xff; break;
+    case 9: action = asv == "makeTable" ? A_MAKE_TABLE : 0xff; break;
+  }
+  if (action == 0xff) return false;
+  p += 1 + alen;
   if (std::memcmp(p, FP_OBJ, 4) != 0) return false;
   p += 4;
   // string header: fixstr or str8 (covers UUID object ids / 'uuid:ctr'
   // elemIds, which msgpack encodes as str8); anything longer falls back
-  auto read_short_str = [&](std::string_view& out, size_t trailing) {
+  auto read_short_str = [&](std::string_view& out) {
+    if (p >= end) return false;
     u8 hb = *p;
     size_t n, hdr;
     if (hb >= 0xa0 && hb <= 0xbf) { n = hb & 0x1f; hdr = 1; }
     else if (hb == 0xd9) {
-      if (static_cast<size_t>(end - p) < 2) return false;
+      if (end - p < 2) return false;
       n = p[1]; hdr = 2;
     } else return false;
-    if (static_cast<size_t>(end - p) < hdr + n + trailing + 1) return false;
+    if (static_cast<size_t>(end - p) < hdr + n) return false;
     out = std::string_view(reinterpret_cast<const char*>(p + hdr), n);
     p += hdr + n;
     return true;
   };
   std::string_view osv;
-  if (!read_short_str(osv, 4)) return false;
-  if (std::memcmp(p, FP_KEY, 4) != 0) return false;
-  p += 4;
-  std::string_view ksv;
-  if (!read_short_str(ksv, is_ins ? 5 : 6)) return false;
+  if (!read_short_str(osv)) return false;
 
-  op.action = is_ins ? A_INS : A_SET;
+  op.action = action;
   op.elem = -1;
   op.actor = actor; op.seq = seq;
   op.datatype = NONE; op.value_rid = NONE; op.value_sid = NONE;
+  op.key = NONE;
   if (dc.obj_sid == NONE || osv != dc.obj_sv) {
     dc.obj_sid = pool.intern.id_of(osv);
     dc.obj_sv = osv;
   }
   op.obj = dc.obj_sid;
-  op.key = pool.intern.id_of(ksv);
 
-  if (is_ins) {
-    if (std::memcmp(p, FP_ELEM, 5) != 0) return false;
+  if (action >= A_MAKE_MAP) {          // {action, obj}
+    if (nkeys != 2) return false;
+    r.advance_to(p);
+    return true;
+  }
+  if (static_cast<size_t>(end - p) < 5 ||
+      std::memcmp(p, FP_KEY, 4) != 0) return false;
+  p += 4;
+  std::string_view ksv;
+  if (!read_short_str(ksv)) return false;
+  if (dc.key_sid == NONE || ksv != dc.key_sv) {
+    dc.key_sid = pool.intern.id_of(ksv);
+    dc.key_sv = ksv;
+  }
+  op.key = dc.key_sid;
+
+  if (action == A_DEL) {               // {action, obj, key}
+    if (nkeys != 3) return false;
+    r.advance_to(p);
+    return true;
+  }
+  if (action == A_INS) {               // {action, obj, key, elem}
+    if (nkeys != 4 || static_cast<size_t>(end - p) < 6 ||
+        std::memcmp(p, FP_ELEM, 5) != 0)
+      return false;
     p += 5;
     u8 eb = *p;
     if (eb <= 0x7f) { op.elem = eb; p += 1; }
@@ -563,42 +675,62 @@ static bool decode_op_fast(Reader& r, Pool& pool, u32 actor, u32 seq,
                 (u32(p[3]) << 8) | p[4];
       p += 5;
     } else return false;
-  } else {
-    if (std::memcmp(p, FP_VALUE, 6) != 0) return false;
-    p += 6;
-    u8 vb = *p;
-    if (vb >= 0xa0 && vb <= 0xbf) {
-      // short string value: intern via the single-char / run caches
-      size_t vlen = vb & 0x1f;
-      if (static_cast<size_t>(end - p) < 1 + vlen) return false;
-      std::string_view s(reinterpret_cast<const char*>(p + 1), vlen);
-      std::string_view raw(reinterpret_cast<const char*>(p), 1 + vlen);
-      if (vlen == 1) {
-        u8 c = static_cast<u8>(s[0]);
-        if (pool.char_sid[c] == NONE) {
-          pool.char_sid[c] = pool.intern.id_of(s);
-          pool.char_rid[c] = pool.vals.id_of(raw);
-        }
-        op.value_sid = pool.char_sid[c];
-        op.value_rid = pool.char_rid[c];
-      } else {
-        if (dc.val_sid == NONE || raw != dc.val_sv) {
-          dc.val_sid = pool.intern.id_of(s);
-          dc.val_rid = pool.vals.id_of(raw);
-          dc.val_sv = raw;
-        }
-        op.value_sid = dc.val_sid;
-        op.value_rid = dc.val_rid;
+    r.advance_to(p);
+    return true;
+  }
+
+  // set / link: {action, obj, key, value[, datatype]}
+  if (nkeys < 4 || static_cast<size_t>(end - p) < 7 ||
+      std::memcmp(p, FP_VALUE, 6) != 0)
+    return false;
+  p += 6;
+  u8 vb = *p;
+  if (vb >= 0xa0 && vb <= 0xbf) {
+    // short string value: intern via the single-char / run caches
+    size_t vlen = vb & 0x1f;
+    if (static_cast<size_t>(end - p) < 1 + vlen) return false;
+    std::string_view s(reinterpret_cast<const char*>(p + 1), vlen);
+    std::string_view raw(reinterpret_cast<const char*>(p), 1 + vlen);
+    if (vlen == 1) {
+      u8 c = static_cast<u8>(s[0]);
+      if (pool.char_sid[c] == NONE) {
+        pool.char_sid[c] = pool.intern.id_of(s);
+        pool.char_rid[c] = pool.vals.id_of(raw);
       }
-      p += 1 + vlen;
+      op.value_sid = pool.char_sid[c];
+      op.value_rid = pool.char_rid[c];
     } else {
-      // non-string or long-string value: generic raw-span capture
-      Reader rv(p, end - p);
-      auto span = rv.raw_value();
-      op.value_rid = pool.vals.id_of(std::string_view(
-          reinterpret_cast<const char*>(span.first), span.second));
-      p = rv.pos();
+      if (dc.val_sid == NONE || raw != dc.val_sv) {
+        dc.val_sid = pool.intern.id_of(s);
+        dc.val_rid = pool.vals.id_of(raw);
+        dc.val_sv = raw;
+      }
+      op.value_sid = dc.val_sid;
+      op.value_rid = dc.val_rid;
     }
+    p += 1 + vlen;
+  } else if (action == A_LINK) {
+    // link targets must intern a value_sid (inbound-ref maintenance);
+    // a non-fixstr target (str8 object id) takes the generic decoder
+    return false;
+  } else {
+    // non-string or long-string value: generic raw-span capture
+    Reader rv(p, end - p);
+    auto span = rv.raw_value();
+    op.value_rid = pool.vals.id_of(std::string_view(
+        reinterpret_cast<const char*>(span.first), span.second));
+    p = rv.pos();
+  }
+  if (nkeys == 5) {                    // trailing datatype
+    if (static_cast<size_t>(end - p) < 10 ||
+        std::memcmp(p, FP_DATATYPE, 9) != 0)
+      return false;
+    p += 9;
+    std::string_view dsv;
+    if (!read_short_str(dsv)) return false;
+    op.datatype = pool.intern.id_of(dsv);
+  } else if (nkeys != 4) {
+    return false;
   }
   r.advance_to(p);
   return true;
@@ -630,7 +762,12 @@ static OpRec decode_op(Reader& r, Pool& pool, u32 actor, u32 seq,
       }
       op.obj = dc.obj_sid;
     } else if (k0 == 'k' && k == "key") {
-      op.key = pool.intern.id_of(r.read_str_view());
+      std::string_view s = r.read_str_view();
+      if (dc.key_sid == NONE || s != dc.key_sv) {
+        dc.key_sid = pool.intern.id_of(s);
+        dc.key_sv = s;
+      }
+      op.key = dc.key_sid;
     } else if (k0 == 'e' && k == "elem") {
       op.elem = r.read_int();
     } else if (k0 == 'd' && k == "datatype") {
@@ -681,7 +818,8 @@ struct LocalReq {
 
 static ChangeRec decode_change(Reader& r, Pool& pool,
                                const std::shared_ptr<std::vector<u8>>& slab,
-                               LocalReq* lr = nullptr) {
+                               LocalReq* lr = nullptr,
+                               DecodeCache* dcp = nullptr) {
   ChangeRec ch;
   const uint8_t* start = r.pos();
   size_t n = r.read_map();
@@ -692,6 +830,12 @@ static ChangeRec decode_change(Reader& r, Pool& pool,
   const uint8_t* rt_start = nullptr;
   const uint8_t* rt_end = nullptr;
   size_t ops_count = 0;
+  // batch-shared cache (string_views into the batch slab, which
+  // outlives every change): consecutive changes of one doc hit the
+  // same object/keys, so resetting per change wastes most of the hits
+  DecodeCache local_dc;
+  DecodeCache& dc = dcp ? *dcp : local_dc;
+  bool ops_inline = false;
   for (size_t i = 0; i < n; ++i) {
     const uint8_t* pair_start = r.pos();
     std::string_view k = r.read_str_view();
@@ -719,12 +863,26 @@ static ChangeRec decode_change(Reader& r, Pool& pool,
         ch.deps.emplace_back(a, s);
       }
     } else if (k == "ops") {
-      // ops need actor/seq which may be decoded after this key; remember
-      // the span and re-parse once the whole map is read
-      ops_start = r.pos();
-      ops_count = r.read_array();
-      for (size_t j = 0; j < ops_count; ++j) r.skip();
-      ops_end = r.pos();
+      if (ch.actor != NONE && ch.seq != 0) {
+        // canonical envelope order ({actor, seq, deps, ops, ...}): ops
+        // decode inline in one walk
+        ops_inline = true;
+        ops_count = r.read_array();
+        // payload-controlled count: clamp the reserve by what the
+        // buffer could possibly hold (>=4 bytes/op) so a corrupt
+        // header raises a decode error, not bad_alloc
+        ch.ops.reserve(std::min(ops_count,
+                                static_cast<size_t>(r.end() - r.pos()) / 4));
+        for (size_t j = 0; j < ops_count; ++j)
+          ch.ops.push_back(decode_op(r, pool, ch.actor, ch.seq, dc));
+      } else {
+        // ops need actor/seq which arrive after this key: remember the
+        // span, generic-skip past it, re-parse once the map is read
+        ops_start = r.pos();
+        ops_count = r.read_array();
+        for (size_t j = 0; j < ops_count; ++j) r.skip();
+        ops_end = r.pos();
+      }
     } else if (k == "message") {
       auto span = r.raw_value();
       ch.has_message = true;
@@ -749,11 +907,11 @@ static ChangeRec decode_change(Reader& r, Pool& pool,
     ch.raw.off = static_cast<u32>(start - slab->data());
     ch.raw.len = static_cast<u32>(r.pos() - start);
   }
-  if (ops_start) {
+  if (ops_start && !ops_inline) {
     Reader ro(ops_start, static_cast<size_t>(ops_end - ops_start));
     ro.read_array();
-    ch.ops.reserve(ops_count);
-    DecodeCache dc;
+    ch.ops.reserve(std::min(ops_count,
+                            static_cast<size_t>(ops_end - ops_start) / 4));
     for (size_t j = 0; j < ops_count; ++j)
       ch.ops.push_back(decode_op(ro, pool, ch.actor, ch.seq, dc));
   }
@@ -1105,6 +1263,7 @@ struct BeginJournal {
 static void update_states(Pool& pool, Batch& b, BeginJournal& j) {
   j.snapped.assign(b.bdocs.size(), 0);
   j.state_pushes.reserve(b.applied.size());
+  Clock dep_scratch;  // reused across changes (swap with st.deps below)
   for (auto& ac : b.applied) {
     DocState& st = *b.bdocs[ac.doc];
     ChangeRec& ch = ac.change;
@@ -1115,24 +1274,27 @@ static void update_states(Pool& pool, Batch& b, BeginJournal& j) {
       j.histories.emplace_back(ac.doc, st.history.size());
     }
     st.history.emplace_back(actor, seq);
-    Clock base = ch.deps;
-    clock_set_max(base, actor, 0);  // ensure present
-    // pin authoring actor at seq-1
-    for (auto& p : base) if (p.first == actor) p.second = seq - 1;
-    // Exact-closure fast seed: (actor, seq-1) is always in base, and its
-    // all_deps entry is already transitively closed, so start from a copy
-    // of it.  Any other dep (da, ds) whose ds is already covered by the
-    // seed contributes nothing (closed clocks are monotone: allDeps(da,ds)
-    // is a subset of any closed clock containing da at >= ds) -- the
-    // common linear-history / gossip case skips most merges entirely.
+    // Exact-closure fast seed: the authoring actor contributes exactly
+    // (actor, seq-1) -- pinned regardless of what ch.deps claims -- and
+    // its all_deps entry is already transitively closed, so start from
+    // a copy of it.  Any other dep (da, ds) whose ds is already covered
+    // by the seed contributes nothing (closed clocks are monotone:
+    // allDeps(da,ds) is a subset of any closed clock containing da at
+    // >= ds) -- the common linear-history / gossip case skips most
+    // merges entirely.  (The former code materialized a pinned copy of
+    // ch.deps first; iterating it directly drops one Clock alloc+copy
+    // per change.)
     Clock all_deps;
     if (seq > 1) all_deps = all_deps_of(st, actor, seq - 1);
-    for (auto& [da, ds] : base) {
-      if (ds == 0 || clock_get(all_deps, da) >= ds) continue;
+    auto cover = [&](u32 da, u32 ds) {
+      if (ds == 0 || clock_get(all_deps, da) >= ds) return;
       const Clock& trans = all_deps_of(st, da, ds);
       for (auto& [ta, ts] : trans) clock_set_max(all_deps, ta, ts);
       clock_set_max(all_deps, da, ds);
-    }
+    };
+    cover(actor, seq - 1);
+    for (auto& [da, ds] : ch.deps)
+      if (da != actor) cover(da, ds);
     auto sit = st.states.find(actor);
     if (sit == st.states.end()) {
       j.actor_orders.emplace_back(ac.doc, st.state_actor_order.size());
@@ -1145,13 +1307,15 @@ static void update_states(Pool& pool, Batch& b, BeginJournal& j) {
     const Clock& adeps = sit->second.back().all_deps;
     j.state_pushes.emplace_back(ac.doc, actor);
     clock_set_max(st.clock, actor, seq);
-    Clock remaining;
+    // frontier rebuild into a reused scratch (swap leaves the old deps
+    // buffer as next change's scratch -- zero allocs steady-state)
+    dep_scratch.clear();
     for (auto& [a, s] : st.deps)
-      if (s > clock_get(adeps, a)) remaining.emplace_back(a, s);
-    clock_set_max(remaining, actor, seq);
+      if (s > clock_get(adeps, a)) dep_scratch.emplace_back(a, s);
+    clock_set_max(dep_scratch, actor, seq);
     // deps[actor] = seq exactly (not max -- seq is the new frontier)
-    for (auto& p : remaining) if (p.first == actor) p.second = seq;
-    st.deps = std::move(remaining);
+    for (auto& p : dep_scratch) if (p.first == actor) p.second = seq;
+    st.deps.swap(dep_scratch);
   }
   // resolve stored pointers after all pushes (the entries vectors may have
   // reallocated; states[actor][seq-1] is the invariant address)
@@ -1286,6 +1450,11 @@ static void encode(Pool& pool, Batch& b) {
   // ops capture inverse ops: only those whose object was NOT created by
   // the same change (reference topLevel gate, op_set.js:233-250 newObjects
   // + :193-200)
+  {
+    size_t total = 0;
+    for (auto& ac : b.applied) total += ac.stored->ops.size();
+    b.ops.reserve(total);
+  }
   for (auto& ac : b.applied) {
     std::unordered_set<u32> new_objs;
     for (const OpRec& op : ac.stored->ops) {
@@ -1304,11 +1473,24 @@ static void encode(Pool& pool, Batch& b) {
     if (sid >= involved.size()) involved.resize(sid + 1, 0);
     involved[sid] = 1;
   };
-  for (auto& ac : b.applied) {
-    DocState& st = *b.bdocs[ac.doc];
-    mark(ac.change.actor);
-    for (auto& [da, ds] : all_deps_of(st, ac.change.actor, ac.change.seq))
-      mark(da);
+  if (b.host_full) {
+    // no kernel rows will be built, so actor ranks are only consumed by
+    // the host paths (host_resolve_step's prior ordering, host_rank's
+    // sibling sort).  Every register prior and every clock-dep actor
+    // has a states entry by construction (they all arrived via applied
+    // changes), so marking each batch doc's state_actor_order covers
+    // them in O(actors) -- replacing the per-group register walks the
+    // kernel path needs (group discovery below is skipped entirely).
+    for (u32 d = 0; d < b.bdocs.size(); ++d)
+      for (u32 a : b.bdocs[d]->state_actor_order) mark(a);
+    for (auto& ac : b.applied) mark(ac.change.actor);
+  } else {
+    for (auto& ac : b.applied) {
+      DocState& st = *b.bdocs[ac.doc];
+      mark(ac.change.actor);
+      for (auto& [da, ds] : all_deps_of(st, ac.change.actor, ac.change.seq))
+        mark(da);
+    }
   }
 
   // group ids per doc, keyed by rkey(obj, key): per-doc flat maps keep
@@ -1331,19 +1513,21 @@ static void encode(Pool& pool, Batch& b) {
     DocState& st = *b.bdocs[f.doc];
     const OpRec& op = *f.op;
     if (is_assign(op.action)) {
-      auto [slot, inserted] =
-          doc_gids[f.doc].insert(DocState::rkey(op.obj, op.key));
-      if (inserted) {
-        *slot = static_cast<u32>(gid_order.size());
-        gid_order.push_back(K3{f.doc, op.obj, op.key});
-        const Register* reg =
-            st.registers.find(DocState::rkey(op.obj, op.key));
-        gid_regs.push_back(reg);
-        if (reg) {
-          for (auto& rec : *reg) {
-            mark(rec.actor);
-            for (auto& [da, ds] : all_deps_of(st, rec.actor, rec.seq))
-              mark(da);
+      if (!b.host_full) {
+        auto [slot, inserted] =
+            doc_gids[f.doc].insert(DocState::rkey(op.obj, op.key));
+        if (inserted) {
+          *slot = static_cast<u32>(gid_order.size());
+          gid_order.push_back(K3{f.doc, op.obj, op.key});
+          const Register* reg =
+              st.registers.find(DocState::rkey(op.obj, op.key));
+          gid_regs.push_back(reg);
+          if (reg) {
+            for (auto& rec : *reg) {
+              mark(rec.actor);
+              for (auto& [da, ds] : all_deps_of(st, rec.actor, rec.seq))
+                mark(da);
+            }
           }
         }
       }
@@ -2546,6 +2730,58 @@ static void emit(Pool& pool, Batch& b) {
   std::vector<size_t> diff_counts(b.bdoc_ids.size(), 0);
   Register reg;  // reused across ops (capacity persists)
 
+  // Direct emission: when every doc's ops form ONE contiguous run (the
+  // universal catch-up shape -- payloads arrive {doc: [changes...]} and
+  // the in-order fast path admits doc by doc), diffs stream straight
+  // into the final result buffer: envelope at run start, diff count
+  // backpatched into a fixed-width array32 header at run end.  The
+  // buffered path pays the whole patch twice in memcpy (per-doc buffer
+  // growth + assembly splice) -- ~90 MB/batch on table workloads.
+  // Local changes stay buffered: their envelope reads undo/redo state
+  // committed AFTER the op loop.
+  std::vector<u8> doc_seen(b.bdoc_ids.size(), 0);
+  bool direct = !b.local_kind;
+  {
+    u32 prev = ~0u;
+    for (auto& f : b.ops) {
+      if (f.doc == prev) continue;
+      if (doc_seen[f.doc]) { direct = false; break; }
+      doc_seen[f.doc] = 1;
+      prev = f.doc;
+    }
+  }
+  Writer out;
+  u32 cur_doc = ~0u;
+  size_t cnt_off = 0;
+  if (direct) {
+    out.buf.reserve(b.ops.size() * 64 + b.bdoc_ids.size() * 96);
+    out.map(b.bdoc_ids.size());
+  }
+  // the ONE patch-envelope writer (both emission modes and the zero-op
+  // loop use it): clock/deps/canUndo/canRedo then the 'diffs' label
+  auto write_envelope = [&](Writer& w_, u32 d) {
+    DocState& st = *b.bdocs[d];
+    w_.str(b.bdoc_ids[d]);
+    w_.map(b.local_kind ? 7 : 5);
+    w_.raw(L_CLOCK); write_clock(w_, pool, st.clock);
+    w_.raw(L_DEPS); write_clock(w_, pool, st.deps);
+    w_.raw(L_CANUNDO); w_.boolean(st.undo_pos > 0);
+    w_.raw(L_CANREDO); w_.boolean(!st.redo_stack.empty());
+    w_.raw(L_DIFFS);
+  };
+  auto open_run = [&](u32 d) {
+    write_envelope(out, d);
+    cnt_off = out.buf.size();
+    out.buf.push_back(0xdd);            // array32, count patched at close
+    out.buf.insert(out.buf.end(), 4, 0);
+  };
+  auto close_run = [&](u32 d) {
+    u32 c = static_cast<u32>(diff_counts[d]);
+    u8* q = out.buf.data() + cnt_off;
+    q[1] = c >> 24; q[2] = (c >> 16) & 0xff;
+    q[3] = (c >> 8) & 0xff; q[4] = c & 0xff;
+  };
+
   // pre-size the hot hash maps / buffers: most assign ops open a fresh
   // register (every Text elemId is its own), and rehash storms during
   // the emit loop dominate otherwise
@@ -2558,7 +2794,7 @@ static void emit(Pool& pool, Batch& b) {
     for (size_t d = 0; d < b.bdoc_ids.size(); ++d) {
       if (assigns[d])
         b.bdocs[d]->registers.reserve(b.bdocs[d]->registers.n + assigns[d]);
-      diff_bufs[d].buf.reserve(per[d] * 48);
+      if (!direct) diff_bufs[d].buf.reserve(per[d] * 48);
     }
   }
 
@@ -2630,7 +2866,12 @@ static void emit(Pool& pool, Batch& b) {
     auto& f = b.ops[op_idx];
     const OpRec& op = *f.op;
     DocState& st = *b.bdocs[f.doc];
-    Writer& w = diff_bufs[f.doc];
+    if (direct && f.doc != cur_doc) {
+      if (cur_doc != ~0u) close_run(cur_doc);
+      open_run(f.doc);
+      cur_doc = f.doc;
+    }
+    Writer& w = direct ? out : diff_bufs[f.doc];
 
     if (op.action >= A_MAKE_MAP) {
       w.map(3);
@@ -2777,17 +3018,21 @@ static void emit(Pool& pool, Batch& b) {
   }
 
   // assemble {doc_id: patch}
-  Writer out;
+  if (direct) {
+    if (cur_doc != ~0u) close_run(cur_doc);
+    // zero-op docs (duplicate-only deliveries, queued-only changes)
+    // still get their envelope
+    for (size_t d = 0; d < b.bdoc_ids.size(); ++d) {
+      if (doc_seen[d]) continue;
+      write_envelope(out, static_cast<u32>(d));
+      out.array(0);
+    }
+    b.result = std::move(out.buf);
+    return;
+  }
   out.map(b.bdoc_ids.size());
   for (size_t d = 0; d < b.bdoc_ids.size(); ++d) {
-    DocState& st = *b.bdocs[d];
-    out.str(b.bdoc_ids[d]);
-    out.map(b.local_kind ? 7 : 5);
-    out.raw(L_CLOCK); write_clock(out, pool, st.clock);
-    out.raw(L_DEPS); write_clock(out, pool, st.deps);
-    out.raw(L_CANUNDO); out.boolean(st.undo_pos > 0);
-    out.raw(L_CANREDO); out.boolean(!st.redo_stack.empty());
-    out.raw(L_DIFFS);
+    write_envelope(out, static_cast<u32>(d));
     out.array(diff_counts[d]);
     out.raw(diff_bufs[d].buf);
     if (b.local_kind) {
@@ -3025,13 +3270,15 @@ void* amtpu_begin(void* pool_ptr, const uint8_t* data, int64_t len) {
     b.host_full = pool.host_full;
     std::vector<std::vector<ChangeRec>> incoming;
     incoming.reserve(n_docs);
+    DecodeCache dc;   // batch-shared: views point into the batch slab
     for (size_t i = 0; i < n_docs; ++i) {
       std::string doc_id = r.read_str();
       size_t n_changes = r.read_array();
       std::vector<ChangeRec> chs;
-      chs.reserve(n_changes);
+      chs.reserve(std::min(n_changes,
+                           static_cast<size_t>(r.end() - r.pos()) / 8));
       for (size_t j = 0; j < n_changes; ++j)
-        chs.push_back(decode_change(r, pool, slab));
+        chs.push_back(decode_change(r, pool, slab, nullptr, &dc));
       b.bdocs.push_back(&pool.doc(doc_id));
       b.bdoc_ids.push_back(std::move(doc_id));
       incoming.push_back(std::move(chs));
