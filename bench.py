@@ -449,7 +449,7 @@ def run_batch_config(build, rng, both_modes=True):
     return result
 
 
-def run_config_5(rng):
+def run_config_5(rng, both_modes=True):
     """64 replicas, ~100k-op backlog, full causal catch-up.  The measured
     rate counts op-APPLICATIONS (every replica ingests every foreign op --
     the work a full catch-up performs, identical to what the reference's
@@ -576,7 +576,7 @@ def run_config_5(rng):
               'baseline': BASELINE_NAME, 'mode': mode,
               'fallbacks': fallbacks}
 
-    if mode in ('host_full', 'kernel'):
+    if both_modes and mode in ('host_full', 'kernel'):
         alt = 'kernel' if mode == 'host_full' else 'host_full'
         with _alt_mode_env(alt):
             arate, ars, afb = measure_catchup(alt)
@@ -754,7 +754,7 @@ def main(argv=None):
     rng = random.Random(SEED)
     both = args.mode == 'auto'
     if args.config == 5:
-        result = run_config_5(rng)
+        result = run_config_5(rng, both_modes=both)
     elif args.config == 1 and env_int('AMTPU_BENCH_C1_MESH', 0):
         result = run_config_1_mesh(rng)
     else:

@@ -554,14 +554,39 @@ static const char* type_name(u8 t) {
 // one-entry intern caches for strings that repeat across consecutive ops
 // (object ids within a change, single-char text values): a short memcmp
 // beats a hash+probe
+// Two-way (current + previous, promote-on-hit) string caches for the
+// hot decode fields.  Two entries, not one: table workloads alternate
+// row-object ops with links into the table (obj: row,row,table,row2...)
+// and row fields cycle two key names -- both patterns thrash a
+// single-entry cache on every op.
 struct DecodeCache {
-  std::string_view obj_sv, val_sv, key_sv;
-  u32 obj_sid = NONE;
+  std::string_view obj_sv, obj_sv2, val_sv, key_sv, key_sv2;
+  u32 obj_sid = NONE, obj_sid2 = NONE;
   u32 val_sid = NONE, val_rid = NONE;
-  // last-key cache: text streams alternate {ins key=prev-elemId} /
-  // {set key=new-elemId}, so every elemId decodes as a key TWICE in a
-  // row (set, then the next op's ins) -- one intern hash instead of two
-  u32 key_sid = NONE;
+  // key cache: text streams intern every elemId as a key TWICE in a row
+  // (set, then the next op's ins) -- one intern hash instead of two
+  u32 key_sid = NONE, key_sid2 = NONE;
+
+  // shared two-way promote-on-hit scheme for both field caches
+  static inline u32 lookup(Interner& in, std::string_view s,
+                           std::string_view& sv, std::string_view& sv2,
+                           u32& sid, u32& sid2) {
+    if (sid == NONE || s != sv) {
+      std::swap(sv, sv2);
+      std::swap(sid, sid2);
+      if (sid == NONE || s != sv) {
+        sid = in.id_of(s);
+        sv = s;
+      }
+    }
+    return sid;
+  }
+  inline u32 obj_of(Interner& in, std::string_view s) {
+    return lookup(in, s, obj_sv, obj_sv2, obj_sid, obj_sid2);
+  }
+  inline u32 key_of(Interner& in, std::string_view s) {
+    return lookup(in, s, key_sv, key_sv2, key_sid, key_sid2);
+  }
 };
 
 // Fixed-layout decode fast path.  The frontend's op builders (reference
@@ -633,11 +658,7 @@ static bool decode_op_fast(Reader& r, Pool& pool, u32 actor, u32 seq,
   op.actor = actor; op.seq = seq;
   op.datatype = NONE; op.value_rid = NONE; op.value_sid = NONE;
   op.key = NONE;
-  if (dc.obj_sid == NONE || osv != dc.obj_sv) {
-    dc.obj_sid = pool.intern.id_of(osv);
-    dc.obj_sv = osv;
-  }
-  op.obj = dc.obj_sid;
+  op.obj = dc.obj_of(pool.intern, osv);
 
   if (action >= A_MAKE_MAP) {          // {action, obj}
     if (nkeys != 2) return false;
@@ -649,11 +670,7 @@ static bool decode_op_fast(Reader& r, Pool& pool, u32 actor, u32 seq,
   p += 4;
   std::string_view ksv;
   if (!read_short_str(ksv)) return false;
-  if (dc.key_sid == NONE || ksv != dc.key_sv) {
-    dc.key_sid = pool.intern.id_of(ksv);
-    dc.key_sv = ksv;
-  }
-  op.key = dc.key_sid;
+  op.key = dc.key_of(pool.intern, ksv);
 
   if (action == A_DEL) {               // {action, obj, key}
     if (nkeys != 3) return false;
@@ -701,7 +718,10 @@ static bool decode_op_fast(Reader& r, Pool& pool, u32 actor, u32 seq,
       op.value_rid = pool.char_rid[c];
     } else {
       if (dc.val_sid == NONE || raw != dc.val_sv) {
-        dc.val_sid = pool.intern.id_of(s);
+        // link values repeat the key (a row add links the row object
+        // under its own id): reuse the key's intern
+        dc.val_sid = (s == ksv && op.key != NONE)
+                         ? op.key : pool.intern.id_of(s);
         dc.val_rid = pool.vals.id_of(raw);
         dc.val_sv = raw;
       }
@@ -755,19 +775,9 @@ static OpRec decode_op(Reader& r, Pool& pool, u32 actor, u32 seq,
     if (k0 == 'a' && k == "action") {
       op.action = parse_action_sv(r.read_str_view());
     } else if (k0 == 'o' && k == "obj") {
-      std::string_view s = r.read_str_view();
-      if (dc.obj_sid == NONE || s != dc.obj_sv) {
-        dc.obj_sid = pool.intern.id_of(s);
-        dc.obj_sv = s;
-      }
-      op.obj = dc.obj_sid;
+      op.obj = dc.obj_of(pool.intern, r.read_str_view());
     } else if (k0 == 'k' && k == "key") {
-      std::string_view s = r.read_str_view();
-      if (dc.key_sid == NONE || s != dc.key_sv) {
-        dc.key_sid = pool.intern.id_of(s);
-        dc.key_sv = s;
-      }
-      op.key = dc.key_sid;
+      op.key = dc.key_of(pool.intern, r.read_str_view());
     } else if (k0 == 'e' && k == "elem") {
       op.elem = r.read_int();
     } else if (k0 == 'd' && k == "datatype") {
@@ -867,6 +877,9 @@ static ChangeRec decode_change(Reader& r, Pool& pool,
         // canonical envelope order ({actor, seq, deps, ops, ...}): ops
         // decode inline in one walk
         ops_inline = true;
+        // duplicate 'ops' keys follow last-wins like every other
+        // envelope field (and the reference's JS object semantics)
+        ch.ops.clear();
         ops_count = r.read_array();
         // payload-controlled count: clamp the reserve by what the
         // buffer could possibly hold (>=4 bytes/op) so a corrupt
@@ -2330,7 +2343,9 @@ static const Register* update_register_mirror(
         if (inbound[i].actor == o.actor && inbound[i].seq == o.seq &&
             inbound[i].key == o.key && inbound[i].obj == o.obj) {
           inbound.erase(inbound.begin() + i);
-          st.path_epoch++;
+          // paths read only inbound[0] (get_path), so cached renderings
+          // go stale ONLY when position 0 changes
+          if (i == 0) st.path_epoch++;
           --i;
         }
       }
@@ -2344,8 +2359,11 @@ static const Register* update_register_mirror(
       for (auto& r : tit->second.inbound)
         if (r == ref) { present = true; break; }
       if (!present) {
+        // no epoch bump: a push onto a NON-empty inbound never changes
+        // inbound[0]; a 0->1 push only un-nulls paths through a
+        // previously-unreachable object, and render_path never caches
+        // unreachable results -- so no cached rendering can go stale
         tit->second.inbound.push_back(ref);
-        st.path_epoch++;
       }
     }
   }
@@ -2801,39 +2819,45 @@ static void emit(Pool& pool, Batch& b) {
   // inline path cache: consecutive ops overwhelmingly target the same
   // object, and pure-map paths (no list indexes) are stable while the
   // doc's inbound-link index (path_epoch) holds still; list-index paths
-  // shift with visibility mutations and are never cached
-  struct {
+  // shift with visibility mutations and are never cached.  TWO entries
+  // (current + previous, promote-on-hit): table workloads alternate
+  // row-object ops with links into the table, which thrashes a
+  // single-entry cache every row
+  struct PathEntry {
     u32 doc = ~0u, obj = NONE;
     u64 epoch = 0;
     std::vector<u8> bytes;
-  } pc;
-  // encoded-object-id cache: consecutive ops target the same object, so
-  // the fixstr header + id bytes render once per run
-  struct {
+  };
+  PathEntry pc, pc2;
+  // encoded-object-id cache (same two-way scheme)
+  struct ObjEntry {
     u32 obj = NONE;
     std::string bytes;
-  } oc;
-  struct {
+  };
+  ObjEntry oc, oc2;
+  struct TypeEntry {
     u32 doc = ~0u, obj = NONE;
     u8 type = 0;
     Arena* arena = nullptr;
     ObjMeta* meta = nullptr;
-  } tc;
+  };
+  TypeEntry tc, tc2;
   auto render_obj = [&](u32 obj) -> const std::string& {
-    if (oc.obj != obj) {
-      const std::string& s = pool.intern.str(obj);
-      oc.bytes.clear();
-      if (s.size() < 32) {
-        oc.bytes.push_back(static_cast<char>(0xa0 | s.size()));
-        oc.bytes.append(s);
-      } else {
-        // rare long ids take the generic writer (str8/16/32 headers)
-        Writer tmp;
-        tmp.str(s);
-        oc.bytes.assign(tmp.buf.begin(), tmp.buf.end());
-      }
-      oc.obj = obj;
+    if (oc.obj == obj) return oc.bytes;
+    std::swap(oc, oc2);
+    if (oc.obj == obj) return oc.bytes;
+    const std::string& s = pool.intern.str(obj);
+    oc.bytes.clear();
+    if (s.size() < 32) {
+      oc.bytes.push_back(static_cast<char>(0xa0 | s.size()));
+      oc.bytes.append(s);
+    } else {
+      // rare long ids take the generic writer (str8/16/32 headers)
+      Writer tmp;
+      tmp.str(s);
+      oc.bytes.assign(tmp.buf.begin(), tmp.buf.end());
     }
+    oc.obj = obj;
     return oc.bytes;
   };
 
@@ -2846,10 +2870,18 @@ static void emit(Pool& pool, Batch& b) {
                          u32 obj) -> const std::vector<u8>& {
     if (pc.doc == doc && pc.obj == obj && pc.epoch == st.path_epoch)
       return pc.bytes;
+    std::swap(pc, pc2);
+    if (pc.doc == doc && pc.obj == obj && pc.epoch == st.path_epoch)
+      return pc.bytes;
     bool ok = get_path(pool, st, obj, path_scratch);
     Writer pw;
     write_path(pw, pool, ok, path_scratch);
-    bool cacheable = true;
+    // cacheable = reachable pure-map paths only.  Unreachable (null)
+    // renderings must NOT cache: a later link can un-null them without
+    // any epoch bump (see update_register_mirror) -- and they cost two
+    // lookups to recompute anyway.  List-index paths shift with
+    // visibility mutations and are never cached either.
+    bool cacheable = ok;
     if (ok)
       for (auto& p : path_scratch)
         if (p.is_index) { cacheable = false; break; }
@@ -2926,6 +2958,7 @@ static void emit(Pool& pool, Batch& b) {
     u8 obj_type;
     Arena* arp = nullptr;
     ObjMeta* om = nullptr;
+    if (f.doc != tc.doc || op.obj != tc.obj) std::swap(tc, tc2);
     if (f.doc == tc.doc && op.obj == tc.obj) {
       obj_type = tc.type;
       arp = tc.arena;
