@@ -49,7 +49,13 @@ def test_graft_entry_single_chip():
     assert 'indexes' in out and 'frontier' in out
 
 
-def test_graft_dryrun_multichip():
+def test_graft_dryrun_multichip(monkeypatch):
+    # the driver runs the dryrun at full scale (2048-doc scaling table);
+    # in the suite the same code paths run with a reduced doc count --
+    # the 16640-element resident arena stays full-size because the
+    # unconditional sharded-dispatch assert needs it past the latched
+    # AMTPU_RESIDENT_MIN default
+    monkeypatch.setenv('AMTPU_DRYRUN_DOCS', '128')
     import __graft_entry__ as g
     g.dryrun_multichip(8)
 
