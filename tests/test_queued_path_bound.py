@@ -1,0 +1,118 @@
+"""Queued-path bound: parity AND a wall-time ceiling at scale.
+
+The causal queue (the `applyQueuedOps` analogue,
+/root/reference/backend/op_set.js:279-295) carries 0% of every benchmark
+config -- real change streams arrive in order (docs/PERF.md wavefront
+table) -- so without this test a quadratic regression in the fixpoint
+would be invisible to every perf artifact.  Here ~10k fully shuffled
+changes across ~100 docs must (a) produce byte-identical patches to the
+oracle fed the SAME shuffled stream, and (b) resolve inside a wall
+ceiling in BOTH execution modes, turning docs/PERF.md's "~1ms per 200
+shuffled changes" claim into a tested bound.
+
+The ceiling is generous (the fixpoint itself resolves this workload in
+well under a second; the bound mostly guards against quadratic blowup)
+because the host jitters +-40% between windows and CI machines vary.
+"""
+
+import os
+import random
+import time
+
+import pytest
+
+from automerge_tpu import backend as Backend
+from automerge_tpu.native import NativeDocPool
+from automerge_tpu.utils.common import ROOT_ID
+
+N_DOCS = int(os.environ.get('AMTPU_QBOUND_DOCS', '100'))
+CHANGES_PER_DOC = int(os.environ.get('AMTPU_QBOUND_CHANGES', '100'))
+OPS_PER_CHANGE = 4
+# wall ceiling for applying the whole shuffled batch (~10k changes /
+# ~40k ops).  The measured time is ~0.5-1s on the 1-core CI host; a
+# quadratic queue regression lands >60s.
+CEILING_S = float(os.environ.get('AMTPU_QBOUND_CEILING_S', '15'))
+
+
+def build_shuffled_batch(rng):
+    """{doc: [changes]} -- per doc, two actors' causal chains (each
+    change depends on the doc's full frontier) delivered fully shuffled,
+    so nothing is admissible in arrival order beyond chance."""
+    batch = {}
+    for d in range(N_DOCS):
+        tid = 'list-%d' % d
+        changes = [{'actor': 'a0', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'makeList', 'obj': tid},
+            {'action': 'link', 'obj': ROOT_ID, 'key': 'l', 'value': tid}]}]
+        seqs = {'a0': 1, 'a1': 0}
+        elem = 0
+        prev = '_head'
+        for i in range(CHANGES_PER_DOC - 1):
+            actor = 'a%d' % (i % 2)
+            ops = []
+            for _ in range(OPS_PER_CHANGE // 2):
+                elem += 1
+                key = '%s:%d' % (actor, elem)
+                ops.append({'action': 'ins', 'obj': tid, 'key': prev,
+                            'elem': elem})
+                ops.append({'action': 'set', 'obj': tid, 'key': key,
+                            'value': elem % 9})
+                prev = key
+            seqs[actor] += 1
+            deps = {a: s for a, s in seqs.items() if a != actor and s}
+            changes.append({'actor': actor, 'seq': seqs[actor],
+                            'deps': deps, 'ops': ops})
+        shuffled = changes[:]
+        rng.shuffle(shuffled)
+        batch[d] = shuffled
+    return batch
+
+
+@pytest.mark.parametrize('mode', ['host_full', 'kernel'])
+def test_shuffled_bulk_parity_and_bound(mode):
+    rng = random.Random(1234)
+    batch = build_shuffled_batch(rng)
+    n_changes = sum(len(c) for c in batch.values())
+    assert n_changes == N_DOCS * CHANGES_PER_DOC
+
+    prior = os.environ.get('AMTPU_HOST_FULL')
+    os.environ['AMTPU_HOST_FULL'] = '1' if mode == 'host_full' else '0'
+    try:
+        pool = NativeDocPool()
+        # warmup on a throwaway doc with an ORDERED, admissible change
+        # stream (a shuffled prefix would just buffer without emitting,
+        # compiling nothing) so kernel-mode jit compiles stay outside
+        # the measured window
+        warm = [{'actor': 'a0', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'makeList', 'obj': 'w'},
+            {'action': 'link', 'obj': ROOT_ID, 'key': 'l', 'value': 'w'},
+            {'action': 'ins', 'obj': 'w', 'key': '_head', 'elem': 1},
+            {'action': 'set', 'obj': 'w', 'key': 'a0:1', 'value': 1}]}]
+        pool.apply_changes('warm', warm)
+        t0 = time.perf_counter()
+        pool.apply_batch(batch)
+        wall = time.perf_counter() - t0
+    finally:
+        if prior is None:
+            os.environ.pop('AMTPU_HOST_FULL', None)
+        else:
+            os.environ['AMTPU_HOST_FULL'] = prior
+
+    assert wall < CEILING_S, (
+        '%s: %d fully shuffled changes took %.2fs (ceiling %.0fs) -- '
+        'the causal-queue fixpoint has regressed'
+        % (mode, n_changes, wall, CEILING_S))
+
+    # everything admitted: nothing left buffered
+    for d in (0, N_DOCS // 2, N_DOCS - 1):
+        assert pool.get_missing_deps(d) == {}
+
+    # byte parity vs the oracle fed the SAME shuffled stream (sampled:
+    # the scalar oracle replays ~100 docs of this in ~10s otherwise)
+    for d in range(0, N_DOCS, 10):
+        st = Backend.init()
+        st, _ = Backend.apply_changes(st, batch[d])
+        assert pool.get_patch(d) == Backend.get_patch(st), \
+            '%s: doc %d diverged from oracle under shuffled delivery' \
+            % (mode, d)
+    print('%s: %d shuffled changes in %.3fs' % (mode, n_changes, wall))
