@@ -144,24 +144,28 @@ struct FlatMap {
   }
 };
 
-// FlatMap variant for LARGE V: the table stores (key, dense index) and
-// values live in a dense append-only vector.  FlatMap<V>::rehash
-// default-constructs + zeroes a capacity-sized V array and moves every
-// element on growth; with V=Register (~100 B) that zero/move traffic
-// profiled as the largest single memory cost of table-heavy batches
-// (fresh pools rebuild every doc's register map per run).  Here rehash
-// touches 12 B/slot regardless of V.  No erase: register mirrors are
-// never removed from a doc (rollback journals never reach them -- they
-// are updated post-commit in emit).
-template <typename V>
-struct FlatMapDense {
+// One open-addressing probing core (key -> dense index), two value
+// storage policies.  The hash table stores (key, slot) pairs only, so
+// rehash touches 12 B/slot regardless of sizeof(V) -- FlatMap<V>'s
+// rehash default-constructed + zeroed a capacity-sized V array and
+// moved every element on growth, which profiled as the largest single
+// memory-traffic source in table-heavy batches (V=Register, ~100 B).
+//
+//   FlatMapDense  -- vector storage: value pointers move when vals
+//                    grows (same aliasing caution as FlatMap's rehash;
+//                    see emit()'s INVARIANT).  No erase.
+//   FlatMapStable -- deque storage: value pointers NEVER move, so
+//                    cached ObjMeta*/Arena* stashes survive insertion.
+//                    Adds backward-shift erase for the rollback path.
+template <typename V, typename Store>
+struct FlatMapIdx {
   std::vector<u64> keys;
   std::vector<u32> slot;
-  std::vector<V> vals;
+  Store vals;
   size_t mask = 0, n = 0;
   static constexpr u64 EMPTY = ~0ull;
 
-  FlatMapDense() { rehash(16); }
+  FlatMapIdx() { rehash(16); }
   void rehash(size_t cap) {
     std::vector<u64> ok = std::move(keys);
     std::vector<u32> os = std::move(slot);
@@ -176,12 +180,6 @@ struct FlatMapDense {
       slot[j] = os[i];
     }
   }
-  void reserve(size_t want) {
-    size_t cap = mask + 1;
-    while (want * 4 >= cap * 3) cap *= 2;
-    if (cap != mask + 1) rehash(cap);
-    vals.reserve(want);
-  }
   V* find(u64 k) {
     size_t i = flatmap_mix(k) & mask;
     while (true) {
@@ -191,10 +189,9 @@ struct FlatMapDense {
     }
   }
   const V* find(u64 k) const {
-    return const_cast<FlatMapDense*>(this)->find(k);
+    return const_cast<FlatMapIdx*>(this)->find(k);
   }
-  // returns (slot, inserted); value pointers move when vals grows --
-  // same aliasing caution as FlatMap's rehash, see emit()'s INVARIANT
+  // returns (slot, inserted)
   std::pair<V*, bool> insert(u64 k) {
     if ((n + 1) * 4 >= (mask + 1) * 3) rehash((mask + 1) * 2);
     size_t i = flatmap_mix(k) & mask;
@@ -209,6 +206,48 @@ struct FlatMapDense {
       }
       i = (i + 1) & mask;
     }
+  }
+  V& operator[](u64 k) { return *insert(k).first; }
+};
+
+template <typename V>
+struct FlatMapDense : FlatMapIdx<V, std::vector<V>> {
+  void reserve(size_t want) {
+    size_t cap = this->mask + 1;
+    while (want * 4 >= cap * 3) cap *= 2;
+    if (cap != this->mask + 1) this->rehash(cap);
+    this->vals.reserve(want);
+  }
+};
+
+template <typename V>
+struct FlatMapStable : FlatMapIdx<V, std::deque<V>> {
+  // backward-shift key removal; the deque slot is orphaned (reset to
+  // V{}) -- only the rare rollback path erases
+  void erase(u64 k) {
+    auto& keys = this->keys;
+    auto& slot = this->slot;
+    const size_t mask = this->mask;
+    size_t i = flatmap_mix(k) & mask;
+    while (true) {
+      if (keys[i] == this->EMPTY) return;
+      if (keys[i] == k) break;
+      i = (i + 1) & mask;
+    }
+    this->vals[slot[i]] = V{};
+    size_t hole = i;
+    size_t j = (i + 1) & mask;
+    while (keys[j] != this->EMPTY) {
+      size_t home = flatmap_mix(keys[j]) & mask;
+      if (((j - home) & mask) >= ((j - hole) & mask)) {
+        keys[hole] = keys[j];
+        slot[hole] = slot[j];
+        hole = j;
+      }
+      j = (j + 1) & mask;
+    }
+    keys[hole] = this->EMPTY;
+    --this->n;
   }
 };
 
@@ -438,7 +477,7 @@ struct DocState {
   std::unordered_map<u32, std::vector<StateEntry>> states;
   std::vector<u32> state_actor_order;   // actors in first-seen order
   std::vector<ChangeRec> queue;
-  std::unordered_map<u32, ObjMeta> objects;
+  FlatMapStable<ObjMeta> objects;  // object sid -> meta
   FlatMapDense<Register> registers;  // rkey(obj, key) -> live field ops
   std::unordered_map<u32, Arena> arenas;
   // application-order log of (actor, seq): save() replays changes in
@@ -1367,18 +1406,17 @@ static void prepass(Pool& pool, Batch& b, BeginJournal& j) {
     DocState& st = *b.bdocs[ac.doc];
     for (const OpRec& op : ac.stored->ops) {
       if (op.action >= A_MAKE_MAP) {
-        if (st.objects.count(op.obj))
+        if (st.objects.find(op.obj))
           throw Error(0, "Duplicate creation of object " +
                              pool.intern.str(op.obj));
         ObjMeta meta;
         meta.type = make_type(op.action);
-        st.objects.emplace(op.obj, std::move(meta));
+        st.objects[op.obj] = std::move(meta);
         if (is_list_type(make_type(op.action))) st.arenas[op.obj];
         j.created_objs.emplace_back(ac.doc, op.obj);
         b.pre_eidx.push_back(-2);
       } else if (op.action == A_INS) {
-        auto oit = st.objects.find(op.obj);
-        if (oit == st.objects.end())
+        if (!st.objects.find(op.obj))
           throw Error(0, "Modification of unknown object " +
                              pool.intern.str(op.obj));
         // arena columns are i32 (the kernel layout) and ekey packs elem
@@ -1422,8 +1460,8 @@ static void prepass(Pool& pool, Batch& b, BeginJournal& j) {
         if (op.elem > ar.max_elem) ar.max_elem = op.elem;
         b.pre_eidx.push_back(-2);
       } else if (is_assign(op.action)) {
-        auto oit = st.objects.find(op.obj);
-        if (oit == st.objects.end())
+        ObjMeta* oit = st.objects.find(op.obj);
+        if (!oit)
           throw Error(0, "Modification of unknown object " +
                              pool.intern.str(op.obj));
         // list assigns resolve their element HERE, in application order
@@ -1433,7 +1471,7 @@ static void prepass(Pool& pool, Batch& b, BeginJournal& j) {
         // an absent element always resolves to a live register and
         // errors; a del never has surviving concurrent priors and is
         // silently dropped.  The resolved index is cached for dom_layout.
-        if (is_list_type(oit->second.type)) {
+        if (is_list_type(oit->type)) {
           Arena& ar = st.arenas[op.obj];
           const std::string& kstr = pool.intern.str(op.key);
           u32 ea; i64 ec;
@@ -1545,9 +1583,8 @@ static void encode(Pool& pool, Batch& b) {
         }
       }
       if (!have_last || f.doc != last_doc || op.obj != last_obj) {
-        auto oit = st.objects.find(op.obj);
-        last_is_list =
-            oit != st.objects.end() && is_list_type(oit->second.type);
+        ObjMeta* oit = st.objects.find(op.obj);
+        last_is_list = oit != nullptr && is_list_type(oit->type);
         last_doc = f.doc; last_obj = op.obj; have_last = true;
       }
       if (last_is_list) {
@@ -2225,11 +2262,14 @@ static void host_dominance(Batch& b) {
 // both the device register kernel and the mid-phase scratch oracle for
 // batches where most groups are wider than the member window (the
 // kernel's output would be discarded for every overflowed row anyway).
-static void host_resolve_step(Pool& pool, Batch& b, u32 doc, DocState& st,
-                              const OpRec& op, Register& reg) {
+// returns the prior mirror register (or nullptr) so the caller can pass
+// it straight to update_register_mirror -- one FlatMap probe per op,
+// not two
+static Register* host_resolve_step(Pool& pool, Batch& b, u32 doc,
+                                   DocState& st, const OpRec& op,
+                                   Register& reg) {
   reg.clear();
-  const Register* rit =
-      st.registers.find(DocState::rkey(op.obj, op.key));
+  Register* rit = st.registers.find(DocState::rkey(op.obj, op.key));
   const bool add = op.action != A_DEL;
   bool placed = false;
   if (rit && !rit->empty()) {
@@ -2269,6 +2309,7 @@ static void host_resolve_step(Pool& pool, Batch& b, u32 doc, DocState& st,
     }
   }
   if (add && !placed) reg.push_back(op);
+  return rit;
 }
 
 // ---------------------------------------------------------------------------
@@ -2323,9 +2364,10 @@ static void register_from_kernel(Batch& b, i64 row, Register& reg) {
 // removes a ~3.6 KB memcpy per op.
 static const Register* update_register_mirror(
     Pool& pool, DocState& st, const OpRec& op, Register& new_register,
-    ObjMeta* obj_meta, bool is_list) {
+    ObjMeta* obj_meta, bool is_list, bool prior_known = false,
+    Register* known_prior = nullptr) {
   u64 rk = DocState::rkey(op.obj, op.key);
-  Register* rit = st.registers.find(rk);
+  Register* rit = prior_known ? known_prior : st.registers.find(rk);
   if (rit) {
     // drop inbound refs of links no longer in the register
     for (auto& o : *rit) {
@@ -2336,9 +2378,9 @@ static const Register* update_register_mirror(
             n.value_rid == o.value_rid) { still = true; break; }
       if (still) continue;
       if (o.value_sid == NONE) continue;
-      auto tit = st.objects.find(o.value_sid);
-      if (tit == st.objects.end()) continue;
-      auto& inbound = tit->second.inbound;
+      ObjMeta* tit = st.objects.find(o.value_sid);
+      if (!tit) continue;
+      auto& inbound = tit->inbound;
       for (size_t i = 0; i < inbound.size(); ++i) {
         if (inbound[i].actor == o.actor && inbound[i].seq == o.seq &&
             inbound[i].key == o.key && inbound[i].obj == o.obj) {
@@ -2352,18 +2394,18 @@ static const Register* update_register_mirror(
     }
   }
   if (op.action == A_LINK && op.value_sid != NONE) {
-    auto tit = st.objects.find(op.value_sid);
-    if (tit != st.objects.end()) {
+    ObjMeta* tit = st.objects.find(op.value_sid);
+    if (tit) {
       InboundRef ref{op.obj, op.key, op.actor, op.value_sid, op.seq};
       bool present = false;
-      for (auto& r : tit->second.inbound)
+      for (auto& r : tit->inbound)
         if (r == ref) { present = true; break; }
       if (!present) {
         // no epoch bump: a push onto a NON-empty inbound never changes
         // inbound[0]; a 0->1 push only un-nulls paths through a
         // previously-unreachable object, and render_path never caches
         // unreachable results -- so no cached rendering can go stale
-        tit->second.inbound.push_back(ref);
+        tit->inbound.push_back(ref);
       }
     }
   }
@@ -2387,12 +2429,12 @@ static bool get_path(Pool& pool, DocState& st, u32 object_id,
                      std::vector<PathElem>& out) {
   out.clear();
   while (object_id != pool.root_sid) {
-    auto mit = st.objects.find(object_id);
-    if (mit == st.objects.end() || mit->second.inbound.empty()) return false;
-    const InboundRef& ref = mit->second.inbound[0];
+    ObjMeta* mit = st.objects.find(object_id);
+    if (mit == nullptr || mit->inbound.empty()) return false;
+    const InboundRef& ref = mit->inbound[0];
     object_id = ref.obj;
-    auto pit = st.objects.find(object_id);
-    u8 ptype = (pit != st.objects.end()) ? pit->second.type : T_MAP;
+    ObjMeta* pit = st.objects.find(object_id);
+    u8 ptype = pit ? pit->type : T_MAP;
     if (is_list_type(ptype)) {
       auto ait = st.arenas.find(object_id);
       if (ait == st.arenas.end()) return false;
@@ -2916,8 +2958,11 @@ static void emit(Pool& pool, Batch& b) {
     if (op.action == A_INS) continue;
 
     i64 row = b.assign_row_of_op[op_idx];
+    Register* prior = nullptr;
+    bool prior_known = false;
     if (b.host_reg_mode) {
-      host_resolve_step(pool, b, f.doc, st, op, reg);
+      prior = host_resolve_step(pool, b, f.doc, st, op, reg);
+      prior_known = true;
     } else {
       bool from_host = false;
       if (!b.host_registers.empty()) {
@@ -2953,8 +2998,10 @@ static void emit(Pool& pool, Batch& b) {
     // object-type run cache: consecutive ops overwhelmingly target the
     // same object, and an object's type never changes once created.
     // Resolved BEFORE the mirror update so the mirror reuses the cached
-    // ObjMeta instead of re-probing st.objects per op.  (ObjMeta pointers
-    // are stable: st.objects is node-based and emit never erases.)
+    // ObjMeta instead of re-probing st.objects per op.  (ObjMeta
+    // pointers are stable: st.objects stores values in a deque
+    // (FlatMapStable) and emit never erases -- an erase would silently
+    // reset the slot in place, so keep it that way.)
     u8 obj_type;
     Arena* arp = nullptr;
     ObjMeta* om = nullptr;
@@ -2974,7 +3021,8 @@ static void emit(Pool& pool, Batch& b) {
     // slots MOVE on rehash -- nothing between here and the emit_*_diff
     // reads below may insert into st.registers
     const Register& ereg = *update_register_mirror(
-        pool, st, op, reg, om, is_list_type(obj_type));
+        pool, st, op, reg, om, is_list_type(obj_type), prior_known,
+        prior);
     // path rendered AFTER the mirror update (the reference computes it
     // inside updateMapKey/updateListElement, post inbound maintenance)
     // but BEFORE this op's visibility mutation
@@ -3133,8 +3181,8 @@ static void materialize(Pool& pool, DocState& st, u32 object_id, Writer& w,
   if (object_id < seen.size() && seen[object_id]) return;
   if (object_id >= seen.size()) seen.resize(object_id + 1, 0);
   seen[object_id] = 1;
-  auto mit = st.objects.find(object_id);
-  u8 type_ = (mit != st.objects.end()) ? mit->second.type : T_MAP;
+  const ObjMeta* mit = st.objects.find(object_id);
+  u8 type_ = mit ? mit->type : T_MAP;
   Writer own;
   size_t own_count = 0;
 
@@ -3185,8 +3233,8 @@ static void materialize(Pool& pool, DocState& st, u32 object_id, Writer& w,
       own.str("action"); own.str("create");
       own_count++;
     }
-    if (mit != st.objects.end()) {
-      for (u32 key : mit->second.key_order) {
+    if (mit) {
+      for (u32 key : mit->key_order) {
         const Register* rit =
             st.registers.find(DocState::rkey(object_id, key));
         if (!rit || rit->empty()) continue;
