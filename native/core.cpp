@@ -624,6 +624,15 @@ struct DecodeCache {
     return lookup(in, s, obj_sv, obj_sv2, obj_sid, obj_sid2);
   }
   inline u32 key_of(Interner& in, std::string_view s) {
+    // miss fallback: probe the obj entries before hashing -- a link's
+    // key repeats the object id of the row ops just decoded (row add:
+    // makeMap obj=row ... link key=row), which would otherwise evict
+    // the two field-name keys every row
+    if ((key_sid == NONE || s != key_sv) &&
+        (key_sid2 == NONE || s != key_sv2)) {
+      if (obj_sid != NONE && s == obj_sv) return obj_sid;
+      if (obj_sid2 != NONE && s == obj_sv2) return obj_sid2;
+    }
     return lookup(in, s, key_sv, key_sv2, key_sid, key_sid2);
   }
 };
@@ -2948,10 +2957,21 @@ static void emit(Pool& pool, Batch& b) {
     Writer& w = direct ? out : diff_bufs[f.doc];
 
     if (op.action >= A_MAKE_MAP) {
-      w.map(3);
-      w.raw(L_ACTION); w.raw(L_CREATE);
-      w.raw(L_OBJ); w.raw(render_obj(op.obj));
-      w.raw(L_TYPE); w.raw(L_TYPES[make_type(op.action)]);
+      const std::string& ob = render_obj(op.obj);
+      const std::string& ty = L_TYPES[make_type(op.action)];
+      if (64 + ob.size() + ty.size() <= DiffBuf::CAP) {
+        DiffBuf d;
+        d.map_hdr(3);
+        d.lit(L_ACTION); d.lit(L_CREATE);
+        d.lit(L_OBJ); d.lit(ob);
+        d.lit(L_TYPE); d.lit(ty);
+        d.commit(w);
+      } else {
+        w.map(3);
+        w.raw(L_ACTION); w.raw(L_CREATE);
+        w.raw(L_OBJ); w.raw(ob);
+        w.raw(L_TYPE); w.raw(ty);
+      }
       diff_counts[f.doc]++;
       continue;
     }
