@@ -261,6 +261,14 @@ struct Interner {
   size_t mask = 0, n = 0;
 
   Interner() { rehash(1 << 10); }
+  // pre-size for an expected total entry count (amortizes the ~10
+  // doubling rehashes a million-op batch otherwise pays on a fresh
+  // pool); never shrinks
+  void reserve(size_t want) {
+    size_t cap = mask + 1;
+    while (want * 4 >= cap * 3) cap *= 2;
+    if (cap != mask + 1) rehash(cap);
+  }
   static inline u64 hash_sv(std::string_view s) {
     u64 h = 1469598103934665603ull;           // FNV-1a 64
     for (char c : s) {
@@ -3365,6 +3373,21 @@ void* amtpu_begin(void* pool_ptr, const uint8_t* data, int64_t len) {
     // one payload copy into a shared slab; every change's raw bytes are
     // spans into it (the caller's buffer may be freed after this call)
     auto slab = std::make_shared<std::vector<u8>>(data, data + len);
+    // pre-size the intern tables from the payload: text catch-up
+    // payloads intern roughly one string (elemId) per ~45 wire bytes,
+    // so a fresh pool otherwise pays ~10 doubling rehashes inside the
+    // decode loop.  Over-estimate is one-time slack; under-estimate
+    // just means fewer doublings than before.
+    // capped: the byte heuristic over-counts value-heavy payloads (a
+    // few huge values, few distinct strings), and reserve never
+    // shrinks -- 4M entries covers ~180 MB of change payload per call
+    // while bounding a pool's table memory at ~48 MB
+    pool.intern.reserve(pool.intern.n +
+                        std::min<size_t>(static_cast<size_t>(len) / 45,
+                                         size_t(4) << 20));
+    pool.vals.reserve(pool.vals.n +
+                      std::min<size_t>(static_cast<size_t>(len) / 90,
+                                       size_t(2) << 20));
     Reader r(slab->data(), slab->size());
     size_t n_docs = r.read_map();
     Batch& b = h->batch;
