@@ -1,15 +1,21 @@
 """Fast perf-iteration harness for the host pipeline.
 
-Runs the BASELINE config-3 workload shape through ShardedNativePool once
-(after one warmup) and prints the wall time plus the AMTPU_TRACE phase
-split.  Intended for tight optimize-measure loops on the HOST phases
-(cxx.decode/schedule/encode/emit + python layer); run with
-JAX_PLATFORMS=cpu when the TPU link is down -- host-phase timings are
-device-independent.
+Builds ONE BASELINE-config workload (default: config 3, the headline
+shape), then loops fresh-pool `apply_batch_bytes` runs and prints wall
+times + the AMTPU_TRACE phase split.  Intended for tight
+optimize-measure loops on the HOST phases; run with JAX_PLATFORMS=cpu
+when the TPU link is down -- host-phase timings are device-independent.
 
-Usage:  AMTPU_TRACE=1 [JAX_PLATFORMS=cpu] python tools/quickbench.py [n_runs]
-Env:    AMTPU_BENCH_DOCS / _ACTORS / _ROUNDS / _OPS_PER_CHANGE / _SHARDS
+The single-core host jitters +-15% between windows: for honest A/B
+comparisons interleave runs of both binaries (swap the built .so), or
+compare the thread-CPU cxx.* spans (AMTPU_TRACE=1), which are immune
+to wall-clock contention.
+
+Usage:  AMTPU_TRACE=1 [JAX_PLATFORMS=cpu] python tools/quickbench.py \
+            [--config N] [--runs K]
+Env:    the same AMTPU_BENCH_* knobs bench.py reads.
 """
+import argparse
 import os
 import sys
 import time
@@ -24,56 +30,50 @@ pin_cpu()
 import msgpack  # noqa: E402
 
 from automerge_tpu import trace  # noqa: E402
-from automerge_tpu.native import ShardedNativePool  # noqa: E402
-
-
-def env_int(name, default):
-    return int(os.environ.get(name, default))
+from automerge_tpu.native import NativeDocPool, ShardedNativePool  # noqa: E402
 
 
 def main():
-    n_runs = int(sys.argv[1]) if len(sys.argv) > 1 else 3
-    n_docs = env_int('AMTPU_BENCH_DOCS', 4096)
-    n_actors = env_int('AMTPU_BENCH_ACTORS', 8)
-    n_rounds = env_int('AMTPU_BENCH_ROUNDS', 2)
-    opc = env_int('AMTPU_BENCH_OPS_PER_CHANGE', 16)
-    n_shards = env_int('AMTPU_BENCH_SHARDS', 20)
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument('--config', type=int, default=3, choices=[1, 2, 3, 4])
+    ap.add_argument('--runs', type=int, default=5)
+    args = ap.parse_args()
 
     import random
-    rng = random.Random(7)
-    from automerge_tpu.parallel.mesh_encode import text_doc_changes
-    t0 = time.perf_counter()
-    batch = {}
-    for d in range(n_docs):
-        batch['text-%d' % d] = text_doc_changes(
-            'text-%d' % d, n_actors, n_rounds, opc,
-            lambda i, a, has: rng.random() < 0.15 and has)
-    total_ops = sum(len(c['ops']) for chs in batch.values() for c in chs)
-    payload = msgpack.packb(batch, use_bin_type=True)
-    print('workload: %d docs, %d ops, payload %.1f MB (built in %.1fs)'
-          % (n_docs, total_ops, len(payload) / 1e6,
-             time.perf_counter() - t0), file=sys.stderr)
 
-    # warmup (jit compile)
+    import bench
+    rng = random.Random(int(os.environ.get('AMTPU_BENCH_SEED', 7)))
     t0 = time.perf_counter()
-    ShardedNativePool(n_shards).apply_batch_bytes(payload)
+    batch, metric = bench.BUILDERS[args.config](rng)
+    total_ops = sum(len(c['ops']) for chs in batch.values() for c in chs)
+    keyed = {NativeDocPool._doc_key(d): chs for d, chs in batch.items()}
+    payload = msgpack.packb(keyed, use_bin_type=True)
+    print('config %d (%s): %d docs, %d ops, payload %.1f MB (built %.1fs)'
+          % (args.config, metric, len(batch), total_ops,
+             len(payload) / 1e6, time.perf_counter() - t0),
+          file=sys.stderr)
+
+    def make_pool():
+        n = min(ShardedNativePool.default_shards(), len(batch))
+        return ShardedNativePool(n) if n > 1 else NativeDocPool()
+
+    t0 = time.perf_counter()
+    make_pool().apply_batch_bytes(payload)
     print('warmup: %.2fs' % (time.perf_counter() - t0), file=sys.stderr)
 
     times = []
-    for run in range(n_runs):
+    for _ in range(args.runs):
         trace.reset()
-        pool = ShardedNativePool(n_shards)
+        pool = make_pool()
         t0 = time.perf_counter()
         pool.apply_batch_bytes(payload)
-        dt = time.perf_counter() - t0
-        times.append(dt)
-        print('run %d: %.3fs  (%.0f ops/s)' % (run, dt, total_ops / dt),
-              file=sys.stderr)
-        if run == n_runs - 1:
-            # last run: steady state (run 0 carries warmup artifacts)
-            print(trace.report(), file=sys.stderr)
+        times.append(time.perf_counter() - t0)
     med = sorted(times)[len(times) // 2]
-    print('median: %.3fs  %.0f ops/s' % (med, total_ops / med))
+    print('runs: %s -> best %.0f ops/s, median %.0f ops/s'
+          % (['%.3f' % t for t in times], total_ops / min(times),
+             total_ops / med), file=sys.stderr)
+    if trace.ENABLED:
+        print(trace.report(), file=sys.stderr)
 
 
 if __name__ == '__main__':
