@@ -1104,13 +1104,28 @@ class TPUDocPool:
     # ------------------------------------------------------------------
 
     def _materialize(self, state, object_id, diffs, seen):
-        """Child-first whole-object materialization."""
-        if object_id in seen:
+        """Two-phase materialization, mirroring the reference exactly
+        (backend/index.js:5-119): each object's own diff block builds
+        ONCE (memoized), but splicing recurses per link OCCURRENCE --
+        an object referenced by both a winner and a conflict (or two
+        fields) has its block spliced once per reference, like
+        makePatch's children recursion.  (`seen` kept for signature
+        compatibility; unused.)"""
+        blocks = {}     # object_id -> (own_diffs, child occurrences)
+        self._mat_instantiate(state, object_id, blocks)
+        self._mat_splice(object_id, blocks, diffs, [])
+
+    def _mat_instantiate(self, state, object_id, blocks):
+        if object_id in blocks:
             return
-        seen.add(object_id)
+        own = []
+        children = []
+        # inserted before filling: a cyclic link encountered mid-fill
+        # memo-returns (reference backend/index.js:92 sets
+        # this.diffs[objectId] first)
+        blocks[object_id] = (own, children)
         meta = state.objects.get(object_id, {'type': 'map'})
         type_ = meta['type']
-        own = []
 
         if type_ in _LIST_TYPES:
             own.append({'obj': object_id, 'type': type_, 'action': 'create'})
@@ -1123,10 +1138,10 @@ class TPUDocPool:
                     continue
                 diff = {'obj': object_id, 'type': type_, 'action': 'insert',
                         'index': index, 'elemId': key}
-                self._materialize_value(state, register[0], diff, diffs, seen)
+                self._mat_value(state, register[0], diff, blocks, children)
                 if len(register) > 1:
-                    diff['conflicts'] = self._materialize_conflicts(
-                        state, register, diffs, seen)
+                    diff['conflicts'] = self._mat_conflicts(
+                        state, register, blocks, children)
                 own.append(diff)
         else:
             if object_id != ROOT_ID:
@@ -1137,19 +1152,16 @@ class TPUDocPool:
                     continue
                 diff = {'obj': object_id, 'type': type_, 'action': 'set',
                         'key': key}
-                self._materialize_value(state, register[0], diff, diffs, seen)
+                self._mat_value(state, register[0], diff, blocks, children)
                 if len(register) > 1:
-                    diff['conflicts'] = self._materialize_conflicts(
-                        state, register, diffs, seen)
+                    diff['conflicts'] = self._mat_conflicts(
+                        state, register, blocks, children)
                 own.append(diff)
-        diffs.extend(own)
 
-    def _materialize_value(self, state, record, diff, diffs, seen):
+    def _mat_value(self, state, record, diff, blocks, children):
         if record['action'] == 'link':
-            child_diffs = []
-            self._materialize(state, record['value'], child_diffs, seen)
-            # child-first: children go before this object's diffs
-            diffs.extend(child_diffs)
+            children.append(record['value'])
+            self._mat_instantiate(state, record['value'], blocks)
             diff['value'] = record['value']
             diff['link'] = True
         else:
@@ -1157,10 +1169,23 @@ class TPUDocPool:
             if record.get('datatype'):
                 diff['datatype'] = record['datatype']
 
-    def _materialize_conflicts(self, state, register, diffs, seen):
+    def _mat_conflicts(self, state, register, blocks, children):
         conflicts = []
         for record in register[1:]:
             c = {'actor': record['actor']}
-            self._materialize_value(state, record, c, diffs, seen)
+            self._mat_value(state, record, c, blocks, children)
             conflicts.append(c)
         return conflicts
+
+    def _mat_splice(self, object_id, blocks, diffs, on_stack):
+        # the reference's makePatch has no cycle guard (it recurses
+        # forever on link cycles), so skipping re-entrant occurrences
+        # diverges only on inputs the reference cannot process
+        if object_id in on_stack:
+            return
+        own, children = blocks[object_id]
+        on_stack.append(object_id)
+        for child in children:
+            self._mat_splice(child, blocks, diffs, on_stack)
+        on_stack.pop()
+        diffs.extend(own)

@@ -3156,37 +3156,34 @@ static void emit(Pool& pool, Batch& b) {
 // whole-doc materialization (getPatch parity)
 // ---------------------------------------------------------------------------
 
-static void materialize(Pool& pool, DocState& st, u32 object_id, Writer& w,
-                        size_t& count, std::vector<u8>& seen);
+// Two-phase materialization, mirroring the reference exactly
+// (backend/index.js:5-119): instantiation is MEMOIZED per object (each
+// object's own diff block builds once), but splicing recurses per link
+// OCCURRENCE -- an object referenced by both a winner and a conflict
+// (or by two fields) has its block spliced once per reference, exactly
+// like makePatch's children recursion.  The scalar oracle reproduces
+// this; a seen-set dedup at the splice level diverged from both.
+struct MatBlock {
+  Writer own;
+  size_t count = 0;
+  std::vector<u32> children;   // link occurrences, reference push order
+};
+struct MatCtx {
+  // node-based map: MatBlock references stay valid across inserts
+  std::unordered_map<u32, MatBlock> blocks;
+};
 
-static void materialize_value(Pool& pool, DocState& st, const OpRec& rec,
-                              Writer& w, size_t& count, std::vector<u8>& seen,
-                              Writer& own, size_t& extra_keys);
+static void mat_instantiate(Pool& pool, DocState& st, u32 object_id,
+                            MatCtx& ctx);
 
-static void materialize_conflicts(Pool& pool, DocState& st,
-                                  const Register& reg, Writer& diffs,
-                                  size_t& count, std::vector<u8>& seen,
-                                  Writer& out) {
-  out.array(reg.size() - 1);
-  for (size_t i = 1; i < reg.size(); ++i) {
-    const OpRec& rec = reg[i];
-    Writer val;
-    size_t extra = 0;
-    materialize_value(pool, st, rec, diffs, count, seen, val, extra);
-    out.map(1 + 1 + extra);
-    out.str("actor"); out.str(pool.intern.str(rec.actor));
-    out.raw(val.buf);
-  }
-}
-
-// writes "value": ... (+ optional link/datatype) into `own`; recursing into
-// children first (their diffs land in `diffs` before the caller's diff)
-static void materialize_value(Pool& pool, DocState& st, const OpRec& rec,
-                              Writer& diffs, size_t& count,
-                              std::vector<u8>& seen, Writer& own,
-                              size_t& extra_keys) {
+// writes "value": ... (+ optional link/datatype) into `own`; link
+// targets are recorded as child occurrences and instantiated (memoized)
+static void mat_value(Pool& pool, DocState& st, const OpRec& rec,
+                      MatCtx& ctx, MatBlock& blk, Writer& own,
+                      size_t& extra_keys) {
   if (rec.action == A_LINK && rec.value_sid != NONE) {
-    materialize(pool, st, rec.value_sid, diffs, count, seen);
+    blk.children.push_back(rec.value_sid);
+    mat_instantiate(pool, st, rec.value_sid, ctx);
     own.str("value");
     own.raw(val_bytes(pool, rec));
     own.str("link"); own.boolean(true);
@@ -3204,22 +3201,37 @@ static void materialize_value(Pool& pool, DocState& st, const OpRec& rec,
   }
 }
 
-static void materialize(Pool& pool, DocState& st, u32 object_id, Writer& w,
-                        size_t& count, std::vector<u8>& seen) {
-  if (object_id < seen.size() && seen[object_id]) return;
-  if (object_id >= seen.size()) seen.resize(object_id + 1, 0);
-  seen[object_id] = 1;
+static void mat_conflicts(Pool& pool, DocState& st, const Register& reg,
+                          MatCtx& ctx, MatBlock& blk, Writer& out) {
+  out.array(reg.size() - 1);
+  for (size_t i = 1; i < reg.size(); ++i) {
+    const OpRec& rec = reg[i];
+    Writer val;
+    size_t extra = 0;
+    mat_value(pool, st, rec, ctx, blk, val, extra);
+    out.map(1 + 1 + extra);
+    out.str("actor"); out.str(pool.intern.str(rec.actor));
+    out.raw(val.buf);
+  }
+}
+
+static void mat_instantiate(Pool& pool, DocState& st, u32 object_id,
+                            MatCtx& ctx) {
+  if (ctx.blocks.count(object_id)) return;
+  // insert BEFORE filling: a cyclic link encountered mid-fill
+  // memo-returns, same as the reference setting this.diffs[objectId]
+  // first (backend/index.js:92)
+  MatBlock& blk = ctx.blocks[object_id];
+  Writer& own = blk.own;
   const ObjMeta* mit = st.objects.find(object_id);
   u8 type_ = mit ? mit->type : T_MAP;
-  Writer own;
-  size_t own_count = 0;
 
   if (is_list_type(type_)) {
     own.map(3);
     own.str("obj"); own.str(pool.intern.str(object_id));
     own.str("type"); own.str(type_name(type_));
     own.str("action"); own.str("create");
-    own_count++;
+    blk.count++;
     auto ait = st.arenas.find(object_id);
     if (ait != st.arenas.end()) {
       Arena& ar = ait->second;
@@ -3235,11 +3247,11 @@ static void materialize(Pool& pool, DocState& st, u32 object_id, Writer& w,
         const Register& reg = *rit;
         Writer val;
         size_t extra = 0;
-        materialize_value(pool, st, reg[0], w, count, seen, val, extra);
+        mat_value(pool, st, reg[0], ctx, blk, val, extra);
         Writer conf;
         size_t nconf = 0;
         if (reg.size() > 1) {
-          materialize_conflicts(pool, st, reg, w, count, seen, conf);
+          mat_conflicts(pool, st, reg, ctx, blk, conf);
           nconf = 1;
         }
         own.map(5 + 1 + extra + nconf);
@@ -3250,7 +3262,7 @@ static void materialize(Pool& pool, DocState& st, u32 object_id, Writer& w,
         own.str("elemId"); own.str(elem_id);
         own.raw(val.buf);
         if (nconf) { own.str("conflicts"); own.raw(conf.buf); }
-        own_count++;
+        blk.count++;
       }
     }
   } else {
@@ -3259,7 +3271,7 @@ static void materialize(Pool& pool, DocState& st, u32 object_id, Writer& w,
       own.str("obj"); own.str(pool.intern.str(object_id));
       own.str("type"); own.str(type_name(type_));
       own.str("action"); own.str("create");
-      own_count++;
+      blk.count++;
     }
     if (mit) {
       for (u32 key : mit->key_order) {
@@ -3269,11 +3281,11 @@ static void materialize(Pool& pool, DocState& st, u32 object_id, Writer& w,
         const Register& reg = *rit;
         Writer val;
         size_t extra = 0;
-        materialize_value(pool, st, reg[0], w, count, seen, val, extra);
+        mat_value(pool, st, reg[0], ctx, blk, val, extra);
         Writer conf;
         size_t nconf = 0;
         if (reg.size() > 1) {
-          materialize_conflicts(pool, st, reg, w, count, seen, conf);
+          mat_conflicts(pool, st, reg, ctx, blk, conf);
           nconf = 1;
         }
         own.map(4 + 1 + extra + nconf);
@@ -3283,12 +3295,36 @@ static void materialize(Pool& pool, DocState& st, u32 object_id, Writer& w,
         own.str("key"); own.str(pool.intern.str(key));
         own.raw(val.buf);
         if (nconf) { own.str("conflicts"); own.raw(conf.buf); }
-        own_count++;
+        blk.count++;
       }
     }
   }
-  w.raw(own.buf);
-  count += own_count;
+}
+
+// the reference's makePatch recursion (backend/index.js:113-118) has no
+// cycle guard -- a link cycle makes it recurse forever, so any
+// terminating behavior here diverges only on inputs the reference
+// cannot process at all; re-entrant occurrences are skipped
+static void mat_splice(u32 object_id, MatCtx& ctx, Writer& w,
+                       size_t& count, std::vector<u32>& on_stack) {
+  for (u32 a : on_stack)
+    if (a == object_id) return;
+  MatBlock& blk = ctx.blocks[object_id];
+  on_stack.push_back(object_id);
+  for (u32 child : blk.children)
+    mat_splice(child, ctx, w, count, on_stack);
+  on_stack.pop_back();
+  w.raw(blk.own.buf);
+  count += blk.count;
+}
+
+static void materialize(Pool& pool, DocState& st, u32 object_id, Writer& w,
+                        size_t& count, std::vector<u8>& seen) {
+  (void)seen;
+  MatCtx ctx;
+  mat_instantiate(pool, st, object_id, ctx);
+  std::vector<u32> stack;
+  mat_splice(object_id, ctx, w, count, stack);
 }
 
 // ---------------------------------------------------------------------------

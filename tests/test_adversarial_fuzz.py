@@ -307,3 +307,120 @@ class TestSaveLoadMidStream:
         # nothing left buffered anywhere
         for pool in restored:
             assert pool.get_missing_deps(0) == {}
+
+
+class TestTableAdversarial:
+    """Table-shaped cliffs (round 5): the emit hot paths this round
+    rewrote -- path-cache invalidation keyed on inbound[0] erasure,
+    two-way obj/type caches, link inbound maintenance, cross-probe
+    decode -- all face concurrent row lifecycles here.
+
+    Shapes (reference Table semantics, frontend/table.js:26-196):
+      * concurrent add/update/unlink/relink of the SAME rows by many
+        actors, shuffled causal delivery;
+      * rows linked under TWO parents, then the first parent's link
+        removed (inbound[0] erase -> cached paths must re-render);
+      * nested maps inside rows written before AND after the row is
+        linked (null -> real path transitions that are never cached).
+    """
+
+    def test_concurrent_row_lifecycle(self, exec_mode):
+        # every per-actor row object is created up front; afterwards six
+        # actors concurrently update/unlink/relink the same rows with NO
+        # causal ordering (deps={}), delivered fully shuffled -- maximal
+        # concurrency on the table's link registers and inbound lists
+        rng = random.Random(seed_base(60601))
+        n_actors = 6
+        objs = ['row-%d-a%d' % (i, a)
+                for i in range(10) for a in range(n_actors)]
+        setup = {'actor': 'setup', 'seq': 1, 'deps': {}, 'ops':
+                 [{'action': 'makeTable', 'obj': 'tb'},
+                  {'action': 'link', 'obj': ROOT_ID, 'key': 'rows',
+                   'value': 'tb'}] +
+                 [op for o in objs for op in (
+                     {'action': 'makeMap', 'obj': o},
+                     {'action': 'set', 'obj': o, 'key': 'n', 'value': -1},
+                     {'action': 'link', 'obj': 'tb', 'key': o,
+                      'value': o})]}
+        changes = []
+        for a in range(n_actors):
+            actor = 'a%d' % a
+            for seq in range(1, 7):
+                ops = []
+                for o in rng.sample(objs, 5):
+                    kind = rng.random()
+                    if kind < 0.3:
+                        ops.append({'action': 'del', 'obj': 'tb',
+                                    'key': o})
+                    elif kind < 0.6:
+                        ops.append({'action': 'link', 'obj': 'tb',
+                                    'key': o, 'value': o})
+                    else:
+                        ops.append({'action': 'set', 'obj': o, 'key': 'n',
+                                    'value': seq * 100 + a})
+                changes.append({'actor': actor, 'seq': seq, 'deps': {},
+                                'ops': ops})
+        rng.shuffle(changes)
+        deliver_all([{0: [setup]}] + [{0: [ch]} for ch in changes])
+
+    def test_two_parent_row_first_link_removed(self, exec_mode):
+        # row under two tables; removing the FIRST link (inbound[0])
+        # must flip emitted paths to the second parent
+        batches = [
+            {0: [{'actor': 'a', 'seq': 1, 'deps': {}, 'ops': [
+                {'action': 'makeTable', 'obj': 't1'},
+                {'action': 'link', 'obj': ROOT_ID, 'key': 'p1',
+                 'value': 't1'},
+                {'action': 'makeTable', 'obj': 't2'},
+                {'action': 'link', 'obj': ROOT_ID, 'key': 'p2',
+                 'value': 't2'},
+                {'action': 'makeMap', 'obj': 'shared'},
+                {'action': 'link', 'obj': 't1', 'key': 'shared',
+                 'value': 'shared'},
+                {'action': 'link', 'obj': 't2', 'key': 'shared',
+                 'value': 'shared'},
+                {'action': 'set', 'obj': 'shared', 'key': 'v',
+                 'value': 1}]}]},
+            {0: [{'actor': 'a', 'seq': 2, 'deps': {}, 'ops': [
+                {'action': 'del', 'obj': 't1', 'key': 'shared'},
+                {'action': 'set', 'obj': 'shared', 'key': 'v',
+                 'value': 2}]}]},
+            {0: [{'actor': 'a', 'seq': 3, 'deps': {}, 'ops': [
+                {'action': 'del', 'obj': 't2', 'key': 'shared'},
+                {'action': 'set', 'obj': 'shared', 'key': 'v',
+                 'value': 3}]}]},
+        ]
+        deliver_all(batches)
+
+    def test_nested_map_written_around_link(self, exec_mode):
+        rng = random.Random(seed_base(60602))
+        ops = [{'action': 'makeTable', 'obj': 'tb'},
+               {'action': 'link', 'obj': ROOT_ID, 'key': 'rows',
+                'value': 'tb'}]
+        for i in range(12):
+            row, child = 'r%d' % i, 'c%d' % i
+            ops += [{'action': 'makeMap', 'obj': row},
+                    {'action': 'makeMap', 'obj': child},
+                    # child written while BOTH are unreachable
+                    {'action': 'set', 'obj': child, 'key': 'x',
+                     'value': i},
+                    {'action': 'link', 'obj': row, 'key': 'kid',
+                     'value': child},
+                    # child written while row is still unreachable
+                    {'action': 'set', 'obj': child, 'key': 'x',
+                     'value': i * 10},
+                    {'action': 'link', 'obj': 'tb', 'key': row,
+                     'value': row},
+                    # and now fully reachable
+                    {'action': 'set', 'obj': child, 'key': 'x',
+                     'value': i * 100}]
+        # split into changes of 5 ops, delivered in order then the
+        # whole stream redelivered shuffled (dedup no-ops)
+        chs = [{'actor': 'a', 'seq': s + 1, 'deps': {},
+                'ops': ops[s * 5:(s + 1) * 5]}
+               for s in range((len(ops) + 4) // 5)]
+        chs = [c for c in chs if c['ops']]
+        deliver_all([{0: chs}])
+        redeliver = [dict(c) for c in chs]
+        rng.shuffle(redeliver)
+        deliver_all([{0: chs}, {0: redeliver}])

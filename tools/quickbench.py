@@ -38,6 +38,8 @@ def main():
     ap.add_argument('--config', type=int, default=3, choices=[1, 2, 3, 4])
     ap.add_argument('--runs', type=int, default=5)
     args = ap.parse_args()
+    if args.runs < 1:
+        ap.error('--runs must be >= 1')
 
     import random
 
@@ -54,7 +56,9 @@ def main():
           file=sys.stderr)
 
     def make_pool():
-        n = min(ShardedNativePool.default_shards(), len(batch))
+        n = int(os.environ.get('AMTPU_BENCH_SHARDS', 0)) or \
+            ShardedNativePool.default_shards()
+        n = min(n, len(batch))
         return ShardedNativePool(n) if n > 1 else NativeDocPool()
 
     t0 = time.perf_counter()
