@@ -1,6 +1,7 @@
 # Top-level developer entry points.
 
-.PHONY: all native test bench bench-all bench-tpu check clean wheel
+.PHONY: all native test bench bench-all bench-tpu check clean wheel \
+	telemetry-check
 
 all: native
 
@@ -49,6 +50,15 @@ check: native
 	JAX_PLATFORMS=cpu python -c "import __graft_entry__ as g; \
 	  g.dryrun_multichip(8); print('dryrun ok')"
 	@echo "CHECK GREEN"
+
+# Observability gate (docs/OBSERVABILITY.md): idle telemetry must be
+# free.  Interleaved A/B of the disabled path vs a no-op-patched "raw"
+# pipeline on the quickbench workload (target ~2% overhead; the assert
+# tolerance is padded for this single-core host's +-15% jitter), plus
+# an enabled-path sanity pass.  CPU-pinned: host-phase cost is
+# device-independent and a wedged tunnel must not hang the gate.
+telemetry-check: native
+	JAX_PLATFORMS=cpu python tools/telemetry_check.py
 
 wheel: native
 	python -m pip wheel --no-deps -w dist .

@@ -9,6 +9,7 @@ can_undo, can_redo, get_actor_id, set_actor_id, get_conflicts, get_object_id.
 
 from . import backend as Backend
 from . import frontend as Frontend
+from . import telemetry
 from .errors import RangeError
 from .models.table import Table
 from .models.text import Text
@@ -48,7 +49,10 @@ def init(actor_id=None):
 
 def change(doc, message=None, callback=None):
     """(reference: automerge.js:25-28)"""
-    new_doc, _ = Frontend.change(doc, message, callback)
+    # root span: mints the trace id every nested backend/sidecar span
+    # (and cross-process request) inherits
+    with telemetry.span('frontend.change'):
+        new_doc, _ = Frontend.change(doc, message, callback)
     return new_doc
 
 
@@ -86,13 +90,14 @@ def merge(local_doc, remote_doc):
     """(reference: automerge.js:54-64)"""
     if Frontend.get_actor_id(local_doc) == Frontend.get_actor_id(remote_doc):
         raise RangeError('Cannot merge an actor with itself')
-    local_state = Frontend.get_backend_state(local_doc)
-    remote_state = Frontend.get_backend_state(remote_doc)
-    state, patch = Backend.merge(local_state, remote_state)
-    if not patch['diffs']:
-        return local_doc
-    patch['state'] = state
-    return Frontend.apply_patch(local_doc, patch)
+    with telemetry.span('frontend.merge'):
+        local_state = Frontend.get_backend_state(local_doc)
+        remote_state = Frontend.get_backend_state(remote_doc)
+        state, patch = Backend.merge(local_state, remote_state)
+        if not patch['diffs']:
+            return local_doc
+        patch['state'] = state
+        return Frontend.apply_patch(local_doc, patch)
 
 
 def diff(old_doc, new_doc):
@@ -113,10 +118,11 @@ def get_changes(old_doc, new_doc):
 
 def apply_changes(doc, changes):
     """(reference: automerge.js:80-85)"""
-    old_state = Frontend.get_backend_state(doc)
-    new_state, patch = Backend.apply_changes(old_state, changes)
-    patch['state'] = new_state
-    return Frontend.apply_patch(doc, patch)
+    with telemetry.span('frontend.apply_changes', changes=len(changes)):
+        old_state = Frontend.get_backend_state(doc)
+        new_state, patch = Backend.apply_changes(old_state, changes)
+        patch['state'] = new_state
+        return Frontend.apply_patch(doc, patch)
 
 
 def get_missing_deps(doc):

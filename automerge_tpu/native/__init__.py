@@ -19,7 +19,7 @@ import time
 import msgpack
 import numpy as np
 
-from .. import trace
+from .. import telemetry, trace
 from ..utils.common import doc_key
 from ..utils.wire import map_header as _map_header
 from ..utils.wire import read_map_header as _read_map_header
@@ -252,6 +252,15 @@ def _apply_batch_dicts(pool, changes_by_doc):
     payload = msgpack.packb(keyed, use_bin_type=True)
     out = msgpack.unpackb(pool.apply_batch_bytes(payload),
                           raw=False, strict_map_key=False)
+    # the op counter lives here because this is where changes exist as
+    # decoded dicts (the bytes path can't count ops without paying a
+    # decode it otherwise avoids; docs it counts itself from the map
+    # header), and AFTER the apply so a failed batch doesn't inflate it;
+    # counts submitted ops of committed batches -- duplicates/queued
+    # changes included (the engine path counts exact causally-applied
+    # ops)
+    telemetry.OPS.inc(sum(len(c.get('ops', ()))
+                          for chs in changes_by_doc.values() for c in chs))
     return {d: out[NativeDocPool._doc_key(d)] for d in changes_by_doc}
 
 
@@ -266,8 +275,10 @@ def _raise_last():
 
 def _devtime_on():
     """AMTPU_DEVTIME=1 turns on synchronous per-dispatch device timing
-    (checked per call, not latched -- bench.py flips it for one pass)."""
-    return os.environ.get('AMTPU_DEVTIME', '0') not in ('', '0')
+    (checked per call, not latched -- bench.py flips it for one pass).
+    Single definition in telemetry so the engine and native paths can't
+    drift."""
+    return telemetry.devtime_on()
 
 
 def _host_dom_on():
@@ -378,11 +389,20 @@ class NativeDocPool:
 
     def apply_batch_bytes(self, payload):
         """msgpack {doc_id: [change...]} -> msgpack {doc_id: patch}."""
+        t0 = time.perf_counter()
         ctx = self._phase_a(payload)
         try:
-            return self._phase_b(ctx)
+            out = self._phase_b(ctx)
         finally:
             lib().amtpu_batch_free(ctx['bh'])
+        # doc count comes free from the payload's map header; a tuple
+        # payload is a shard sub-call whose docs the sharded top level
+        # already counted
+        docs = _read_map_header(payload)[0] \
+            if isinstance(payload, (bytes, bytearray)) else 0
+        telemetry.observe_batch('native', time.perf_counter() - t0,
+                                docs=docs)
+        return out
 
     def _phase_a(self, payload):
         """Host begin + async device dispatch.  Returns a context dict;
@@ -1225,6 +1245,7 @@ class ShardedNativePool:
 
     def apply_batch_bytes(self, payload):
         L = lib()
+        t_batch = time.perf_counter()
         # materialize the lazy pool list on THIS thread before any
         # worker threads touch the property: two workers racing on
         # `_pools is None` would each build a list and apply shards to
@@ -1261,7 +1282,13 @@ class ShardedNativePool:
             n, off = _read_map_header(r)
             total += n
             bodies.append(memoryview(r)[off:])   # no intermediate copy
-        return _map_header(total) + b''.join(bodies)
+        out = _map_header(total) + b''.join(bodies)
+        # whole-batch series; shard sub-batches land under pool="native"
+        # (threads mode) or not at all (pipeline mode drives _phase_a/b
+        # directly), so the two label values never double-count one level
+        telemetry.observe_batch('sharded', time.perf_counter() - t_batch,
+                                docs=_read_map_header(payload)[0])
+        return out
 
     def _run_pipelined(self, subs):
         """Phase a for every shard, then phase b for every shard.  A shard

@@ -28,8 +28,11 @@ The pool exposes the reference Backend surface per document
 `get_changes_for_actor`) plus `apply_batch` for the many-docs fast path.
 """
 
+import time
+
 import numpy as np
 
+from .. import telemetry
 from ..errors import AutomergeError, RangeError
 from ..ops import clock as clock_ops
 from ..ops import list_rank, registers as register_ops
@@ -183,6 +186,31 @@ class TPUDocPool:
 
     def _apply_batch_inner(self, changes_by_doc, local):
         doc_ids = list(changes_by_doc.keys())
+        t_batch = time.perf_counter()
+        with telemetry.span('engine.batch', docs=len(doc_ids)) as sp:
+            diffs_by_doc, n_applied_ops = self._apply_batch_phases(
+                doc_ids, changes_by_doc, local)
+            sp.set_attr('ops', n_applied_ops)
+        # counted AFTER the phases commit (a failed batch rolls back and
+        # must not inflate the counters), and from the APPLIED set --
+        # duplicates and dep-queued changes don't count as work done
+        telemetry.observe_batch('engine', time.perf_counter() - t_batch,
+                                docs=len(doc_ids), ops=n_applied_ops)
+
+        # ---- 6. patches --------------------------------------------------
+        patches = {}
+        for doc_id in doc_ids:
+            state = self.docs[doc_id]
+            patches[doc_id] = {
+                'clock': dict(state.clock),
+                'deps': dict(state.deps),
+                'canUndo': state.undo_pos > 0,
+                'canRedo': bool(state.redo_stack),
+                'diffs': diffs_by_doc.get(doc_id, []),
+            }
+        return patches
+
+    def _apply_batch_phases(self, doc_ids, changes_by_doc, local):
         for doc_id in doc_ids:
             self.doc(doc_id)
 
@@ -193,7 +221,8 @@ class TPUDocPool:
         # which are snapshotted and rolled back on error
         queue_snaps = {d: list(self.docs[d].queue) for d in doc_ids
                        if self.docs[d].queue}
-        applied, dup_checks = self._schedule(doc_ids, changes_by_doc)
+        with telemetry.span('engine.schedule'):
+            applied, dup_checks = self._schedule(doc_ids, changes_by_doc)
         try:
             self._validate(applied, dup_checks)
         except Exception:
@@ -227,29 +256,21 @@ class TPUDocPool:
             state.deps = remaining
 
         # ---- 3. metadata pre-pass: object creation + arena appends ------
-        self._prepass(applied)
+        with telemetry.span('engine.prepass'):
+            self._prepass(applied)
 
         # ---- 4. encode applied ops --------------------------------------
-        enc = self._encode(applied, local)
+        with telemetry.span('engine.encode'):
+            enc = self._encode(applied, local)
 
         # ---- 4. device kernels ------------------------------------------
-        outputs = self._run_kernels(enc)
+        with telemetry.span('engine.kernels'):
+            outputs = self._run_kernels(enc)
 
         # ---- 5. emission + mirror updates -------------------------------
-        diffs_by_doc = self._emit(enc, outputs, local)
-
-        # ---- 6. patches --------------------------------------------------
-        patches = {}
-        for doc_id in doc_ids:
-            state = self.docs[doc_id]
-            patches[doc_id] = {
-                'clock': dict(state.clock),
-                'deps': dict(state.deps),
-                'canUndo': state.undo_pos > 0,
-                'canRedo': bool(state.redo_stack),
-                'diffs': diffs_by_doc.get(doc_id, []),
-            }
-        return patches
+        with telemetry.span('engine.emit'):
+            diffs_by_doc = self._emit(enc, outputs, local)
+        return diffs_by_doc, sum(len(c['ops']) for _, c in applied)
 
     def get_clock(self, doc_id):
         """{'clock': ..., 'deps': ...} without materializing the doc --
@@ -326,7 +347,8 @@ class TPUDocPool:
         (parity: backend/index.js:5-119)."""
         state = self.peek(doc_id)
         diffs = []
-        self._materialize(state, ROOT_ID, diffs, set())
+        with telemetry.span('engine.materialize'):
+            self._materialize(state, ROOT_ID, diffs, set())
         return {
             'clock': dict(state.clock),
             'deps': dict(state.deps),
@@ -672,11 +694,19 @@ class TPUDocPool:
             c_arr[:T, :A] = np.stack(clock_rows)
             d_arr = np.zeros((Tp,), bool)
             d_arr[:T] = d_col
+            # device-time attribution: np.asarray blocks on the device
+            # outputs, so under AMTPU_DEVTIME the perf_counter pair IS
+            # the synchronous dispatch+compute time (host occupancy and
+            # device time report separately; docs/OBSERVABILITY.md)
+            devtime = telemetry.devtime_on()
+            t0 = time.perf_counter() if devtime else 0.0
             reg_out = register_ops.resolve_registers(
                 g_arr, t_arr, a_arr, s_arr, c_arr, d_arr,
                 np.ones((Tp,), bool),
                 sort_idx=np.lexsort((t_arr, g_arr)).astype(np.int32))
             reg_out = {k: np.asarray(v)[:T] for k, v in reg_out.items()}
+            if devtime:
+                telemetry.observe_device_dispatch(time.perf_counter() - t0)
         else:
             reg_out = None
 
@@ -717,11 +747,15 @@ class TPUDocPool:
             skey_obj = np.where(val_arr, obj_arr, 2 ** 30)
             sort_idx = np.lexsort(
                 (-act_arr, -ctr_arr, par_arr, skey_obj)).astype(np.int32)
+            devtime = telemetry.devtime_on()
+            t0 = time.perf_counter() if devtime else 0.0
             # doubling depth bound: DFS chains never cross objects
             rank = np.asarray(list_rank.linearize(
                 obj_arr, par_arr, ctr_arr, act_arr, val_arr,
                 n_iters=list_rank.ceil_log2(max(max_obj_len, 1)) + 1,
                 sort_idx=sort_idx))[:L]
+            if devtime:
+                telemetry.observe_device_dispatch(time.perf_counter() - t0)
         else:
             rank = np.zeros((0,), np.int32)
 
@@ -858,8 +892,13 @@ class TPUDocPool:
                         orank[o, t] = rank[base + eidx]
                         od[o, t] = delta
                         ov[o, t] = True
+                devtime = telemetry.devtime_on()
+                t0 = time.perf_counter() if devtime else 0.0
                 idxs = np.asarray(dominance_grouped_auto(
                     v0, er, oe, orank, od, ov, chunk=K))
+                if devtime:
+                    telemetry.observe_device_dispatch(
+                        time.perf_counter() - t0)
                 for o, akey in enumerate(slab):
                     for t, (op_idx, row, _e, _d) in enumerate(obj_ops[akey]):
                         out[op_idx] = (int(idxs[o, t]), row)

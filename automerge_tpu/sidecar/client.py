@@ -11,6 +11,8 @@ import struct
 import subprocess
 import sys
 
+from .. import telemetry
+
 
 class SidecarClient:
     def __init__(self, proc=None, sock_path=None, use_msgpack=False):
@@ -60,6 +62,12 @@ class SidecarClient:
     def call(self, cmd, **kwargs):
         self._next_id += 1
         req = dict(kwargs, cmd=cmd, id=self._next_id)
+        # distributed tracing: when a span is active client-side, ship
+        # its ids so the server's request span resumes the same trace
+        # (server consumes the envelope; responses are unchanged)
+        tctx = telemetry.current_trace_context()
+        if tctx is not None:
+            req.setdefault('trace', tctx)
         if self._msgpack:
             import msgpack
             body = msgpack.packb(req, use_bin_type=True)
@@ -107,3 +115,13 @@ class SidecarClient:
     def get_missing_changes(self, doc, have_deps):
         return self.call('get_missing_changes', doc=doc,
                          have_deps=have_deps)
+
+    # -- observability ---------------------------------------------------
+
+    def metrics(self):
+        """Prometheus text exposition of the SERVER process
+        ({'contentType': ..., 'body': ...})."""
+        return self.call('metrics')
+
+    def healthz(self):
+        return self.call('healthz')

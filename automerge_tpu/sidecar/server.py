@@ -22,6 +22,16 @@ Requests (fields beyond `cmd`/`id` per command):
   {"id": 7, "cmd": "ping"}
   {"id": 8, "cmd": "save",               "doc": d}
   {"id": 9, "cmd": "load",               "doc": d, "data": <checkpoint>}
+  {"id": 10, "cmd": "metrics"}
+  {"id": 11, "cmd": "healthz"}
+
+Observability: `metrics` answers {"contentType": ..., "body": <Prometheus
+text exposition>} for the whole process (docs/OBSERVABILITY.md), and
+`healthz` a liveness dict -- the same payloads the optional HTTP
+listener (--metrics-port) serves at /metrics and /healthz.  Requests may
+carry {"trace": {"traceId": ..., "spanId": ...}} to resume a client-side
+trace; the envelope is consumed server-side (responses are unchanged)
+and surfaces in the JSONL span export (AMTPU_TRACE_FILE).
 
 Checkpoints are binary; on the wire they travel base64-encoded
 ({"checkpoint_b64": ...} from save, and load's "data" field accepts the
@@ -32,6 +42,7 @@ Responses: {"id": ..., "result": ...} or {"id": ..., "error": msg,
 "errorType": "AutomergeError"|"RangeError"|"TypeError"}.
 
 Run: python -m automerge_tpu.sidecar.server [--socket PATH] [--msgpack]
+         [--metrics-port N]
 """
 
 import argparse
@@ -40,8 +51,11 @@ import os
 import socket
 import struct
 import sys
+import time
 
+from .. import telemetry
 from ..errors import AutomergeError, RangeError
+from ..telemetry import httpd as telemetry_httpd
 from ..utils.jaxenv import pin_cpu
 
 # honor a JAX_PLATFORMS=cpu environment (the sitecustomize-registered
@@ -108,12 +122,44 @@ class SidecarBackend:
 
     # -- dispatch -------------------------------------------------------
 
+    # the protocol's command set -- also the label universe for the
+    # per-command request metrics (an unknown wire string must not mint
+    # unbounded label values)
+    COMMANDS = ('ping', 'apply_changes', 'apply_batch',
+                'apply_local_change', 'get_patch', 'save', 'load',
+                'get_missing_deps', 'get_missing_changes',
+                'get_changes_for_actor', 'metrics', 'healthz')
+
     def handle(self, req):
+        """Wraps dispatch in the per-request telemetry: a span resuming
+        the client's trace context (when the request carries one) plus
+        always-on request count/latency series.  Responses are
+        byte-identical to the un-instrumented protocol."""
+        cmd = req.get('cmd')
+        label = cmd if cmd in self.COMMANDS else 'unknown'
+        tctx = req.get('trace')
+        tctx = tctx if isinstance(tctx, dict) else {}
+        t0 = time.perf_counter()
+        with telemetry.span_with_context(
+                'sidecar.request', tctx.get('traceId'), tctx.get('spanId'),
+                cmd=label, rid=req.get('id')):
+            resp = self._dispatch(req, cmd)
+        telemetry.SIDECAR_LATENCY.labels(label).observe(
+            time.perf_counter() - t0)
+        telemetry.SIDECAR_REQS.labels(
+            label, 'error' if 'error' in resp else 'ok').inc()
+        return resp
+
+    def _dispatch(self, req, cmd):
         rid = req.get('id')
         try:
-            cmd = req.get('cmd')
             if cmd == 'ping':
                 result = {'ok': True}
+            elif cmd == 'metrics':
+                result = {'contentType': telemetry_httpd.CONTENT_TYPE,
+                          'body': telemetry.render_prometheus()}
+            elif cmd == 'healthz':
+                result = telemetry.healthz()
             elif cmd == 'apply_changes':
                 result = self.apply_changes(req['doc'], req['changes'])
             elif cmd == 'apply_batch':
@@ -195,7 +241,37 @@ def main(argv=None):
     ap.add_argument('--msgpack', action='store_true',
                     help='length-prefixed msgpack framing instead of '
                          'JSON lines')
+    # a set-but-empty/garbage AMTPU_METRICS_PORT must not kill a server
+    # that never asked for metrics -- fall back to off
+    try:
+        env_port = int(os.environ.get('AMTPU_METRICS_PORT', -1))
+    except ValueError:
+        print('sidecar: ignoring non-integer AMTPU_METRICS_PORT=%r'
+              % os.environ['AMTPU_METRICS_PORT'], file=sys.stderr)
+        env_port = -1
+    ap.add_argument('--metrics-port', type=int, default=env_port,
+                    help='serve Prometheus /metrics + /healthz on this '
+                         'HTTP port (0 = ephemeral; default: off, or '
+                         'AMTPU_METRICS_PORT)')
+    ap.add_argument('--metrics-host',
+                    default=os.environ.get('AMTPU_METRICS_HOST',
+                                           '127.0.0.1'),
+                    help='bind address for the metrics listener '
+                         '(default loopback; 0.0.0.0 for a remote '
+                         'Prometheus fleet scrape)')
+    ap.add_argument('--trace', action='store_true',
+                    help='enable span tracing at startup (equivalent to '
+                         'AMTPU_TRACE=1; pair with AMTPU_TRACE_FILE for '
+                         'JSONL export)')
     args = ap.parse_args(argv)
+
+    if args.trace:
+        telemetry.enable()
+    if args.metrics_port >= 0:
+        srv = telemetry_httpd.start_metrics_server(args.metrics_port,
+                                                   host=args.metrics_host)
+        print('sidecar: metrics on http://%s:%d/metrics'
+              % (args.metrics_host, srv.server_port), file=sys.stderr)
 
     if args.socket:
         if os.path.exists(args.socket):

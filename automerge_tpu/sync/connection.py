@@ -11,6 +11,7 @@ same clock-union/missing-changes algebra runs as mesh collectives
 
 from .. import backend as Backend
 from .. import frontend as Frontend
+from .. import telemetry
 from ..utils.common import less_or_equal
 
 
@@ -48,7 +49,10 @@ class Connection:
         self._our_clock = clock_union(self._our_clock, doc_id, clock)
         if changes is not None:
             msg['changes'] = changes
-        self._send_msg(msg)
+        telemetry.SYNC_MSGS.labels('out').inc()
+        with telemetry.span('sync.send', doc=doc_id,
+                            changes=len(changes) if changes else 0):
+            self._send_msg(msg)
 
     def maybe_send_changes(self, doc_id):
         """Ships changes the peer is missing, or advertises our clock
@@ -82,6 +86,12 @@ class Connection:
 
     def receive_msg(self, msg):
         """(reference: connection.js:91-108)"""
+        telemetry.SYNC_MSGS.labels('in').inc()
+        with telemetry.span('sync.receive', doc=msg.get('docId'),
+                            changes=len(msg.get('changes') or ())):
+            return self._receive_msg(msg)
+
+    def _receive_msg(self, msg):
         if 'clock' in msg and msg['clock'] is not None:
             self._their_clock = clock_union(self._their_clock, msg['docId'],
                                             msg['clock'])

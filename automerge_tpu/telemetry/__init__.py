@@ -1,0 +1,251 @@
+"""automerge_tpu.telemetry -- the observability layer (PR 1).
+
+Replaces the flat `trace.py` occupancy counter with three composable
+pieces, threaded through every layer of the stack (frontend -> sidecar
+-> pool -> kernels -> sync):
+
+  * a metric REGISTRY (`registry`): counters, gauges, log-bucketed
+    histograms; thread-safe; near-zero-cost when idle.  The standard
+    families below fire per batch / per sidecar request, never per op.
+  * structured SPANS (`span`, `span_with_context`): request/batch-scoped
+    timing carrying a trace id and attributes, propagated across the
+    sidecar process boundary, exportable as JSONL
+    (`AMTPU_TRACE_FILE=...`).  Spans are opt-in: `enable()` / `disable()`
+    at runtime, or `AMTPU_TRACE=1` at startup (the legacy gate).
+  * PROMETHEUS exposition (`render_prometheus`): the registry plus
+    families derived from the span occupancy table and the always-on
+    flat metric map, served by the sidecar's `metrics` request type and
+    the optional HTTP listener (`httpd.start_metrics_server`).
+
+The always-on flat map (`metric` / `metrics_snapshot`) is kept verbatim
+from trace.py: the handful of numbers every bench line must report
+unconditionally -- oracle-fallback and degradation counters, measured
+device seconds.  Incremented once per BATCH, never per op.
+
+`automerge_tpu.trace` remains as a compatibility shim over this module,
+so pre-PR-1 call sites and the `trace.ENABLED = True` toggle keep
+working.
+
+Metric catalog: docs/OBSERVABILITY.md.
+"""
+
+import os
+import threading
+import time
+
+from .metrics import (DEFAULT_BUCKETS, MetricRegistry,  # noqa: F401
+                      format_value)
+from .spans import (NULL_SPAN, current_span,  # noqa: F401
+                    current_trace_context, disable, enable, enabled,
+                    new_id, phase_add, phase_count, phase_report,
+                    phase_reset, phase_snapshot, set_trace_file, span,
+                    span_with_context, trace_file)
+
+_START_TIME = time.time()
+
+registry = MetricRegistry()
+
+# -- standard families (the catalog's core; docs/OBSERVABILITY.md) ----------
+
+BATCHES = registry.counter(
+    'amtpu_batches_total', 'Batches applied, by pool entry point',
+    ('pool',))
+BATCH_LATENCY = registry.histogram(
+    'amtpu_batch_latency_seconds',
+    'Wall-clock latency of one apply-batch pass, by pool entry point',
+    ('pool',))
+OPS = registry.counter(
+    'amtpu_ops_total', 'Operations counted on committed batches only '
+    '(engine path: exact causally-applied ops; dict-level native path: '
+    'submitted ops incl. duplicates/queued -- the bytes path cannot '
+    'count without a decode it avoids)')
+DOCS = registry.counter(
+    'amtpu_docs_total', 'Documents touched by committed batches')
+SIDECAR_REQS = registry.counter(
+    'amtpu_sidecar_requests_total', 'Sidecar protocol requests served',
+    ('cmd', 'outcome'))
+SIDECAR_LATENCY = registry.histogram(
+    'amtpu_sidecar_request_seconds', 'Sidecar request service time',
+    ('cmd',))
+SYNC_MSGS = registry.counter(
+    'amtpu_sync_messages_total', 'Connection sync messages processed',
+    ('direction',))
+
+# fallback reasons pre-seeded into the exposition so dashboards see
+# explicit zeros before the first degradation (the same names
+# trace.metric('fallback.<reason>') call sites emit)
+KNOWN_FALLBACK_REASONS = ('layout_batches', 'overflow_batches',
+                          'overflow_rows', 'member_overflow_rows')
+
+
+# ---------------------------------------------------------------------------
+# always-on flat metrics (trace.metric compat; one dict update per batch)
+# ---------------------------------------------------------------------------
+
+_flat_lock = threading.Lock()
+_flat = {}
+
+
+def metric(name, n=1):
+    """Unconditionally accumulates `n` into the always-on counter."""
+    with _flat_lock:
+        _flat[name] = _flat.get(name, 0.0) + n
+
+
+def metrics_reset():
+    with _flat_lock:
+        _flat.clear()
+
+
+def metrics_snapshot():
+    """{name: value} of the always-on counters since metrics_reset()."""
+    with _flat_lock:
+        return dict(_flat)
+
+
+# ---------------------------------------------------------------------------
+# batch + device helpers (the per-layer call sites)
+# ---------------------------------------------------------------------------
+
+def observe_batch(pool, seconds, docs=0, ops=0):
+    """One apply-batch pass completed: latency histogram + counters.
+    `pool` names the entry point ('engine' | 'native' | 'sharded'), so
+    whole-batch and per-shard latencies stay separate series."""
+    BATCHES.labels(pool).inc()
+    BATCH_LATENCY.labels(pool).observe(seconds)
+    if docs:
+        DOCS.inc(docs)
+    if ops:
+        OPS.inc(ops)
+
+
+def devtime_on():
+    """AMTPU_DEVTIME=1: synchronous per-dispatch device timing (checked
+    per call, not latched -- bench.py flips it for one dedicated pass)."""
+    return os.environ.get('AMTPU_DEVTIME', '0') not in ('', '0')
+
+
+def observe_device_dispatch(seconds, n=1):
+    """One synchronous (block_until_ready) kernel dispatch measured:
+    lands in the flat map under the names bench.py already reads."""
+    metric('device.dispatch_sync_s', seconds)
+    metric('device.dispatches', n)
+
+
+# ---------------------------------------------------------------------------
+# exposition
+# ---------------------------------------------------------------------------
+
+def _render_derived(out):
+    """Families derived at scrape time from the span occupancy table and
+    the flat map -- keeps the hot paths at one dict update while the
+    scrape surface stays fully structured."""
+    from .metrics import _labels_text
+
+    phases = phase_snapshot()
+    out.append('# HELP amtpu_phase_seconds_total Per-phase host occupancy '
+               'seconds (sums across shard threads; exceeds wall time '
+               'when shards overlap); only populated while tracing is '
+               'enabled')
+    out.append('# TYPE amtpu_phase_seconds_total counter')
+    for name in sorted(phases):
+        out.append('amtpu_phase_seconds_total%s %s' % (
+            _labels_text(('phase',), (name,)),
+            format_value(float(phases[name]['s']))))
+    out.append('# HELP amtpu_phase_calls_total Per-phase call counts '
+               '(see amtpu_phase_seconds_total)')
+    out.append('# TYPE amtpu_phase_calls_total counter')
+    for name in sorted(phases):
+        out.append('amtpu_phase_calls_total%s %s' % (
+            _labels_text(('phase',), (name,)),
+            format_value(phases[name]['n'])))
+
+    flat = metrics_snapshot()
+    fallbacks = {r: 0.0 for r in KNOWN_FALLBACK_REASONS}
+    rest = {}
+    for k, v in flat.items():
+        if k.startswith('fallback.'):
+            fallbacks[k.split('.', 1)[1]] = v
+        elif k not in ('device.dispatch_sync_s', 'device.dispatches'):
+            rest[k] = v
+    out.append('# HELP amtpu_fallback_total Oracle-fallback / degradation '
+               'events by reason (always on; nonzero means a batch left '
+               'the fast path)')
+    out.append('# TYPE amtpu_fallback_total counter')
+    for reason in sorted(fallbacks):
+        out.append('amtpu_fallback_total%s %s' % (
+            _labels_text(('reason',), (reason,)),
+            format_value(fallbacks[reason])))
+    out.append('# HELP amtpu_device_seconds_total Measured synchronous '
+               'device time (block_until_ready; populated under '
+               'AMTPU_DEVTIME=1)')
+    out.append('# TYPE amtpu_device_seconds_total counter')
+    out.append('amtpu_device_seconds_total %s'
+               % format_value(float(flat.get('device.dispatch_sync_s',
+                                             0.0))))
+    out.append('# HELP amtpu_device_dispatches_total Synchronously '
+               'measured kernel dispatches (AMTPU_DEVTIME=1)')
+    out.append('# TYPE amtpu_device_dispatches_total counter')
+    out.append('amtpu_device_dispatches_total %s'
+               % format_value(float(flat.get('device.dispatches', 0.0))))
+    out.append('# HELP amtpu_runtime_counter Remaining always-on flat '
+               'counters, exported verbatim by name')
+    out.append('# TYPE amtpu_runtime_counter gauge')
+    for k in sorted(rest):
+        out.append('amtpu_runtime_counter%s %s' % (
+            _labels_text(('name',), (k,)), format_value(float(rest[k]))))
+
+    out.append('# HELP amtpu_telemetry_enabled Whether span tracing is '
+               'currently enabled (1) or idle (0)')
+    out.append('# TYPE amtpu_telemetry_enabled gauge')
+    out.append('amtpu_telemetry_enabled %d' % (1 if enabled() else 0))
+    out.append('# HELP amtpu_up Process liveness (constant 1 while the '
+               'exporter answers)')
+    out.append('# TYPE amtpu_up gauge')
+    out.append('amtpu_up 1')
+
+
+def render_prometheus():
+    """Full Prometheus text exposition (format 0.0.4) for this process."""
+    out = []
+    for fam in registry.families():
+        fam.render(out)
+    _render_derived(out)
+    return '\n'.join(out) + '\n'
+
+
+def healthz():
+    """Liveness payload for /healthz and the in-band `healthz` command.
+    Batch counts report per pool label (summing them would double-count
+    a sharded batch against its per-shard sub-batches)."""
+    return {'ok': True, 'uptime_s': round(time.time() - _START_TIME, 3),
+            'telemetry_enabled': enabled(),
+            'batches': BATCHES.snapshot() or {}}
+
+
+def bench_block():
+    """The per-BENCH-line embed: fallback rates, device seconds, batch
+    latency summaries, and (when tracing) the phase occupancy table."""
+    flat = metrics_snapshot()
+    block = {
+        'fallbacks': {k.split('.', 1)[1]: round(v, 6)
+                      for k, v in flat.items()
+                      if k.startswith('fallback.')},
+        'device_s': round(flat.get('device.dispatch_sync_s', 0.0), 4),
+        'device_dispatches': int(flat.get('device.dispatches', 0)),
+        'batch_latency': BATCH_LATENCY.snapshot() or {},
+        'ops_total': OPS.value,
+        'docs_total': DOCS.value,
+    }
+    if enabled():
+        block['phases'] = {k: {'s': round(v['s'], 4), 'n': v['n']}
+                           for k, v in phase_snapshot().items()}
+    return block
+
+
+def reset_all():
+    """Test/bench isolation: zero the registry, the flat map, and the
+    phase occupancy table (enable state and exporter are untouched)."""
+    registry.reset()
+    metrics_reset()
+    phase_reset()

@@ -1,0 +1,51 @@
+"""Sidecar metrics listener: a tiny stdlib HTTP server exposing
+`/metrics` (Prometheus text exposition) and `/healthz` (JSON liveness)
+so a fleet of sidecars is scrapeable without touching the stream
+protocol.  Runs as a daemon thread next to the stream loop; the same
+payloads are also answerable in-band via the `metrics` / `healthz`
+request types (sidecar/server.py) for transports that already hold a
+stream open.
+"""
+
+import json
+import threading
+
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+CONTENT_TYPE = 'text/plain; version=0.0.4; charset=utf-8'
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def do_GET(self):
+        from . import healthz, render_prometheus
+        path = self.path.split('?', 1)[0]
+        if path == '/metrics':
+            body = render_prometheus().encode()
+            ctype = CONTENT_TYPE
+        elif path == '/healthz':
+            body = (json.dumps(healthz()) + '\n').encode()
+            ctype = 'application/json'
+        else:
+            self.send_response(404)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header('Content-Type', ctype)
+        self.send_header('Content-Length', str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):
+        pass    # scrapes every few seconds must not spam stderr
+
+
+def start_metrics_server(port, host='127.0.0.1'):
+    """Starts the listener on (host, port) in a daemon thread; port 0
+    binds an ephemeral port.  Returns the server (server.server_port
+    holds the bound port; server.shutdown() stops it)."""
+    server = ThreadingHTTPServer((host, port), _Handler)
+    server.daemon_threads = True
+    thread = threading.Thread(target=server.serve_forever,
+                              name='amtpu-metrics', daemon=True)
+    thread.start()
+    return server

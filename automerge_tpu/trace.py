@@ -1,112 +1,73 @@
-"""Opt-in phase tracing (AMTPU_TRACE=1).
+"""Compatibility shim over `automerge_tpu.telemetry` (PR 1).
 
-The reference ships no instrumentation (SURVEY.md section 5); since this
-framework's metric is ops/sec, it adds an opt-in timing/counter layer:
-per-phase wall time and op counts accumulated across every pool dispatch.
+The original trace.py was a flat occupancy counter gated on an
+import-time AMTPU_TRACE snapshot.  The real implementation now lives in
+`automerge_tpu.telemetry` (structured spans, metric registry, Prometheus
+exposition); this module keeps every pre-PR-1 call site working:
 
-Enable with AMTPU_TRACE=1 (checked once at import).  Phases are
-accumulated under a lock because `ShardedNativePool` drives shards from
-concurrent threads -- phase sums therefore measure *occupancy* (total
-seconds spent in a phase across all threads), which can exceed wall time
-when shards overlap.  That is the useful number on a 1-core host: it shows
-where the serialized host budget goes.
+  * `trace.span / add / count` -- phase occupancy, gated on the runtime
+    enable flag (`telemetry.enable()` / `disable()`).
+  * `trace.metric / metrics_reset / metrics_snapshot` -- the always-on
+    flat counters every bench line embeds.
+  * `trace.ENABLED` -- reads AND writes forward to the runtime flag via
+    a module-class property, so `trace.ENABLED = True` (tests,
+    __graft_entry__) now toggles tracing at runtime instead of racing an
+    import-order snapshot.
 
-Usage:
-    from automerge_tpu import trace
-    trace.reset()
-    ... run workload ...
-    print(trace.report())
+New code should import `automerge_tpu.telemetry` directly.
 """
 
-import os
-import threading
-import time
-from collections import defaultdict
-from contextlib import contextmanager
+import sys
+import types
 
-ENABLED = os.environ.get('AMTPU_TRACE', '0') not in ('', '0')
+from . import telemetry as _t
 
-_lock = threading.Lock()
-_seconds = defaultdict(float)
-_counts = defaultdict(int)
+span = _t.span
 
 
 def add(phase, seconds, n=1):
-    if not ENABLED:
-        return
-    with _lock:
-        _seconds[phase] += seconds
-        _counts[phase] += n
+    _t.phase_add(phase, seconds, n)
 
 
 def count(counter, n=1):
-    if not ENABLED:
-        return
-    with _lock:
-        _counts[counter] += n
-
-
-# ---------------------------------------------------------------------------
-# Always-on metrics (NOT gated by AMTPU_TRACE): the handful of numbers a
-# bench run must be able to report unconditionally -- oracle-fallback
-# rates (a degraded run must be visible in every bench JSON line, VERDICT
-# r3 #7) and measured device time (VERDICT r3 #2).  Incremented once per
-# BATCH, never per op, so the cost is one dict update per dispatch.
-# ---------------------------------------------------------------------------
-
-_metrics = defaultdict(float)
+    _t.phase_count(counter, n)
 
 
 def metric(name, n=1):
-    """Unconditionally accumulates `n` into the always-on counter."""
-    with _lock:
-        _metrics[name] += n
+    _t.metric(name, n)
 
 
 def metrics_reset():
-    with _lock:
-        _metrics.clear()
+    _t.metrics_reset()
 
 
 def metrics_snapshot():
-    """{name: value} of the always-on counters since metrics_reset()."""
-    with _lock:
-        return dict(_metrics)
-
-
-@contextmanager
-def span(phase):
-    """Times a with-block into `phase` (no-op unless AMTPU_TRACE=1)."""
-    if not ENABLED:
-        yield
-        return
-    t0 = time.perf_counter()
-    try:
-        yield
-    finally:
-        add(phase, time.perf_counter() - t0)
+    return _t.metrics_snapshot()
 
 
 def reset():
-    with _lock:
-        _seconds.clear()
-        _counts.clear()
+    _t.phase_reset()
 
 
 def snapshot():
-    """{phase: {'s': seconds, 'n': calls}} accumulated since reset()."""
-    with _lock:
-        keys = set(_seconds) | set(_counts)
-        return {k: {'s': _seconds.get(k, 0.0), 'n': _counts.get(k, 0)}
-                for k in sorted(keys)}
+    return _t.phase_snapshot()
 
 
 def report():
-    snap = snapshot()
-    if not snap:
-        return 'trace: (empty)'
-    width = max(len(k) for k in snap)
-    lines = ['trace (occupancy seconds; threads overlap):']
-    for k, v in sorted(snap.items(), key=lambda kv: -kv[1]['s']):
-        lines.append('  %-*s %8.3fs  x%d' % (width, k, v['s'], v['n']))
-    return '\n'.join(lines)
+    return _t.phase_report()
+
+
+class _TraceModule(types.ModuleType):
+    @property
+    def ENABLED(self):
+        return _t.enabled()
+
+    @ENABLED.setter
+    def ENABLED(self, value):
+        if value:
+            _t.enable()
+        else:
+            _t.disable()
+
+
+sys.modules[__name__].__class__ = _TraceModule

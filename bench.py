@@ -281,7 +281,7 @@ def _measure_mode(make_pool, payload, total_ops, label):
     resolves to.  Returns (median_rate, pool_from_last_run, stats)."""
     import gc
 
-    from automerge_tpu import trace
+    from automerge_tpu import telemetry, trace
 
     # ---- warmup ----------------------------------------------------------
     t0 = time.perf_counter()
@@ -300,7 +300,12 @@ def _measure_mode(make_pool, payload, total_ops, label):
     # an externally-exported AMTPU_DEVTIME=1 must not poison the timed
     # runs (restored for the dedicated pass below)
     devtime_prior = os.environ.pop('AMTPU_DEVTIME', None)
+    # one measurement window per mode: flat metrics AND the registry
+    # reset together, so the telemetry block captured below describes
+    # exactly these 3 timed runs (not warmups, parity checks, or a
+    # sibling mode's passes)
     trace.metrics_reset()
+    telemetry.registry.reset()
     for run in range(3):
         trace.reset()
         pool = make_pool()
@@ -321,6 +326,10 @@ def _measure_mode(make_pool, payload, total_ops, label):
                  if k.startswith('fallback.')}
     print('[%s] fallbacks (3 runs): %s' % (label, fallbacks or 'none'),
           file=sys.stderr)
+    # captured HERE, before the devtime pass resets the flat metrics:
+    # the embedded block describes the timed runs, so a degraded run's
+    # fallback counts survive into the artifact
+    telemetry_block = telemetry.bench_block()
 
     # ---- device-time pass ------------------------------------------------
     # One EXTRA pass with synchronous per-dispatch timing: every device
@@ -353,7 +362,10 @@ def _measure_mode(make_pool, payload, total_ops, label):
           'busy, %d dispatches' % (label, device['sync_dispatch_s'],
                                    dev_wall, 100 * device['busy_frac'],
                                    device['dispatches']), file=sys.stderr)
-    return rate, pool, {'fallbacks': fallbacks, 'device': device}
+    telemetry_block['device_s'] = device['sync_dispatch_s']
+    telemetry_block['device_dispatches'] = device['dispatches']
+    return rate, pool, {'fallbacks': fallbacks, 'device': device,
+                        'telemetry': telemetry_block}
 
 
 def run_batch_config(build, rng, both_modes=True):
@@ -759,6 +771,14 @@ def main(argv=None):
         result = run_config_1_mesh(rng)
     else:
         result = run_batch_config(BUILDERS[args.config], rng, both_modes=both)
+    # every BENCH line embeds a telemetry block (fallback rates, device
+    # seconds, batch-latency histograms) so an artifact is
+    # self-describing about HOW its number was produced.  Configs 1-4
+    # already carry a per-mode block scoped to their timed runs
+    # (_measure_mode); this setdefault covers the remaining paths
+    # (config 5, mesh) with the process-wide view
+    from automerge_tpu import telemetry
+    result.setdefault('telemetry', telemetry.bench_block())
     print(json.dumps(result))
     # a parity failure in EITHER mode fails the run: the sibling-mode
     # block exists precisely so a kernel-path regression is loud even

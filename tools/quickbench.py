@@ -2,34 +2,40 @@
 
 Builds ONE BASELINE-config workload (default: config 3, the headline
 shape), then loops fresh-pool `apply_batch_bytes` runs and prints wall
-times + the AMTPU_TRACE phase split.  Intended for tight
-optimize-measure loops on the HOST phases; run with JAX_PLATFORMS=cpu
-when the TPU link is down -- host-phase timings are device-independent.
+times + the phase split.  Intended for tight optimize-measure loops on
+the HOST phases; run with JAX_PLATFORMS=cpu when the TPU link is down --
+host-phase timings are device-independent.
 
 The single-core host jitters +-15% between windows: for honest A/B
 comparisons interleave runs of both binaries (swap the built .so), or
-compare the thread-CPU cxx.* spans (AMTPU_TRACE=1), which are immune
+compare the thread-CPU cxx.* spans (tracing on), which are immune
 to wall-clock contention.
 
-Usage:  AMTPU_TRACE=1 [JAX_PLATFORMS=cpu] python tools/quickbench.py \
-            [--config N] [--runs K]
+Tracing is toggled at RUNTIME (telemetry.enable(); no more AMTPU_TRACE
+env mutation before import); --no-trace measures the production
+disabled path.  The final stdout line is BENCH JSON embedding
+`telemetry.bench_block()` (fallback rates, device seconds, batch
+histograms).  `make telemetry-check` gates the disabled-path overhead
+of the same workload (tools/telemetry_check.py).
+
+Usage:  [JAX_PLATFORMS=cpu] python tools/quickbench.py \
+            [--config N] [--runs K] [--no-trace]
 Env:    the same AMTPU_BENCH_* knobs bench.py reads.
 """
 import argparse
+import json
 import os
 import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-os.environ.setdefault('AMTPU_TRACE', '1')
-
 from automerge_tpu.utils.jaxenv import pin_cpu  # noqa: E402
 pin_cpu()
 
 import msgpack  # noqa: E402
 
-from automerge_tpu import trace  # noqa: E402
+from automerge_tpu import telemetry  # noqa: E402
 from automerge_tpu.native import NativeDocPool, ShardedNativePool  # noqa: E402
 
 
@@ -37,9 +43,15 @@ def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument('--config', type=int, default=3, choices=[1, 2, 3, 4])
     ap.add_argument('--runs', type=int, default=5)
+    ap.add_argument('--no-trace', action='store_true',
+                    help='leave span tracing disabled (measures the '
+                         'production path; always-on counters still '
+                         'accumulate)')
     args = ap.parse_args()
     if args.runs < 1:
         ap.error('--runs must be >= 1')
+    if not args.no_trace:
+        telemetry.enable()
 
     import random
 
@@ -65,9 +77,12 @@ def main():
     make_pool().apply_batch_bytes(payload)
     print('warmup: %.2fs' % (time.perf_counter() - t0), file=sys.stderr)
 
+    # ONE measurement window for the whole embed: warmup's compiles are
+    # excluded, then histograms, counters, AND phases all cover exactly
+    # the timed runs (mixed windows would skew any phase-per-batch math)
+    telemetry.reset_all()
     times = []
     for _ in range(args.runs):
-        trace.reset()
         pool = make_pool()
         t0 = time.perf_counter()
         pool.apply_batch_bytes(payload)
@@ -76,8 +91,12 @@ def main():
     print('runs: %s -> best %.0f ops/s, median %.0f ops/s'
           % (['%.3f' % t for t in times], total_ops / min(times),
              total_ops / med), file=sys.stderr)
-    if trace.ENABLED:
-        print(trace.report(), file=sys.stderr)
+    if telemetry.enabled():
+        print(telemetry.phase_report(), file=sys.stderr)
+    print(json.dumps({'metric': 'quickbench_%s' % metric,
+                      'value': round(total_ops / med, 1),
+                      'unit': 'ops/sec', 'config': args.config,
+                      'telemetry': telemetry.bench_block()}))
 
 
 if __name__ == '__main__':

@@ -1,0 +1,149 @@
+"""Disabled-path overhead gate for the telemetry layer.
+
+The observability contract (ISSUE 1 / docs/OBSERVABILITY.md) is that an
+idle telemetry layer is FREE: with tracing disabled, the instrumented
+pipeline must run within noise of an un-instrumented one.  The
+un-instrumented binary no longer exists, so this harness reconstructs
+it in-process: every telemetry entry point the hot path touches
+(trace.span/count/add/metric, telemetry.observe_batch, the always-on
+counters) is monkeypatched to a bare no-op, which is the
+closest executable stand-in for deleting the call sites.
+
+Protocol: one warmup, then PAIRS interleaved (raw, disabled) runs of
+the quickbench workload on fresh pools -- interleaving is the only
+honest A/B on this single-core host (runs drift +-15% between windows;
+see tools/quickbench.py).  MINIMA compare (the minimum of N identical
+runs is the least-contended sample, the robust statistic for a shared
+host); the target is ~2% overhead, the assert threshold defaults to 6%
+to absorb residual jitter (AMTPU_TCHECK_TOL overrides).  A final
+enabled-path pass sanity-checks
+that tracing actually records (an accidentally dead telemetry layer
+must not pass the overhead gate by being dead).
+
+Run via `make telemetry-check`, or directly:
+    JAX_PLATFORMS=cpu AMTPU_BENCH_DOCS=256 python tools/telemetry_check.py
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# small-but-real default workload (env overrides win)
+os.environ.setdefault('AMTPU_BENCH_DOCS', '256')
+os.environ.setdefault('AMTPU_BENCH_ORACLE_DOCS', '1')
+
+from automerge_tpu.utils.jaxenv import pin_cpu  # noqa: E402
+pin_cpu()
+
+import msgpack  # noqa: E402
+
+from automerge_tpu import telemetry, trace  # noqa: E402
+from automerge_tpu.native import NativeDocPool, ShardedNativePool  # noqa: E402
+from automerge_tpu.telemetry.spans import NULL_SPAN  # noqa: E402
+
+PAIRS = int(os.environ.get('AMTPU_TCHECK_PAIRS', 5))
+TOL = float(os.environ.get('AMTPU_TCHECK_TOL', 0.06))
+
+
+def _noop(*args, **kwargs):
+    return None
+
+
+def _null_span(*args, **kwargs):
+    return NULL_SPAN
+
+
+_PATCHES = [
+    (trace, 'span', _null_span), (trace, 'count', _noop),
+    (trace, 'add', _noop), (trace, 'metric', _noop),
+    (telemetry, 'span', _null_span),
+    (telemetry, 'observe_batch', _noop),
+    (telemetry, 'observe_device_dispatch', _noop),
+    (telemetry, 'metric', _noop),
+]
+
+
+class raw_mode(object):
+    """Context manager approximating the un-instrumented pipeline."""
+
+    def __enter__(self):
+        self._saved = [(m, n, getattr(m, n)) for m, n, _ in _PATCHES]
+        for m, n, f in _PATCHES:
+            setattr(m, n, f)
+
+    def __exit__(self, *exc):
+        for m, n, f in self._saved:
+            setattr(m, n, f)
+        return False
+
+
+def main():
+    import random
+
+    import bench
+    rng = random.Random(int(os.environ.get('AMTPU_BENCH_SEED', 7)))
+    config = int(os.environ.get('AMTPU_TCHECK_CONFIG', 3))
+    batch, metric = bench.BUILDERS[config](rng)
+    total_ops = sum(len(c['ops']) for chs in batch.values() for c in chs)
+    keyed = {NativeDocPool._doc_key(d): chs for d, chs in batch.items()}
+    payload = msgpack.packb(keyed, use_bin_type=True)
+    print('telemetry-check: config %d, %d docs, %d ops'
+          % (config, len(batch), total_ops), file=sys.stderr)
+
+    def make_pool():
+        n = int(os.environ.get('AMTPU_BENCH_SHARDS', 0)) or \
+            ShardedNativePool.default_shards()
+        n = min(n, len(batch))
+        return ShardedNativePool(n) if n > 1 else NativeDocPool()
+
+    def run_once():
+        pool = make_pool()
+        t0 = time.perf_counter()
+        pool.apply_batch_bytes(payload)
+        return time.perf_counter() - t0
+
+    telemetry.disable()
+    run_once()                      # warmup: jit compiles, allocator heat
+    raw_times, dis_times = [], []
+    for _ in range(PAIRS):
+        with raw_mode():
+            raw_times.append(run_once())
+        dis_times.append(run_once())
+    raw_best = min(raw_times)
+    dis_best = min(dis_times)
+    overhead = (dis_best - raw_best) / raw_best
+    print('raw (no-op patched): %s' % ['%.3f' % t for t in raw_times],
+          file=sys.stderr)
+    print('disabled telemetry:  %s' % ['%.3f' % t for t in dis_times],
+          file=sys.stderr)
+    print('telemetry-check: disabled-path overhead %.2f%% '
+          '(best %.3fs vs %.3fs; tolerance %.0f%%)'
+          % (100 * overhead, dis_best, raw_best, 100 * TOL))
+
+    # enabled-path sanity: tracing must actually record when on
+    telemetry.reset_all()
+    telemetry.enable()
+    try:
+        run_once()
+        snap = telemetry.phase_snapshot()
+        assert snap, 'enabled tracing recorded no phases'
+        assert telemetry.metrics_snapshot() is not None
+        block = telemetry.bench_block()
+        assert block['batch_latency'], 'no batch latency recorded'
+    finally:
+        telemetry.disable()
+    print('telemetry-check: enabled-path sanity ok (%d phases)'
+          % len(snap), file=sys.stderr)
+
+    if overhead > TOL:
+        print('telemetry-check: FAIL -- disabled path is %.1f%% slower '
+              'than the no-op pipeline (tolerance %.0f%%)'
+              % (100 * overhead, 100 * TOL))
+        return 1
+    print('telemetry-check: PASS')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
