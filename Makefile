@@ -1,7 +1,7 @@
 # Top-level developer entry points.
 
 .PHONY: all native test bench bench-all bench-tpu check clean wheel \
-	telemetry-check
+	telemetry-check fallback-check
 
 all: native
 
@@ -49,7 +49,15 @@ check: native
 	        % (r['mode'], r['value'], k['value']))"
 	JAX_PLATFORMS=cpu python -c "import __graft_entry__ as g; \
 	  g.dryrun_multichip(8); print('dryrun ok')"
+	$(MAKE) fallback-check
 	@echo "CHECK GREEN"
+
+# Escalation-ladder gate (ISSUE 2): a config-4-shaped smoke on the
+# FORCED kernel path must report fallback.oracle == 0 with the per-tier
+# escalation counters present in the BENCH telemetry block -- the table
+# workload may never fall back to host-oracle register resolution again.
+fallback-check: native
+	JAX_PLATFORMS=cpu python tools/fallback_check.py
 
 # Observability gate (docs/OBSERVABILITY.md): idle telemetry must be
 # free.  Interleaved A/B of the disabled path vs a no-op-patched "raw"

@@ -364,6 +364,11 @@ class NativeDocPool:
         from .resident import ResidentCache
         self._resident = ResidentCache()
 
+    @staticmethod
+    def _backend_is_cpu():
+        import jax
+        return jax.default_backend() == 'cpu'
+
     def _ensure_mode_flags(self):
         # resolved lazily at the first batch (jax backend init is heavy
         # and pools are built in sharded bulk); re-checked never -- the
@@ -471,11 +476,12 @@ class NativeDocPool:
                     weff *= 2
             wenv = os.environ.get('AMTPU_WEFF')
             if wenv and not use_members:
-                # test-only: force a narrower window so the overflow ->
-                # oracle fallback branch is REACHABLE (the dynamic sizing
-                # above makes saturation impossible by construction);
-                # parity still holds because overflow falls back to the
-                # exact host oracle.  tests/test_native.py uses this to
+                # test-only: force a narrower window so the overflow
+                # branch is REACHABLE (the dynamic sizing above makes
+                # saturation impossible by construction); parity still
+                # holds because flagged groups escalate through exact
+                # wider kernel tiers (or the host oracle under
+                # AMTPU_ESCALATE=0).  tests/test_native.py uses this to
                 # pin the fallback paths under both dominance modes.
                 weff = min(self.WINDOW, max(2, int(wenv)))
             ctx.update(dims=(T, Tp, A, Ap, Larena, Lp, n_blocks, max_obj,
@@ -492,16 +498,21 @@ class NativeDocPool:
                 return ctx
 
             # Host-register mode: when a map-only batch's register rows
-            # mostly sit in groups wider than the member window, the
-            # kernel's output would be discarded for every overflowed
-            # row and the host oracle re-resolves them anyway.  Skip the
-            # dispatch entirely; emit resolves each register against the
-            # live mirror in one O(w) merge (no sort).  The 64-writer
-            # replica catch-up shape (BASELINE config 5) is the
-            # canonical case.
+            # mostly sit in groups wider than the member window, emit can
+            # resolve each register against the live mirror in one O(w)
+            # merge (no sort) with no dispatch at all.  That only beats
+            # the kernel on the CPU backend, where XLA shares the host
+            # core; on accelerators the escalation ladder keeps the
+            # resolution on device (one wider dispatch per tier), so
+            # hostreg engages only when the ladder is unavailable.  The
+            # 64-writer replica catch-up shape (BASELINE config 5) is
+            # the canonical CPU case.
+            from ..ops.registers import escalation_enabled
             if (use_members and n_blocks == 0 and 2 * pre_ovf >= T
                     and os.environ.get('AMTPU_HOST_REG', '1')
-                    not in ('', '0')):
+                    not in ('', '0')
+                    and (not escalation_enabled()
+                         or self._backend_is_cpu())):
                 trace.count('hostreg.batches')
                 trace.metric('hostreg.batches')
                 ctx.update(mode='hostreg')
@@ -521,6 +532,19 @@ class NativeDocPool:
                         L, bh, Tp, Ap, CTp, Lp, max_obj, mem,
                         weff=ctx['weff'])
                 ctx.update(mode='old', reg_out=reg_out, rank=rank)
+                # member-mode overflow flags are HOST-computed, so the
+                # escalation tiers dispatch here -- async, overlapping
+                # the pipeline's other host work -- and collect in
+                # phase b (kernel-decided overflow, e.g. AMTPU_WEFF,
+                # stays synchronous in _escalate)
+                if hovf is not None and hovf.any():
+                    from ..ops import registers as register_ops
+                    if register_ops.escalation_enabled():
+                        r = self._register_views(L, bh, Tp, Ap, CTp)
+                        ctx['esc'] = register_ops.escalate_overflow_dispatch(
+                            r['g'], r['t'], r['a'], r['s'],
+                            r['d'].astype(bool), r['ctab'], r['cidx'],
+                            hovf.astype(bool))
             if devtime:
                 # AMTPU_DEVTIME=1: block on the dispatched outputs and
                 # record the synchronous dispatch+compute time.  This
@@ -749,7 +773,7 @@ class NativeDocPool:
                     combo = np.asarray(ctx['combo'])
                     packed = np.ascontiguousarray(combo[:Tp])
                     dom_idx = np.ascontiguousarray(combo[Tp:], np.int32)
-                    fallback = bool((packed >> 28 & 1).any())
+                    fallback = bool((packed >> 30 & 1).any())
                     if not fallback:
                         # conflicts stay SPARSE: only rows whose register
                         # kept >1 member carry a conflict list.  When the
@@ -758,7 +782,7 @@ class NativeDocPool:
                         # saves nothing -- transfer the whole matrix once
                         # and slice host-side instead.
                         conf_rows = np.nonzero(
-                            (packed >> 24 & 0xf) > 1)[0].astype(np.int32)
+                            (packed >> 24 & 0x3f) > 1)[0].astype(np.int32)
                         if conf_rows.size * 4 > Tp:
                             allconf = np.asarray(
                                 ctx['reg_out']['conflicts'])
@@ -768,12 +792,15 @@ class NativeDocPool:
                             conf_vals = self._gather_conflict_rows(
                                 ctx['reg_out'], conf_rows)
             if fallback:
-                # >window concurrent writers on some register: re-fetch the
-                # full outputs + rank and take the exact host path
+                # >window concurrent writers on some register: re-fetch
+                # the full outputs + rank, escalate the flagged groups
+                # through wider kernel tiers, and hand only what the
+                # ladder could not hold (fallback.oracle) to the C++
+                # oracle replay
                 trace.count('fused.fallback_overflow')
                 trace.metric('fallback.overflow_batches')
                 trace.metric('fallback.overflow_rows',
-                             int((packed >> 28 & 1).sum()))
+                             int((packed >> 30 & 1).sum()))
                 reg_out = ctx['reg_out']
                 winner = np.ascontiguousarray(reg_out['winner'], np.int32)
                 conflicts = np.ascontiguousarray(reg_out['conflicts'],
@@ -782,13 +809,16 @@ class NativeDocPool:
                                              np.int32)
                 overflow = np.ascontiguousarray(reg_out['overflow'],
                                                 np.uint8)
+                winner, conflicts, alive, overflow = self._escalate(
+                    L, ctx, winner, conflicts, alive, overflow)
                 rank_arr = (np.ascontiguousarray(ctx['rank'], np.int32)
                             if ctx['rank'] is not None
                             else np.zeros(0, np.int32))
                 hostdom = ctx.get('hostdom')
                 with trace.span('host.mid'):
                     if L.amtpu_mid(bh, ip(winner), ip(conflicts),
-                                   ctx['weff'], ip(alive), up(overflow),
+                                   self._mid_window(ctx, conflicts),
+                                   ip(alive), up(overflow),
                                    None if hostdom else ip(rank_arr),
                                    1 if hostdom else 0) != 0:
                         _raise_last()
@@ -825,18 +855,23 @@ class NativeDocPool:
                     if ctx.get('hovf') is not None:
                         # member mode: overflow is host-decided (>WINDOW
                         # concurrent streams / same-change dup assigns)
-                        overflow = np.ascontiguousarray(ctx['hovf'])
+                        overflow = np.array(ctx['hovf'], np.uint8)
                         n_ovf = int(overflow.sum())
                         if n_ovf:
                             trace.metric('fallback.member_overflow_rows',
                                          n_ovf)
                             trace.metric('fallback.overflow_batches')
+                    if overflow.any():
+                        winner, conflicts, alive, overflow = \
+                            self._escalate(L, ctx, winner, conflicts,
+                                           alive, overflow)
                 else:
                     winner = conflicts = alive = np.zeros(0, np.int32)
                     overflow = np.zeros(0, np.uint8)
                 rank_arr = np.ascontiguousarray(rank, np.int32)
             with trace.span('host.mid'):
-                if L.amtpu_mid(bh, ip(winner), ip(conflicts), ctx['weff'],
+                if L.amtpu_mid(bh, ip(winner), ip(conflicts),
+                               self._mid_window(ctx, conflicts),
                                ip(alive), up(overflow),
                                ip(rank_arr), 0) != 0:
                     _raise_last()
@@ -875,6 +910,47 @@ class NativeDocPool:
         ptr = L.amtpu_result(bh, ctypes.byref(out_len))
         return ctypes.string_at(ptr, out_len.value) \
             if out_len.value else b'\x80'
+
+    @staticmethod
+    def _mid_window(ctx, conflicts):
+        """Conflicts-matrix width handed to amtpu_mid: the escalation
+        merge may have widened it beyond the dispatch window."""
+        return int(conflicts.shape[1]) if conflicts.ndim == 2 \
+            else ctx['weff']
+
+    def _escalate(self, L, ctx, winner, conflicts, alive, overflow):
+        """Tiered escalation ladder over the batch's register columns:
+        collects the tier dispatches (pre-dispatched async in phase a
+        when the flags were host-computed, dispatched here otherwise)
+        and merges the results, clearing the flags of resolved rows.
+        Rows still flagged afterwards -- groups wider than every tier /
+        over the scratch budget, or all of them under AMTPU_ESCALATE=0
+        -- take the C++ oracle replay in amtpu_mid and are counted as
+        fallback.oracle."""
+        from ..ops import registers as register_ops
+        esc = ctx.pop('esc', None)
+        if esc is None and register_ops.escalation_enabled():
+            T, Tp, A, Ap = ctx['dims'][:4]
+            CTp = ctx['dims'][8]
+            r = self._register_views(L, ctx['bh'], Tp, Ap, CTp)
+            esc = register_ops.escalate_overflow_dispatch(
+                r['g'], r['t'], r['a'], r['s'],
+                r['d'].astype(bool), r['ctab'], r['cidx'],
+                overflow.astype(bool))
+        if esc is not None:
+            resolved = register_ops.escalate_overflow_collect(esc[0])
+            if resolved:
+                winner = np.array(winner, np.int32)
+                conflicts = np.array(conflicts, np.int32)
+                alive = np.array(alive, np.int32)
+                overflow = np.array(overflow, np.uint8)
+                winner, conflicts, alive, overflow = \
+                    register_ops.merge_escalated(
+                        winner, conflicts, alive, overflow, resolved)
+        n_oracle = int(np.asarray(overflow, bool).sum())
+        if n_oracle:
+            trace.metric('fallback.oracle', n_oracle)
+        return winner, conflicts, alive, overflow
 
     def _gather_conflict_rows(self, reg_out, rows):
         """Lazy conflicts fetch: only registers that kept >1 member have
@@ -964,12 +1040,13 @@ class NativeDocPool:
     @staticmethod
     def _unpack_packed(packed):
         """Splits the packed [T] i32 register summary (24-bit winner,
-        0xffffff = none | 4-bit alive | 1-bit overflow) -- the single
-        source of truth for the transfer-packed bit layout."""
+        0xffffff = none | 6-bit alive, saturated at 63 | overflow in bit
+        30) -- the single source of truth for the transfer-packed bit
+        layout (ops/registers.py PACKED_ALIVE_MAX)."""
         winner = np.ascontiguousarray(packed & 0xffffff, np.int32)
         winner[winner == 0xffffff] = -1
-        alive = np.ascontiguousarray((packed >> 24) & 0xf, np.int32)
-        overflow = np.ascontiguousarray((packed >> 28) & 1, np.uint8)
+        alive = np.ascontiguousarray((packed >> 24) & 0x3f, np.int32)
+        overflow = np.ascontiguousarray((packed >> 30) & 1, np.uint8)
         return winner, alive, overflow
 
     def _run_dominance(self, L, bh):
@@ -1226,17 +1303,32 @@ class ShardedNativePool:
         # convention as NativeDocPool._ensure_mode_flags)
         self._n_shards = n_shards
         self._pools = None
+        # materialization lock: ANY entry point may be the first to touch
+        # the lazy properties from concurrent threads; without it two
+        # racers could each build a pool list and apply shards to pools
+        # the losing assignment discards
+        import threading
+        self._pools_lock = threading.Lock()
 
     @property
     def n_shards(self):
         if self._n_shards is None:
-            self._n_shards = self.default_shards(self.mode)
+            with self._pools_lock:
+                if self._n_shards is None:
+                    self._n_shards = self.default_shards(self.mode)
         return self._n_shards
 
     @property
     def pools(self):
+        # double-checked under the lock so every concurrent first-toucher
+        # observes the SAME pool list (no call site needs to pre-touch)
         if self._pools is None:
-            self._pools = [NativeDocPool() for _ in range(self.n_shards)]
+            # resolve n_shards BEFORE taking the lock: it acquires the
+            # same (non-reentrant) lock for its own lazy materialization
+            n = self.n_shards
+            with self._pools_lock:
+                if self._pools is None:
+                    self._pools = [NativeDocPool() for _ in range(n)]
         return self._pools
 
     def _shard_of(self, doc_id):
@@ -1246,10 +1338,9 @@ class ShardedNativePool:
     def apply_batch_bytes(self, payload):
         L = lib()
         t_batch = time.perf_counter()
-        # materialize the lazy pool list on THIS thread before any
-        # worker threads touch the property: two workers racing on
-        # `_pools is None` would each build a list and apply shards to
-        # pools the losing assignment discards
+        # warm the lazy pool list on THIS thread (the property itself is
+        # now lock-guarded, so this is an optimization -- jax backend
+        # resolution happens once here instead of inside a worker)
         self.pools
         with trace.span('shard.split'):
             sp = L.amtpu_shard_split(payload, len(payload), self.n_shards)

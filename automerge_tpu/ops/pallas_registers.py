@@ -196,8 +196,9 @@ def resolve_registers_pallas(group, time, actor, seq, is_del, sort_idx,
     }
     out['packed'] = (jnp.where(out['winner'] >= 0, out['winner'],
                                0xffffff).astype(jnp.int32)
-                     | (out['alive_after'] << 24)
-                     | (out['overflow'].astype(jnp.int32) << 28))
+                     | (jnp.minimum(out['alive_after'],
+                                    xla_registers.PACKED_ALIVE_MAX) << 24)
+                     | (out['overflow'].astype(jnp.int32) << 30))
     return out
 
 

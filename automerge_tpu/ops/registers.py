@@ -12,21 +12,32 @@ time_q <= t at the same register causally supersedes it
 (supersedes = NOT concurrent, reference op_set.js:7-16).  Supersession is
 evaluated over a fixed window of W predecessors -- register survivor sets are
 concurrent antichains, which stay tiny in real workloads; a full window
-(possible overflow) is flagged so the host can fall back to the oracle for
-that register, keeping byte parity always.
+(possible overflow) is flagged, and the host ESCALATES the flagged groups
+through wider member-window size classes (W in {16, 32, 64, ...}) in one
+re-dispatch per tier (`escalate_overflow`) -- still on device, still exact.
+The scalar oracle remains the parity REFEREE (differential tests), not the
+executor: only groups wider than every tier (AMTPU_MAX_TIER, default 1024
+candidate rows) ever reach the host oracle, and the fuzz/bench workloads
+never produce one.
 
 All ops across all docs are flattened into one array; groups are globally
 unique ids for (doc, obj, key), so no per-doc padding is needed.
 """
 
+import os
 from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-# Window of predecessors considered per op.  Conflict sets larger than this
-# overflow to the host oracle (rare: needs >W concurrent writers on one key).
+# Window of predecessors considered per op in the base dispatch.  Conflict
+# sets larger than this overflow and escalate through the tier ladder.
 WINDOW = 8
+
+# The packed transfer word carries alive_after in 6 bits (24..29),
+# saturated here; every packed-path consumer only tests alive > 0 / > 1.
+PACKED_ALIVE_MAX = 63
 
 
 @partial(jax.jit, static_argnames=('window',))
@@ -52,7 +63,8 @@ def resolve_registers_members(time, actor, seq, mem_idx, is_del,
 
     Returns the same dict as `resolve_registers`, in original row order;
     `overflow` is all-False (the host flags >window-stream groups itself
-    and routes them to the oracle fallback before dispatch).
+    and routes them through the escalation ladder -- a wider tier of this
+    same kernel -- before dispatch; see `escalate_overflow`).
     """
     T = time.shape[0]
     W = window
@@ -114,13 +126,10 @@ def resolve_registers_members(time, actor, seq, mem_idx, is_del,
         'visible_before': visible_before,
         'overflow': jnp.zeros((T,), jnp.bool_),
     }
-    if window > 14:
-        raise ValueError(
-            'packed alive_after field is 4 bits; window=%d overflows it '
-            '(max alive_after is window+1)' % window)
     out['packed'] = (jnp.where(out['winner'] >= 0, out['winner'],
                                0xffffff).astype(jnp.int32)
-                     | (out['alive_after'] << 24))
+                     | (jnp.minimum(out['alive_after'], PACKED_ALIVE_MAX)
+                        << 24))
     return out
 
 
@@ -157,7 +166,8 @@ def resolve_registers(group, time, actor, seq, clock=None, is_del=None,
       conflicts:   int32 [T, window] -- losing op indices, actor-descending,
                    -1 padded.
       visible_before: bool -- register non-empty just before this op.
-      overflow:    bool -- window saturated; host must re-resolve this group.
+      overflow:    bool -- window saturated; the host escalates this group
+                   through a wider kernel tier (`escalate_overflow`).
     """
     T = group.shape[0]
     W = window
@@ -256,17 +266,16 @@ def resolve_registers(group, time, actor, seq, clock=None, is_del=None,
         'overflow': jnp.zeros((T,), jnp.bool_).at[sort_idx].set(overflow),
     }
     # transfer-packed summary: winner (24 bits, 0xffffff = none) | alive
-    # (4 bits) | overflow (1 bit).  One [T] i32 D2H instead of four arrays;
-    # conflicts rows are fetched lazily only where alive > 1.  Callers must
-    # use the unpacked outputs when T >= 2**24.
-    if window > 14:
-        raise ValueError(
-            'packed alive_after field is 4 bits; window=%d overflows it '
-            '(max alive_after is window+1)' % window)
+    # (6 bits, SATURATED at PACKED_ALIVE_MAX -- consumers only test >0 and
+    # >1; the exact count stays in the unpacked alive_after) | overflow
+    # (bit 30).  One [T] i32 D2H instead of four arrays; conflicts rows
+    # are fetched lazily only where alive > 1.  Callers must use the
+    # unpacked outputs when T >= 2**24.
     out['packed'] = (jnp.where(out['winner'] >= 0, out['winner'],
                                0xffffff).astype(jnp.int32)
-                     | (out['alive_after'] << 24)
-                     | (out['overflow'].astype(jnp.int32) << 28))
+                     | (jnp.minimum(out['alive_after'], PACKED_ALIVE_MAX)
+                        << 24)
+                     | (out['overflow'].astype(jnp.int32) << 30))
     return out
 
 
@@ -412,3 +421,249 @@ def resolve_rank_dominate(group, time, actor, seq, clock_table, clock_idx,
     idx = dominance_grouped(v0, er, oe, orank, od, ov, chunk=chunk)
     combo = jnp.concatenate([reg['packed'], idx.reshape(-1)])
     return reg, rank, combo
+
+
+# ---------------------------------------------------------------------------
+# tiered escalation ladder (host driver)
+#
+# The base dispatch runs at WINDOW; groups it flags as overflowed are
+# re-encoded into a flat padded member-window layout and re-dispatched
+# through power-of-two size classes W in {16, 32, 64, ...} -- ONE device
+# pass per tier present in the batch, never one host replay per group.
+# Member candidates are the per-actor-LATEST rows of each stream (only
+# those can survive: an op with a newer same-actor successor is always
+# superseded), extended with every row of an actor's latest seq so that
+# same-change duplicate assigns -- the one shape the fixed member build
+# in native/core.cpp routes to overflow -- bucket into a (slightly
+# wider) tier instead of the oracle.  A group only reaches the host
+# oracle when its candidate width exceeds every tier (AMTPU_MAX_TIER).
+# ---------------------------------------------------------------------------
+
+#: smallest escalation tier; the ladder is floor, 2*floor, 4*floor, ...
+ESCALATION_FLOOR = 16
+
+#: widest tier before a group falls back to the host oracle
+#: (AMTPU_MAX_TIER overrides)
+DEFAULT_MAX_TIER = 1024
+
+#: cap on ONE tier dispatch's dominant device intermediate -- the
+#: [Tn, W+1, W+1] pairwise supersession tensor (i32).  Groups whose own
+#: padded cost exceeds this are memory-unboundable at any chunking and
+#: take the host oracle (counted fallback.oracle); multi-group tiers are
+#: CHUNKED into as many dispatches as the budget requires.  256 MB
+#: matches the dominance kernel's slab cap.  AMTPU_ESCALATE_BUDGET_MB
+#: overrides.
+DEFAULT_ESCALATION_BUDGET = 256 << 20
+
+
+def _escalation_budget():
+    mb = os.environ.get('AMTPU_ESCALATE_BUDGET_MB')
+    return (int(mb) << 20) if mb else DEFAULT_ESCALATION_BUDGET
+
+
+def escalation_enabled():
+    """AMTPU_ESCALATE=0 disables the ladder (every overflowed group then
+    takes the host oracle, the pre-escalation behaviour) -- an A/B and
+    parity-test hook, checked per batch."""
+    return os.environ.get('AMTPU_ESCALATE', '1') not in ('', '0')
+
+
+def _tier_of(n, floor=ESCALATION_FLOOR):
+    w = floor
+    while w < n:
+        w *= 2
+    return w
+
+
+def _dispatch_cost(n_rows, W):
+    """Bytes of the dominant [Tn, W+1, W+1] i32 intermediate of one
+    member-kernel dispatch, at the PADDED row count."""
+    return _tier_of(n_rows, ESCALATION_FLOOR) * (W + 1) * (W + 1) * 4
+
+
+def escalate_overflow(group, time, actor, seq, is_del, clock_table,
+                      clock_idx, overflow, floor=ESCALATION_FLOOR,
+                      max_tier=None):
+    """Resolves every row of every overflow-flagged register group through
+    wider member-window kernel tiers (synchronous composition of
+    `escalate_overflow_dispatch` + `escalate_overflow_collect`; pipelined
+    callers split the two so tier kernels overlap other host work).
+
+    Args (host numpy, original row order; padding rows carry group == -1):
+      group/time/actor/seq/is_del: the register columns fed to the base
+          dispatch.
+      clock_table, clock_idx: deduplicated clock rows (callers with a
+          dense [T, A] clock pass it as the table with clock_idx=arange).
+      overflow: [T] bool -- the base kernel's overflow flags (sliding
+          mode) or the host-computed member flags.  The WHOLE group of any
+          flagged row is re-resolved (flags may cover only the saturated
+          suffix).
+
+    Returns (resolved, oracle_rows, tier_rows):
+      resolved:   {row: (winner_row, [conflict_rows...], alive_after,
+                  visible_before)} -- indices are GLOBAL rows, covering
+                  every row of every escalated group.
+      oracle_rows: np.int32 [n] -- rows of groups wider than every tier
+                  OR too large for the device-scratch budget; the caller
+                  must resolve these with the host oracle.
+      tier_rows:  {W: row count} -- rows resolved per tier (the caller's
+                  telemetry source).
+    """
+    pending, oracle_rows, tier_rows = escalate_overflow_dispatch(
+        group, time, actor, seq, is_del, clock_table, clock_idx,
+        overflow, floor=floor, max_tier=max_tier)
+    return escalate_overflow_collect(pending), oracle_rows, tier_rows
+
+
+def escalate_overflow_dispatch(group, time, actor, seq, is_del,
+                               clock_table, clock_idx, overflow,
+                               floor=ESCALATION_FLOOR, max_tier=None):
+    """The dispatch half of the ladder: host member-window build + one
+    ASYNC kernel dispatch per tier chunk (device->host copies started,
+    never awaited).  Returns (pending, oracle_rows, tier_rows) where
+    `pending` is fed to `escalate_overflow_collect` -- callers with a
+    phased pipeline dispatch here (phase a) and collect after their
+    other host work (phase b), so tier kernels overlap it."""
+    from .. import telemetry
+
+    if max_tier is None:
+        max_tier = int(os.environ.get('AMTPU_MAX_TIER', DEFAULT_MAX_TIER))
+    group = np.asarray(group)
+    time = np.asarray(time)
+    actor = np.asarray(actor)
+    seq = np.asarray(seq)
+    is_del = np.asarray(is_del)
+    clock_idx = np.asarray(clock_idx, np.int32)
+
+    flagged = np.asarray(overflow, bool) & (group >= 0)
+    ovf_gids = np.unique(group[flagged])
+    pending = []
+    tier_rows = {}
+    if ovf_gids.size == 0:
+        return pending, np.zeros((0,), np.int32), tier_rows
+
+    # all rows of the flagged groups, in (group, time) order
+    sel = np.isin(group, ovf_gids)
+    sel_rows = np.nonzero(sel)[0]
+    order = np.lexsort((time[sel_rows], group[sel_rows]))
+    sel_rows = sel_rows[order]
+    bounds = np.nonzero(np.diff(group[sel_rows]))[0] + 1
+    group_row_blocks = np.split(sel_rows, bounds)
+
+    tiers = {}        # W -> [(rows list, member lists)]
+    oracle_rows = []
+    for rows in group_row_blocks:
+        streams = {}  # actor -> ([rows...], seq of those rows)
+        mem_lists = []
+        width = 0
+        for r in rows:
+            cands = [x for lst, _ in streams.values() for x in lst]
+            mem_lists.append(cands)
+            if len(cands) > width:
+                width = len(cands)
+            a, s = int(actor[r]), int(seq[r])
+            held = streams.get(a)
+            if held is not None and held[1] == s:
+                held[0].append(int(r))   # same-change duplicate assign
+            else:
+                streams[a] = ([int(r)], s)
+        W = _tier_of(max(width, 1), floor)
+        budget = _escalation_budget()
+        if W > max_tier or _dispatch_cost(len(rows), W) > budget:
+            # wider than every tier, or memory-unboundable at any
+            # chunking: the one remaining host-oracle route
+            oracle_rows.extend(int(r) for r in rows)
+            continue
+        tiers.setdefault(W, []).append((rows, mem_lists))
+        telemetry.ESCALATION_TIER.observe(W)
+
+    for W, entries in sorted(tiers.items()):
+        # chunk the tier so each dispatch's [Tn, W+1, W+1] intermediate
+        # stays under the scratch budget (a lone group always fits: the
+        # bucketing above sent oversized ones to the oracle)
+        budget = _escalation_budget()
+        chunks, cur, cur_rows = [], [], 0
+        for entry in entries:
+            n_rows = len(entry[0])
+            if cur and _dispatch_cost(cur_rows + n_rows, W) > budget:
+                chunks.append(cur)
+                cur, cur_rows = [], 0
+            cur.append(entry)
+            cur_rows += n_rows
+        chunks.append(cur)
+        for chunk in chunks:
+            sub_rows = np.concatenate([rows for rows, _ in chunk])
+            n = len(sub_rows)
+            Tn = _tier_of(n, ESCALATION_FLOOR)  # shape-bucketed padding
+            local = {int(r): i for i, r in enumerate(sub_rows)}
+            mem = np.full((Tn, W), -1, np.int32)
+            i = 0
+            for rows, mem_lists in chunk:
+                for cands in mem_lists:
+                    for k, c in enumerate(cands):
+                        mem[i, k] = local[c]
+                    i += 1
+
+            def pad(col, fill, dtype):
+                out = np.full((Tn,), fill, dtype)
+                out[:n] = col[sub_rows]
+                return out
+
+            with telemetry.span('device.escalate', tier=W, rows=n):
+                out = resolve_registers_members(
+                    pad(time, 0, np.int32), pad(actor, 0, np.int32),
+                    pad(seq, 0, np.int32), mem, pad(is_del, False, bool),
+                    clock_table, pad(clock_idx, 0, np.int32), window=W)
+                for k in ('winner', 'conflicts', 'alive_after',
+                          'visible_before'):
+                    if hasattr(out[k], 'copy_to_host_async'):
+                        out[k].copy_to_host_async()
+            pending.append((W, sub_rows, out))
+            tier_rows[W] = tier_rows.get(W, 0) + n
+            telemetry.metric('fallback.escalated.w%d' % W, n)
+
+    return pending, np.asarray(oracle_rows, np.int32), tier_rows
+
+
+def escalate_overflow_collect(pending):
+    """The collect half: awaits each tier dispatch's outputs and scatters
+    them into the global-row `resolved` map (`escalate_overflow`'s
+    contract)."""
+    resolved = {}
+    for _W, sub_rows, out in pending:
+        n = len(sub_rows)
+        win = np.asarray(out['winner'])[:n]
+        conf = np.asarray(out['conflicts'])[:n]
+        alive = np.asarray(out['alive_after'])[:n]
+        vb = np.asarray(out['visible_before'])[:n]
+        for i, r in enumerate(sub_rows):
+            w = int(win[i])
+            confs = [int(sub_rows[c]) for c in conf[i] if c >= 0]
+            resolved[int(r)] = (int(sub_rows[w]) if w >= 0 else -1,
+                                confs, int(alive[i]), bool(vb[i]))
+    return resolved
+
+
+def merge_escalated(winner, conflicts, alive, overflow, resolved):
+    """Scatters `escalate_overflow` results into the (host) register
+    output arrays, widening the conflicts matrix when a tier kept more
+    survivors than its column count, and CLEARING the overflow flag of
+    every resolved row -- flags left standing afterwards are exactly the
+    rows the caller must route to the host oracle.  Returns the four
+    (possibly replaced) arrays."""
+    if not resolved:
+        return winner, conflicts, alive, overflow
+    width = conflicts.shape[1] if conflicts.ndim == 2 else 0
+    need = max(len(c) for (_, c, _, _) in resolved.values())
+    if need > width:
+        wide = np.full((conflicts.shape[0], need), -1, conflicts.dtype)
+        wide[:, :width] = conflicts
+        conflicts = wide
+    for row, (w, confs, al, _vb) in resolved.items():
+        winner[row] = w
+        conflicts[row, :] = -1
+        if confs:
+            conflicts[row, :len(confs)] = confs
+        alive[row] = al
+        overflow[row] = 0
+    return winner, conflicts, alive, overflow

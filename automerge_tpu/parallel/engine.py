@@ -20,8 +20,12 @@ Per batch:
                  work never runs in Python.
 
 Registers whose concurrency window overflows (more than WINDOW live writers
-on one key) are re-resolved host-side with oracle semantics -- parity always
-wins over speed.
+on one key) ESCALATE through wider member-window kernel tiers
+(W in {16, 32, 64, ...}; `ops/registers.escalate_overflow`) -- one extra
+device pass per tier, still exact, counted per tier as
+`fallback.escalated.wN`.  The scalar oracle is the parity referee in the
+differential suites, not the executor: only a group wider than every tier
+(AMTPU_MAX_TIER) is replayed host-side, counted as `fallback.oracle`.
 
 The pool exposes the reference Backend surface per document
 (`apply_changes`, `get_patch`, `get_missing_changes`, `get_missing_deps`,
@@ -770,11 +774,33 @@ class TPUDocPool:
                 if v:
                     vis0[base + i] = 1.0
 
-        # host fallback for overflowed register groups: replay that group's
-        # ops sequentially with oracle semantics so BOTH the emitted register
-        # and the visibility timeline stay byte-faithful (parity wins)
+        # Overflowed register groups: re-dispatch through the tiered
+        # escalation ladder (wider member-window kernels, one device pass
+        # per tier) -- resolution stays on device and byte-faithful.  The
+        # host oracle replays ONLY groups wider than every tier (or all
+        # flagged groups when AMTPU_ESCALATE=0), counted as
+        # fallback.oracle; the fuzz/bench workloads never produce one.
         host_registers = {}
         if reg_out is not None and reg_out['overflow'].any():
+            if register_ops.escalation_enabled():
+                resolved, _oracle_rows, _tiers = \
+                    register_ops.escalate_overflow(
+                        g_arr[:T], t_arr[:T], a_arr[:T], s_arr[:T],
+                        d_arr[:T], c_arr, np.arange(T, dtype=np.int32),
+                        reg_out['overflow'])
+                if resolved:
+                    reg_out = {k: np.array(v) for k, v in reg_out.items()}
+                    (reg_out['winner'], reg_out['conflicts'],
+                     reg_out['alive_after'], reg_out['overflow']) = \
+                        register_ops.merge_escalated(
+                            reg_out['winner'], reg_out['conflicts'],
+                            reg_out['alive_after'], reg_out['overflow'],
+                            resolved)
+                    for row, (_w, _c, _al, vb) in resolved.items():
+                        reg_out['visible_before'][row] = vb
+        if reg_out is not None and reg_out['overflow'].any():
+            telemetry.metric('fallback.oracle',
+                             int(reg_out['overflow'].sum()))
             overflowed = set()
             for op_idx, row in assign_row_of_op.items():
                 if reg_out['overflow'][row]:

@@ -71,11 +71,28 @@ SYNC_MSGS = registry.counter(
     'amtpu_sync_messages_total', 'Connection sync messages processed',
     ('direction',))
 
-# fallback reasons pre-seeded into the exposition so dashboards see
-# explicit zeros before the first degradation (the same names
-# trace.metric('fallback.<reason>') call sites emit)
+# fallback reasons pre-seeded into the exposition AND every bench_block
+# so dashboards/gates see explicit zeros before the first degradation
+# (the same names trace.metric('fallback.<reason>') call sites emit).
+# 'oracle' counts register rows that actually reached the host oracle
+# after the escalation ladder; 'escalated.wN' counts rows resolved on
+# device by the W=N tier (make fallback-check asserts oracle == 0 with
+# the tier counters present).
 KNOWN_FALLBACK_REASONS = ('layout_batches', 'overflow_batches',
-                          'overflow_rows', 'member_overflow_rows')
+                          'overflow_rows', 'member_overflow_rows',
+                          'oracle', 'escalated.w16', 'escalated.w32',
+                          'escalated.w64')
+
+# escalation tier widths are powers of two: exact log2 bucket bounds
+ESCALATION_TIER_BUCKETS = tuple(float(2 ** i) for i in range(4, 15))
+
+# tier histogram: one observation per escalated register GROUP at the
+# tier width that resolved it -- the distribution of live-writer
+# antichain widths the ladder actually served
+ESCALATION_TIER = registry.histogram(
+    'amtpu_escalation_tier_width',
+    'Escalation-ladder tier width (W) observed per escalated register '
+    'group', buckets=ESCALATION_TIER_BUCKETS)
 
 
 # ---------------------------------------------------------------------------
@@ -227,10 +244,12 @@ def bench_block():
     """The per-BENCH-line embed: fallback rates, device seconds, batch
     latency summaries, and (when tracing) the phase occupancy table."""
     flat = metrics_snapshot()
-    block = {
-        'fallbacks': {k.split('.', 1)[1]: round(v, 6)
+    fallbacks = {r: 0.0 for r in KNOWN_FALLBACK_REASONS}
+    fallbacks.update({k.split('.', 1)[1]: round(v, 6)
                       for k, v in flat.items()
-                      if k.startswith('fallback.')},
+                      if k.startswith('fallback.')})
+    block = {
+        'fallbacks': fallbacks,
         'device_s': round(flat.get('device.dispatch_sync_s', 0.0), 4),
         'device_dispatches': int(flat.get('device.dispatches', 0)),
         'batch_latency': BATCH_LATENCY.snapshot() or {},

@@ -902,6 +902,7 @@ static ChangeRec decode_change(Reader& r, Pool& pool,
   DecodeCache local_dc;
   DecodeCache& dc = dcp ? *dcp : local_dc;
   bool ops_inline = false;
+  u32 stamp_actor = NONE, stamp_seq = 0;  // actor/seq at inline decode
   for (size_t i = 0; i < n; ++i) {
     const uint8_t* pair_start = r.pos();
     std::string_view k = r.read_str_view();
@@ -933,6 +934,8 @@ static ChangeRec decode_change(Reader& r, Pool& pool,
         // canonical envelope order ({actor, seq, deps, ops, ...}): ops
         // decode inline in one walk
         ops_inline = true;
+        stamp_actor = ch.actor;
+        stamp_seq = ch.seq;
         // duplicate 'ops' keys follow last-wins like every other
         // envelope field (and the reference's JS object semantics)
         ch.ops.clear();
@@ -983,6 +986,16 @@ static ChangeRec decode_change(Reader& r, Pool& pool,
                             static_cast<size_t>(ops_end - ops_start) / 4));
     for (size_t j = 0; j < ops_count; ++j)
       ch.ops.push_back(decode_op(ro, pool, ch.actor, ch.seq, dc));
+  } else if (ops_inline &&
+             (ch.actor != stamp_actor || ch.seq != stamp_seq)) {
+    // a malformed envelope repeated 'actor'/'seq' with a DIFFERENT value
+    // after the 'ops' key: the envelope fields are last-wins (JS object
+    // semantics, matching the span re-parse path), so re-stamp the
+    // already-decoded ops with the final values
+    for (OpRec& op : ch.ops) {
+      op.actor = ch.actor;
+      op.seq = ch.seq;
+    }
   }
   return ch;
 }
@@ -1063,8 +1076,11 @@ struct Fenwick {
   void reset(size_t n) { t.assign(n + 1, 0); }
   void add(i32 i, i32 d) {
     // i == -1 (an unranked arena row reaching a sweep) would loop
-    // forever: x starts at 0 and x & -x stays 0
-    assert(i >= 0);
+    // forever: x starts at 0 and x & -x stays 0.  Throw instead of
+    // assert so -DNDEBUG release builds fail loudly rather than hang
+    // (matching every other internal-invariant violation).
+    if (i < 0)
+      throw Error(0, "Fenwick add on unranked (negative) index");
     for (i32 x = i + 1; x < static_cast<i32>(t.size()); x += x & -x)
       t[x] += d;
   }
@@ -1119,8 +1135,8 @@ struct Batch {
   std::vector<i32> k_winner, k_conflicts, k_alive;
   std::vector<u8> k_overflow;
   // packed-mode alternative: the kernel's packed word per row (24-bit
-  // winner | 4-bit alive | overflow bit) + conflicts only for the rare
-  // rows that kept >1 member
+  // winner | 6-bit alive, saturated at 63 | overflow in bit 30) +
+  // conflicts only for the rare rows that kept >1 member
   std::vector<i32> k_packed;
   FlatMap<std::array<i32, 8>> sparse_conflicts;
   bool packed_mode = false;
@@ -1762,13 +1778,16 @@ static void encode(Pool& pool, Batch& b) {
 
     // Hot keys: when any group holds more rows than the sliding window,
     // the window fills with dead sequential versions and the conservative
-    // overflow rule would punt most of the batch to the host oracle.
+    // overflow rule would punt most of the batch off the fast path.
     // Build explicit member windows instead: each row's candidates are
     // the LATEST row per actor stream on its key (only those can survive
     // -- an op with a newer same-actor successor is always superseded).
     // Overflow then means >WINDOW genuinely concurrent streams, or a
     // change assigning one key twice (same actor+seq rows, which the
-    // window cannot hold) -- both routed to the exact host fallback.
+    // window cannot hold) -- both flagged host_ovf, which the Python
+    // driver ESCALATES through wider member-window kernel tiers
+    // (ops/registers.escalate_overflow); only groups wider than every
+    // tier reach the mid-phase host oracle below.
     const int W = 8;   // ops/registers.WINDOW
     if (max_count > W) {
       b.use_members = true;
@@ -2253,7 +2272,7 @@ static void host_dominance(Batch& b) {
         if (hit != b.host_registers.end()) {
           alive_now = !hit->second.empty();
         } else if (b.packed_mode) {
-          alive_now = ((b.k_packed[e.reg_row] >> 24) & 0xf) > 0;
+          alive_now = ((b.k_packed[e.reg_row] >> 24) & 0x3f) > 0;
         } else {
           alive_now = b.k_alive[e.reg_row] > 0;
         }
@@ -2354,7 +2373,7 @@ static void register_from_kernel(Batch& b, i64 row, Register& reg) {
     const i32 packed = b.k_packed[row];
     const i32 w = packed & 0xffffff;
     if (w != 0xffffff) reg.push_back(*b.src_records[w]);
-    if (((packed >> 24) & 0xf) > 1) {
+    if (((packed >> 24) & 0x3f) > 1) {
       auto* conf = b.sparse_conflicts.find(static_cast<u64>(row));
       if (conf) {
         for (int c = 0; c < b.window && c < 8; ++c) {

@@ -7,7 +7,8 @@ patches at every delivery -- the same contract as
 tests/test_engine_differential.py, pointed at:
 
   * wide antichains: >8 concurrent writer streams per key (member-window
-    overflow -> hostreg / oracle fallback), on maps AND list elements;
+    overflow -> tiered kernel escalation, hostreg on the CPU backend; the
+    host oracle only as parity referee), on maps AND list elements;
   * deep cross-doc causal chains delivered fully reversed (the causal
     queue fixpoint, not the in-order fast path);
   * undo/redo interleaved with remote merges (undo-stack capture against
@@ -80,9 +81,12 @@ def exec_mode(request):
 
 
 class TestWideAntichains:
-    """Register groups wider than every kernel window."""
+    """Register groups wider than the base kernel window: every width
+    must resolve through the escalation ladder (n writers -> n-1
+    candidates: 9/15/17 land in the w16 tier, 33 in w32),
+    byte-identical to the oracle in both execution modes."""
 
-    @pytest.mark.parametrize('n_writers', [12, 20])
+    @pytest.mark.parametrize('n_writers', [9, 12, 15, 17, 20, 33])
     def test_map_hot_keys(self, n_writers, exec_mode):
         rng = random.Random(seed_base(501) + n_writers)
         changes = []
@@ -132,6 +136,105 @@ class TestWideAntichains:
             writers.append({'actor': 'w%02d' % a, 'seq': 1,
                             'deps': {'base': 1}, 'ops': [op]})
         deliver_all([{0: [base]}, {0: writers}])
+
+
+class TestEscalationFallbackFree:
+    """The ISSUE-2 acceptance lanes: the kernel path must be oracle-free
+    on every width the ladder serves -- including the table-adversarial
+    shape (same-change dup assigns) that produced the recorded 8,532
+    oracle-fallback rows, and a 100+ concurrent-live-writer antichain."""
+
+    def _assert_kernel_fallback_free(self, run, exec_mode,
+                                     expect_escalated=True):
+        from automerge_tpu import telemetry
+        telemetry.metrics_reset()
+        run()
+        snap = telemetry.metrics_snapshot()
+        assert snap.get('fallback.oracle', 0) == 0, snap
+        if expect_escalated:
+            assert any(k.startswith('fallback.escalated.w') and v > 0
+                       for k, v in snap.items()), (exec_mode, snap)
+
+    @pytest.mark.parametrize('n_writers', [9, 15, 17, 33, 100, 120])
+    def test_concurrent_live_writers_one_key(self, n_writers, exec_mode):
+        """n fully concurrent live writers on one key in ONE batch: the
+        widest antichain shape, resolved without a single oracle row."""
+        writers = [{'actor': 'w%03d' % a, 'seq': 1, 'deps': {},
+                    'ops': [{'action': 'set', 'obj': ROOT_ID, 'key': 'k',
+                             'value': 'w%03d' % a}]}
+                   for a in range(n_writers)]
+        self._assert_kernel_fallback_free(
+            lambda: deliver_all([{0: writers}]), exec_mode)
+
+    def test_table_shape_dup_assigns(self, exec_mode):
+        """Config-4-shaped rows: concurrent field updates where changes
+        assign the SAME row key twice (the member-window-unholdable
+        shape) -- previously all-oracle, now all-ladder."""
+        rng = random.Random(seed_base(60603))
+        n_actors = 9
+        rows = ['row-%d' % i for i in range(6)]
+        setup = {'actor': 'setup', 'seq': 1, 'deps': {}, 'ops':
+                 [{'action': 'makeTable', 'obj': 'tb'},
+                  {'action': 'link', 'obj': ROOT_ID, 'key': 'rows',
+                   'value': 'tb'}] +
+                 [op for r in rows for op in (
+                     {'action': 'makeMap', 'obj': r},
+                     {'action': 'set', 'obj': r, 'key': 'n', 'value': -1},
+                     {'action': 'link', 'obj': 'tb', 'key': r,
+                      'value': r})]}
+        updates = []
+        for a in range(n_actors):
+            ops = []
+            for _ in range(8):   # 8 picks of 6 rows: dup assigns certain
+                r = rows[rng.randrange(len(rows))]
+                ops.append({'action': 'set', 'obj': r, 'key': 'n',
+                            'value': rng.randrange(1000)})
+            updates.append({'actor': 'a%d' % a, 'seq': 1,
+                            'deps': {'setup': 1}, 'ops': ops})
+        self._assert_kernel_fallback_free(
+            lambda: deliver_all([{0: [setup]}, {0: updates}]), exec_mode)
+
+    def test_oracle_referee_parity(self, exec_mode):
+        """AMTPU_ESCALATE=0 pins the referee: the host oracle must
+        produce byte-identical patches to the ladder (and the run must
+        actually take the oracle path -- fallback.oracle > 0)."""
+        from automerge_tpu import telemetry
+        prior = os.environ.get('AMTPU_ESCALATE')
+        os.environ['AMTPU_ESCALATE'] = '0'
+        try:
+            telemetry.metrics_reset()
+            writers = [{'actor': 'w%02d' % a, 'seq': 1, 'deps': {},
+                        'ops': [{'action': 'set', 'obj': ROOT_ID,
+                                 'key': 'k', 'value': a}]}
+                       for a in range(20)]
+            deliver_all([{0: writers}])
+            snap = telemetry.metrics_snapshot()
+            assert snap.get('fallback.oracle', 0) > 0, snap
+        finally:
+            if prior is None:
+                os.environ.pop('AMTPU_ESCALATE', None)
+            else:
+                os.environ['AMTPU_ESCALATE'] = prior
+
+    def test_wide_antichain_with_list_dominance(self, exec_mode):
+        """30 concurrent writers on ONE list element register: escalation
+        must compose with the dominance stage, not just map emits."""
+        base = {'actor': 'base', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'makeList', 'obj': 'l'},
+            {'action': 'link', 'obj': ROOT_ID, 'key': 'list',
+             'value': 'l'},
+            {'action': 'ins', 'obj': 'l', 'key': '_head', 'elem': 1},
+            {'action': 'set', 'obj': 'l', 'key': 'base:1', 'value': 'v0'}]}
+        writers = []
+        for a in range(30):
+            op = ({'action': 'del', 'obj': 'l', 'key': 'base:1'}
+                  if a == 13 else
+                  {'action': 'set', 'obj': 'l', 'key': 'base:1',
+                   'value': 'w%02d' % a})
+            writers.append({'actor': 'w%02d' % a, 'seq': 1,
+                            'deps': {'base': 1}, 'ops': [op]})
+        self._assert_kernel_fallback_free(
+            lambda: deliver_all([{0: [base]}, {0: writers}]), exec_mode)
 
 
 class TestReversedCausalChains:

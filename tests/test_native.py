@@ -426,6 +426,41 @@ def test_wide_overflow_register_conflicts_emit_correctly():
     assert len(final['conflicts']) == 19
 
 
+def test_duplicate_actor_seq_after_ops_last_wins():
+    """Malformed envelope repeating 'actor'/'seq' with DIFFERENT values
+    AFTER the 'ops' key: the inline-decoded ops must be re-stamped with
+    the final (last-wins) values, matching the span-reparse path and JS
+    object semantics -- previously they kept the stale attribution."""
+    import msgpack
+
+    from automerge_tpu.native import NativeDocPool
+    ops = [{'action': 'set', 'obj': ROOT_ID, 'key': 'k', 'value': 7}]
+    # canonical reference: the change as a JS object would decode it
+    ref = native_pool()
+    ref_patch = ref.apply_changes('doc', [
+        {'actor': 'zzz', 'seq': 1, 'deps': {}, 'ops': ops}])
+
+    # malformed wire form: actor 'aaa' triggers inline op decode, then
+    # 'actor'/'seq' repeat after 'ops' with the values that must win
+    body = (msgpack.packb('actor') + msgpack.packb('aaa') +
+            msgpack.packb('seq') + msgpack.packb(9) +
+            msgpack.packb('deps') + msgpack.packb({}) +
+            msgpack.packb('ops') + msgpack.packb(ops) +
+            msgpack.packb('actor') + msgpack.packb('zzz') +
+            msgpack.packb('seq') + msgpack.packb(1))
+    change = b'\x86' + body                        # fixmap, 6 pairs
+    key = NativeDocPool._doc_key('doc')
+    payload = (b'\x81' + msgpack.packb(key) +      # {doc: [change]}
+               b'\x91' + change)
+    nat = native_pool()
+    got = msgpack.unpackb(nat.apply_batch_bytes(payload), raw=False)[key]
+    assert got == ref_patch
+    assert got['clock'] == {'zzz': 1}
+    # the register record itself carries the re-stamped attribution
+    reg = nat.get_register('doc', ROOT_ID, 'k')
+    assert [(r['actor'], r['seq']) for r in reg] == [('zzz', 1)]
+
+
 class TestHostDominanceParity:
     """A/B parity between the two dominance implementations: the device
     kernel (`ops/pallas_dominance.py` / the fused dispatch) and the C++

@@ -350,6 +350,192 @@ class TestRegisters:
         assert np.asarray(out['conflicts'])[1, 0] == 1       # A's op loses
 
 
+class TestEscalationLadder:
+    """escalate_overflow must equal an exact wide sliding-window dispatch
+    on every overflowed group -- for antichain widths spanning several
+    tiers (9, 15, 17, 33, 100+ concurrent live writers) and for the one
+    shape member windows alone cannot hold (same-change dup assigns)."""
+
+    def _concurrent_group(self, n_writers, base_time=0, gid=0, A=None):
+        """Rows of one group: n_writers fully concurrent single-seq
+        writers (empty clocks)."""
+        rows = []
+        for i in range(n_writers):
+            rows.append((gid, base_time + i, i, 1, False))
+        return rows
+
+    def _dispatch(self, rows, A, dels=()):
+        T = len(rows)
+        group = np.array([r[0] for r in rows], np.int32)
+        time = np.array([r[1] for r in rows], np.int32)
+        actor = np.array([r[2] for r in rows], np.int32)
+        seq = np.array([r[3] for r in rows], np.int32)
+        is_del = np.array([r[4] for r in rows], bool)
+        ctab = np.zeros((T, A), np.int32)
+        cidx = np.arange(T, dtype=np.int32)
+        return group, time, actor, seq, is_del, ctab, cidx
+
+    @pytest.mark.parametrize('n_writers', [9, 15, 17, 33, 100, 130])
+    def test_matches_wide_sliding_window(self, n_writers):
+        from automerge_tpu.ops import registers as R
+        cols = self._dispatch(self._concurrent_group(n_writers),
+                              A=n_writers)
+        group, time, actor, seq, is_del, ctab, cidx = cols
+        T = len(group)
+        ref = R.resolve_registers(
+            group, time, actor, seq, is_del=is_del,
+            alive_in=np.ones(T, bool), window=T,
+            sort_idx=np.lexsort((time, group)).astype(np.int32),
+            clock_table=ctab, clock_idx=cidx)
+        ref = {k: np.asarray(v) for k, v in ref.items()}
+        ovf = np.zeros(T, bool)
+        ovf[-1] = True   # flag one saturated row; the WHOLE group escalates
+        resolved, oracle_rows, tiers = R.escalate_overflow(
+            group, time, actor, seq, is_del, ctab, cidx, ovf)
+        assert oracle_rows.size == 0
+        assert len(resolved) == T
+        expect_tier = R._tier_of(n_writers - 1, R.ESCALATION_FLOOR)
+        assert list(tiers) == [expect_tier], tiers
+        for row, (w, confs, alive, vb) in resolved.items():
+            assert w == ref['winner'][row]
+            assert confs == [c for c in ref['conflicts'][row] if c >= 0]
+            assert alive == ref['alive_after'][row]
+            assert vb == bool(ref['visible_before'][row])
+
+    def test_dup_assign_same_change(self):
+        """A change assigning one key twice (same actor+seq rows): the
+        fixed member build can't hold it; the ladder's dup-extended
+        streams must."""
+        from automerge_tpu.ops import registers as R
+        rows = self._concurrent_group(10)
+        rows.append((0, 10, 4, 1, False))   # actor 4 assigns again, seq 1
+        rows.append((0, 11, 4, 1, False))   # ...and a third time
+        cols = self._dispatch(rows, A=10)
+        group, time, actor, seq, is_del, ctab, cidx = cols
+        T = len(group)
+        ref = R.resolve_registers(
+            group, time, actor, seq, is_del=is_del,
+            alive_in=np.ones(T, bool), window=T,
+            sort_idx=np.lexsort((time, group)).astype(np.int32),
+            clock_table=ctab, clock_idx=cidx)
+        ref = {k: np.asarray(v) for k, v in ref.items()}
+        resolved, oracle_rows, _ = R.escalate_overflow(
+            group, time, actor, seq, is_del, ctab, cidx,
+            np.ones(T, bool))
+        assert oracle_rows.size == 0
+        for row, (w, confs, alive, _vb) in resolved.items():
+            assert w == ref['winner'][row]
+            assert confs == [c for c in ref['conflicts'][row] if c >= 0]
+            assert alive == ref['alive_after'][row]
+
+    def test_multi_group_multi_tier_and_oracle_residue(self):
+        """Groups of different widths bucket into different tiers in one
+        call; a group wider than max_tier comes back as oracle rows."""
+        from automerge_tpu.ops import registers as R
+        rows = []
+        rows += self._concurrent_group(9, base_time=0, gid=0)
+        rows += self._concurrent_group(33, base_time=100, gid=1)
+        rows += self._concurrent_group(40, base_time=200, gid=2)
+        cols = self._dispatch(rows, A=40)
+        group, time, actor, seq, is_del, ctab, cidx = cols
+        T = len(group)
+        resolved, oracle_rows, tiers = R.escalate_overflow(
+            group, time, actor, seq, is_del, ctab, cidx,
+            np.ones(T, bool), max_tier=32)
+        # gid 2 needs W=64 > max_tier -> oracle residue, whole group
+        assert sorted(oracle_rows.tolist()) == list(range(42, 82))
+        assert set(tiers) == {16, 32}
+        assert len(resolved) == 42
+        # unflagged groups are untouched
+        resolved2, oracle2, tiers2 = R.escalate_overflow(
+            group, time, actor, seq, is_del, ctab, cidx,
+            np.zeros(T, bool))
+        assert not resolved2 and not oracle2.size and not tiers2
+
+    def test_scratch_budget_chunks_and_oracle_residue(self):
+        """The [Tn, W+1, W+1] scratch budget: a tier of many groups is
+        CHUNKED into several dispatches (all still resolved), while a
+        single group too large for any chunking takes the oracle."""
+        import os
+
+        from automerge_tpu.ops import registers as R
+        # six groups of 300 rows each (12 actors x 25 sequential rounds:
+        # width stays 12 -> tier 16, but the row count is what the
+        # budget must chunk); clocks make each actor's later write
+        # supersede its earlier ones
+        rows = []
+        t = 0
+        for g in range(6):
+            for s in range(1, 26):
+                for a in range(12):
+                    rows.append((g, t, a, s, False))
+                    t += 1
+        group = np.array([r[0] for r in rows], np.int32)
+        time = np.array([r[1] for r in rows], np.int32)
+        actor = np.array([r[2] for r in rows], np.int32)
+        seq = np.array([r[3] for r in rows], np.int32)
+        is_del = np.zeros(len(rows), bool)
+        T = len(rows)
+        ctab = np.zeros((T, 12), np.int32)
+        ctab[np.arange(T), actor] = seq - 1
+        cidx = np.arange(T, dtype=np.int32)
+        prior = os.environ.get('AMTPU_ESCALATE_BUDGET_MB')
+        os.environ['AMTPU_ESCALATE_BUDGET_MB'] = '1'
+        try:
+            # one group fits a dispatch; two do not -> the tier chunks
+            assert R._dispatch_cost(300, 16) <= 1 << 20
+            assert R._dispatch_cost(600, 16) > 1 << 20
+            resolved, oracle_rows, tiers = R.escalate_overflow(
+                group, time, actor, seq, is_del, ctab, cidx,
+                np.ones(T, bool))
+            assert oracle_rows.size == 0
+            assert len(resolved) == T          # every row still resolved
+            assert tiers == {16: T}
+            ref = R.resolve_registers(
+                group, time, actor, seq, is_del=is_del,
+                alive_in=np.ones(T, bool), window=16,
+                sort_idx=np.lexsort((time, group)).astype(np.int32),
+                clock_table=ctab, clock_idx=cidx)
+            refw = np.asarray(ref['winner'])
+            refa = np.asarray(ref['alive_after'])
+            for row, (w, _c, a_, _vb) in resolved.items():
+                assert w == refw[row]
+                assert a_ == refa[row]
+            # a single group whose own padded cost exceeds the budget
+            # is memory-unboundable -> oracle residue, not an OOM
+            wide = self._dispatch(self._concurrent_group(600), A=600)
+            g2, t2, a2, s2, d2, ct2, ci2 = wide
+            r2, oracle2, tiers2 = R.escalate_overflow(
+                g2, t2, a2, s2, d2, ct2, ci2, np.ones(600, bool))
+            assert not r2 and not tiers2
+            assert oracle2.size == 600
+        finally:
+            if prior is None:
+                os.environ.pop('AMTPU_ESCALATE_BUDGET_MB', None)
+            else:
+                os.environ['AMTPU_ESCALATE_BUDGET_MB'] = prior
+
+    def test_packed_word_saturates_alive(self):
+        """Widened packed layout: alive saturates at 63 (bits 24..29),
+        overflow rides bit 30, winner keeps its 24 bits."""
+        from automerge_tpu.ops import registers as R
+        n = 70   # survivors > PACKED_ALIVE_MAX
+        cols = self._dispatch(self._concurrent_group(n), A=n)
+        group, time, actor, seq, is_del, ctab, cidx = cols
+        out = R.resolve_registers(
+            group, time, actor, seq, is_del=is_del,
+            alive_in=np.ones(n, bool), window=n,
+            sort_idx=np.lexsort((time, group)).astype(np.int32),
+            clock_table=ctab, clock_idx=cidx)
+        packed = np.asarray(out['packed'])
+        alive = np.asarray(out['alive_after'])
+        last = int(np.argmax(alive))          # row with all 70 alive
+        assert alive[last] == n
+        assert (packed[last] >> 24) & 0x3f == R.PACKED_ALIVE_MAX
+        assert (packed[last] & 0xffffff) == np.asarray(out['winner'])[last]
+        assert (packed[last] >> 30) & 1 == 0
+
+
 class TestPallasDominance:
     """The Pallas TPU kernel must equal the XLA kernel bit-for-bit; on the
     CPU test mesh it runs through the Pallas interpreter."""

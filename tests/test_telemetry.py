@@ -399,7 +399,12 @@ def test_bench_block_shape():
                     'changes': [CH]})
     telemetry.metric('fallback.overflow_batches', 2)
     block = telemetry.bench_block()
-    assert block['fallbacks'] == {'overflow_batches': 2}
+    # every KNOWN reason is pre-seeded at 0 (the fallback-check gate
+    # reads presence, not just values); observed counters overlay
+    assert block['fallbacks']['overflow_batches'] == 2
+    for reason in telemetry.KNOWN_FALLBACK_REASONS:
+        assert reason in block['fallbacks'], reason
+    assert block['fallbacks']['oracle'] == 0
     assert block['batch_latency']['engine']['count'] == 1
     assert block['ops_total'] >= 1 and block['docs_total'] >= 1
     assert 'engine.kernels' in block['phases']

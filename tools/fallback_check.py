@@ -1,0 +1,77 @@
+"""CI gate: the kernel path must be oracle-free on the table workload.
+
+Runs a config-4-shaped smoke (the shape that produced 8,532 host-oracle
+rows before the escalation ladder, VERDICT r5) through tools/quickbench.py
+with the kernel path forced (AMTPU_HOST_FULL=0), then fails if
+
+  * the telemetry block reports ANY `fallback.oracle` count -- a register
+    group fell past every escalation tier back to the host oracle, or
+  * the per-tier escalation counters (`fallback.escalated.wN`) are absent
+    from the block -- the bench line stopped proving where resolution
+    work landed, or
+  * nothing escalated at all -- the smoke no longer exercises the ladder
+    and the gate would be vacuously green.
+
+Wired into `make check` as `make fallback-check`.
+
+Usage: [JAX_PLATFORMS=cpu] python tools/fallback_check.py
+"""
+import json
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def main():
+    env = dict(os.environ)
+    env.setdefault('JAX_PLATFORMS', 'cpu')
+    env['AMTPU_HOST_FULL'] = '0'            # the kernel path IS the subject
+    # deterministic shape: enough docs that the seeded workload grows a
+    # register group past the base window (member mode engages and every
+    # same-change dup-assign group escalates), and a PINNED shard count
+    # so the doc->shard split doesn't vary with the host's core count
+    env.setdefault('AMTPU_BENCH_C4_DOCS', '256')
+    env.setdefault('AMTPU_BENCH_SHARDS', '8')
+    proc = subprocess.run(
+        [sys.executable, os.path.join(HERE, 'quickbench.py'),
+         '--config', '4', '--runs', '1'],
+        env=env, stdout=subprocess.PIPE, text=True)
+    if proc.returncode != 0:
+        print('fallback-check: quickbench smoke failed (rc=%d)'
+              % proc.returncode, file=sys.stderr)
+        return 1
+    line = proc.stdout.strip().splitlines()[-1]
+    result = json.loads(line)
+    fallbacks = result.get('telemetry', {}).get('fallbacks', {})
+
+    tiers = {k: v for k, v in fallbacks.items()
+             if k.startswith('escalated.w')}
+    problems = []
+    if 'oracle' not in fallbacks:
+        problems.append("no 'oracle' counter in the telemetry block")
+    elif fallbacks['oracle'] != 0:
+        problems.append('kernel path reported %s fallback.oracle rows'
+                        % fallbacks['oracle'])
+    if not tiers:
+        problems.append('per-tier escalation counters absent from the '
+                        'telemetry block')
+    elif sum(tiers.values()) <= 0:
+        problems.append('smoke did not exercise the escalation ladder '
+                        '(all tier counters zero)')
+    if problems:
+        print('fallback-check FAILED:', file=sys.stderr)
+        for p in problems:
+            print('  * ' + p, file=sys.stderr)
+        print('  telemetry.fallbacks = %s' % json.dumps(fallbacks),
+              file=sys.stderr)
+        return 1
+    active = {k: v for k, v in tiers.items() if v}
+    print('fallback-check: oracle=0, escalated tiers %s, %.0f ops/s'
+          % (json.dumps(active), result.get('value', 0.0)))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
