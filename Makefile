@@ -1,7 +1,7 @@
 # Top-level developer entry points.
 
 .PHONY: all native test bench bench-all bench-tpu check clean wheel \
-	telemetry-check fallback-check
+	telemetry-check fallback-check perf-smoke
 
 all: native
 
@@ -50,6 +50,7 @@ check: native
 	JAX_PLATFORMS=cpu python -c "import __graft_entry__ as g; \
 	  g.dryrun_multichip(8); print('dryrun ok')"
 	$(MAKE) fallback-check
+	$(MAKE) perf-smoke
 	@echo "CHECK GREEN"
 
 # Escalation-ladder gate (ISSUE 2): a config-4-shaped smoke on the
@@ -58,6 +59,13 @@ check: native
 # workload may never fall back to host-oracle register resolution again.
 fallback-check: native
 	JAX_PLATFORMS=cpu python tools/fallback_check.py
+
+# Packed-epilogue gate (ISSUE 3): the same config-4 smoke must be served
+# by the packed member epilogue (collect.packed_member_batches > 0) with
+# ZERO full-matrix readbacks and fallback.oracle == 0 -- the collect
+# transfer wall may not silently return.
+perf-smoke: native
+	JAX_PLATFORMS=cpu python tools/perf_smoke.py
 
 # Observability gate (docs/OBSERVABILITY.md): idle telemetry must be
 # free.  Interleaved A/B of the disabled path vs a no-op-patched "raw"

@@ -14,6 +14,7 @@ zero-Python wire path the sidecar serves.
 import ctypes
 import os
 import subprocess
+import threading
 import time
 
 import msgpack
@@ -98,11 +99,23 @@ def _load():
         ctypes.c_int,
         ctypes.POINTER(ctypes.c_int32),
         ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int32)]
+    lib.amtpu_esc_dims.argtypes = [ctypes.c_void_p,
+                                   ctypes.POINTER(ctypes.c_int64)]
+    lib.amtpu_esc_group_meta.restype = ctypes.POINTER(ctypes.c_int64)
+    lib.amtpu_esc_group_meta.argtypes = [ctypes.c_void_p]
+    lib.amtpu_esc_rows.restype = ctypes.POINTER(ctypes.c_int32)
+    lib.amtpu_esc_rows.argtypes = [ctypes.c_void_p]
+    lib.amtpu_esc_mem_off.restype = ctypes.POINTER(ctypes.c_int64)
+    lib.amtpu_esc_mem_off.argtypes = [ctypes.c_void_p]
+    lib.amtpu_esc_mem.restype = ctypes.POINTER(ctypes.c_int32)
+    lib.amtpu_esc_mem.argtypes = [ctypes.c_void_p]
     lib.amtpu_mid_packed.restype = ctypes.c_int
     lib.amtpu_mid_packed.argtypes = [
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
         ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
-        ctypes.c_int64, ctypes.POINTER(ctypes.c_int32)]
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int]
     lib.amtpu_finish.restype = ctypes.c_int
     lib.amtpu_finish.argtypes = [ctypes.c_void_p]
     lib.amtpu_host_dominance.restype = ctypes.c_int
@@ -195,13 +208,127 @@ def _take_buf(ptr, length):
         lib().amtpu_buf_free(ptr)
 
 
+# ---------------------------------------------------------------------------
+# batch-handle accounting: every amtpu_begin* success increments, every
+# free decrements -- the assertion hook tests use to prove a phase-a
+# failure cannot leak the C++ batch handle (each handle owns the whole
+# decoded batch, so a leak under sustained error traffic is unbounded
+# memory growth).
+# ---------------------------------------------------------------------------
+
+_live_lock = threading.Lock()
+_live_batches = 0
+
+
+def _track_begin():
+    global _live_batches
+    with _live_lock:
+        _live_batches += 1
+
+
+def _free_batch(bh):
+    """The ONLY way batch handles are freed: pairs the counter with the
+    C++ free so live_batch_handles() stays truthful."""
+    global _live_batches
+    lib().amtpu_batch_free(bh)
+    with _live_lock:
+        _live_batches -= 1
+
+
+def live_batch_handles():
+    """Currently allocated C++ batch handles (test/leak-audit hook)."""
+    with _live_lock:
+        return _live_batches
+
+
+def _packed_epilogue_on():
+    """AMTPU_PACKED_EPILOGUE=0 forces the full-matrix member epilogue
+    (the pre-packed readback path, kept as the parity A/B arm); default
+    on.  Checked per batch, not latched."""
+    return os.environ.get('AMTPU_PACKED_EPILOGUE', '1') not in ('', '0')
+
+
+def _conf_dense_thresh():
+    """Dense-conflicts switch factor: the row-gather kernel saves
+    nothing once `conf_rows * thresh > Tp` -- transfer the whole matrix
+    and slice host-side instead.  AMTPU_CONF_DENSE_THRESH overrides the
+    default factor 4 (0 disables the dense path entirely)."""
+    try:
+        return int(os.environ.get('AMTPU_CONF_DENSE_THRESH', '4'))
+    except ValueError:
+        return 4
+
+
+def _ctx_ready(ctx):
+    """True when every device output phase b will block on has already
+    resolved -- the ready-order collect predicate.  Host-only modes
+    (hostreg) are always ready."""
+    for arr in _ctx_pending_arrays(ctx):
+        is_ready = getattr(arr, 'is_ready', None)
+        if is_ready is not None and not is_ready():
+            return False
+    return True
+
+
+def _ctx_pending_arrays(ctx):
+    out = []
+    combo = ctx.get('combo')
+    if combo is not None:
+        out.append(combo)
+    elif ctx.get('reg_out') is not None:
+        out.append(ctx['reg_out']['packed'])
+    esc = ctx.get('esc')
+    if esc:
+        out.extend(t_out['packed'] for _w, _rows, t_out in esc[0])
+    return out
+
+
+def _collect_ready_order(entries, on_result=None, on_error=None):
+    """Drives phase b over (key, pool, ctx) entries READY-FIRST: each
+    round picks the first entry whose dispatched device outputs have
+    already resolved (jax.Array.is_ready) and runs its host mid/emit;
+    only when nothing is ready does it block on the oldest submission.
+    One slow shard then no longer stalls shards whose results are
+    already sitting in host memory -- shard k's C++ mid/emit overlaps
+    shard k+1's in-flight device wait (ISSUE 3 tentpole b).
+
+    Every entry runs to completion regardless of earlier failures (their
+    begins have committed state); errors go to `on_error(key, exc)`."""
+    pending = list(entries)
+    while pending:
+        pick = None
+        for i, (_key, _pool, ctx) in enumerate(pending):
+            if _ctx_ready(ctx):
+                pick = i
+                break
+        if pick is None:
+            # nothing resolved yet: block on the oldest submission
+            pick = 0
+            trace.metric('collect.wait_in_order')
+        elif pick > 0:
+            trace.metric('collect.ready_reorder')
+        key, pool, ctx = pending.pop(pick)
+        try:
+            result = pool._phase_b(ctx)
+            if on_result is not None:
+                on_result(key, result)
+        except Exception as e:
+            if on_error is not None:
+                on_error(key, e)
+            else:
+                raise
+        finally:
+            _free_batch(ctx['bh'])
+
+
 def apply_payloads_pipelined(pools_payloads):
     """Applies (NativeDocPool, payload_bytes) pairs with host/device
     overlap: every pool's begin + kernel dispatch runs first (phase a),
-    then results collect and emit in order (phase b) -- pool k's device
-    work overlaps pool k+1's host begin, the same pattern
-    ShardedNativePool uses across shards.  The PUBLIC entry for fanning a
-    round of independent deliveries (replica catch-up) over many pools.
+    then results collect and emit ready-first (phase b) -- pool k's
+    device work overlaps pool k+1's host begin AND pool j's mid/emit,
+    the same pattern ShardedNativePool uses across shards.  The PUBLIC
+    entry for fanning a round of independent deliveries (replica
+    catch-up) over many pools.
 
     Pools that already began successfully still run to completion when a
     later one fails; the first error is re-raised afterwards."""
@@ -209,16 +336,11 @@ def apply_payloads_pipelined(pools_payloads):
     errors = []
     for pool, payload in pools_payloads:
         try:
-            ctxs.append((pool, pool._phase_a(payload)))
+            ctxs.append((None, pool, pool._phase_a(payload)))
         except Exception as e:
             errors.append(e)
-    for pool, ctx in ctxs:
-        try:
-            pool._phase_b(ctx)
-        except Exception as e:
-            errors.append(e)
-        finally:
-            lib().amtpu_batch_free(ctx['bh'])
+    _collect_ready_order(ctxs,
+                         on_error=lambda _k, e: errors.append(e))
     if errors:
         raise errors[0]
 
@@ -399,7 +521,7 @@ class NativeDocPool:
         try:
             out = self._phase_b(ctx)
         finally:
-            lib().amtpu_batch_free(ctx['bh'])
+            _free_batch(ctx['bh'])
         # doc count comes free from the payload's map header; a tuple
         # payload is a shard sub-call whose docs the sharded top level
         # already counted
@@ -432,6 +554,7 @@ class NativeDocPool:
             bh = L.amtpu_begin(self._pool, data, n)
         if not bh:
             _raise_last()
+        _track_begin()
         return self._phase_a_rest(bh)
 
     def _phase_a_rest(self, bh):
@@ -540,11 +663,8 @@ class NativeDocPool:
                 if hovf is not None and hovf.any():
                     from ..ops import registers as register_ops
                     if register_ops.escalation_enabled():
-                        r = self._register_views(L, bh, Tp, Ap, CTp)
-                        ctx['esc'] = register_ops.escalate_overflow_dispatch(
-                            r['g'], r['t'], r['a'], r['s'],
-                            r['d'].astype(bool), r['ctab'], r['cidx'],
-                            hovf.astype(bool))
+                        ctx['esc'] = self._escalation_dispatch(
+                            L, ctx, hovf.astype(bool))
             if devtime:
                 # AMTPU_DEVTIME=1: block on the dispatched outputs and
                 # record the synchronous dispatch+compute time.  This
@@ -560,7 +680,11 @@ class NativeDocPool:
                     trace.metric('device.dispatches')
             return ctx
         except Exception:
-            L.amtpu_batch_free(bh)
+            # phase-a failure frees its OWN handle (callers only see an
+            # exception, never a ctx to free); the live-handle counter
+            # stays balanced -- tests assert live_batch_handles() == 0
+            # after forced phase-a errors
+            _free_batch(bh)
             raise
 
     def _register_views(self, L, bh, Tp, Ap, CTp):
@@ -609,7 +733,8 @@ class NativeDocPool:
             if mem is not None:
                 reg_out = register_ops.resolve_registers_members(
                     r['t'], r['a'], r['s'], mem, r['d'].astype(bool),
-                    r['ctab'], r['cidx'], window=ctx['weff'])
+                    r['ctab'], r['cidx'], window=ctx['weff'],
+                    want_visible_before=False)
             else:
                 # Pallas stencil kernel on TPU (VMEM-resident pairwise
                 # temporaries), XLA twin elsewhere -- bit-equal outputs
@@ -776,21 +901,13 @@ class NativeDocPool:
                     fallback = bool((packed >> 30 & 1).any())
                     if not fallback:
                         # conflicts stay SPARSE: only rows whose register
-                        # kept >1 member carry a conflict list.  When the
-                        # workload is conflict-DENSE (hot-key maps: most
-                        # rows keep >1 member) the row-gather kernel
-                        # saves nothing -- transfer the whole matrix once
-                        # and slice host-side instead.
+                        # kept >1 member carry a conflict list (the
+                        # dense-workload switch lives in
+                        # _fetch_conflict_rows)
                         conf_rows = np.nonzero(
                             (packed >> 24 & 0x3f) > 1)[0].astype(np.int32)
-                        if conf_rows.size * 4 > Tp:
-                            allconf = np.asarray(
-                                ctx['reg_out']['conflicts'])
-                            conf_vals = np.ascontiguousarray(
-                                allconf[conf_rows], np.int32)
-                        else:
-                            conf_vals = self._gather_conflict_rows(
-                                ctx['reg_out'], conf_rows)
+                        conf_vals = self._fetch_conflict_rows(
+                            ctx['reg_out'], conf_rows, Tp)
             if fallback:
                 # >window concurrent writers on some register: re-fetch
                 # the full outputs + rank, escalate the flagged groups
@@ -801,6 +918,7 @@ class NativeDocPool:
                 trace.metric('fallback.overflow_batches')
                 trace.metric('fallback.overflow_rows',
                              int((packed >> 30 & 1).sum()))
+                trace.metric('collect.full_matrix_readback')
                 reg_out = ctx['reg_out']
                 winner = np.ascontiguousarray(reg_out['winner'], np.int32)
                 conflicts = np.ascontiguousarray(reg_out['conflicts'],
@@ -836,45 +954,72 @@ class NativeDocPool:
                         trace.metric('device.dispatches')
             else:
                 hostdom = ctx.get('hostdom')
+                conf_offs = np.arange(conf_rows.size + 1,
+                                      dtype=np.int32) * ctx['weff']
                 with trace.span('host.mid'):
                     if L.amtpu_mid_packed(
                             bh, ip(packed), ctx['weff'], ip(conf_rows),
-                            ip(conf_vals), len(conf_rows),
-                            None if hostdom else ip(dom_idx)) != 0:
+                            ip(conf_offs), ip(conf_vals), len(conf_rows),
+                            None, None, None if hostdom else ip(dom_idx),
+                            1 if hostdom else 0) != 0:
                         _raise_last()
                 if hostdom:
                     with trace.span('host.dominance'):
                         if L.amtpu_host_dominance(bh) != 0:
                             _raise_last()
         else:
-            with trace.span('device.collect'):
-                reg_out, rank = ctx['reg_out'], ctx['rank']
-                if Tp > 0:
-                    winner, conflicts, alive, overflow = \
-                        self._unpack_register_out(reg_out, Tp)
-                    if ctx.get('hovf') is not None:
-                        # member mode: overflow is host-decided (>WINDOW
-                        # concurrent streams / same-change dup assigns)
-                        overflow = np.array(ctx['hovf'], np.uint8)
-                        n_ovf = int(overflow.sum())
-                        if n_ovf:
-                            trace.metric('fallback.member_overflow_rows',
-                                         n_ovf)
-                            trace.metric('fallback.overflow_batches')
-                    if overflow.any():
+            reg_out, rank = ctx['reg_out'], ctx['rank']
+            # Packed member epilogue (ISSUE 3 tentpole a): member-mode
+            # batches transfer ONE i32 per register row + a sparse CSR
+            # conflict gather instead of the full O(Tp x W) matrices;
+            # escalation-tier results merge into the packed word, and
+            # only the ladder's residue rides the C++ oracle replay.
+            if (Tp > 0 and ctx.get('hovf') is not None
+                    and Tp < (1 << 24) and _packed_epilogue_on()):
+                with trace.span('device.collect'):
+                    (packed, conf_rows, conf_offs, conf_vals,
+                     residual) = self._collect_member_packed(
+                        ctx, reg_out, Tp)
+                    rank_arr = np.ascontiguousarray(rank, np.int32)
+                trace.metric('collect.packed_member_batches')
+                with trace.span('host.mid'):
+                    if L.amtpu_mid_packed(
+                            bh, ip(packed), ctx['weff'], ip(conf_rows),
+                            ip(conf_offs), ip(conf_vals), len(conf_rows),
+                            None if residual is None else up(residual),
+                            ip(rank_arr), None, 0) != 0:
+                        _raise_last()
+            else:
+                with trace.span('device.collect'):
+                    if Tp > 0:
+                        trace.metric('collect.full_matrix_readback')
                         winner, conflicts, alive, overflow = \
-                            self._escalate(L, ctx, winner, conflicts,
-                                           alive, overflow)
-                else:
-                    winner = conflicts = alive = np.zeros(0, np.int32)
-                    overflow = np.zeros(0, np.uint8)
-                rank_arr = np.ascontiguousarray(rank, np.int32)
-            with trace.span('host.mid'):
-                if L.amtpu_mid(bh, ip(winner), ip(conflicts),
-                               self._mid_window(ctx, conflicts),
-                               ip(alive), up(overflow),
-                               ip(rank_arr), 0) != 0:
-                    _raise_last()
+                            self._unpack_register_out(reg_out, Tp)
+                        if ctx.get('hovf') is not None:
+                            # member mode: overflow is host-decided
+                            # (>WINDOW concurrent streams / same-change
+                            # dup assigns)
+                            overflow = np.array(ctx['hovf'], np.uint8)
+                            n_ovf = int(overflow.sum())
+                            if n_ovf:
+                                trace.metric(
+                                    'fallback.member_overflow_rows',
+                                    n_ovf)
+                                trace.metric('fallback.overflow_batches')
+                        if overflow.any():
+                            winner, conflicts, alive, overflow = \
+                                self._escalate(L, ctx, winner, conflicts,
+                                               alive, overflow)
+                    else:
+                        winner = conflicts = alive = np.zeros(0, np.int32)
+                        overflow = np.zeros(0, np.uint8)
+                    rank_arr = np.ascontiguousarray(rank, np.int32)
+                with trace.span('host.mid'):
+                    if L.amtpu_mid(bh, ip(winner), ip(conflicts),
+                                   self._mid_window(ctx, conflicts),
+                                   ip(alive), up(overflow),
+                                   ip(rank_arr), 0) != 0:
+                        _raise_last()
             t0 = time.perf_counter() if _devtime_on() else 0.0
             with trace.span('device.dominance'):
                 self._run_dominance(L, bh)
@@ -918,6 +1063,50 @@ class NativeDocPool:
         return int(conflicts.shape[1]) if conflicts.ndim == 2 \
             else ctx['weff']
 
+    def _esc_layout_groups(self, L, bh):
+        """CSR group records from the C++ escalation layout
+        (amtpu_esc_*), built at begin for member-mode overflow --
+        replaces the host-side window re-derivation.  None when the
+        batch carries no layout (sliding-mode overflow, AMTPU_WEFF)."""
+        dims = (ctypes.c_int64 * 3)()
+        L.amtpu_esc_dims(bh, dims)
+        n_groups, R, M = [int(x) for x in dims]
+        if n_groups == 0:
+            return None
+        meta = np.ctypeslib.as_array(L.amtpu_esc_group_meta(bh),
+                                     shape=(n_groups, 3))
+        rows_all = np.ctypeslib.as_array(L.amtpu_esc_rows(bh), shape=(R,))
+        off = np.ctypeslib.as_array(L.amtpu_esc_mem_off(bh),
+                                    shape=(R + 1,))
+        vals_all = np.ctypeslib.as_array(L.amtpu_esc_mem(bh),
+                                         shape=(M,)) if M else \
+            np.zeros(0, np.int32)
+        groups = []
+        for gi in range(n_groups):
+            rs, k, width = (int(meta[gi, 0]), int(meta[gi, 1]),
+                            int(meta[gi, 2]))
+            groups.append((rows_all[rs:rs + k],
+                           np.diff(off[rs:rs + k + 1]),
+                           vals_all[off[rs]:off[rs + k]], width))
+        return groups
+
+    def _escalation_dispatch(self, L, ctx, flagged):
+        """Tier-ladder dispatch for this batch's flagged rows: prefers
+        the C++-prebuilt member layout; falls back to the generic host
+        window build (sliding-mode overflow has no layout)."""
+        from ..ops import registers as register_ops
+        Tp, Ap = ctx['dims'][1], ctx['dims'][3]
+        CTp = ctx['dims'][8]
+        r = self._register_views(L, ctx['bh'], Tp, Ap, CTp)
+        groups = self._esc_layout_groups(L, ctx['bh'])
+        if groups is not None:
+            return register_ops.escalate_dispatch_groups(
+                groups, r['t'], r['a'], r['s'], r['d'].astype(bool),
+                r['ctab'], r['cidx'], want_visible_before=False)
+        return register_ops.escalate_overflow_dispatch(
+            r['g'], r['t'], r['a'], r['s'], r['d'].astype(bool),
+            r['ctab'], r['cidx'], flagged, want_visible_before=False)
+
     def _escalate(self, L, ctx, winner, conflicts, alive, overflow):
         """Tiered escalation ladder over the batch's register columns:
         collects the tier dispatches (pre-dispatched async in phase a
@@ -930,27 +1119,112 @@ class NativeDocPool:
         from ..ops import registers as register_ops
         esc = ctx.pop('esc', None)
         if esc is None and register_ops.escalation_enabled():
-            T, Tp, A, Ap = ctx['dims'][:4]
-            CTp = ctx['dims'][8]
-            r = self._register_views(L, ctx['bh'], Tp, Ap, CTp)
-            esc = register_ops.escalate_overflow_dispatch(
-                r['g'], r['t'], r['a'], r['s'],
-                r['d'].astype(bool), r['ctab'], r['cidx'],
-                overflow.astype(bool))
+            esc = self._escalation_dispatch(L, ctx,
+                                            overflow.astype(bool))
         if esc is not None:
-            resolved = register_ops.escalate_overflow_collect(esc[0])
-            if resolved:
+            chunks = register_ops.escalate_overflow_collect_arrays(esc[0])
+            if chunks:
                 winner = np.array(winner, np.int32)
                 conflicts = np.array(conflicts, np.int32)
                 alive = np.array(alive, np.int32)
                 overflow = np.array(overflow, np.uint8)
                 winner, conflicts, alive, overflow = \
-                    register_ops.merge_escalated(
-                        winner, conflicts, alive, overflow, resolved)
+                    register_ops.merge_escalated_arrays(
+                        winner, conflicts, alive, overflow, chunks)
         n_oracle = int(np.asarray(overflow, bool).sum())
         if n_oracle:
             trace.metric('fallback.oracle', n_oracle)
         return winner, conflicts, alive, overflow
+
+    def _collect_member_packed(self, ctx, reg_out, Tp):
+        """Packed member epilogue (ISSUE 3 tentpole a): ONE [Tp] i32
+        word + a sparse CSR conflict gather cross the device boundary
+        instead of the full winner/conflicts/alive/overflow matrices
+        (_unpack_register_out).  Escalation-tier results merge INTO the
+        packed word host-side -- their conflicts ride the same CSR at
+        tier width -- and rows the ladder could not resolve stay flagged
+        in the returned residual vector for the C++ oracle replay
+        (fallback.oracle).
+
+        Returns (packed [Tp] i32, conf_rows, conf_offs, conf_vals,
+        residual u8 [Tp] | None)."""
+        from ..ops import registers as register_ops
+        packed = np.asarray(reg_out['packed'])
+        flagged = np.asarray(ctx['hovf']).astype(bool)
+        residual = None
+        esc_parts = []            # (global rows, global conflicts) pairs
+        if flagged.any():
+            trace.metric('fallback.member_overflow_rows',
+                         int(flagged.sum()))
+            trace.metric('fallback.overflow_batches')
+            esc = ctx.pop('esc', None)
+            if esc is None and register_ops.escalation_enabled():
+                # flags are host-computed, so phase a normally
+                # pre-dispatched the tiers; dispatch late if it could not
+                esc = self._escalation_dispatch(lib(), ctx, flagged)
+            packed = np.array(packed)            # writable copy
+            residual = np.array(np.asarray(ctx['hovf']), np.uint8)
+            if esc is not None:
+                for ch in register_ops.escalate_overflow_collect_arrays(
+                        esc[0]):
+                    packed[ch.rows] = register_ops.pack_register_word(
+                        ch.winner, ch.alive)
+                    residual[ch.rows] = 0
+                    if ch.conf_rows.size:
+                        esc_parts.append((ch.rows[ch.conf_rows],
+                                          ch.conflicts))
+            n_oracle = int(residual.sum())
+            if n_oracle:
+                trace.metric('fallback.oracle', n_oracle)
+            else:
+                residual = None
+        # base sparse conflicts: rows OUTSIDE flagged groups that kept
+        # more than one member (flagged groups' base-kernel output is
+        # invalid -- they re-resolved in the tiers or the oracle replay)
+        base_mask = ((packed >> 24) & 0x3f) > 1
+        if flagged.any():
+            base_mask &= ~flagged
+        conf_rows_b = np.nonzero(base_mask)[0].astype(np.int32)
+        conf_vals_b = self._fetch_conflict_rows(reg_out, conf_rows_b, Tp)
+        weff = ctx['weff']
+        if not esc_parts:
+            conf_offs = np.arange(conf_rows_b.size + 1,
+                                  dtype=np.int32) * weff
+            conf_vals = np.ascontiguousarray(conf_vals_b, np.int32) \
+                .reshape(-1)
+            return packed, conf_rows_b, conf_offs, conf_vals, residual
+        rows_parts = [conf_rows_b]
+        vals_parts = [np.ascontiguousarray(conf_vals_b,
+                                           np.int32).reshape(-1)]
+        lens = [np.full(conf_rows_b.size, weff, np.int32)]
+        for rows_g, conf_g in esc_parts:
+            rows_parts.append(np.ascontiguousarray(rows_g, np.int32))
+            vals_parts.append(np.ascontiguousarray(conf_g,
+                                                   np.int32).reshape(-1))
+            lens.append(np.full(rows_g.size, conf_g.shape[1], np.int32))
+        conf_rows = np.ascontiguousarray(np.concatenate(rows_parts),
+                                         np.int32)
+        conf_offs = np.zeros(conf_rows.size + 1, np.int32)
+        np.cumsum(np.concatenate(lens), out=conf_offs[1:])
+        conf_vals = np.ascontiguousarray(np.concatenate(vals_parts),
+                                         np.int32)
+        return packed, conf_rows, conf_offs, conf_vals, residual
+
+    def _fetch_conflict_rows(self, reg_out, conf_rows, Tp):
+        """Sparse-vs-dense conflicts fetch: the device row gather wins
+        while >1-member rows are rare; once `conf_rows * thresh > Tp`
+        (AMTPU_CONF_DENSE_THRESH, default 4; 0 disables the dense path)
+        the whole [Tp, W] matrix transfers once and slices host-side
+        instead.  Each choice is counted: collect.conflict_sparse /
+        collect.conflict_dense."""
+        thresh = _conf_dense_thresh()
+        if thresh and conf_rows.size * thresh > Tp:
+            trace.metric('collect.conflict_dense')
+            allconf = np.asarray(reg_out['conflicts'])
+            return np.ascontiguousarray(allconf[conf_rows], np.int32)
+        if conf_rows.size:
+            trace.metric('collect.conflict_sparse')
+        return self._gather_conflict_rows(reg_out, conf_rows)
 
     def _gather_conflict_rows(self, reg_out, rows):
         """Lazy conflicts fetch: only registers that kept >1 member have
@@ -1005,7 +1279,8 @@ class NativeDocPool:
             if mem is not None:
                 reg_out = register_ops.resolve_registers_members(
                     r['t'], r['a'], r['s'], mem, r['d'].astype(bool),
-                    r['ctab'], r['cidx'], window=weff)
+                    r['ctab'], r['cidx'], window=weff,
+                    want_visible_before=False)
             else:
                 reg_out = register_ops.resolve_registers(
                     r['g'], r['t'], r['a'], r['s'],
@@ -1126,11 +1401,12 @@ class NativeDocPool:
                                          len(payload))
         if not bh:
             _raise_last()
+        _track_begin()
         ctx = self._phase_a_rest(bh)
         try:
             out = self._phase_b(ctx)
         finally:
-            lib().amtpu_batch_free(bh)
+            _free_batch(bh)
         return msgpack.unpackb(out, raw=False, strict_map_key=False)[key]
 
     def get_patch(self, doc_id):
@@ -1382,31 +1658,31 @@ class ShardedNativePool:
         return out
 
     def _run_pipelined(self, subs):
-        """Phase a for every shard, then phase b for every shard.  A shard
-        error must NOT leave *other* shards half-applied (their begin has
-        already committed state), so every healthy shard still runs to
-        completion and the first error is re-raised afterwards -- matching
-        the threads-mode semantics."""
-        L = lib()
-        ctxs = [None] * self.n_shards
+        """Phase a for every shard, then phase b READY-FIRST: shards
+        whose device outputs already resolved collect and emit before a
+        slow shard that happens to sit earlier in submission order
+        (_collect_ready_order).  A shard error must NOT leave *other*
+        shards half-applied (their begin has already committed state),
+        so every healthy shard still runs to completion and the first
+        error is re-raised afterwards -- matching the threads-mode
+        semantics."""
+        ctxs = []
         results = [None] * self.n_shards
         errors = []
         for s in range(self.n_shards):
             if subs[s] is None:
                 continue
             try:
-                ctxs[s] = self.pools[s]._phase_a(subs[s])
+                ctxs.append((s, self.pools[s], self.pools[s]._phase_a(
+                    subs[s])))
             except Exception as e:
                 errors.append((s, e))
-        for s in range(self.n_shards):
-            if ctxs[s] is None:
-                continue
-            try:
-                results[s] = self.pools[s]._phase_b(ctxs[s])
-            except Exception as e:
-                errors.append((s, e))
-            finally:
-                L.amtpu_batch_free(ctxs[s]['bh'])
+
+        def keep(s, result):
+            results[s] = result
+
+        _collect_ready_order(ctxs, on_result=keep,
+                             on_error=lambda s, e: errors.append((s, e)))
         _raise_shard_errors(errors)
         return results
 

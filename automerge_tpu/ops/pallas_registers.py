@@ -194,11 +194,8 @@ def resolve_registers_pallas(group, time, actor, seq, is_del, sort_idx,
         'overflow':
             jnp.zeros((T,), jnp.bool_).at[sort_idx].set(ovf_s > 0),
     }
-    out['packed'] = (jnp.where(out['winner'] >= 0, out['winner'],
-                               0xffffff).astype(jnp.int32)
-                     | (jnp.minimum(out['alive_after'],
-                                    xla_registers.PACKED_ALIVE_MAX) << 24)
-                     | (out['overflow'].astype(jnp.int32) << 30))
+    out['packed'] = xla_registers.pack_register_word(
+        out['winner'], out['alive_after'], out['overflow'])
     return out
 
 

@@ -25,6 +25,8 @@ unique ids for (doc, obj, key), so no per-doc padding is needed.
 """
 
 import os
+import threading
+from collections import namedtuple
 from functools import partial
 
 import jax
@@ -40,9 +42,45 @@ WINDOW = 8
 PACKED_ALIVE_MAX = 63
 
 
-@partial(jax.jit, static_argnames=('window',))
+def pack_register_word(winner, alive_after, overflow=None):
+    """Encodes the packed [T] i32 transfer word: winner (24 bits,
+    0xffffff = none) | alive_after (6 bits, saturated at
+    PACKED_ALIVE_MAX) | overflow in bit 30.  Works on jnp and np arrays;
+    the decode twin is NativeDocPool._unpack_packed."""
+    xp = jnp if isinstance(winner, jnp.ndarray) else np
+    word = (xp.where(winner >= 0, winner, 0xffffff).astype(xp.int32)
+            | (xp.minimum(alive_after, PACKED_ALIVE_MAX).astype(xp.int32)
+               << 24))
+    if overflow is not None:
+        word = word | (overflow.astype(xp.int32) << 30)
+    return word
+
+
+def _order_by_paircount(m_actor, m_time, alive, m_src, W):
+    """Winner/conflicts from member arrays without a sort: position by
+    pairwise count over (actor desc, time desc) -- times are unique, so
+    the order is total -- then scatter through a position one-hot.
+    Returns (winner [T], conflicts [T, W]) with -1 padding."""
+    a_u = m_actor[:, :, None]
+    a_v = m_actor[:, None, :]
+    t_u = m_time[:, :, None]
+    t_v = m_time[:, None, :]
+    precede = alive[:, None, :] & \
+        ((a_v > a_u) | ((a_v == a_u) & (t_v > t_u)))          # v before u
+    pos = jnp.sum(precede.astype(jnp.int32), axis=2)          # [T, W+1]
+    src = jnp.where(alive, m_src, -1)
+    winner = jnp.sum(jnp.where((pos == 0) & alive, src + 1, 0), axis=1) - 1
+    kpos = jax.lax.broadcasted_iota(jnp.int32,
+                                    (m_actor.shape[0], W + 1, W), 2)
+    poh = (pos[:, :, None] == kpos + 1) & alive[:, :, None]
+    conflicts = jnp.sum(jnp.where(poh, (src + 1)[:, :, None], 0), axis=1) - 1
+    return winner, conflicts
+
+
+@partial(jax.jit, static_argnames=('window', 'want_visible_before'))
 def resolve_registers_members(time, actor, seq, mem_idx, is_del,
-                              clock_table, clock_idx, window=WINDOW):
+                              clock_table, clock_idx, window=WINDOW,
+                              want_visible_before=True):
     """Member-explicit register resolution -- EXACT for up to `window`
     concurrent actor streams per key.
 
@@ -65,11 +103,16 @@ def resolve_registers_members(time, actor, seq, mem_idx, is_del,
     `overflow` is all-False (the host flags >window-stream groups itself
     and routes them through the escalation ladder -- a wider tier of this
     same kernel -- before dispatch; see `escalate_overflow`).
+
+    `want_visible_before=False` drops the visible_before output AND its
+    compute (a second [T, W+1, W+1] reduction chain) -- the native
+    packed epilogue never reads it (C++ tracks its own running
+    visibility); only the engine path and the fused dominance derivation
+    need it.
     """
     T = time.shape[0]
     W = window
-    clock = clock_table[clock_idx]
-    A = clock.shape[1]
+    A = clock_table.shape[1]
 
     valid_m = mem_idx >= 0                                    # [T, W]
     midx = jnp.clip(mem_idx, 0, T - 1)
@@ -81,7 +124,12 @@ def resolve_registers_members(time, actor, seq, mem_idx, is_del,
     m_seq = seq[all_idx]
     m_time = time[all_idx]
     m_del = is_del[all_idx]
-    m_clock = clock[all_idx]                                  # [T, W+1, A]
+    # member clocks gather INDICES first, then rows from the compact
+    # deduplicated table: [T, W+1] small gather + [T, W+1, A] gather out
+    # of CTp rows beats materializing [T, A] and gathering the blown-up
+    # matrix (measured ~2x on the whole kernel, XLA:CPU config 4)
+    m_cidx = clock_idx[all_idx]                               # [T, W+1]
+    m_clock = clock_table[m_cidx]                             # [T, W+1, A]
 
     onehot = jax.nn.one_hot(m_actor, A, dtype=jnp.int32)
     P = jnp.einsum('tua,tva->tuv', m_clock, onehot)           # [T,W+1,W+1]
@@ -97,39 +145,35 @@ def resolve_registers_members(time, actor, seq, mem_idx, is_del,
     superseded = jnp.any(supersedes, axis=1)                  # [T, W+1]
     alive = all_valid & ~superseded & ~m_del
 
-    superseded_wo_self = jnp.any(supersedes[:, 1:, :], axis=1)
-    alive_before = all_valid & ~superseded_wo_self & ~m_del
-    visible_before = jnp.any(alive_before[:, 1:], axis=1)
+    visible_before = None
+    if want_visible_before:
+        superseded_wo_self = jnp.any(supersedes[:, 1:, :], axis=1)
+        alive_before = all_valid & ~superseded_wo_self & ~m_del
+        visible_before = jnp.any(alive_before[:, 1:], axis=1)
 
     alive_after = jnp.sum(alive, axis=1).astype(jnp.int32)
 
-    # winner/conflicts order: actor desc, ties newest-first.  Composite
-    # int64 keys are unavailable on default-precision TPU, so compose two
-    # stable argsorts: time desc first, then actor desc.
-    t_order = jnp.argsort(-m_time, axis=1, stable=True)
-    actor_t = jnp.take_along_axis(m_actor, t_order, axis=1)
-    alive_t = jnp.take_along_axis(alive, t_order, axis=1)
-    src_t = jnp.take_along_axis(all_idx, t_order, axis=1)
-    actor_keyed = jnp.where(alive_t, actor_t, -1)
-    a_order = jnp.argsort(-actor_keyed, axis=1, stable=True)
-    sorted_alive = jnp.take_along_axis(alive_t, a_order, axis=1)
-    sorted_src = jnp.where(sorted_alive,
-                           jnp.take_along_axis(src_t, a_order, axis=1), -1)
-
-    winner = sorted_src[:, 0]
-    conflicts = sorted_src[:, 1:]
+    # winner/conflicts order: actor desc, ties newest-first.  Ordering
+    # WITHOUT argsort: times are unique, so each alive member's output
+    # position is a PAIRWISE COUNT --
+    #   pos(u) = #{v alive : actor_v > actor_u
+    #              or (actor_v == actor_u and time_v > time_u)}
+    # -- and winner/conflicts scatter through a position one-hot.  The
+    # same formulation as the Pallas stencil kernel, bit-equal to the
+    # two-stable-argsort epilogue it replaced; a stable argsort over
+    # [T, W+1] was the single costliest op of this kernel on XLA:CPU.
+    winner, conflicts = _order_by_paircount(m_actor, m_time, alive,
+                                            all_idx, W)
 
     out = {
         'alive_after': alive_after,
         'winner': winner,
         'conflicts': conflicts,
-        'visible_before': visible_before,
         'overflow': jnp.zeros((T,), jnp.bool_),
     }
-    out['packed'] = (jnp.where(out['winner'] >= 0, out['winner'],
-                               0xffffff).astype(jnp.int32)
-                     | (jnp.minimum(out['alive_after'], PACKED_ALIVE_MAX)
-                        << 24))
+    if want_visible_before:
+        out['visible_before'] = visible_before
+    out['packed'] = pack_register_word(out['winner'], out['alive_after'])
     return out
 
 
@@ -241,16 +285,14 @@ def resolve_registers(group, time, actor, seq, clock=None, is_del=None,
     alive_after = jnp.sum(alive, axis=1).astype(jnp.int32)
 
     # winner: alive member with max actor rank; conflicts: remaining alive
-    # members, actor-descending (the reference's sortBy(actor).reverse())
-    actor_keyed = jnp.where(alive, m_actor, -1)
-    order = jnp.argsort(-actor_keyed, axis=1, stable=True)          # [T, W+1]
-    sorted_alive = jnp.take_along_axis(alive, order, axis=1)
+    # members, actor-descending (the reference's sortBy(actor).reverse()),
+    # ties newest-first (slot ascending = time descending).  Ordered by
+    # pairwise count instead of a stable argsort over [T, W+1] -- the
+    # Pallas kernel's formulation, bit-equal and far cheaper on XLA:CPU.
+    m_t = members(t_s, 0)
     member_src = members(sort_idx, -1)                              # [T, W+1]
-    sorted_src = jnp.take_along_axis(member_src, order, axis=1)
-    sorted_src = jnp.where(sorted_alive, sorted_src, -1)
-
-    winner = sorted_src[:, 0]
-    conflicts = sorted_src[:, 1:]
+    winner, conflicts = _order_by_paircount(m_actor, m_t, alive,
+                                            member_src, W)
 
     # overflow: the whole window is same-group valid AND the earliest window
     # slot is still alive -- older ops beyond the window could matter
@@ -271,11 +313,8 @@ def resolve_registers(group, time, actor, seq, clock=None, is_del=None,
     # (bit 30).  One [T] i32 D2H instead of four arrays; conflicts rows
     # are fetched lazily only where alive > 1.  Callers must use the
     # unpacked outputs when T >= 2**24.
-    out['packed'] = (jnp.where(out['winner'] >= 0, out['winner'],
-                               0xffffff).astype(jnp.int32)
-                     | (jnp.minimum(out['alive_after'], PACKED_ALIVE_MAX)
-                        << 24)
-                     | (out['overflow'].astype(jnp.int32) << 30))
+    out['packed'] = pack_register_word(out['winner'], out['alive_after'],
+                                       out['overflow'])
     return out
 
 
@@ -286,13 +325,16 @@ def gather_rows(mat, rows):
 
 
 def _resolve(group, time, actor, seq, clock_table, clock_idx, is_del,
-             alive_in, sort_idx, mem_idx, window):
+             alive_in, sort_idx, mem_idx, window,
+             want_visible_before=True):
     """Mode dispatch: member-explicit when the host built mem_idx (groups
-    wider than the sliding window), else the sliding-window kernel."""
+    wider than the sliding window), else the sliding-window kernel.
+    `want_visible_before` only prunes the member kernel (the sliding
+    kernel computes it either way)."""
     if mem_idx is not None:
-        return resolve_registers_members(time, actor, seq, mem_idx, is_del,
-                                         clock_table, clock_idx,
-                                         window=window)
+        return resolve_registers_members(
+            time, actor, seq, mem_idx, is_del, clock_table, clock_idx,
+            window=window, want_visible_before=want_visible_before)
     return resolve_registers(group, time, actor, seq, is_del=is_del,
                              alive_in=alive_in, window=window,
                              sort_idx=sort_idx, clock_table=clock_table,
@@ -307,10 +349,13 @@ def resolve_and_rank(group, time, actor, seq, clock_table, clock_idx,
     """Register resolution + RGA linearization in ONE dispatch: the two
     computations are independent, so fusing them halves the dispatch /
     sync round trips of a batch (the device link has ~70ms latency per
-    blocking transfer in this deployment)."""
+    blocking transfer in this deployment).  Member-mode visible_before
+    is pruned: this entry's consumers (the native mode='old' paths) take
+    running visibility from the C++ mirrors, never from the kernel."""
     from .list_rank import linearize
     reg = _resolve(group, time, actor, seq, clock_table, clock_idx, is_del,
-                   alive_in, sort_idx, mem_idx, window)
+                   alive_in, sort_idx, mem_idx, window,
+                   want_visible_before=False)
     rank = linearize(eobj, epar, ectr, eact, evalid, n_iters,
                      sort_idx=lin_sort)
     return reg, rank
@@ -515,19 +560,131 @@ def escalate_overflow(group, time, actor, seq, is_del, clock_table,
     return escalate_overflow_collect(pending), oracle_rows, tier_rows
 
 
+def _member_windows(rows, actor, seq):
+    """Member-candidate windows for ONE escalated group, vectorized.
+
+    `rows` are the group's global row ids in (group, time) order.  Row
+    j's candidacy ends at the first later row of the same actor with a
+    DIFFERENT seq (a same-actor successor supersedes it; same-change
+    duplicate assigns share a seq and accumulate) -- and the superseding
+    row itself still SEES j, because member lists are built before the
+    stream update.  So j is a member of row i's window iff
+    j < i <= kill(j), which turns the whole build into interval
+    expansion instead of per-row Python list copies (the old streams
+    loop was O(rows * width) of interpreter work per group).
+
+    Returns a CSR group record (rows, lens [k], vals, width): row i's
+    candidates are the next lens[i] entries of vals (group-LOCAL
+    indexes), the same layout the C++ escalation layout (amtpu_esc_*)
+    emits.
+    """
+    k = len(rows)
+    a = np.asarray(actor[rows])
+    s = np.asarray(seq[rows])
+    # kill[j]: reverse scan over each actor's time-ordered rows (the
+    # stable argsort groups actors while preserving time order within)
+    order = np.argsort(a, kind='stable')
+    kill = np.full(k, k, np.int64)
+    for x in range(k - 2, -1, -1):
+        j, nxt = order[x], order[x + 1]
+        if a[j] == a[nxt]:
+            kill[j] = nxt if s[j] != s[nxt] else kill[nxt]
+    # per-row window width without materializing the pair list:
+    # lens(i) = #{j : j < i <= kill(j)} via a difference array
+    delta = np.zeros(k + 2, np.int64)
+    delta[1:k + 1] += 1
+    np.subtract.at(delta, kill + 1, 1)
+    lens_i = np.cumsum(delta)[:k]
+    width = int(lens_i.max(initial=0))
+    if width == 0:
+        return (rows, lens_i, np.zeros(0, np.int64), 0)
+    # expand each j into its target rows [j+1, min(kill(j), k-1)] as
+    # (i, j) pairs (kill == k marks never-killed candidates); sorted by
+    # i, the j's are exactly the CSR value runs
+    jlens = np.minimum(kill, k - 1) - np.arange(k)
+    total = int(jlens.sum())
+    j_rep = np.repeat(np.arange(k, dtype=np.int64), jlens)
+    cum = np.concatenate(([0], np.cumsum(jlens)[:-1]))
+    i_tgt = j_rep + 1 + (np.arange(total) - np.repeat(cum, jlens))
+    ordp = np.argsort(i_tgt, kind='stable')
+    return (rows, lens_i, j_rep[ordp], width)
+
+
+#: reusable host staging buffers for tier chunks, keyed by the shape
+#: bucket (thread-local: shard threads escalate concurrently).  Reuse is
+#: CPU-backend only: there the dispatch-time host->device copy is
+#: synchronous, so the buffers are free once the jit call returns.  On
+#: accelerators the H2D transfer may still be in flight when the next
+#: chunk would overwrite the buffer, so each dispatch gets fresh arrays
+#: (which the donated jit then consumes).
+_tier_state = threading.local()
+
+
+def _tier_alloc(Tn, W):
+    return {
+        'mem': np.empty((Tn, W), np.int32),
+        'time': np.empty((Tn,), np.int32),
+        'actor': np.empty((Tn,), np.int32),
+        'seq': np.empty((Tn,), np.int32),
+        'isdel': np.empty((Tn,), bool),
+        'cidx': np.empty((Tn,), np.int32),
+    }
+
+
+def _tier_buffers(Tn, W):
+    import jax
+    if jax.default_backend() != 'cpu':
+        return _tier_alloc(Tn, W)
+    cache = getattr(_tier_state, 'bufs', None)
+    if cache is None:
+        cache = _tier_state.bufs = {}
+    bufs = cache.get((Tn, W))
+    if bufs is None:
+        bufs = cache[(Tn, W)] = _tier_alloc(Tn, W)
+    return bufs
+
+
+_members_donated = None
+
+
+def _dispatch_members_tier(time, actor, seq, mem, is_del, clock_table,
+                           clock_idx, window, want_visible_before=True):
+    """One tier-chunk dispatch.  On accelerators the per-row inputs are
+    DONATED: XLA reuses their freshly transferred device buffers for
+    outputs instead of allocating per dispatch (the host staging arrays
+    are numpy and stay owned by _tier_buffers).  clock_table is shared
+    across chunks and never donated."""
+    global _members_donated
+    import jax
+    if jax.default_backend() == 'cpu':
+        return resolve_registers_members(
+            time, actor, seq, mem, is_del, clock_table, clock_idx,
+            window=window, want_visible_before=want_visible_before)
+    if _members_donated is None:
+        _members_donated = jax.jit(
+            resolve_registers_members,
+            static_argnames=('window', 'want_visible_before'),
+            donate_argnums=(0, 1, 2, 3, 4, 6))
+    return _members_donated(time, actor, seq, mem, is_del, clock_table,
+                            clock_idx, window=window,
+                            want_visible_before=want_visible_before)
+
+
 def escalate_overflow_dispatch(group, time, actor, seq, is_del,
                                clock_table, clock_idx, overflow,
-                               floor=ESCALATION_FLOOR, max_tier=None):
+                               floor=ESCALATION_FLOOR, max_tier=None,
+                               want_visible_before=True):
     """The dispatch half of the ladder: host member-window build + one
-    ASYNC kernel dispatch per tier chunk (device->host copies started,
-    never awaited).  Returns (pending, oracle_rows, tier_rows) where
-    `pending` is fed to `escalate_overflow_collect` -- callers with a
-    phased pipeline dispatch here (phase a) and collect after their
-    other host work (phase b), so tier kernels overlap it."""
-    from .. import telemetry
+    ASYNC kernel dispatch per tier chunk.  Only the O(Tn) outputs start
+    device->host copies (packed epilogue); the [Tn, W] conflicts matrix
+    stays device-resident for the collect half's sparse row gather.
+    Returns (pending, oracle_rows, tier_rows) where `pending` is fed to
+    `escalate_overflow_collect_arrays` -- callers with a phased pipeline
+    dispatch here (phase a) and collect after their other host work
+    (phase b), so tier kernels overlap it.
 
-    if max_tier is None:
-        max_tier = int(os.environ.get('AMTPU_MAX_TIER', DEFAULT_MAX_TIER))
+    `want_visible_before=False` (the native drivers) drops that output
+    and its kernel compute; collected chunks then carry all-False vb."""
     group = np.asarray(group)
     time = np.asarray(time)
     actor = np.asarray(actor)
@@ -537,10 +694,8 @@ def escalate_overflow_dispatch(group, time, actor, seq, is_del,
 
     flagged = np.asarray(overflow, bool) & (group >= 0)
     ovf_gids = np.unique(group[flagged])
-    pending = []
-    tier_rows = {}
     if ovf_gids.size == 0:
-        return pending, np.zeros((0,), np.int32), tier_rows
+        return [], np.zeros((0,), np.int32), {}
 
     # all rows of the flagged groups, in (group, time) order
     sel = np.isin(group, ovf_gids)
@@ -548,40 +703,53 @@ def escalate_overflow_dispatch(group, time, actor, seq, is_del,
     order = np.lexsort((time[sel_rows], group[sel_rows]))
     sel_rows = sel_rows[order]
     bounds = np.nonzero(np.diff(group[sel_rows]))[0] + 1
-    group_row_blocks = np.split(sel_rows, bounds)
+    groups = [_member_windows(rows, actor, seq)
+              for rows in np.split(sel_rows, bounds)]
+    return escalate_dispatch_groups(
+        groups, time, actor, seq, is_del, clock_table, clock_idx,
+        floor=floor, max_tier=max_tier,
+        want_visible_before=want_visible_before)
 
-    tiers = {}        # W -> [(rows list, member lists)]
+
+def escalate_dispatch_groups(groups, time, actor, seq, is_del,
+                             clock_table, clock_idx,
+                             floor=ESCALATION_FLOOR, max_tier=None,
+                             want_visible_before=True):
+    """Dispatch half over PREBUILT CSR group records
+    (rows, lens, vals, width) -- either `_member_windows` output or the
+    C++ escalation layout (amtpu_esc_*), which the native driver reads
+    instead of re-deriving windows host-side.  Same return contract as
+    `escalate_overflow_dispatch`."""
+    from .. import telemetry
+
+    if max_tier is None:
+        max_tier = int(os.environ.get('AMTPU_MAX_TIER', DEFAULT_MAX_TIER))
+    time = np.asarray(time)
+    actor = np.asarray(actor)
+    seq = np.asarray(seq)
+    is_del = np.asarray(is_del)
+    clock_idx = np.asarray(clock_idx, np.int32)
+
+    budget = _escalation_budget()
+    pending = []
+    tier_rows = {}
+    tiers = {}        # W -> [group record]
     oracle_rows = []
-    for rows in group_row_blocks:
-        streams = {}  # actor -> ([rows...], seq of those rows)
-        mem_lists = []
-        width = 0
-        for r in rows:
-            cands = [x for lst, _ in streams.values() for x in lst]
-            mem_lists.append(cands)
-            if len(cands) > width:
-                width = len(cands)
-            a, s = int(actor[r]), int(seq[r])
-            held = streams.get(a)
-            if held is not None and held[1] == s:
-                held[0].append(int(r))   # same-change duplicate assign
-            else:
-                streams[a] = ([int(r)], s)
+    for grp in groups:
+        rows, width = grp[0], grp[3]
         W = _tier_of(max(width, 1), floor)
-        budget = _escalation_budget()
         if W > max_tier or _dispatch_cost(len(rows), W) > budget:
             # wider than every tier, or memory-unboundable at any
             # chunking: the one remaining host-oracle route
             oracle_rows.extend(int(r) for r in rows)
             continue
-        tiers.setdefault(W, []).append((rows, mem_lists))
+        tiers.setdefault(W, []).append(grp)
         telemetry.ESCALATION_TIER.observe(W)
 
     for W, entries in sorted(tiers.items()):
         # chunk the tier so each dispatch's [Tn, W+1, W+1] intermediate
         # stays under the scratch budget (a lone group always fits: the
         # bucketing above sent oversized ones to the oracle)
-        budget = _escalation_budget()
         chunks, cur, cur_rows = [], [], 0
         for entry in entries:
             n_rows = len(entry[0])
@@ -592,32 +760,44 @@ def escalate_overflow_dispatch(group, time, actor, seq, is_del,
             cur_rows += n_rows
         chunks.append(cur)
         for chunk in chunks:
-            sub_rows = np.concatenate([rows for rows, _ in chunk])
+            sub_rows = np.concatenate([g[0] for g in chunk])
             n = len(sub_rows)
             Tn = _tier_of(n, ESCALATION_FLOOR)  # shape-bucketed padding
-            local = {int(r): i for i, r in enumerate(sub_rows)}
-            mem = np.full((Tn, W), -1, np.int32)
-            i = 0
-            for rows, mem_lists in chunk:
-                for cands in mem_lists:
-                    for k, c in enumerate(cands):
-                        mem[i, k] = local[c]
-                    i += 1
+            bufs = _tier_buffers(Tn, W)
+            mem = bufs['mem']
+            mem[:] = -1
+            # CSR -> padded window matrix, vectorized per CHUNK: row and
+            # member indexes are group-local; adding each group's chunk
+            # offset makes them chunk-local
+            offs = np.concatenate(
+                ([0], np.cumsum([len(g[0]) for g in chunk])))
+            lens_cat = np.concatenate([g[1] for g in chunk])
+            total = int(lens_cat.sum())
+            if total:
+                vals_cat = np.concatenate(
+                    [g[2] + off for g, off in zip(chunk, offs)])
+                ii = np.repeat(np.arange(n), lens_cat)
+                starts = np.concatenate(([0], np.cumsum(lens_cat)[:-1]))
+                slot = np.arange(total) - np.repeat(starts, lens_cat)
+                mem[ii, slot] = vals_cat
 
-            def pad(col, fill, dtype):
-                out = np.full((Tn,), fill, dtype)
+            def pad(name, col, fill):
+                out = bufs[name]
                 out[:n] = col[sub_rows]
+                out[n:] = fill
                 return out
 
             with telemetry.span('device.escalate', tier=W, rows=n):
-                out = resolve_registers_members(
-                    pad(time, 0, np.int32), pad(actor, 0, np.int32),
-                    pad(seq, 0, np.int32), mem, pad(is_del, False, bool),
-                    clock_table, pad(clock_idx, 0, np.int32), window=W)
-                for k in ('winner', 'conflicts', 'alive_after',
-                          'visible_before'):
-                    if hasattr(out[k], 'copy_to_host_async'):
-                        out[k].copy_to_host_async()
+                out = _dispatch_members_tier(
+                    pad('time', time, 0), pad('actor', actor, 0),
+                    pad('seq', seq, 0), mem, pad('isdel', is_del, False),
+                    clock_table, pad('cidx', clock_idx, 0), W,
+                    want_visible_before=want_visible_before)
+                for key in ('packed', 'winner', 'alive_after',
+                            'visible_before'):
+                    if key in out and hasattr(out[key],
+                                              'copy_to_host_async'):
+                        out[key].copy_to_host_async()
             pending.append((W, sub_rows, out))
             tier_rows[W] = tier_rows.get(W, 0) + n
             telemetry.metric('fallback.escalated.w%d' % W, n)
@@ -625,23 +805,103 @@ def escalate_overflow_dispatch(group, time, actor, seq, is_del,
     return pending, np.asarray(oracle_rows, np.int32), tier_rows
 
 
-def escalate_overflow_collect(pending):
-    """The collect half: awaits each tier dispatch's outputs and scatters
-    them into the global-row `resolved` map (`escalate_overflow`'s
-    contract)."""
-    resolved = {}
-    for _W, sub_rows, out in pending:
+#: one collected tier chunk: `rows` are global batch rows; `winner` /
+#: `conflicts` carry GLOBAL row ids (-1 padded); `conf_rows` indexes
+#: into `rows` (only rows that kept >1 member have a conflicts row)
+EscalatedChunk = namedtuple(
+    'EscalatedChunk',
+    ['rows', 'winner', 'conf_rows', 'conflicts', 'alive',
+     'visible_before'])
+
+
+def escalate_overflow_collect_arrays(pending):
+    """The collect half, vectorized: awaits each tier chunk's O(Tn)
+    outputs and translates tier-local indices to global batch rows.
+    Conflicts are row-gathered ON DEVICE only where a register kept >1
+    member (the tiers' packed epilogue: the [Tn, W] matrix never
+    transfers whole).  Returns a list of EscalatedChunk."""
+    chunks = []
+    for W, sub_rows, out in pending:
         n = len(sub_rows)
+        sub = np.ascontiguousarray(sub_rows, np.int64)
         win = np.asarray(out['winner'])[:n]
-        conf = np.asarray(out['conflicts'])[:n]
-        alive = np.asarray(out['alive_after'])[:n]
-        vb = np.asarray(out['visible_before'])[:n]
-        for i, r in enumerate(sub_rows):
-            w = int(win[i])
-            confs = [int(sub_rows[c]) for c in conf[i] if c >= 0]
-            resolved[int(r)] = (int(sub_rows[w]) if w >= 0 else -1,
-                                confs, int(alive[i]), bool(vb[i]))
+        alive = np.ascontiguousarray(np.asarray(out['alive_after'])[:n],
+                                     np.int32)
+        if 'visible_before' in out:
+            vb = np.ascontiguousarray(
+                np.asarray(out['visible_before'])[:n], bool)
+        else:
+            vb = np.zeros((n,), bool)
+        conf_rows = np.nonzero(alive > 1)[0].astype(np.int32)
+        conf_g = np.zeros((0, W), np.int32)
+        if conf_rows.size:
+            padlen = 1
+            while padlen < conf_rows.size:
+                padlen *= 2
+            rows_p = np.zeros((padlen,), np.int32)
+            rows_p[:conf_rows.size] = conf_rows
+            conf = np.asarray(gather_rows(out['conflicts'],
+                                          rows_p))[:conf_rows.size]
+            conf_g = np.where(conf >= 0, sub[np.clip(conf, 0, n - 1)],
+                              -1).astype(np.int32)
+        win_g = np.where(win >= 0, sub[np.clip(win, 0, n - 1)],
+                         -1).astype(np.int32)
+        chunks.append(EscalatedChunk(sub.astype(np.int32), win_g,
+                                     conf_rows, conf_g, alive, vb))
+    return chunks
+
+
+def escalate_overflow_collect(pending):
+    """Dict-contract collect: the global-row `resolved` map
+    (`escalate_overflow`'s documented contract), built from the
+    vectorized chunks.  Batch drivers consume the array chunks directly
+    (`escalate_overflow_collect_arrays`); this form remains for
+    per-row consumers and the kernel unit tests."""
+    resolved = {}
+    for ch in escalate_overflow_collect_arrays(pending):
+        conf_of = {}
+        for i, local in enumerate(ch.conf_rows):
+            conf_of[int(local)] = [int(c) for c in ch.conflicts[i]
+                                   if c >= 0]
+        for i, r in enumerate(ch.rows):
+            resolved[int(r)] = (int(ch.winner[i]), conf_of.get(i, []),
+                                int(ch.alive[i]),
+                                bool(ch.visible_before[i]))
     return resolved
+
+
+def merge_escalated_arrays(winner, conflicts, alive, overflow, chunks,
+                           visible_before=None):
+    """Vectorized merge of EscalatedChunks into the (host, writable)
+    register output arrays: scatters winner/conflicts/alive, widens the
+    conflicts matrix when a tier kept more survivors than its column
+    count, and clears the overflow flag of every resolved row -- flags
+    left standing afterwards are exactly the rows the caller must route
+    to the host oracle.  Returns the four (possibly replaced) arrays."""
+    if not chunks:
+        return winner, conflicts, alive, overflow
+    width = conflicts.shape[1] if conflicts.ndim == 2 else 0
+    need = width
+    for ch in chunks:
+        if ch.conf_rows.size:
+            need = max(need, int((ch.conflicts >= 0).sum(axis=1)
+                                 .max(initial=0)))
+    if need > width:
+        wide = np.full((conflicts.shape[0], need), -1, conflicts.dtype)
+        if width:
+            wide[:, :width] = conflicts
+        conflicts = wide
+    for ch in chunks:
+        winner[ch.rows] = ch.winner
+        conflicts[ch.rows, :] = -1
+        if ch.conf_rows.size:
+            m = min(ch.conflicts.shape[1], conflicts.shape[1])
+            conflicts[ch.rows[ch.conf_rows], :m] = ch.conflicts[:, :m]
+        alive[ch.rows] = ch.alive
+        overflow[ch.rows] = 0
+        if visible_before is not None:
+            visible_before[ch.rows] = ch.visible_before
+    return winner, conflicts, alive, overflow
 
 
 def merge_escalated(winner, conflicts, alive, overflow, resolved):

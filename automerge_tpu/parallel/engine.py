@@ -783,21 +783,22 @@ class TPUDocPool:
         host_registers = {}
         if reg_out is not None and reg_out['overflow'].any():
             if register_ops.escalation_enabled():
-                resolved, _oracle_rows, _tiers = \
-                    register_ops.escalate_overflow(
+                pending, _oracle_rows, _tiers = \
+                    register_ops.escalate_overflow_dispatch(
                         g_arr[:T], t_arr[:T], a_arr[:T], s_arr[:T],
                         d_arr[:T], c_arr, np.arange(T, dtype=np.int32),
                         reg_out['overflow'])
-                if resolved:
+                chunks = register_ops.escalate_overflow_collect_arrays(
+                    pending)
+                if chunks:
                     reg_out = {k: np.array(v) for k, v in reg_out.items()}
                     (reg_out['winner'], reg_out['conflicts'],
                      reg_out['alive_after'], reg_out['overflow']) = \
-                        register_ops.merge_escalated(
+                        register_ops.merge_escalated_arrays(
                             reg_out['winner'], reg_out['conflicts'],
                             reg_out['alive_after'], reg_out['overflow'],
-                            resolved)
-                    for row, (_w, _c, _al, vb) in resolved.items():
-                        reg_out['visible_before'][row] = vb
+                            chunks,
+                            visible_before=reg_out['visible_before'])
         if reg_out is not None and reg_out['overflow'].any():
             telemetry.metric('fallback.oracle',
                              int(reg_out['overflow'].sum()))

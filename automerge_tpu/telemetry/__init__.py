@@ -83,6 +83,25 @@ KNOWN_FALLBACK_REASONS = ('layout_batches', 'overflow_batches',
                           'oracle', 'escalated.w16', 'escalated.w32',
                           'escalated.w64')
 
+# collect-path counters (`trace.metric('collect.<name>')` call sites),
+# pre-seeded into every bench_block so gates can assert explicit zeros:
+# packed_member_batches  -- member-mode batches served by the packed
+#                           epilogue (ONE i32/row + sparse conflicts)
+# full_matrix_readback   -- batches that read back the full
+#                           winner/conflicts/alive/overflow matrices
+#                           (AMTPU_PACKED_EPILOGUE=0, Tp >= 2^24, or the
+#                           kernel-overflow fused fallback)
+# conflict_sparse/dense  -- which side of the AMTPU_CONF_DENSE_THRESH
+#                           switch each conflicts fetch took
+# ready_reorder          -- pipelined phase-b picks served out of
+#                           submission order because their device
+#                           outputs resolved first
+# wait_in_order          -- rounds where nothing was ready and collect
+#                           blocked on the oldest submission
+KNOWN_COLLECT_KEYS = ('packed_member_batches', 'full_matrix_readback',
+                      'conflict_sparse', 'conflict_dense',
+                      'ready_reorder', 'wait_in_order')
+
 # escalation tier widths are powers of two: exact log2 bucket bounds
 ESCALATION_TIER_BUCKETS = tuple(float(2 ** i) for i in range(4, 15))
 
@@ -248,8 +267,13 @@ def bench_block():
     fallbacks.update({k.split('.', 1)[1]: round(v, 6)
                       for k, v in flat.items()
                       if k.startswith('fallback.')})
+    collect = {r: 0.0 for r in KNOWN_COLLECT_KEYS}
+    collect.update({k.split('.', 1)[1]: round(v, 6)
+                    for k, v in flat.items()
+                    if k.startswith('collect.')})
     block = {
         'fallbacks': fallbacks,
+        'collect': collect,
         'device_s': round(flat.get('device.dispatch_sync_s', 0.0), 4),
         'device_dispatches': int(flat.get('device.dispatches', 0)),
         'batch_latency': BATCH_LATENCY.snapshot() or {},

@@ -1136,9 +1136,13 @@ struct Batch {
   std::vector<u8> k_overflow;
   // packed-mode alternative: the kernel's packed word per row (24-bit
   // winner | 6-bit alive, saturated at 63 | overflow in bit 30) +
-  // conflicts only for the rare rows that kept >1 member
+  // conflicts only for the rare rows that kept >1 member, stored CSR
+  // (row -> (offset, len) into sparse_vals) so escalation-tier rows of
+  // ANY width ride the same channel as the base kernel's window-wide
+  // rows
   std::vector<i32> k_packed;
-  FlatMap<std::array<i32, 8>> sparse_conflicts;
+  FlatMap<std::pair<i32, i32>> sparse_conflicts;
+  std::vector<i32> sparse_vals;
   bool packed_mode = false;
   std::vector<i32> rank;        // [L]
   int window = 8;
@@ -1171,6 +1175,17 @@ struct Batch {
   std::unordered_map<u64, HostFen> host_fens;   // akey -> running counts
   std::vector<i32> mem_idx;    // [Tp * WINDOW]
   std::vector<u8> host_ovf;    // [Tp]
+  // Escalation member layout (built at begin when member-mode overflow
+  // exists): every flagged group's rows in (group, time) order plus
+  // each row's candidate window -- the same per-actor-latest-seq
+  // streams rule as the base member build, at UNLIMITED width, with
+  // same-change duplicate assigns accumulating -- so the Python tier
+  // ladder pads tier chunks with vectorized copies instead of
+  // re-deriving windows row by row (ISSUE 3 tentpole a/c).
+  std::vector<i64> esc_group_meta;   // [n_groups * 3]: row_start, n, width
+  std::vector<i32> esc_rows;         // [R] global rows
+  std::vector<i64> esc_mem_off;      // [R + 1] CSR offsets
+  std::vector<i32> esc_mem;          // CSR values, group-LOCAL indexes
 
   // per-op arena index resolved by prepass in application order:
   // -2 = not a list assign, -1 = dropped del on an absent element
@@ -1840,6 +1855,55 @@ static void encode(Pool& pool, Batch& b) {
           ++b.n_pre_ovf;
         }
       }
+      // Escalation member layout for the flagged groups: sort_idx is
+      // the (group, time) bucket order, so each group is one contiguous
+      // run.  Streams here are UNLIMITED width (the base build stops at
+      // W) and same-change duplicate assigns accumulate -- exactly the
+      // candidate rule the Python ladder's tiers need.
+      if (b.any_ovf) {
+        b.esc_mem_off.push_back(0);
+        std::vector<std::vector<i32>> streams;
+        std::vector<i32> s_actor, s_seq;
+        for (i64 i = 0; i < b.Tp;) {
+          i32 g = b.g_col[b.sort_idx[i]];
+          i64 j = i;
+          while (j < b.Tp && b.g_col[b.sort_idx[j]] == g) ++j;
+          if (g < 0 || !govf[g]) { i = j; continue; }
+          i64 start = static_cast<i64>(b.esc_rows.size());
+          streams.clear();
+          s_actor.clear();
+          s_seq.clear();
+          i32 width = 0;
+          for (i64 p = i; p < j; ++p) {
+            i32 r = b.sort_idx[p];
+            i32 li = static_cast<i32>(p - i);   // group-LOCAL index
+            b.esc_rows.push_back(r);
+            i32 cnt = 0;
+            for (auto& st : streams) {
+              for (i32 c : st) b.esc_mem.push_back(c);
+              cnt += static_cast<i32>(st.size());
+            }
+            b.esc_mem_off.push_back(static_cast<i64>(b.esc_mem.size()));
+            if (cnt > width) width = cnt;
+            i32 a = b.a_col[r], s = b.s_col[r];
+            size_t k = 0;
+            for (; k < s_actor.size(); ++k)
+              if (s_actor[k] == a) break;
+            if (k < s_actor.size()) {
+              if (s_seq[k] == s) streams[k].push_back(li);
+              else { streams[k].assign(1, li); s_seq[k] = s; }
+            } else {
+              s_actor.push_back(a);
+              s_seq.push_back(s);
+              streams.emplace_back(1, li);
+            }
+          }
+          b.esc_group_meta.push_back(start);
+          b.esc_group_meta.push_back(j - i);
+          b.esc_group_meta.push_back(width);
+          i = j;
+        }
+      }
     }
   } else {
     b.Tp = 0;
@@ -2092,9 +2156,12 @@ static void begin_phases(Pool& pool, Batch& b,
   }
 }
 
-static void mid_phase(Pool& pool, Batch& b) {
-  // overflow fallback: re-resolve whole groups with oracle semantics
-  if (b.T > 0) {
+// overflow fallback: re-resolve whole groups with oracle semantics.
+// Flags live in k_overflow (assigned by amtpu_mid, or the RESIDUAL
+// member-overflow vector of amtpu_mid_packed -- empty when the caller
+// had no overflow at all).
+static void oracle_replay(Pool& pool, Batch& b) {
+  if (b.T > 0 && !b.k_overflow.empty()) {
     std::unordered_map<K3, char, K3Hash> overflowed;
     bool any = false;
     for (size_t op_idx = 0; op_idx < b.ops.size(); ++op_idx) {
@@ -2139,6 +2206,10 @@ static void mid_phase(Pool& pool, Batch& b) {
       }
     }
   }
+}
+
+static void mid_phase(Pool& pool, Batch& b) {
+  oracle_replay(pool, b);
 
   // fill the fallback-path mirrors (er/orank from the fetched rank, od
   // from running host visibility); timelines/layout were built at begin.
@@ -2166,6 +2237,8 @@ static void mid_phase(Pool& pool, Batch& b) {
         bool alive_now;
         auto hit = b.host_registers.find(e.op_idx);
         if (hit != b.host_registers.end()) alive_now = !hit->second.empty();
+        else if (b.packed_mode)
+          alive_now = ((b.k_packed[e.reg_row] >> 24) & 0x3f) > 0;
         else alive_now = b.k_alive[e.reg_row] > 0;
         u64 vk = static_cast<u64>(base + e.eidx);
         bool before;
@@ -2376,10 +2449,9 @@ static void register_from_kernel(Batch& b, i64 row, Register& reg) {
     if (((packed >> 24) & 0x3f) > 1) {
       auto* conf = b.sparse_conflicts.find(static_cast<u64>(row));
       if (conf) {
-        for (int c = 0; c < b.window && c < 8; ++c) {
-          i32 s = (*conf)[c];
-          if (s >= 0) reg.push_back(*b.src_records[s]);
-        }
+        const i32* vals = b.sparse_vals.data() + conf->first;
+        for (i32 c = 0; c < conf->second; ++c)
+          if (vals[c] >= 0) reg.push_back(*b.src_records[vals[c]]);
       }
     }
     return;
@@ -3643,6 +3715,20 @@ void amtpu_pool_set_hostfull(void* pool_ptr, int on) {
 const int32_t* amtpu_col_memidx(void* bp) { return static_cast<BatchHandle*>(bp)->batch.mem_idx.data(); }
 const uint8_t* amtpu_col_hostovf(void* bp) { return static_cast<BatchHandle*>(bp)->batch.host_ovf.data(); }
 
+// escalation member layout (built when member-mode overflow exists):
+// dims = [n_groups, n_rows, mem_total]; group_meta packs
+// (row_start, n, width) i64 triples; mem is CSR with group-LOCAL values
+void amtpu_esc_dims(void* bp, int64_t* out) {
+  Batch& b = static_cast<BatchHandle*>(bp)->batch;
+  out[0] = static_cast<i64>(b.esc_group_meta.size() / 3);
+  out[1] = static_cast<i64>(b.esc_rows.size());
+  out[2] = static_cast<i64>(b.esc_mem.size());
+}
+const int64_t* amtpu_esc_group_meta(void* bp) { return static_cast<BatchHandle*>(bp)->batch.esc_group_meta.data(); }
+const int32_t* amtpu_esc_rows(void* bp) { return static_cast<BatchHandle*>(bp)->batch.esc_rows.data(); }
+const int64_t* amtpu_esc_mem_off(void* bp) { return static_cast<BatchHandle*>(bp)->batch.esc_mem_off.data(); }
+const int32_t* amtpu_esc_mem(void* bp) { return static_cast<BatchHandle*>(bp)->batch.esc_mem.data(); }
+
 // register columns (valid when Tp > 0)
 const int32_t* amtpu_col_g(void* bp) { return static_cast<BatchHandle*>(bp)->batch.g_col.data(); }
 const int32_t* amtpu_col_t(void* bp) { return static_cast<BatchHandle*>(bp)->batch.t_col.data(); }
@@ -3738,39 +3824,56 @@ int amtpu_mid_fused(void* bp, const int32_t* winner, const int32_t* conflicts,
   return 0;
 }
 
-// packed fused-path entry: the register summary stays in its packed form
-// (C++ unpacks winner/alive lazily per row) and conflicts arrive SPARSE --
-// only rows whose register kept >1 member (rare outside hot-key
-// workloads), as (row, 8 x member) pairs.  Caller must have verified no
-// overflow bit is set and b.Tp < 2^24.
+// packed-path entry: the register summary stays in its packed form (C++
+// unpacks winner/alive lazily per row) and conflicts arrive SPARSE as
+// CSR -- conf_rows[i]'s members are conf_vals[conf_offs[i] ..
+// conf_offs[i+1]), which covers both the base kernel's window-wide rows
+// and escalation-tier rows of ANY width.  host_ovf (nullable) carries
+// the RESIDUAL member-overflow flags left after the host's escalation
+// merge: rows still flagged take the in-C++ oracle replay
+// (fallback.oracle).  Exactly one dominance source applies: dom_idx
+// (fused-path device indexes), rank (device-dominance mirror fill, as
+// amtpu_mid), or host_dom=1 (amtpu_host_dominance follows).  Caller
+// guarantees b.Tp < 2^24.
 int amtpu_mid_packed(void* bp, const int32_t* packed, int window,
-                     const int32_t* conf_rows, const int32_t* conf_vals,
-                     int64_t n_conf, const int32_t* dom_idx) {
+                     const int32_t* conf_rows, const int32_t* conf_offs,
+                     const int32_t* conf_vals, int64_t n_conf,
+                     const uint8_t* host_ovf, const int32_t* rank,
+                     const int32_t* dom_idx, int host_dom) {
   BatchHandle& h = *static_cast<BatchHandle*>(bp);
   Batch& b = h.batch;
   try {
-    if (window > 8)
-      throw Error(0, "packed conflicts carry 8 slots; window too wide");
     double t0 = mono_now();
     b.window = window;
     b.packed_mode = true;
+    b.host_dom = host_dom != 0;
+    if (b.host_dom && (rank || dom_idx))
+      throw Error(0, "amtpu_mid_packed: host_dom callers must pass "
+                     "rank=NULL and dom_idx=NULL");
     if (b.Tp > 0) b.k_packed.assign(packed, packed + b.Tp);
+    b.sparse_vals.assign(
+        conf_vals, conf_vals + (n_conf > 0 ? conf_offs[n_conf] : 0));
     b.sparse_conflicts.reserve(static_cast<size_t>(n_conf) + 1);
-    for (int64_t i = 0; i < n_conf; ++i) {
-      std::array<i32, 8> row_vals;
-      // rows arrive at the caller's (dynamic) window width; missing
-      // slots are empty
-      for (int c = 0; c < 8; ++c)
-        row_vals[c] = c < window ? conf_vals[i * window + c] : -1;
-      *b.sparse_conflicts.insert(
-          static_cast<u64>(conf_rows[i])).first = row_vals;
-    }
-    i64 off = 0;
-    if (dom_idx) {      // NULL when the caller uses amtpu_host_dominance
+    for (int64_t i = 0; i < n_conf; ++i)
+      *b.sparse_conflicts.insert(static_cast<u64>(conf_rows[i])).first =
+          std::pair<i32, i32>(conf_offs[i],
+                              conf_offs[i + 1] - conf_offs[i]);
+    if (host_ovf && b.Tp > 0)
+      b.k_overflow.assign(host_ovf, host_ovf + b.Tp);
+    if (dom_idx) {
+      i64 off = 0;
       for (auto& blk : b.dom_blocks) {
         blk.indexes.assign(dom_idx + off, dom_idx + off + blk.W * blk.Tp);
         off += blk.W * blk.Tp;
       }
+      oracle_replay(*h.pool, b);   // no-op unless host_ovf flagged rows
+    } else {
+      if (!b.host_dom && !rank && !b.dom_blocks.empty())
+        throw Error(0, "amtpu_mid_packed: device-dominance callers must "
+                       "pass rank or dom_idx");
+      if (b.Lp > 0 && !b.dom_blocks.empty() && rank)
+        b.rank.assign(rank, rank + b.Lp);
+      mid_phase(*h.pool, b);
     }
     b.tr_mid = mono_now() - t0;
   } catch (const Error& e) {

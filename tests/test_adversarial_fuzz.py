@@ -237,6 +237,129 @@ class TestEscalationFallbackFree:
             lambda: deliver_all([{0: [base]}, {0: writers}]), exec_mode)
 
 
+@pytest.fixture(params=['packed', 'unpacked'])
+def packed_epilogue(request):
+    """Both member epilogues face the same schedules: the packed
+    transfer (default) and the full-matrix readback
+    (AMTPU_PACKED_EPILOGUE=0) -- byte parity between them is the
+    ISSUE-3 acceptance bar."""
+    prior = os.environ.get('AMTPU_PACKED_EPILOGUE')
+    os.environ['AMTPU_PACKED_EPILOGUE'] = \
+        '1' if request.param == 'packed' else '0'
+    yield request.param
+    if prior is None:
+        os.environ.pop('AMTPU_PACKED_EPILOGUE', None)
+    else:
+        os.environ['AMTPU_PACKED_EPILOGUE'] = prior
+
+
+class TestPackedEpilogueParity:
+    """ISSUE-3 fuzz lane: the packed member epilogue (ONE i32 per
+    register row + sparse CSR conflicts + in-packed escalation merge)
+    must be byte-identical to the full-matrix readback it replaced,
+    against the scalar-oracle referee, in both execution modes.
+
+    The workload is built to hit every packed-path branch at once:
+    member mode (hot keys deeper than the sliding window), host-flagged
+    overflow escalating through wider tiers (>8 concurrent streams AND
+    same-change dup assigns), base-kernel conflict rows OUTSIDE the
+    flagged groups (the sparse CSR gather), deletes, and registers that
+    resolve to a single survivor."""
+
+    def _workload(self, rng, n_actors=11, n_keys=6, n_rounds=3):
+        batches = []
+        setup = {'actor': 'setup', 'seq': 1, 'deps': {}, 'ops':
+                 [{'action': 'set', 'obj': ROOT_ID, 'key': 'k%d' % k,
+                   'value': 'base'} for k in range(n_keys)]}
+        batches.append({0: [setup]})
+        for rnd in range(n_rounds):
+            changes = []
+            for a in range(n_actors):
+                ops = []
+                # hot key k0: every actor, every round (member mode +
+                # >8 concurrent streams -> escalation)
+                ops.append({'action': 'set', 'obj': ROOT_ID, 'key': 'k0',
+                            'value': 'a%d-r%d' % (a, rnd)})
+                if a == 3:
+                    # same-change dup assign: the member-window
+                    # unholdable shape
+                    ops.append({'action': 'set', 'obj': ROOT_ID,
+                                'key': 'k0', 'value': 'dup-%d' % rnd})
+                # narrow keys: 2-3 writers each (conflicts survive on
+                # the BASE kernel path, outside any flagged group)
+                k = 1 + (a + rnd) % (n_keys - 1)
+                if a < 3:
+                    op = {'action': 'set', 'obj': ROOT_ID,
+                          'key': 'k%d' % k, 'value': a * 100 + rnd}
+                    if a == 2 and rnd == 1:
+                        op = {'action': 'del', 'obj': ROOT_ID,
+                              'key': 'k%d' % k}
+                    ops.append(op)
+                # deep sequential history on one key: member mode with a
+                # single surviving stream
+                if a == 5:
+                    for i in range(4):
+                        ops.append({'action': 'set', 'obj': ROOT_ID,
+                                    'key': 'k5',
+                                    'value': 'seq-%d-%d' % (rnd, i)})
+                # private keys: unflagged member rows keep the batch off
+                # the hostreg route (2 * pre_ovf < T), so the KERNEL
+                # member path -- the epilogue under test -- serves it
+                for i in range(3):
+                    ops.append({'action': 'set', 'obj': ROOT_ID,
+                                'key': 'p%d' % a,
+                                'value': 'p-%d-%d-%d' % (a, rnd, i)})
+                changes.append({'actor': 'f%02d' % a, 'seq': rnd + 1,
+                                'deps': {'setup': 1},
+                                'ops': ops})
+            rng.shuffle(changes)
+            batches.append({0: changes})
+        return batches
+
+    def test_member_epilogue_byte_parity(self, packed_epilogue,
+                                         exec_mode):
+        from automerge_tpu import telemetry
+        telemetry.metrics_reset()
+        rng = random.Random(seed_base(70707))
+        # pin routing: hostreg would bypass the epilogue under test on
+        # the CPU backend (the counters below assert which path served)
+        prior = os.environ.get('AMTPU_HOST_REG')
+        os.environ['AMTPU_HOST_REG'] = '0'
+        try:
+            deliver_all(self._workload(rng))
+        finally:
+            if prior is None:
+                os.environ.pop('AMTPU_HOST_REG', None)
+            else:
+                os.environ['AMTPU_HOST_REG'] = prior
+        snap = telemetry.metrics_snapshot()
+        assert snap.get('fallback.oracle', 0) == 0, snap
+        if exec_mode == 'kernel':
+            # the toggle must actually select the epilogue under test
+            if packed_epilogue == 'packed':
+                assert snap.get('collect.packed_member_batches', 0) > 0, \
+                    snap
+                assert snap.get('collect.full_matrix_readback', 0) == 0, \
+                    snap
+            else:
+                assert snap.get('collect.full_matrix_readback', 0) > 0, \
+                    snap
+                assert snap.get('collect.packed_member_batches', 0) == 0, \
+                    snap
+
+    @pytest.mark.parametrize('seed', [1, 2, 3])
+    def test_rotating_hot_key_fuzz(self, seed, packed_epilogue,
+                                   exec_mode):
+        """Randomized widths/depths: writer counts rotate through the
+        base window, the first tier, and multi-tier territory."""
+        rng = random.Random(seed_base(81000 + seed))
+        n_actors = rng.choice([9, 12, 17])
+        batches = self._workload(rng, n_actors=n_actors,
+                                 n_keys=rng.randrange(3, 7),
+                                 n_rounds=2)
+        deliver_all(batches)
+
+
 class TestReversedCausalChains:
     def test_deep_chain_reversed(self, exec_mode):
         """120-deep cross-actor dependency chain delivered fully

@@ -384,6 +384,72 @@ class TestQueryConstLookup:
         assert 'ghost' not in pool.docs
 
 
+class TestBatchHandleLeaks:
+    """Phase-a failures after amtpu_begin must free the C++ batch handle
+    (each handle owns the whole decoded batch; leaking under sustained
+    error traffic is unbounded growth).  live_batch_handles() is the
+    audit hook: every begin increments, every free decrements."""
+
+    def _simple_batch(self):
+        return {0: [{'actor': 'a0', 'seq': 1, 'deps': {},
+                     'ops': [{'action': 'set', 'obj': ROOT_ID,
+                              'key': 'k', 'value': 1}]}]}
+
+    def test_success_path_balances(self):
+        from automerge_tpu import native
+        base = native.live_batch_handles()
+        pool = native.NativeDocPool()
+        pool.apply_batch(self._simple_batch())
+        assert native.live_batch_handles() == base
+
+    def test_phase_a_failure_frees_handle(self):
+        """AMTPU_WEFF with a non-numeric value raises inside
+        _phase_a_rest AFTER begin succeeded -- exactly the window where
+        a leak would hide."""
+        import os
+        from automerge_tpu import native
+        base = native.live_batch_handles()
+        pool = native.NativeDocPool()
+        prior = os.environ.get('AMTPU_WEFF')
+        os.environ['AMTPU_WEFF'] = 'bogus'
+        try:
+            with pytest.raises(ValueError):
+                pool.apply_batch(self._simple_batch())
+        finally:
+            if prior is None:
+                os.environ.pop('AMTPU_WEFF', None)
+            else:
+                os.environ['AMTPU_WEFF'] = prior
+        assert native.live_batch_handles() == base
+        # the pool is still serviceable after the failed batch
+        pool.apply_batch(self._simple_batch())
+        assert native.live_batch_handles() == base
+
+    def test_pipelined_phase_a_failure_frees_all(self):
+        """The pipelined driver collects phase-a errors across pools;
+        every handle -- failed and healthy alike -- must be freed."""
+        import os
+        from automerge_tpu import native
+        base = native.live_batch_handles()
+        import msgpack
+        payload = msgpack.packb(
+            {native.NativeDocPool._doc_key(0):
+             self._simple_batch()[0]}, use_bin_type=True)
+        pools = [native.NativeDocPool() for _ in range(3)]
+        prior = os.environ.get('AMTPU_WEFF')
+        os.environ['AMTPU_WEFF'] = 'bogus'
+        try:
+            with pytest.raises(ValueError):
+                native.apply_payloads_pipelined(
+                    [(p, payload) for p in pools])
+        finally:
+            if prior is None:
+                os.environ.pop('AMTPU_WEFF', None)
+            else:
+                os.environ['AMTPU_WEFF'] = prior
+        assert native.live_batch_handles() == base
+
+
 class TestShardErrorReporting:
     def test_error_names_failing_shard(self):
         from automerge_tpu.native import ShardedNativePool

@@ -515,6 +515,54 @@ class TestEscalationLadder:
             else:
                 os.environ['AMTPU_ESCALATE_BUDGET_MB'] = prior
 
+    def test_packed_word_codec_round_trip(self):
+        """pack_register_word (kernel side) and NativeDocPool's
+        _unpack_packed (host side) are the two ends of the packed
+        transfer: encode/decode must round-trip at the edges -- no
+        winner (0xffffff), alive saturation at PACKED_ALIVE_MAX, and
+        the overflow bit."""
+        from automerge_tpu.native import NativeDocPool
+        from automerge_tpu.ops import registers as R
+        winner = np.array([-1, 0, 123456, (1 << 24) - 2], np.int32)
+        alive = np.array([0, 1, 63, 1000], np.int32)
+        ovf = np.array([0, 1, 0, 1], np.uint8)
+        word = R.pack_register_word(winner, alive, ovf)
+        w2, a2, o2 = NativeDocPool._unpack_packed(word)
+        assert w2.tolist() == winner.tolist()
+        assert a2.tolist() == [0, 1, 63, R.PACKED_ALIVE_MAX]
+        assert o2.tolist() == ovf.tolist()
+
+    def test_escalated_merge_writes_decodable_words(self):
+        """The packed member epilogue merges tier results INTO the packed
+        word (native _collect_member_packed); the merged words must
+        decode to the wide-window reference -- winner exact, alive
+        saturated, overflow bit CLEAR for every ladder-resolved row even
+        though the row entered flagged."""
+        from automerge_tpu.native import NativeDocPool
+        from automerge_tpu.ops import registers as R
+        n = 70    # survivors > PACKED_ALIVE_MAX: saturation engaged
+        cols = self._dispatch(self._concurrent_group(n), A=n)
+        group, time, actor, seq, is_del, ctab, cidx = cols
+        ref = R.resolve_registers(
+            group, time, actor, seq, is_del=is_del,
+            alive_in=np.ones(n, bool), window=n,
+            sort_idx=np.lexsort((time, group)).astype(np.int32),
+            clock_table=ctab, clock_idx=cidx)
+        pending, oracle_rows, _tiers = R.escalate_overflow_dispatch(
+            group, time, actor, seq, is_del, ctab, cidx,
+            np.ones(n, bool))
+        assert oracle_rows.size == 0
+        # the merge the native driver performs, on a base word that
+        # entered with the member-overflow route (flag conceptually set)
+        packed = np.full(n, -1, np.int32)     # poisoned base words
+        for ch in R.escalate_overflow_collect_arrays(pending):
+            packed[ch.rows] = R.pack_register_word(ch.winner, ch.alive)
+        w2, a2, o2 = NativeDocPool._unpack_packed(packed)
+        assert w2.tolist() == np.asarray(ref['winner']).tolist()
+        assert a2.tolist() == np.minimum(
+            np.asarray(ref['alive_after']), R.PACKED_ALIVE_MAX).tolist()
+        assert (o2 == 0).all()
+
     def test_packed_word_saturates_alive(self):
         """Widened packed layout: alive saturates at 63 (bits 24..29),
         overflow rides bit 30, winner keeps its 24 bits."""

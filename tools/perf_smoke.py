@@ -1,0 +1,80 @@
+"""CI gate: the packed member epilogue must be ACTIVE on the table
+workload's kernel path (ISSUE 3).
+
+Runs the config-4 shape through tools/quickbench.py with the kernel path
+forced (AMTPU_HOST_FULL=0) and fails if
+
+  * `fallback.oracle` is nonzero -- a register group fell past every
+    escalation tier back to the host oracle, or
+  * `collect.packed_member_batches` is zero -- the member-mode batches
+    stopped taking the packed epilogue (ONE i32 per register row +
+    sparse CSR conflicts), or
+  * `collect.full_matrix_readback` is nonzero -- some batch read back
+    the full winner/conflicts/alive/overflow matrices, the pre-packed
+    transfer wall this gate exists to keep dead.
+
+Wired into `make check` as `make perf-smoke` (next to fallback-check,
+which gates the escalation ladder itself on the same shape).
+
+Usage: [JAX_PLATFORMS=cpu] python tools/perf_smoke.py
+"""
+import json
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def main():
+    env = dict(os.environ)
+    env.setdefault('JAX_PLATFORMS', 'cpu')
+    env['AMTPU_HOST_FULL'] = '0'            # the kernel path IS the subject
+    env.pop('AMTPU_PACKED_EPILOGUE', None)  # gate the DEFAULT epilogue
+    # same deterministic shape as fallback-check: member mode engages and
+    # the dup-assign groups escalate, so the packed epilogue (not the
+    # fused path) is what actually serves the batches
+    env.setdefault('AMTPU_BENCH_C4_DOCS', '256')
+    env.setdefault('AMTPU_BENCH_SHARDS', '8')
+    proc = subprocess.run(
+        [sys.executable, os.path.join(HERE, 'quickbench.py'),
+         '--config', '4', '--runs', '1'],
+        env=env, stdout=subprocess.PIPE, text=True)
+    if proc.returncode != 0:
+        print('perf-smoke: quickbench failed (rc=%d)' % proc.returncode,
+              file=sys.stderr)
+        return 1
+    line = proc.stdout.strip().splitlines()[-1]
+    result = json.loads(line)
+    tel = result.get('telemetry', {})
+    fallbacks = tel.get('fallbacks', {})
+    collect = tel.get('collect', {})
+
+    problems = []
+    if fallbacks.get('oracle', -1) != 0:
+        problems.append('fallback.oracle = %s (want 0)'
+                        % fallbacks.get('oracle'))
+    if collect.get('packed_member_batches', 0) <= 0:
+        problems.append('collect.packed_member_batches missing/zero -- '
+                        'the packed member epilogue did not engage')
+    if collect.get('full_matrix_readback', 0) != 0:
+        problems.append('collect.full_matrix_readback = %s (want 0) -- '
+                        'a batch read back the full register matrices'
+                        % collect.get('full_matrix_readback'))
+    if problems:
+        print('perf-smoke FAILED:', file=sys.stderr)
+        for p in problems:
+            print('  * ' + p, file=sys.stderr)
+        print('  telemetry.collect = %s' % json.dumps(collect),
+              file=sys.stderr)
+        print('  telemetry.fallbacks = %s' % json.dumps(fallbacks),
+              file=sys.stderr)
+        return 1
+    print('perf-smoke: packed epilogue on %d member batches, '
+          'full-matrix readbacks 0, oracle 0, %.0f ops/s'
+          % (collect['packed_member_batches'], result.get('value', 0.0)))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

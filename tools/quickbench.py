@@ -47,9 +47,17 @@ def main():
                     help='leave span tracing disabled (measures the '
                          'production path; always-on counters still '
                          'accumulate)')
+    ap.add_argument('--phases', type=int, nargs='?', const=10, default=0,
+                    metavar='N',
+                    help='print a top-N phase table (seconds + share of '
+                         'native batch time) from the embedded telemetry '
+                         'block -- collect regressions readable without '
+                         'jq (default N=10; implies tracing)')
     args = ap.parse_args()
     if args.runs < 1:
         ap.error('--runs must be >= 1')
+    if args.phases and args.no_trace:
+        ap.error('--phases needs tracing; drop --no-trace')
     if not args.no_trace:
         telemetry.enable()
 
@@ -93,10 +101,38 @@ def main():
              total_ops / med), file=sys.stderr)
     if telemetry.enabled():
         print(telemetry.phase_report(), file=sys.stderr)
+    block = telemetry.bench_block()
+    if args.phases:
+        print(phase_table(block, args.phases), file=sys.stderr)
     print(json.dumps({'metric': 'quickbench_%s' % metric,
                       'value': round(total_ops / med, 1),
                       'unit': 'ops/sec', 'config': args.config,
-                      'telemetry': telemetry.bench_block()}))
+                      'telemetry': block}))
+
+
+def phase_table(block, top_n):
+    """Top-N phase table from a bench_block: seconds + share of the
+    summed per-shard native batch time (shares can exceed 100% only if
+    a span double-counts; collect share is THE regression gauge --
+    ISSUE 3 tracks it below 50%).  Note: with async dispatch,
+    device.collect includes the kernel compute it blocks on."""
+    phases = block.get('phases') or {}
+    lat = block.get('batch_latency', {})
+    # pipeline mode drives _phase_a/b directly, so only the whole-batch
+    # 'sharded' series exists -- fall back to it for the share basis
+    native_s = (lat.get('native', {}).get('sum', 0.0)
+                or lat.get('sharded', {}).get('sum', 0.0))
+    rows = sorted(((v['s'], v['n'], k) for k, v in phases.items()
+                   if v['s'] > 0), reverse=True)[:top_n]
+    if not rows:
+        return 'phase table: no phase occupancy recorded'
+    width = max(len(k) for _s, _n, k in rows)
+    out = ['top %d phases (of %.2fs native batch time):'
+           % (len(rows), native_s)]
+    for s, n, k in rows:
+        share = (' %5.1f%%' % (100.0 * s / native_s)) if native_s else ''
+        out.append('  %-*s %8.3fs%s  x%d' % (width, k, s, share, n))
+    return '\n'.join(out)
 
 
 if __name__ == '__main__':
