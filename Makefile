@@ -1,7 +1,7 @@
 # Top-level developer entry points.
 
 .PHONY: all native test bench bench-all bench-tpu check clean wheel \
-	telemetry-check fallback-check perf-smoke
+	telemetry-check fallback-check perf-smoke chaos-check
 
 all: native
 
@@ -51,6 +51,7 @@ check: native
 	  g.dryrun_multichip(8); print('dryrun ok')"
 	$(MAKE) fallback-check
 	$(MAKE) perf-smoke
+	$(MAKE) chaos-check
 	@echo "CHECK GREEN"
 
 # Escalation-ladder gate (ISSUE 2): a config-4-shaped smoke on the
@@ -66,6 +67,15 @@ fallback-check: native
 # transfer wall may not silently return.
 perf-smoke: native
 	JAX_PLATFORMS=cpu python tools/perf_smoke.py
+
+# Resilience gate (ISSUE 4, docs/RESILIENCE.md): injected faults must
+# actually be isolated -- two forced transient device faults retry to a
+# byte-identical config-3 result, a doc-pinned permanent fault
+# quarantines exactly that doc with healthy-doc parity intact, and a
+# SIGKILLed sidecar server respawns + replays its checkpoint WAL with a
+# clean process tree afterwards.
+chaos-check: native
+	JAX_PLATFORMS=cpu python tools/chaos_check.py
 
 # Observability gate (docs/OBSERVABILITY.md): idle telemetry must be
 # free.  Interleaved A/B of the disabled path vs a no-op-patched "raw"

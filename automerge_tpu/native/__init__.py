@@ -20,7 +20,7 @@ import time
 import msgpack
 import numpy as np
 
-from .. import telemetry, trace
+from .. import faults, telemetry, trace
 from ..utils.common import doc_key
 from ..utils.wire import map_header as _map_header
 from ..utils.wire import read_map_header as _read_map_header
@@ -55,6 +55,8 @@ def _load():
     lib.amtpu_begin_local.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                       ctypes.c_char_p, ctypes.c_int64]
     lib.amtpu_batch_free.argtypes = [ctypes.c_void_p]
+    lib.amtpu_batch_rollback.restype = ctypes.c_int
+    lib.amtpu_batch_rollback.argtypes = [ctypes.c_void_p]
     lib.amtpu_batch_dims.argtypes = [ctypes.c_void_p,
                                      ctypes.POINTER(ctypes.c_int64)]
     for name in ('g', 't', 'a', 's', 'clocktab', 'clockidx', 'sort',
@@ -241,6 +243,38 @@ def live_batch_handles():
         return _live_batches
 
 
+def _rollback_batch(bh, exc=None):
+    """Best-effort pool rollback of a FAILED batch (pre-free).
+
+    Success (returns True) means the pool is byte-identical to its
+    pre-begin state: the failure is retryable/bisectable because re-
+    applying the same changes is not swallowed by seq dedup.  Failure
+    means emit already ran; the exception is marked
+    ``amtpu_state_suspect`` so `resilience` refuses to re-apply those
+    docs (the pre-resilience whole-batch raise is the only safe
+    outcome there).
+    """
+    if lib().amtpu_batch_rollback(bh) != 0:
+        if exc is not None:
+            exc.amtpu_state_suspect = True
+        telemetry.metric('resilience.rollback_unavailable')
+        return False
+    telemetry.metric('resilience.rollback')
+    return True
+
+
+def _batch_docs(bh, payload):
+    """Doc keys of a begun batch -- fault-pinning lookups only (the
+    disarmed fast path never calls this)."""
+    if isinstance(payload, tuple):
+        head = ctypes.string_at(payload[0], min(payload[1], 16))
+    else:
+        head = bytes(payload[:16])
+    n = _read_map_header(head)[0]
+    L = lib()
+    return [L.amtpu_batch_doc_id(bh, i).decode() for i in range(n)]
+
+
 def _packed_epilogue_on():
     """AMTPU_PACKED_EPILOGUE=0 forces the full-matrix member epilogue
     (the pre-packed readback path, kept as the parity A/B arm); default
@@ -313,6 +347,7 @@ def _collect_ready_order(entries, on_result=None, on_error=None):
             if on_result is not None:
                 on_result(key, result)
         except Exception as e:
+            _rollback_batch(ctx['bh'], e)
             if on_error is not None:
                 on_error(key, e)
             else:
@@ -356,6 +391,8 @@ def _load_batch(pool, blobs):
     and applies it as a single batch -- per-doc loads each pay a full
     device round trip; a whole DocSet restore should pay one."""
     from ..errors import RangeError
+    if faults.ARMED:
+        faults.fire('checkpoint.load', [doc_key(d) for d in blobs])
     parts = [_map_header(len(blobs))]
     for doc_id, data in blobs.items():
         if not data.startswith(_CKPT_PREFIX):
@@ -368,11 +405,14 @@ def _load_batch(pool, blobs):
 
 def _apply_batch_dicts(pool, changes_by_doc):
     """Shared dict-level apply_batch: msgpack round trip through the
-    pool's wire path (pool is any object with apply_batch_bytes)."""
+    pool's RESILIENT wire path (pool is any object with
+    apply_batch_bytes_resilient) -- a device/native-path failure is
+    retried, bisected, and at worst quarantined per doc instead of
+    failing every doc in the batch (automerge_tpu.resilience)."""
     keyed = {NativeDocPool._doc_key(d): chs
              for d, chs in changes_by_doc.items()}
     payload = msgpack.packb(keyed, use_bin_type=True)
-    out = msgpack.unpackb(pool.apply_batch_bytes(payload),
+    out = msgpack.unpackb(pool.apply_batch_bytes_resilient(payload),
                           raw=False, strict_map_key=False)
     # the op counter lives here because this is where changes exist as
     # decoded dicts (the bytes path can't count ops without paying a
@@ -384,6 +424,18 @@ def _apply_batch_dicts(pool, changes_by_doc):
     telemetry.OPS.inc(sum(len(c.get('ops', ()))
                           for chs in changes_by_doc.values() for c in chs))
     return {d: out[NativeDocPool._doc_key(d)] for d in changes_by_doc}
+
+
+def _raise_if_quarantined(doc_id, result):
+    """Single-doc entry points keep their raise contract: a one-doc
+    batch has nothing to isolate FROM, so a quarantine envelope there
+    surfaces as the exception it stands for."""
+    from ..resilience import is_quarantined
+    if is_quarantined(result):
+        from ..errors import AutomergeError
+        raise AutomergeError('doc %r quarantined: [%s] %s'
+                             % (doc_id, result['errorType'],
+                                result['error']))
 
 
 def _raise_last():
@@ -520,6 +572,9 @@ class NativeDocPool:
         ctx = self._phase_a(payload)
         try:
             out = self._phase_b(ctx)
+        except Exception as e:
+            _rollback_batch(ctx['bh'], e)
+            raise
         finally:
             _free_batch(ctx['bh'])
         # doc count comes free from the payload's map header; a tuple
@@ -555,13 +610,24 @@ class NativeDocPool:
         if not bh:
             _raise_last()
         _track_begin()
-        return self._phase_a_rest(bh)
+        fault_docs = None
+        if faults.ARMED:
+            fault_docs = _batch_docs(bh, payload)
+            try:
+                faults.fire('native.begin', fault_docs)
+            except Exception as e:
+                # semantics: "begin failed" -- the pool must look
+                # untouched, exactly like a real begin-phase throw
+                _rollback_batch(bh, e)
+                _free_batch(bh)
+                raise
+        return self._phase_a_rest(bh, fault_docs)
 
-    def _phase_a_rest(self, bh):
+    def _phase_a_rest(self, bh, fault_docs=None):
         """Post-begin half of phase a: read batch dims and dispatch the
         device kernels.  Shared by the batch and local-change entries."""
         L = lib()
-        ctx = {'bh': bh}
+        ctx = {'bh': bh, 'fault_docs': fault_docs}
         try:
             dims = (ctypes.c_int64 * self.N_DIMS)()
             L.amtpu_batch_dims(bh, dims)
@@ -641,6 +707,8 @@ class NativeDocPool:
                 ctx.update(mode='hostreg')
                 return ctx
 
+            if faults.ARMED:
+                faults.fire('device.dispatch', ctx['fault_docs'])
             devtime = _devtime_on()
             t0 = time.perf_counter() if devtime else 0.0
             if fused_ok:
@@ -679,11 +747,14 @@ class NativeDocPool:
                                  time.perf_counter() - t0)
                     trace.metric('device.dispatches')
             return ctx
-        except Exception:
+        except Exception as e:
             # phase-a failure frees its OWN handle (callers only see an
             # exception, never a ctx to free); the live-handle counter
             # stays balanced -- tests assert live_batch_handles() == 0
-            # after forced phase-a errors
+            # after forced phase-a errors.  Rollback first: begin already
+            # committed schedule state, and a retry/bisect is only byte-
+            # safe against the pre-begin pool.
+            _rollback_batch(bh, e)
             _free_batch(bh)
             raise
 
@@ -875,6 +946,12 @@ class NativeDocPool:
         """Collect device results, run host mid+emit, return patch bytes."""
         L = lib()
         bh = ctx['bh']
+        if faults.ARMED:
+            # both sites fire BEFORE their phase mutates anything, so a
+            # rollback + re-apply reproduces the fault-free byte stream
+            if ctx['mode'] != 'hostreg':
+                faults.fire('device.collect', ctx.get('fault_docs'))
+            faults.fire('native.mid', ctx.get('fault_docs'))
         T, Tp, A, Ap, Larena, Lp, n_blocks, max_obj, CTp = ctx['dims']
 
         def ip(a):
@@ -1381,11 +1458,21 @@ class NativeDocPool:
 
     _doc_key = staticmethod(doc_key)
 
+    def apply_batch_bytes_resilient(self, payload):
+        """`apply_batch_bytes` behind the resilience layer: transient
+        failures retry with backoff, persistent ones bisect down to the
+        poison doc(s), which quarantine as per-doc error envelopes while
+        every healthy doc commits (docs/RESILIENCE.md)."""
+        from .. import resilience
+        return resilience.apply_payload(self, payload)
+
     def apply_batch(self, changes_by_doc):
         return _apply_batch_dicts(self, changes_by_doc)
 
     def apply_changes(self, doc_id, changes):
-        return self.apply_batch({doc_id: changes})[doc_id]
+        out = self.apply_batch({doc_id: changes})[doc_id]
+        _raise_if_quarantined(doc_id, out)
+        return out
 
     def apply_local_change(self, doc_id, request):
         """Applies one local change request with the reference's undo
@@ -1402,9 +1489,19 @@ class NativeDocPool:
         if not bh:
             _raise_last()
         _track_begin()
-        ctx = self._phase_a_rest(bh)
+        if faults.ARMED:
+            try:
+                faults.fire('native.begin', [key])
+            except Exception as e:
+                _rollback_batch(bh, e)
+                _free_batch(bh)
+                raise
+        ctx = self._phase_a_rest(bh, [key] if faults.ARMED else None)
         try:
             out = self._phase_b(ctx)
+        except Exception as e:
+            _rollback_batch(bh, e)
+            raise
         finally:
             _free_batch(bh)
         return msgpack.unpackb(out, raw=False, strict_map_key=False)[key]
@@ -1634,9 +1731,17 @@ class ShardedNativePool:
                             if n.value > 1 else None)
             with trace.span('shard.run'):
                 if self.mode == 'pipeline':
-                    results = self._run_pipelined(subs)
+                    results, errors = self._run_pipelined(subs)
                 else:
-                    results = self._run_threaded(subs)
+                    results, errors = self._run_threaded(subs)
+            if errors:
+                # poison-batch isolation at SHARD granularity: a failed
+                # shard rolled its pool back, so its whole sub-payload
+                # re-applies through the resilience layer (retry ->
+                # bisect -> quarantine) while the healthy shards'
+                # results stand (docs/RESILIENCE.md)
+                errors = self._retry_failed_shards(subs, results, errors)
+            _raise_shard_errors(errors)
         finally:
             L.amtpu_shard_free(sp)
         # merge the per-shard {doc: patch} maps at the byte level: sum the
@@ -1683,8 +1788,7 @@ class ShardedNativePool:
 
         _collect_ready_order(ctxs, on_result=keep,
                              on_error=lambda s, e: errors.append((s, e)))
-        _raise_shard_errors(errors)
-        return results
+        return results, errors
 
     def _run_threaded(self, subs):
         results = [None] * self.n_shards
@@ -1704,15 +1808,36 @@ class ShardedNativePool:
             t.start()
         for t in threads:
             t.join()
-        _raise_shard_errors(errors)
-        return results
+        return results, errors
+
+    def _retry_failed_shards(self, subs, results, errors):
+        """Re-applies each failed shard's sub-payload through the
+        resilience layer on that shard's own pool; returns the errors
+        resilience must not isolate (they re-raise, as before)."""
+        from .. import resilience
+        remaining = []
+        for s, e in errors:
+            if not resilience.should_isolate(e):
+                remaining.append((s, e))
+                continue
+            try:
+                results[s] = resilience.apply_payload(
+                    self.pools[s], subs[s], first_exc=e)
+            except Exception as e2:
+                remaining.append((s, e2))
+        return remaining
+
+    def apply_batch_bytes_resilient(self, payload):
+        """Alias for `apply_batch_bytes`: the sharded driver already
+        isolates failures per shard internally."""
+        return self.apply_batch_bytes(payload)
 
     def apply_batch(self, changes_by_doc):
         return _apply_batch_dicts(self, changes_by_doc)
 
     def apply_changes(self, doc_id, changes):
         return self.pools[self._shard_of(doc_id)].apply_changes(
-            doc_id, changes)
+            doc_id, changes)   # quarantine raises inside (single doc)
 
     def apply_local_change(self, doc_id, request):
         return self.pools[self._shard_of(doc_id)].apply_local_change(

@@ -25,7 +25,6 @@ unique ids for (doc, obj, key), so no per-doc padding is needed.
 """
 
 import os
-import threading
 from collections import namedtuple
 from functools import partial
 
@@ -610,16 +609,6 @@ def _member_windows(rows, actor, seq):
     return (rows, lens_i, j_rep[ordp], width)
 
 
-#: reusable host staging buffers for tier chunks, keyed by the shape
-#: bucket (thread-local: shard threads escalate concurrently).  Reuse is
-#: CPU-backend only: there the dispatch-time host->device copy is
-#: synchronous, so the buffers are free once the jit call returns.  On
-#: accelerators the H2D transfer may still be in flight when the next
-#: chunk would overwrite the buffer, so each dispatch gets fresh arrays
-#: (which the donated jit then consumes).
-_tier_state = threading.local()
-
-
 def _tier_alloc(Tn, W):
     return {
         'mem': np.empty((Tn, W), np.int32),
@@ -632,16 +621,15 @@ def _tier_alloc(Tn, W):
 
 
 def _tier_buffers(Tn, W):
-    import jax
-    if jax.default_backend() != 'cpu':
-        return _tier_alloc(Tn, W)
-    cache = getattr(_tier_state, 'bufs', None)
-    if cache is None:
-        cache = _tier_state.bufs = {}
-    bufs = cache.get((Tn, W))
-    if bufs is None:
-        bufs = cache[(Tn, W)] = _tier_alloc(Tn, W)
-    return bufs
+    # Every dispatch gets FRESH staging arrays.  An earlier revision
+    # reused thread-local buffers on the CPU backend, assuming the
+    # dispatch-time host->device copy is synchronous -- it is not: jax's
+    # CPU backend ZERO-COPIES 64-byte-aligned numpy inputs and dispatch
+    # is async, so refilling a reused buffer for chunk B while chunk A's
+    # kernel is still consuming the same memory silently corrupts A's
+    # inputs (alignment-dependent, nondeterministic).  On accelerators
+    # the fresh arrays additionally feed donate_argnums.
+    return _tier_alloc(Tn, W)
 
 
 _members_donated = None
@@ -720,8 +708,13 @@ def escalate_dispatch_groups(groups, time, actor, seq, is_del,
     C++ escalation layout (amtpu_esc_*), which the native driver reads
     instead of re-deriving windows host-side.  Same return contract as
     `escalate_overflow_dispatch`."""
-    from .. import telemetry
+    from .. import faults, telemetry
 
+    if faults.ARMED:
+        # tier dispatch is pure device work over a still-live batch
+        # handle: a fault here propagates to the phase-a/b handlers,
+        # which roll the pool back -- retry/bisect stay byte-safe
+        faults.fire('escalation.tier')
     if max_tier is None:
         max_tier = int(os.environ.get('AMTPU_MAX_TIER', DEFAULT_MAX_TIER))
     time = np.asarray(time)

@@ -3,51 +3,244 @@ socket.  This is the Python twin of the Node `backend=tpu` adapter -- it
 implements the reference Backend call surface (backend/index.js:312-315)
 by shipping requests across the process boundary, which is exactly the
 deployment seam the reference designed the frontend/backend split for
-(CHANGELOG.md:36-39, "work moved to a background thread")."""
+(CHANGELOG.md:36-39, "work moved to a background thread").
+
+Self-healing (docs/RESILIENCE.md): a client that SPAWNED its server
+owns the process, so on a crashed/wedged server (EOF, broken pipe,
+request deadline exceeded) it kills the remains, respawns the server
+with capped exponential backoff, replays its state from the rolling
+checkpoint WAL (periodic `save` snapshots + the mutating-request log
+since, riding the existing save/load protocol), and retries the
+in-flight request -- the request never received a response, so the
+replayed state cannot contain it and the retry is exactly-once.  Each
+respawn exports the restart count to the new server via
+``AMTPU_SIDECAR_RESTARTS``, which `healthz` reports.  Clients that
+ADOPTED a process or connected to a socket do not own the server;
+for them a transport error marks the client dead so reuse raises a
+clear error instead of desyncing request ids.
+"""
 
 import json
+import os
 import socket
 import struct
 import subprocess
 import sys
+import time
 
 from .. import telemetry
 
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+#: commands that mutate server state -- the WAL records exactly these
+WAL_CMDS = ('apply_changes', 'apply_batch', 'apply_local_change', 'load')
+
+
+class SidecarTimeout(ConnectionError):
+    """The server produced no response within the request deadline."""
+
+
+def _env_float(name, default):
+    try:
+        v = os.environ.get(name, '')
+        return float(v) if v else default
+    except ValueError:
+        return default
+
+
+class CheckpointWAL:
+    """Rolling client-side write-ahead log for sidecar state replay.
+
+    Two tiers: per-doc ``save()`` checkpoint snapshots, plus the ordered
+    log of mutating requests acknowledged since the last compaction.
+    When the log exceeds ``compact_every`` entries (AMTPU_WAL_COMPACT,
+    default 32) every known doc is snapshotted and the log is cleared,
+    bounding both replay time and WAL memory.  Replay = load every
+    snapshot, then re-send the residual log in order.
+
+    Caveat: checkpoints serialize change history only, so a server-side
+    undo stack survives a respawn only as far as the residual log's
+    `apply_local_change` entries rebuild it; an undo whose originating
+    change was already compacted away replays as an error.
+    """
+
+    def __init__(self, compact_every=None):
+        if compact_every is None:
+            try:
+                compact_every = int(os.environ.get('AMTPU_WAL_COMPACT',
+                                                   '32') or 32)
+            except ValueError:
+                compact_every = 32
+        self.compact_every = max(1, compact_every)
+        self.snapshots = {}      # doc -> checkpoint_b64
+        self.log = []            # (cmd, kwargs) in ack order
+        self.docs = set()
+
+    @staticmethod
+    def _docs_of(cmd, kwargs):
+        if cmd == 'apply_batch':
+            return list(kwargs.get('docs', {}))
+        doc = kwargs.get('doc')
+        return [doc] if doc is not None else []
+
+    def record(self, cmd, kwargs):
+        """One mutating request was ACKNOWLEDGED by the server."""
+        self.log.append((cmd, kwargs))
+        self.docs.update(self._docs_of(cmd, kwargs))
+
+    def maybe_compact(self, call_raw):
+        """Snapshot + truncate when the log is due.  ``call_raw`` is the
+        client's no-WAL no-heal request function.  A compaction failure
+        (server died under us) is swallowed -- the uncompacted log still
+        replays, and the NEXT request heals the server."""
+        if len(self.log) < self.compact_every:
+            return
+        try:
+            snaps = {}
+            for doc in sorted(self.docs):
+                snaps[doc] = call_raw('save',
+                                      {'doc': doc})['checkpoint_b64']
+        except Exception:
+            telemetry.metric('sidecar.client.wal_compact_failed')
+            return
+        self.snapshots = snaps
+        del self.log[:]
+        telemetry.metric('sidecar.client.wal_compactions')
+
+    def replay(self, call_raw):
+        """Rebuilds a FRESH server's state: snapshots first, then the
+        residual log, in order."""
+        for doc in sorted(self.snapshots):
+            call_raw('load', {'doc': doc, 'data': self.snapshots[doc]})
+        for cmd, kwargs in self.log:
+            call_raw(cmd, dict(kwargs))
+        telemetry.metric('sidecar.client.wal_replays')
+
 
 class SidecarClient:
-    def __init__(self, proc=None, sock_path=None, use_msgpack=False):
+    # class-level defaults so a hand-assembled client (tests build one
+    # via __new__ around BytesIO pipes) behaves like a non-healing
+    # adopted-transport client
+    _dead = False
+    _heal = False
+    _wal = None
+    _deadline_s = None
+    _heartbeat_s = None
+    _max_respawns = 3
+    _respawns = 0
+    _last_ok = 0.0
+    _proc = None
+    _sock = None
+
+    def __init__(self, proc=None, sock_path=None, use_msgpack=False,
+                 deadline_s=None, heal=None, max_respawns=None,
+                 heartbeat_s=None, wal=None):
         """Connects to a server.  Exactly one of:
           * proc=None, sock_path=None: spawn a stdio server subprocess
           * sock_path: connect to a unix socket
           * proc: adopt an existing subprocess with stdio pipes
+
+        `deadline_s` (AMTPU_SIDECAR_DEADLINE_S) bounds the wait for the
+        first byte of each response; `heartbeat_s`
+        (AMTPU_SIDECAR_HEARTBEAT_S) pings before a request when the
+        connection has been idle longer than that, so a dead server is
+        caught by a cheap probe instead of a shipped batch.  `heal`
+        enables crash-respawn-replay; default: on iff this client spawns
+        its own server (it owns the process).  `max_respawns`
+        (AMTPU_SIDECAR_MAX_RESPAWNS, default 3) bounds heals per request.
         """
         self._msgpack = use_msgpack
         self._next_id = 0
         self._proc = None
         self._sock = None
+        self._dead = False
+        self._respawns = 0
+        self._last_ok = time.monotonic()
+        self._deadline_s = deadline_s if deadline_s is not None else \
+            (_env_float('AMTPU_SIDECAR_DEADLINE_S', 0) or None)
+        self._heartbeat_s = heartbeat_s if heartbeat_s is not None else \
+            (_env_float('AMTPU_SIDECAR_HEARTBEAT_S', 0) or None)
+        if max_respawns is None:
+            max_respawns = int(_env_float('AMTPU_SIDECAR_MAX_RESPAWNS', 3))
+        self._max_respawns = max_respawns
+        if sock_path or proc is not None:
+            # healing means killing + respawning the server from OUR
+            # spawn recipe -- only meaningful for a server this client
+            # created.  Refuse loudly rather than recording a WAL that
+            # can never replay.
+            if heal:
+                raise ValueError('heal=True requires a self-spawned '
+                                 'server (no proc=/sock_path=)')
+            self._heal = False
         if sock_path:
             self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
             self._sock.connect(sock_path)
             self._r = self._sock.makefile('rb')
             self._w = self._sock.makefile('wb')
+        elif proc is not None:
+            self._adopt(proc)
         else:
-            if proc is None:
-                cmd = [sys.executable, '-m', 'automerge_tpu.sidecar.server']
-                if use_msgpack:
-                    cmd.append('--msgpack')
-                proc = subprocess.Popen(
-                    cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE)
-            self._proc = proc
-            self._r = proc.stdout
-            self._w = proc.stdin
+            self._spawn()
+            self._heal = True if heal is None else bool(heal)
+        self._wal = None
+        if self._heal:
+            self._wal = wal if wal is not None else CheckpointWAL()
+
+    # -- process lifecycle ----------------------------------------------
+
+    def _spawn(self):
+        cmd = [sys.executable, '-m', 'automerge_tpu.sidecar.server']
+        if self._msgpack:
+            cmd.append('--msgpack')
+        env = dict(os.environ)
+        # cwd-independent import of this very package + restart count
+        # surfaced by the new server's healthz
+        env['PYTHONPATH'] = _REPO_ROOT + (
+            os.pathsep + env['PYTHONPATH'] if env.get('PYTHONPATH') else '')
+        env['AMTPU_SIDECAR_RESTARTS'] = str(self._respawns)
+        self._adopt(subprocess.Popen(cmd, stdin=subprocess.PIPE,
+                                     stdout=subprocess.PIPE, env=env))
+
+    def _adopt(self, proc):
+        self._proc = proc
+        self._r = proc.stdout
+        self._w = proc.stdin
+
+    def _teardown_proc(self):
+        """Closes pipes and reaps the server process, escalating to
+        kill() -- never leaks a zombie into the process tree."""
+        proc, self._proc = self._proc, None
+        for f in (getattr(self, '_w', None), getattr(self, '_r', None)):
+            try:
+                if f is not None:
+                    f.close()
+            except Exception:
+                pass
+        if proc is not None:
+            try:
+                proc.kill()
+            except Exception:
+                pass
+            try:
+                proc.wait(timeout=10)
+            except Exception:
+                pass
 
     def close(self):
+        self._dead = True
         try:
             self._w.close()
         except Exception:
             pass
         if self._proc is not None:
-            self._proc.wait(timeout=10)
+            try:
+                self._proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                # a wedged server must not leak past close(): escalate
+                # to SIGKILL and reap the corpse
+                self._proc.kill()
+                self._proc.wait(timeout=10)
         if self._sock is not None:
             self._sock.close()
 
@@ -57,22 +250,31 @@ class SidecarClient:
     def __exit__(self, *exc):
         self.close()
 
-    # -- rpc ------------------------------------------------------------
+    # -- transport ------------------------------------------------------
 
-    def call(self, cmd, **kwargs):
-        self._next_id += 1
-        req = dict(kwargs, cmd=cmd, id=self._next_id)
-        # distributed tracing: when a span is active client-side, ship
-        # its ids so the server's request span resumes the same trace
-        # (server consumes the envelope; responses are unchanged)
-        tctx = telemetry.current_trace_context()
-        if tctx is not None:
-            req.setdefault('trace', tctx)
+    def _await_response(self):
+        """Blocks until the first byte of the response is available (or
+        the request deadline passes).  Crash detection needs no timeout
+        -- a dead server's pipe/socket EOFs immediately -- so the
+        deadline only guards the WEDGED-server case."""
+        if self._deadline_s is None:
+            return
+        import select
+        ready, _, _ = select.select([self._r], [], [], self._deadline_s)
+        if not ready:
+            raise SidecarTimeout(
+                'sidecar server produced no response within %.1fs'
+                % self._deadline_s)
+
+    def _roundtrip(self, req):
+        """One framed request/response exchange; raises ConnectionError
+        (incl. SidecarTimeout) on any transport-level failure."""
         if self._msgpack:
             import msgpack
             body = msgpack.packb(req, use_bin_type=True)
             self._w.write(struct.pack('>I', len(body)) + body)
             self._w.flush()
+            self._await_response()
             head = self._r.read(4)
             if len(head) < 4:
                 raise ConnectionError('sidecar server closed the stream')
@@ -82,10 +284,24 @@ class SidecarClient:
         else:
             self._w.write((json.dumps(req) + '\n').encode())
             self._w.flush()
+            self._await_response()
             line = self._r.readline()
             if not line:
                 raise ConnectionError('sidecar server closed the stream')
             resp = json.loads(line)
+        self._last_ok = time.monotonic()
+        return resp
+
+    def _call_raw(self, cmd, kwargs):
+        """Request + protocol error mapping, NO healing and NO WAL
+        recording -- the primitive heal/replay/compaction run on (a
+        replayed request must not re-enter the WAL)."""
+        self._next_id += 1
+        req = dict(kwargs, cmd=cmd, id=self._next_id)
+        tctx = telemetry.current_trace_context()
+        if tctx is not None:
+            req.setdefault('trace', tctx)
+        resp = self._roundtrip(req)
         if 'error' in resp:
             from ..errors import AutomergeError, RangeError
             types = {'AutomergeError': AutomergeError,
@@ -94,6 +310,75 @@ class SidecarClient:
             raise types.get(resp.get('errorType'), AutomergeError)(
                 resp['error'])
         return resp['result']
+
+    def _respawn_and_replay(self):
+        """Kills the server remains, respawns with capped exponential
+        backoff until a ping answers, then replays the checkpoint WAL
+        into the fresh process."""
+        self._respawns += 1
+        telemetry.metric('sidecar.client.respawns')
+        deadline = time.monotonic() + _env_float(
+            'AMTPU_SIDECAR_RESPAWN_DEADLINE_S', 30.0)
+        delay = 0.05
+        while True:
+            self._teardown_proc()
+            try:
+                self._spawn()
+                self._call_raw('ping', {})
+                break
+            except (OSError, ConnectionError) as e:
+                if time.monotonic() > deadline:
+                    self._dead = True
+                    raise ConnectionError(
+                        'sidecar server would not come back: %s' % e) \
+                        from e
+                time.sleep(delay)
+                delay = min(delay * 2, 1.0)
+        if self._wal is not None:
+            try:
+                self._wal.replay(self._call_raw)
+            except Exception as e:
+                # a half-replayed server is WORSE than a dead client:
+                # later calls would silently build on state missing the
+                # WAL's tail.  Refuse loudly.
+                self._dead = True
+                self._teardown_proc()
+                raise ConnectionError(
+                    'sidecar WAL replay failed after respawn (%s: %s); '
+                    'client is dead' % (type(e).__name__, e)) from e
+
+    # -- rpc ------------------------------------------------------------
+
+    def call(self, cmd, **kwargs):
+        if self._dead:
+            raise ConnectionError(
+                'sidecar client is dead (server lost or close() called); '
+                'build a new SidecarClient')
+        heals = 0
+        while True:
+            try:
+                if (self._heartbeat_s is not None and cmd != 'ping'
+                        and time.monotonic() - self._last_ok
+                        > self._heartbeat_s):
+                    # cheap liveness probe: catch a dead server before
+                    # shipping (and possibly losing) a batch
+                    self._call_raw('ping', {})
+                result = self._call_raw(cmd, kwargs)
+                break
+            except ConnectionError as e:
+                telemetry.metric('sidecar.client.transport_errors')
+                if not self._heal or self._proc is None \
+                        or heals >= self._max_respawns:
+                    # reuse after this point would desync request ids /
+                    # framing -- refuse loudly instead
+                    self._dead = True
+                    raise
+                heals += 1
+                self._respawn_and_replay()
+        if self._wal is not None and cmd in WAL_CMDS:
+            self._wal.record(cmd, kwargs)
+            self._wal.maybe_compact(self._call_raw)
+        return result
 
     # -- Backend surface -------------------------------------------------
 
@@ -125,3 +410,8 @@ class SidecarClient:
 
     def healthz(self):
         return self.call('healthz')
+
+    @property
+    def restarts(self):
+        """Server respawns this client has performed."""
+        return self._respawns

@@ -48,12 +48,13 @@ Run: python -m automerge_tpu.sidecar.server [--socket PATH] [--msgpack]
 import argparse
 import json
 import os
+import signal
 import socket
 import struct
 import sys
 import time
 
-from .. import telemetry
+from .. import faults, telemetry
 from ..errors import AutomergeError, RangeError
 from ..telemetry import httpd as telemetry_httpd
 from ..utils.jaxenv import pin_cpu
@@ -191,11 +192,31 @@ class SidecarBackend:
         except (AutomergeError, RangeError, TypeError) as e:
             return {'id': rid, 'error': str(e),
                     'errorType': type(e).__name__}
+        except Exception as e:
+            # an unexpected exception out of the pool (e.g. a RuntimeError
+            # from JAX) must not kill the whole serve loop: answer the
+            # protocol's InternalError envelope and keep serving -- one
+            # poisoned request is one failed response, not an outage
+            telemetry.SIDECAR_INTERNAL.inc()
+            telemetry.metric('sidecar.internal_errors')
+            return {'id': rid,
+                    'error': '%s: %s' % (type(e).__name__, e),
+                    'errorType': 'InternalError'}
 
 
 def serve_stream(rfile, wfile, use_msgpack=False, backend=None):
-    """Serves requests from a byte stream until EOF."""
+    """Serves requests from a byte stream until EOF.
+
+    The `sidecar.frame` fault site fires per request BEFORE dispatch and
+    is deliberately uncaught: an armed frame fault kills the serve loop
+    (and the process, under __main__), simulating the server crash the
+    self-healing client exists to survive."""
     backend = backend or SidecarBackend()
+
+    def frame_fault():
+        if faults.ARMED:
+            faults.fire('sidecar.frame')
+
     if use_msgpack:
         import msgpack
         while True:
@@ -214,6 +235,7 @@ def serve_stream(rfile, wfile, use_msgpack=False, backend=None):
                 resp = {'id': None, 'error': 'bad msgpack: %s' % e,
                         'errorType': 'RangeError'}
             else:
+                frame_fault()
                 resp = backend.handle(req)
             out = msgpack.packb(resp, use_bin_type=True)
             wfile.write(struct.pack('>I', len(out)) + out)
@@ -229,6 +251,7 @@ def serve_stream(rfile, wfile, use_msgpack=False, backend=None):
                 resp = {'id': None, 'error': 'bad json: %s' % e,
                         'errorType': 'RangeError'}
             else:
+                frame_fault()
                 resp = backend.handle(req)
             wfile.write((json.dumps(resp) + '\n').encode())
             wfile.flush()
@@ -273,12 +296,38 @@ def main(argv=None):
         print('sidecar: metrics on http://%s:%d/metrics'
               % (args.metrics_host, srv.server_port), file=sys.stderr)
 
+    # supervised restarts deliver SIGTERM (and interactive runs SIGINT);
+    # the handler does the listener/socket-path cleanup ITSELF and exits
+    # hard -- raising SystemExit from a signal handler is unreliable
+    # here (the signal may land inside a C-extension callback, e.g. the
+    # XLA GC hook, where the exception is printed and swallowed), and a
+    # stale socket path hands the next incarnation an "address already
+    # in use" race
+    cleanup = []      # filled by the socket branch below
+
+    def _graceful_exit(signum, _frame):
+        for fn in cleanup:
+            try:
+                fn()
+            except Exception:
+                pass
+        os._exit(128 + signum)
+
+    try:
+        signal.signal(signal.SIGTERM, _graceful_exit)
+        signal.signal(signal.SIGINT, _graceful_exit)
+    except ValueError:
+        pass      # not the main thread (embedded serve): signals stay
+
     if args.socket:
         if os.path.exists(args.socket):
             os.unlink(args.socket)
         srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         srv.bind(args.socket)
         srv.listen(1)
+        cleanup.append(srv.close)
+        cleanup.append(lambda: os.path.exists(args.socket)
+                       and os.unlink(args.socket))
         backend = SidecarBackend()   # pool shared across connections
         try:
             while True:

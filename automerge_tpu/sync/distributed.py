@@ -93,16 +93,28 @@ class ProcessMesh:
     def _connect(self, peer):
         sock = self.out.get(peer)
         if sock is None:
+            # capped exponential backoff under one overall deadline: a
+            # slow-starting peer (cold jax init, supervised restart)
+            # must not abort the whole mesh, while a genuinely absent
+            # one still fails within 30s.  Early attempts stay cheap
+            # (short connect timeout, short sleep); later ones back off
+            # so P processes don't hammer a struggling listener.
             deadline = time.time() + 30
+            delay, timeout = 0.05, 1.0
             while True:
                 try:
                     sock = socket.create_connection(
-                        ('127.0.0.1', self.port_base + peer), timeout=5)
+                        ('127.0.0.1', self.port_base + peer),
+                        timeout=min(timeout, max(0.1,
+                                                 deadline - time.time())))
                     break
                 except OSError:
                     if time.time() > deadline:
                         raise
-                    time.sleep(0.05)
+                    time.sleep(min(delay, max(0.0,
+                                              deadline - time.time())))
+                    delay = min(delay * 1.6, 2.0)
+                    timeout = min(timeout * 2, 5.0)
             sock.sendall(struct.pack('>I', self.pid))
             self.out[peer] = sock
         return sock

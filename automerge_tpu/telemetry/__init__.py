@@ -70,6 +70,10 @@ SIDECAR_LATENCY = registry.histogram(
 SYNC_MSGS = registry.counter(
     'amtpu_sync_messages_total', 'Connection sync messages processed',
     ('direction',))
+SIDECAR_INTERNAL = registry.counter(
+    'amtpu_sidecar_internal_errors_total',
+    'Unexpected exceptions the sidecar dispatch answered as the '
+    'InternalError envelope (the serve loop survived them)')
 
 # fallback reasons pre-seeded into the exposition AND every bench_block
 # so dashboards/gates see explicit zeros before the first degradation
@@ -102,6 +106,28 @@ KNOWN_COLLECT_KEYS = ('packed_member_batches', 'full_matrix_readback',
                       'conflict_sparse', 'conflict_dense',
                       'ready_reorder', 'wait_in_order')
 
+# resilience counters (`telemetry.metric('resilience.<name>')` call
+# sites; glossary: docs/RESILIENCE.md), pre-seeded into every
+# bench_block and the healthz payload so gates and dashboards see
+# explicit zeros before the first fault:
+# retry.attempts/success/    bounded-backoff retries of transient
+#   exhausted                  failures and their outcomes
+# bisect.rounds              doc-set splits while isolating poison docs
+# quarantined                docs answered as per-doc error envelopes
+# degraded                   docs healed on the full-host path
+#                              (AMTPU_DEGRADE=1; DISTINCT from
+#                              fallback.oracle -- perf gates stay
+#                              meaningful)
+# rollback /                 failed batches rolled back to the pre-begin
+#   rollback_unavailable       pool state, or found past the point of
+#                              rollback (emit already ran)
+# fault_injected             armed `automerge_tpu.faults` sites that
+#                              fired (also per-site subkeys)
+KNOWN_RESILIENCE_KEYS = ('retry.attempts', 'retry.success',
+                         'retry.exhausted', 'bisect.rounds',
+                         'quarantined', 'degraded', 'rollback',
+                         'rollback_unavailable', 'fault_injected')
+
 # escalation tier widths are powers of two: exact log2 bucket bounds
 ESCALATION_TIER_BUCKETS = tuple(float(2 ** i) for i in range(4, 15))
 
@@ -126,6 +152,28 @@ def metric(name, n=1):
     """Unconditionally accumulates `n` into the always-on counter."""
     with _flat_lock:
         _flat[name] = _flat.get(name, 0.0) + n
+
+
+# healthz's `degraded` flag must mean "degrading RECENTLY", not "ever
+# degraded since process start" -- a long-lived server that quarantined
+# one poison doc at t0 must not look drain-worthy forever.  Resilience
+# events stamp this; healthz compares against the window.
+_last_degraded_ts = 0.0
+
+
+def note_degraded():
+    """One quarantine/degrade event happened now (called by
+    automerge_tpu.resilience alongside its counters)."""
+    global _last_degraded_ts
+    _last_degraded_ts = time.time()
+
+
+def _degraded_window_s():
+    try:
+        v = os.environ.get('AMTPU_DEGRADED_WINDOW_S', '')
+        return float(v) if v else 300.0
+    except ValueError:
+        return 300.0
 
 
 def metrics_reset():
@@ -253,10 +301,33 @@ def render_prometheus():
 def healthz():
     """Liveness payload for /healthz and the in-band `healthz` command.
     Batch counts report per pool label (summing them would double-count
-    a sharded batch against its per-shard sub-batches)."""
+    a sharded batch against its per-shard sub-batches).  The resilience
+    block surfaces degraded/quarantine state (docs/RESILIENCE.md):
+    `degraded` is WINDOWED -- true only when a quarantine/degrade event
+    happened within the last AMTPU_DEGRADED_WINDOW_S seconds (default
+    300) -- so one poison doc at t0 doesn't mark a long-lived server
+    drain-worthy forever; the cumulative counters stay in `resilience`.
+    `restarts` is the supervising client's respawn count (exported into
+    this process via AMTPU_SIDECAR_RESTARTS on each respawn)."""
+    flat = metrics_snapshot()
+    res = {k: 0.0 for k in KNOWN_RESILIENCE_KEYS}
+    res.update({k.split('.', 1)[1]: v for k, v in flat.items()
+                if k.startswith('resilience.')})
+    try:
+        restarts = int(os.environ.get('AMTPU_SIDECAR_RESTARTS', '0') or 0)
+    except ValueError:
+        restarts = 0
+    degraded_age = time.time() - _last_degraded_ts if _last_degraded_ts \
+        else None
     return {'ok': True, 'uptime_s': round(time.time() - _START_TIME, 3),
             'telemetry_enabled': enabled(),
-            'batches': BATCHES.snapshot() or {}}
+            'batches': BATCHES.snapshot() or {},
+            'restarts': restarts,
+            'degraded': (degraded_age is not None
+                         and degraded_age < _degraded_window_s()),
+            'last_degraded_age_s': (None if degraded_age is None
+                                    else round(degraded_age, 3)),
+            'resilience': res}
 
 
 def bench_block():
@@ -271,9 +342,14 @@ def bench_block():
     collect.update({k.split('.', 1)[1]: round(v, 6)
                     for k, v in flat.items()
                     if k.startswith('collect.')})
+    resilience = {r: 0.0 for r in KNOWN_RESILIENCE_KEYS}
+    resilience.update({k.split('.', 1)[1]: round(v, 6)
+                       for k, v in flat.items()
+                       if k.startswith('resilience.')})
     block = {
         'fallbacks': fallbacks,
         'collect': collect,
+        'resilience': resilience,
         'device_s': round(flat.get('device.dispatch_sync_s', 0.0), 4),
         'device_dispatches': int(flat.get('device.dispatches', 0)),
         'batch_latency': BATCH_LATENCY.snapshot() or {},

@@ -2131,9 +2131,9 @@ static void ensure_dom_fills(Batch& b, size_t blk_idx) {
 // state).  After begin succeeds, no later phase (mid/emit) throws for
 // well-formed pools.
 static void begin_phases(Pool& pool, Batch& b,
-                         std::vector<std::vector<ChangeRec>>& incoming) {
+                         std::vector<std::vector<ChangeRec>>& incoming,
+                         BeginJournal& j) {
   double t1 = mono_now();
-  BeginJournal j;
   ++pool.epoch;
   for (u32 d = 0; d < b.bdocs.size(); ++d)
     if (!b.bdocs[d]->queue.empty())
@@ -3466,6 +3466,14 @@ using namespace amtpu;
 struct BatchHandle {
   Pool* pool;
   Batch batch;
+  // the begin journal OUTLIVES begin so amtpu_batch_rollback can undo a
+  // batch whose device/mid phase failed AFTER begin committed schedule
+  // state -- the resilience layer's retry/bisect re-applies are only
+  // byte-safe against a pool restored to its pre-begin state.  emit is
+  // the first phase that mutates docs beyond the journal's reach, so
+  // amtpu_finish revokes rollback at entry.
+  BeginJournal journal;
+  bool can_rollback = false;
 };
 
 static thread_local std::string g_error;
@@ -3535,7 +3543,8 @@ void* amtpu_begin(void* pool_ptr, const uint8_t* data, int64_t len) {
       incoming.push_back(std::move(chs));
     }
     b.tr_decode = mono_now() - t0;
-    begin_phases(pool, h->batch, incoming);
+    begin_phases(pool, h->batch, incoming, h->journal);
+    h->can_rollback = true;
     if (getenv("AMTPU_TRACE_BEGIN")) {
       double t_phases = mono_now();
       incoming.clear();  // measure ChangeRec teardown separately
@@ -3677,7 +3686,8 @@ void* amtpu_begin_local(void* pool_ptr, const char* doc_id,
     bb.bdoc_ids.push_back(doc_id);
     std::vector<std::vector<ChangeRec>> incoming(1);
     incoming[0].push_back(std::move(change));
-    begin_phases(pool, bb, incoming);
+    begin_phases(pool, bb, incoming, h->journal);
+    h->can_rollback = true;
   } catch (const Error& e) {
     g_error = e.what(); g_error_kind = e.kind;
     return nullptr;
@@ -3689,6 +3699,31 @@ void* amtpu_begin_local(void* pool_ptr, const char* doc_id,
 }
 
 void amtpu_batch_free(void* b) { delete static_cast<BatchHandle*>(b); }
+
+// Undo everything this batch's begin committed (clocks, history, states,
+// arena appends, created objects, causal queues): the pool returns to
+// its byte-identical pre-begin state, so the caller may re-apply the
+// same changes (retry) or any subset (poison bisection) without seq
+// dedup swallowing them.  Legal from begin success until amtpu_finish
+// is first entered (mid phases only mutate batch-local state); the
+// handle still must be freed afterwards.  Returns 0 on success, -1 when
+// the batch can no longer be rolled back.
+int amtpu_batch_rollback(void* bp) {
+  BatchHandle& h = *static_cast<BatchHandle*>(bp);
+  if (!h.can_rollback) {
+    g_error = "batch can no longer be rolled back (emit already ran)";
+    g_error_kind = 0;
+    return -1;
+  }
+  try {
+    h.journal.rollback(h.batch);
+    h.can_rollback = false;   // rollback moves journal state: one-shot
+  } catch (const std::exception& e) {
+    g_error = e.what(); g_error_kind = 0;
+    return -1;
+  }
+  return 0;
+}
 
 // dims: [T, Tp, A, Ap, L, Lp, n_dom_blocks, max_arena_len, CTp,
 //        use_members, any_ovf, max_group]
@@ -4023,6 +4058,9 @@ int amtpu_host_dominance(void* bp) {
 // ---- phase 3 --------------------------------------------------------------
 int amtpu_finish(void* bp) {
   BatchHandle& h = *static_cast<BatchHandle*>(bp);
+  // emit mutates register mirrors / undo stacks / patches -- state the
+  // begin journal never recorded -- so rollback stops being legal here
+  h.can_rollback = false;
   try {
     double t0 = mono_now();
     collect_indexes(h.batch);
