@@ -1,7 +1,7 @@
 # Top-level developer entry points.
 
 .PHONY: all native test bench bench-all bench-tpu check clean wheel \
-	telemetry-check fallback-check perf-smoke chaos-check
+	telemetry-check fallback-check perf-smoke chaos-check serve-check
 
 all: native
 
@@ -52,6 +52,7 @@ check: native
 	$(MAKE) fallback-check
 	$(MAKE) perf-smoke
 	$(MAKE) chaos-check
+	$(MAKE) serve-check
 	@echo "CHECK GREEN"
 
 # Escalation-ladder gate (ISSUE 2): a config-4-shaped smoke on the
@@ -76,6 +77,15 @@ perf-smoke: native
 # clean process tree afterwards.
 chaos-check: native
 	JAX_PLATFORMS=cpu python tools/chaos_check.py
+
+# Serve-gateway gate (ISSUE 5, docs/SERVING.md): 32 concurrent
+# connections of mixed-doc traffic must coalesce (median batch
+# occupancy > 4 docs/flush) with every patch byte-identical to serial
+# application; with the queue capped low, overloaded requests must get
+# the typed Overloaded envelope and the server must stay healthy after
+# the burst; no oracle fallback, no leaked batch handles at drain.
+serve-check: native
+	JAX_PLATFORMS=cpu python tools/serve_check.py
 
 # Observability gate (docs/OBSERVABILITY.md): idle telemetry must be
 # free.  Interleaved A/B of the disabled path vs a no-op-patched "raw"

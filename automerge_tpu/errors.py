@@ -7,3 +7,16 @@ class AutomergeError(Exception):
 
 class RangeError(AutomergeError, ValueError):
     """Mirrors JS RangeError (invalid value / out of range)."""
+
+
+class OverloadedError(AutomergeError):
+    """The serve gateway refused a mutating request at admission
+    (docs/SERVING.md): the request queue crossed its high watermark and
+    is shedding until it drains below the low one.  ``retry_after_ms``
+    carries the server's backoff hint (the wire envelope's
+    ``retryAfterMs``); retrying after that delay is expected to be
+    admitted once the queue drains."""
+
+    def __init__(self, msg, retry_after_ms=None):
+        super().__init__(msg)
+        self.retry_after_ms = retry_after_ms

@@ -1,0 +1,503 @@
+"""Continuous-batching serve gateway (docs/SERVING.md).
+
+The single-connection sidecar left the batched resolver's throughput
+unreachable from real traffic: N clients each applying changes to their
+own doc produced N serialized single-doc passes.  This gateway is the
+CRDT analogue of continuous batching in inference serving (Orca,
+OSDI '22): many concurrent connections decode requests into one shared
+admission-controlled queue, and a single dispatcher thread coalesces
+pending mutations across connections into one ``NativeDocPool``
+apply-batch per flush, routing each per-doc result back to the
+``(connection, request id)`` that asked for it.
+
+Three layers:
+
+  * **connections** (:class:`_Conn`) -- one reader thread per accepted
+    unix-socket connection, speaking the sidecar's existing framings
+    (JSON lines or length-prefixed msgpack).  Responses are written
+    whole under a per-connection lock, so dispatcher and reader never
+    interleave frames.  Per connection, responses may complete out of
+    request order (reads bypass the queue); clients match by id
+    (``SidecarClient`` demultiplexes).
+  * **scheduling** (:class:`GatewayServer` + ``scheduler.queue``) --
+    mutating commands queue; the dispatcher drains them when the flush
+    deadline (``AMTPU_FLUSH_DEADLINE_MS``), the doc cap, or the op cap
+    closes the window.  ``apply_changes`` (and client-sent
+    ``apply_batch``) ops with disjoint docs merge into ONE pool batch --
+    byte-identical per doc to serial application because the pool's
+    single-doc entry points already route through the same batch path.
+    ``apply_local_change`` and ``load`` are ordered singletons (their
+    undo/replay semantics don't compose into a doc-keyed batch); they
+    execute serially inside the same flush cycle under the same per-doc
+    FIFO.  Read-only commands on docs with no pending mutation run
+    inline on the reader thread (no flush wait); with a pending
+    mutation they queue, preserving read-your-writes per connection.
+  * **isolation** -- the flush runs the pool's RESILIENT path, so a
+    poisoned doc answers only its own request with the per-doc error
+    envelope while the rest of the coalesced batch commits.  A
+    whole-batch protocol error (validation: nothing committed,
+    post-rollback) replays the flush's ops serially so every request
+    still gets exactly the result serial application would have
+    produced (``scheduler.serial_fallback``).
+
+Overload: past the queue's high watermark mutating requests answer the
+typed ``{"errorType": "Overloaded", "retryAfterMs": ...}`` envelope
+instead of growing memory; ``healthz`` gains a ``scheduler`` section
+(queue depth, shed state, occupancy summary, live batch handles).
+"""
+
+import json
+import os
+import socket
+import struct
+import sys
+import threading
+import time
+
+from .. import faults, telemetry
+from ..resilience import is_quarantined
+from .queue import (READ_CMDS, AdmissionQueue,  # noqa: F401 (re-export)
+                    Overloaded, PendingOp, flush_deadline_s,
+                    max_batch_docs, max_batch_ops)
+
+#: commands answered without touching the pool (never queued, no lock)
+PURE_CMDS = ('ping', 'metrics', 'healthz')
+
+# READ_CMDS (read-only pool commands: inline bypass when their doc has
+# no pending mutation, queued/ordered otherwise) is owned by .queue --
+# its pending-doc accounting must agree with this routing table
+
+#: mutating commands the dispatcher coalesces into one pool batch
+BATCH_CMDS = ('apply_changes', 'apply_batch')
+
+#: mutating commands executed as ordered singletons within a flush
+EXEC_CMDS = ('apply_local_change', 'load')
+
+
+def _op_weight(cmd, req):
+    """Queued-op count a request admits as (the admission unit): number
+    of changes for the apply commands, 1 for everything else."""
+    try:
+        if cmd == 'apply_changes':
+            return max(1, len(req['changes']))
+        if cmd == 'apply_batch':
+            return max(1, sum(max(1, len(chs))
+                              for chs in req['docs'].values()))
+    except (TypeError, AttributeError, KeyError):
+        pass
+    return 1
+
+
+def _op_docs(cmd, req):
+    """Doc keys a request touches, or None when the request is too
+    malformed to route (the serial backend then answers its protocol
+    error inline).  Batchable commands also validate their changes
+    payload here: a request the flush's merge step could not even
+    ASSEMBLE must take the inline error path, not poison a coalesced
+    flush into whole-InternalError."""
+    if cmd == 'apply_batch':
+        docs = req.get('docs')
+        if not isinstance(docs, dict) or not docs:
+            return None
+        if any(not isinstance(chs, list) for chs in docs.values()):
+            return None
+        return tuple(docs)
+    doc = req.get('doc')
+    if doc is None:
+        return None
+    if isinstance(doc, (dict, list, set)):
+        return None          # unhashable: cannot key FIFO state on it
+    if cmd == 'apply_changes' and \
+            not isinstance(req.get('changes'), list):
+        return None
+    return (doc,)
+
+
+class _Conn(object):
+    """One accepted connection: a reader thread decoding frames into
+    the gateway, plus a locked framed writer any thread may answer
+    through."""
+
+    def __init__(self, sock, gateway, cid):
+        self.sock = sock
+        self.gateway = gateway
+        self.cid = cid
+        self.rfile = sock.makefile('rb')
+        self.wfile = sock.makefile('wb')
+        self._wlock = threading.Lock()
+        self.closed = False
+
+    def send(self, resp):
+        """Writes one response frame atomically; a dead peer marks the
+        connection closed (later sends drop silently -- the requester is
+        gone, there is nobody to answer)."""
+        if self.closed:
+            return
+        try:
+            if self.gateway.use_msgpack:
+                import msgpack
+                body = msgpack.packb(resp, use_bin_type=True)
+                frame = struct.pack('>I', len(body)) + body
+            else:
+                frame = (json.dumps(resp) + '\n').encode()
+            with self._wlock:
+                self.wfile.write(frame)
+                self.wfile.flush()
+        except (BrokenPipeError, ConnectionError, OSError, ValueError):
+            self.close()
+
+    def run(self):
+        """Reader loop: decode frames, route into the gateway.  The
+        `sidecar.frame` fault site fires per request BEFORE routing and
+        is deliberately uncaught (it tears this connection down,
+        simulating a mid-stream transport crash)."""
+        try:
+            if self.gateway.use_msgpack:
+                self._run_msgpack()
+            else:
+                self._run_jsonl()
+        except (BrokenPipeError, ConnectionError, OSError, ValueError):
+            pass
+        finally:
+            self.close()
+            self.gateway._conn_gone(self)
+
+    def _frame_fault(self):
+        if faults.ARMED:
+            faults.fire('sidecar.frame')
+
+    def _run_jsonl(self):
+        for line in self.rfile:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                req = json.loads(line)
+            except ValueError as e:
+                self.send({'id': None, 'error': 'bad json: %s' % e,
+                           'errorType': 'RangeError'})
+                continue
+            self._frame_fault()
+            self.gateway.submit(self, req)
+
+    def _run_msgpack(self):
+        import msgpack
+        while True:
+            head = self.rfile.read(4)
+            if len(head) < 4:
+                break
+            (n,) = struct.unpack('>I', head)
+            body = self.rfile.read(n)
+            if len(body) < n:
+                break
+            try:
+                req = msgpack.unpackb(body, raw=False,
+                                      strict_map_key=False)
+                if not isinstance(req, dict):
+                    raise ValueError('request is not a map')
+            except Exception as e:
+                self.send({'id': None, 'error': 'bad msgpack: %s' % e,
+                           'errorType': 'RangeError'})
+                continue
+            self._frame_fault()
+            self.gateway.submit(self, req)
+
+    def close(self):
+        self.closed = True
+        # shutdown FIRST: a foreign thread closing the makefile objects
+        # would block on the BufferedReader lock the reader thread holds
+        # inside its blocking recv -- shutdown EOFs that recv, releasing
+        # the lock, and only then are the file objects closed
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        for f in (self.wfile, self.rfile):
+            try:
+                f.close()
+            except Exception:
+                pass
+        try:
+            self.sock.close()
+        except Exception:
+            pass
+
+
+class GatewayServer(object):
+    """The multi-client continuously-batching unix-socket server.
+
+    Embeddable: ``start()`` spawns the accept + dispatcher threads and
+    returns; ``stop()`` drains and joins them.  ``serve_forever()`` is
+    the blocking entry `python -m automerge_tpu.sidecar.server --socket`
+    uses.
+    """
+
+    def __init__(self, sock_path, use_msgpack=False, backend=None,
+                 queue=None, backlog=128):
+        if backend is None:
+            from ..sidecar.server import SidecarBackend
+            backend = SidecarBackend()
+        self.sock_path = sock_path
+        self.use_msgpack = use_msgpack
+        self.backend = backend
+        self.queue = queue if queue is not None else AdmissionQueue()
+        self.backlog = backlog
+        # one pool, many threads: inline reads and the dispatcher's
+        # flushes serialize on this lock (the C++ pool and the jax
+        # client are driven single-threaded, as they always were)
+        self.pool_lock = threading.RLock()
+        self._srv = None
+        self._conns = {}
+        self._conns_lock = threading.Lock()
+        self._next_cid = 0
+        self._accept_thread = None
+        self._dispatch_thread = None
+        self._stopping = False
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self):
+        if os.path.exists(self.sock_path):
+            os.unlink(self.sock_path)
+        self._srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._srv.bind(self.sock_path)
+        self._srv.listen(self.backlog)
+        telemetry.register_healthz_section('scheduler',
+                                           self._healthz_section)
+        self._dispatch_thread = threading.Thread(
+            target=self._dispatch_loop, name='amtpu-gw-dispatch',
+            daemon=True)
+        self._dispatch_thread.start()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name='amtpu-gw-accept', daemon=True)
+        self._accept_thread.start()
+        return self
+
+    def serve_forever(self):
+        self.start()
+        try:
+            self._dispatch_thread.join()
+        except KeyboardInterrupt:
+            self.stop()
+
+    def stop(self):
+        self._stopping = True
+        srv, self._srv = self._srv, None
+        if srv is not None:
+            try:
+                srv.close()
+            except Exception:
+                pass
+        if os.path.exists(self.sock_path):
+            try:
+                os.unlink(self.sock_path)
+            except OSError:
+                pass
+        with self._conns_lock:
+            conns = list(self._conns.values())
+        for conn in conns:
+            conn.close()
+        self.queue.close()
+        if self._dispatch_thread is not None:
+            self._dispatch_thread.join(timeout=30)
+        telemetry.register_healthz_section('scheduler', None)
+
+    def _healthz_section(self):
+        from ..native import live_batch_handles
+        stats = self.queue.stats()
+        with self._conns_lock:
+            stats['connections'] = len(self._conns)
+        stats['occupancy'] = telemetry.BATCH_OCCUPANCY.summary()
+        stats['queue_wait_ms'] = telemetry.QUEUE_WAIT.summary()
+        stats['live_batch_handles'] = live_batch_handles()
+        stats['fallback_oracle'] = telemetry.metrics_snapshot().get(
+            'fallback.oracle', 0.0)
+        return stats
+
+    # -- connection layer -----------------------------------------------
+
+    def _accept_loop(self):
+        while not self._stopping:
+            try:
+                sock, _ = self._srv.accept()
+            except OSError:
+                break           # listener closed by stop()
+            with self._conns_lock:
+                self._next_cid += 1
+                conn = _Conn(sock, self, self._next_cid)
+                self._conns[conn.cid] = conn
+            threading.Thread(target=conn.run,
+                             name='amtpu-gw-conn-%d' % conn.cid,
+                             daemon=True).start()
+
+    def _conn_gone(self, conn):
+        with self._conns_lock:
+            self._conns.pop(conn.cid, None)
+
+    # -- request routing ------------------------------------------------
+
+    def submit(self, conn, req):
+        """Routes one decoded request.  Runs on the connection's reader
+        thread; anything that can block on the pool or the queue must
+        not stall OTHER connections (it only stalls this reader)."""
+        cmd = req.get('cmd')
+        rid = req.get('id')
+        if cmd in PURE_CMDS:
+            conn.send(self.backend.handle(req))
+            return
+        if cmd in READ_CMDS:
+            docs = _op_docs(cmd, req)
+            if docs is None or not self.queue.doc_pending(docs[0]):
+                # inline bypass: no queued mutation can be reordered
+                # against, so answer straight off the reader thread
+                telemetry.metric('scheduler.bypass_reads')
+                with self.pool_lock:
+                    conn.send(self.backend.handle(req))
+                return
+            op = PendingOp(conn, rid, cmd, req, docs, 1, batchable=False)
+            try:
+                self.queue.offer(op, admit_always=True)
+            except Overloaded as e:     # only on gateway shutdown
+                conn.send({'id': rid, 'error': str(e),
+                           'errorType': 'Overloaded',
+                           'retryAfterMs': e.retry_after_ms})
+            return
+        if cmd in BATCH_CMDS or cmd in EXEC_CMDS:
+            docs = _op_docs(cmd, req)
+            if docs is None:
+                # malformed routing fields: the serial backend's error
+                # contract answers (missing field -> RangeError, bad
+                # type -> TypeError), nothing mutates
+                with self.pool_lock:
+                    conn.send(self.backend.handle(req))
+                return
+            op = PendingOp(conn, rid, cmd, req, docs,
+                           _op_weight(cmd, req),
+                           batchable=(cmd in BATCH_CMDS))
+            try:
+                self.queue.offer(op)
+            except Overloaded as e:
+                conn.send({'id': rid, 'error': str(e),
+                           'errorType': 'Overloaded',
+                           'retryAfterMs': e.retry_after_ms})
+            return
+        # unknown command: the serial backend's RangeError contract
+        conn.send(self.backend.handle(req))
+
+    # -- the dispatcher -------------------------------------------------
+
+    def _dispatch_loop(self):
+        deadline = flush_deadline_s()
+        mdocs, mops = max_batch_docs(), max_batch_ops()
+        while True:
+            if not self.queue.wait_for_work(deadline, mdocs, mops):
+                return          # closed and drained
+            batch, execs = self.queue.claim(mdocs, mops)
+            if not batch and not execs:
+                continue
+            try:
+                self._flush(batch, execs)
+            except Exception as e:
+                # a dispatcher death would hang every queued client;
+                # answer what we can and keep serving
+                print('gateway: flush failed: %s: %s'
+                      % (type(e).__name__, e), file=sys.stderr)
+                for op in batch + execs:
+                    self._finish(op, {
+                        'id': op.rid,
+                        'error': '%s: %s' % (type(e).__name__, e),
+                        'errorType': 'InternalError'})
+
+    def _flush(self, batch, execs):
+        telemetry.metric('scheduler.flushes')
+        # the flush span parents the pool's batch spans (contextvars
+        # nesting), completing the request -> flush -> batch trace link
+        with telemetry.span('scheduler.flush', batched=len(batch),
+                            exec_ops=len(execs)) as fsp:
+            with self.pool_lock:
+                if batch:
+                    self._run_batch(batch, fsp)
+                for op in execs:
+                    self._run_exec(op)
+
+    def _observe_wait(self, ops):
+        now = time.perf_counter()
+        for op in ops:
+            telemetry.QUEUE_WAIT.observe((now - op.enq_t) * 1000.0)
+
+    def _run_batch(self, ops, fsp=None):
+        """One coalesced pool pass over disjoint-doc mutating ops, per
+        -request responses routed back by (conn, id)."""
+        self._observe_wait(ops)
+        telemetry.metric('scheduler.coalesced_ops', len(ops))
+        t0 = time.perf_counter()
+        try:
+            # merge building sits INSIDE the try: a request malformed in
+            # a way routing didn't catch degrades to the serial replay
+            # (per-request protocol errors), never to a whole-flush
+            # InternalError
+            merged = {}
+            for op in ops:
+                if op.cmd == 'apply_changes':
+                    merged[op.req['doc']] = op.req['changes']
+                else:                       # apply_batch
+                    merged.update(op.req['docs'])
+            telemetry.BATCH_OCCUPANCY.observe(len(merged))
+            telemetry.metric('scheduler.batched_docs', len(merged))
+            out = self.backend.pool.apply_batch(merged)
+        except Exception as e:
+            # whole-batch protocol error (validation; nothing committed,
+            # post-rollback): replay serially so each request gets
+            # exactly the result/error serial application produces
+            if isinstance(e, (MemoryError, SystemExit,
+                              KeyboardInterrupt)):
+                raise
+            telemetry.metric('scheduler.serial_fallback')
+            for op in ops:
+                self._run_exec(op, count=False)
+            return
+        dt = time.perf_counter() - t0
+        flush_id = getattr(fsp, 'span_id', None)
+        for op in ops:
+            if op.cmd == 'apply_changes':
+                res = out[op.req['doc']]
+                if is_quarantined(res):
+                    telemetry.metric('scheduler.quarantined')
+                    resp = {'id': op.rid, 'error': res['error'],
+                            'errorType': res['errorType']}
+                else:
+                    resp = {'id': op.rid, 'result': res}
+            else:
+                sub = {d: out[d] for d in op.req['docs']}
+                nq = sum(1 for r in sub.values() if is_quarantined(r))
+                if nq:
+                    telemetry.metric('scheduler.quarantined', nq)
+                resp = {'id': op.rid, 'result': sub}
+            # the per-command request series the serial server emits in
+            # handle(): batched requests record the shared flush apply
+            # time (docs/OBSERVABILITY.md)
+            telemetry.SIDECAR_LATENCY.labels(op.cmd).observe(dt)
+            telemetry.SIDECAR_REQS.labels(
+                op.cmd, 'error' if 'error' in resp else 'ok').inc()
+            # request span resuming the client's trace, carrying the
+            # flush span id as a link (request -> flush -> batch)
+            tctx = op.req.get('trace')
+            tctx = tctx if isinstance(tctx, dict) else {}
+            with telemetry.span_with_context(
+                    'sidecar.request', tctx.get('traceId'),
+                    tctx.get('spanId'), cmd=op.cmd, rid=op.rid,
+                    batched=True, flush=flush_id):
+                self._finish(op, resp)
+
+    def _run_exec(self, op, count=True):
+        """One ordered singleton through the serial backend dispatch --
+        identical result envelope (and telemetry) to the pre-gateway
+        server."""
+        if count:
+            telemetry.metric('scheduler.exec_ops')
+            self._observe_wait([op])
+        self._finish(op, self.backend.handle(op.req))
+
+    def _finish(self, op, resp):
+        op.conn.send(resp)
+        self.queue.note_complete(op)

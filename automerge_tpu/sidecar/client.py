@@ -26,6 +26,7 @@ import socket
 import struct
 import subprocess
 import sys
+import threading
 import time
 
 from .. import telemetry
@@ -119,6 +120,16 @@ class CheckpointWAL:
 
 
 class SidecarClient:
+    """Thread-safe: one client may be shared across caller threads.
+    Request ids are allocated under a lock, frames are written whole
+    under a write lock, and responses are DEMULTIPLEXED by id -- the
+    serve gateway (docs/SERVING.md) may answer a connection's requests
+    out of request order (reads bypass the batch path), so whichever
+    thread is waiting first becomes the reader and parks frames that
+    answer other threads' ids.  Healing (respawn+replay) serializes on
+    the transport lock; it remains designed for the single-threaded
+    self-spawned case and is best-effort under concurrency."""
+
     # class-level defaults so a hand-assembled client (tests build one
     # via __new__ around BytesIO pipes) behaves like a non-healing
     # adopted-transport client
@@ -132,6 +143,13 @@ class SidecarClient:
     _last_ok = 0.0
     _proc = None
     _sock = None
+    _id_lock = None
+    _w_lock = None
+    _life_lock = None
+    _resp_cond = None
+    _resp = None
+    _reader_live = False
+    _rx_exc = None
 
     def __init__(self, proc=None, sock_path=None, use_msgpack=False,
                  deadline_s=None, heal=None, max_respawns=None,
@@ -152,6 +170,7 @@ class SidecarClient:
         """
         self._msgpack = use_msgpack
         self._next_id = 0
+        self._init_locks()
         self._proc = None
         self._sock = None
         self._dead = False
@@ -252,6 +271,17 @@ class SidecarClient:
 
     # -- transport ------------------------------------------------------
 
+    def _init_locks(self):
+        """Demux state; lazy for hand-assembled clients (tests build one
+        via __new__, which skips __init__)."""
+        self._id_lock = threading.Lock()
+        self._w_lock = threading.Lock()
+        self._life_lock = threading.RLock()   # heal/WAL serialization
+        self._resp_cond = threading.Condition()
+        self._resp = {}           # rid -> parked response frame
+        self._reader_live = False
+        self._rx_exc = None
+
     def _await_response(self):
         """Blocks until the first byte of the response is available (or
         the request deadline passes).  Crash detection needs no timeout
@@ -266,15 +296,22 @@ class SidecarClient:
                 'sidecar server produced no response within %.1fs'
                 % self._deadline_s)
 
-    def _roundtrip(self, req):
-        """One framed request/response exchange; raises ConnectionError
-        (incl. SidecarTimeout) on any transport-level failure."""
+    def _write_frame(self, req):
         if self._msgpack:
             import msgpack
             body = msgpack.packb(req, use_bin_type=True)
-            self._w.write(struct.pack('>I', len(body)) + body)
+            frame = struct.pack('>I', len(body)) + body
+        else:
+            frame = (json.dumps(req) + '\n').encode()
+        with self._w_lock:
+            self._w.write(frame)
             self._w.flush()
-            self._await_response()
+
+    def _read_frame(self):
+        """One framed response off the transport (reader role only)."""
+        self._await_response()
+        if self._msgpack:
+            import msgpack
             head = self._r.read(4)
             if len(head) < 4:
                 raise ConnectionError('sidecar server closed the stream')
@@ -282,9 +319,6 @@ class SidecarClient:
             resp = msgpack.unpackb(self._r.read(n), raw=False,
                                    strict_map_key=False)
         else:
-            self._w.write((json.dumps(req) + '\n').encode())
-            self._w.flush()
-            self._await_response()
             line = self._r.readline()
             if not line:
                 raise ConnectionError('sidecar server closed the stream')
@@ -292,21 +326,93 @@ class SidecarClient:
         self._last_ok = time.monotonic()
         return resp
 
+    def _roundtrip(self, req):
+        """One framed request/response exchange; raises ConnectionError
+        (incl. SidecarTimeout) on any transport-level failure.  The
+        response for `req['id']` may arrive after responses for OTHER
+        threads' requests (the gateway answers reads out of order):
+        whichever waiter reaches the transport first reads frames,
+        keeps its own, and parks the rest by id."""
+        if self._resp_cond is None:
+            self._init_locks()
+        rid = req['id']
+        self._write_frame(req)
+        deadline = None if self._deadline_s is None else \
+            time.monotonic() + self._deadline_s
+        while True:
+            with self._resp_cond:
+                while True:
+                    if rid in self._resp:
+                        return self._resp.pop(rid)
+                    if self._rx_exc is not None:
+                        raise ConnectionError(
+                            'sidecar transport failed in another '
+                            'thread: %s' % self._rx_exc)
+                    if not self._reader_live:
+                        self._reader_live = True
+                        break          # this thread becomes the reader
+                    timeout = None if deadline is None else \
+                        deadline - time.monotonic()
+                    if timeout is not None and timeout <= 0:
+                        raise SidecarTimeout(
+                            'sidecar server produced no response '
+                            'within %.1fs' % self._deadline_s)
+                    self._resp_cond.wait(timeout)
+            # reader role (outside the condition: the read blocks)
+            try:
+                resp = self._read_frame()
+            except BaseException as e:
+                with self._resp_cond:
+                    self._reader_live = False
+                    self._rx_exc = e
+                    self._resp_cond.notify_all()
+                raise
+            with self._resp_cond:
+                self._reader_live = False
+                r = resp.get('id') if isinstance(resp, dict) else None
+                if r != rid and r is not None:
+                    self._resp[r] = resp
+                self._resp_cond.notify_all()
+                if r == rid or r is None:
+                    # (id None: a server-side parse error response --
+                    # attribute it to this request, nobody else can
+                    # claim it)
+                    return resp
+
+    def _reset_demux(self):
+        """After a heal the old stream is gone: parked frames and the
+        sticky receive error belong to the dead transport."""
+        if self._resp_cond is None:
+            return
+        with self._resp_cond:
+            self._resp.clear()
+            self._rx_exc = None
+            self._reader_live = False
+            self._resp_cond.notify_all()
+
     def _call_raw(self, cmd, kwargs):
         """Request + protocol error mapping, NO healing and NO WAL
         recording -- the primitive heal/replay/compaction run on (a
         replayed request must not re-enter the WAL)."""
-        self._next_id += 1
-        req = dict(kwargs, cmd=cmd, id=self._next_id)
+        if self._id_lock is None:
+            self._init_locks()
+        with self._id_lock:
+            self._next_id += 1
+            rid = self._next_id
+        req = dict(kwargs, cmd=cmd, id=rid)
         tctx = telemetry.current_trace_context()
         if tctx is not None:
             req.setdefault('trace', tctx)
         resp = self._roundtrip(req)
         if 'error' in resp:
-            from ..errors import AutomergeError, RangeError
+            from ..errors import (AutomergeError, OverloadedError,
+                                  RangeError)
             types = {'AutomergeError': AutomergeError,
                      'RangeError': RangeError, 'TypeError': TypeError,
                      'KeyError': KeyError}
+            if resp.get('errorType') == 'Overloaded':
+                raise OverloadedError(resp['error'],
+                                      resp.get('retryAfterMs'))
             raise types.get(resp.get('errorType'), AutomergeError)(
                 resp['error'])
         return resp['result']
@@ -322,7 +428,8 @@ class SidecarClient:
         delay = 0.05
         while True:
             self._teardown_proc()
-            try:
+            self._reset_demux()    # parked frames/errors died with the
+            try:                   # old transport
                 self._spawn()
                 self._call_raw('ping', {})
                 break
@@ -374,10 +481,13 @@ class SidecarClient:
                     self._dead = True
                     raise
                 heals += 1
-                self._respawn_and_replay()
+                with self._life_lock:
+                    if not self._dead:     # another thread may have
+                        self._respawn_and_replay()   # healed already
         if self._wal is not None and cmd in WAL_CMDS:
-            self._wal.record(cmd, kwargs)
-            self._wal.maybe_compact(self._call_raw)
+            with self._life_lock:
+                self._wal.record(cmd, kwargs)
+                self._wal.maybe_compact(self._call_raw)
         return result
 
     # -- Backend surface -------------------------------------------------

@@ -12,6 +12,15 @@ Two framings:
     native serialization); the request envelope itself is decoded in
     Python before dispatch.
 
+Socket mode serves through the continuous-batching gateway
+(automerge_tpu/scheduler/, docs/SERVING.md): many concurrent
+connections, mutating requests coalesced across connections into one
+pool batch per flush, typed Overloaded shedding past the queue
+watermark.  Responses may then complete out of request order within a
+connection (reads bypass the batch path); clients match responses by
+id.  `--serial` (or AMTPU_GATEWAY=0) restores the one-connection
+-at-a-time in-order loop.  Stdio mode is always serial.
+
 Requests (fields beyond `cmd`/`id` per command):
   {"id": 1, "cmd": "apply_changes",      "doc": d, "changes": [...]}
   {"id": 2, "cmd": "apply_batch",        "docs": {d: [...], ...}}
@@ -282,11 +291,18 @@ def main(argv=None):
                     help='bind address for the metrics listener '
                          '(default loopback; 0.0.0.0 for a remote '
                          'Prometheus fleet scrape)')
+    ap.add_argument('--serial', action='store_true',
+                    help='socket mode only: serve one connection at a '
+                         'time through the pre-gateway serial loop '
+                         'instead of the continuous-batching gateway '
+                         '(docs/SERVING.md)')
     ap.add_argument('--trace', action='store_true',
                     help='enable span tracing at startup (equivalent to '
                          'AMTPU_TRACE=1; pair with AMTPU_TRACE_FILE for '
                          'JSONL export)')
     args = ap.parse_args(argv)
+    if os.environ.get('AMTPU_GATEWAY', '1') in ('', '0'):
+        args.serial = True          # env kill-switch for the gateway
 
     if args.trace:
         telemetry.enable()
@@ -319,7 +335,22 @@ def main(argv=None):
     except ValueError:
         pass      # not the main thread (embedded serve): signals stay
 
-    if args.socket:
+    if args.socket and not args.serial:
+        # default socket mode: the continuous-batching serve gateway
+        # (docs/SERVING.md) -- many concurrent connections, cross
+        # -connection coalescing into one pool batch per flush,
+        # admission control past the queue watermark
+        from ..scheduler import GatewayServer
+        gw = GatewayServer(args.socket, use_msgpack=args.msgpack,
+                           backend=SidecarBackend())
+        cleanup.append(gw.stop)
+        try:
+            gw.serve_forever()
+        finally:
+            gw.stop()
+    elif args.socket:
+        # --serial: the pre-gateway loop -- one connection at a time,
+        # strictly in-order responses (debugging / bisection aid)
         if os.path.exists(args.socket):
             os.unlink(args.socket)
         srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)

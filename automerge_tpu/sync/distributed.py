@@ -416,9 +416,14 @@ class DistributedReplicaSet:
 
 def _worker(pid, n_processes, coord_port, mesh_port_base):
     os.environ['JAX_PLATFORMS'] = 'cpu'
-    from ..utils.jaxenv import pin_cpu
+    from ..utils.jaxenv import enable_cpu_collectives, pin_cpu
     pin_cpu(force=True)
     import jax
+    # CPU multi-process collectives need the Gloo backend opt-in on jax
+    # versions that gate it (without it every process_allgather dies
+    # with "Multiprocess computations aren't implemented on the CPU
+    # backend")
+    enable_cpu_collectives()
     jax.distributed.initialize(
         coordinator_address='127.0.0.1:%d' % coord_port,
         num_processes=n_processes, process_id=pid)
@@ -463,9 +468,12 @@ def _worker(pid, n_processes, coord_port, mesh_port_base):
     print('DISTRIBUTED-OK pid=%d rounds=%s' % (pid, rounds), flush=True)
 
 
-def launch(n_processes=2, timeout=240):
+def launch(n_processes=2, timeout=240, _retries=1):
     """Spawns the dryrun workers; returns their outputs.  Raises on any
-    non-zero exit."""
+    non-zero exit.  One retry absorbs the Gloo TCP transport's known
+    size-mismatch race ("op.preamble.length <= op.nbytes"), which
+    aborts a worker process at random under back-to-back collectives of
+    varying shapes -- an infrastructure flake, not a convergence bug."""
     import subprocess
     with socket.socket() as probe:
         probe.bind(('127.0.0.1', 0))
@@ -491,6 +499,10 @@ def launch(n_processes=2, timeout=240):
             raise
         outs.append(out)
         if p.returncode != 0:
+            if _retries > 0 and 'op.preamble.length' in out:
+                for q in procs:
+                    q.kill()
+                return launch(n_processes, timeout, _retries - 1)
             raise RuntimeError('worker failed (rc=%d):\n%s'
                                % (p.returncode, out))
     return outs

@@ -128,6 +128,48 @@ KNOWN_RESILIENCE_KEYS = ('retry.attempts', 'retry.success',
                          'quarantined', 'degraded', 'rollback',
                          'rollback_unavailable', 'fault_injected')
 
+# scheduler counters (`telemetry.metric('scheduler.<name>')` call sites
+# in automerge_tpu/scheduler/; glossary: docs/OBSERVABILITY.md,
+# architecture: docs/SERVING.md), pre-seeded into every bench_block so
+# gates and dashboards see explicit zeros before the first gateway
+# request:
+# flushes            dispatcher flush cycles that executed work
+# coalesced_ops      mutating requests coalesced into batch flushes
+# batched_docs       docs carried by gateway batch flushes
+# exec_ops           ordered ops the dispatcher ran serially (local
+#                      changes, loads, queued reads, serial replays)
+# bypass_reads       read-only requests served inline off the reader
+#                      thread (no queue, no flush wait)
+# parked             claim passes that left an op queued because its
+#                      doc already had an op in the flush
+# shed               mutating requests refused with the Overloaded
+#                      envelope (admission control)
+# serial_fallback    flushes replayed serially after a whole-batch
+#                      protocol error (per-request results restored)
+# quarantined        per-doc resilience envelopes routed back to the
+#                      originating request by a flush
+KNOWN_SCHEDULER_KEYS = ('flushes', 'coalesced_ops', 'batched_docs',
+                        'exec_ops', 'bypass_reads', 'parked', 'shed',
+                        'serial_fallback', 'quarantined')
+
+# docs per gateway flush are effectively powers of two: exact log2 bounds
+BATCH_OCCUPANCY_BUCKETS = tuple(float(2 ** i) for i in range(13))
+
+BATCH_OCCUPANCY = registry.histogram(
+    'amtpu_batch_occupancy',
+    'Documents coalesced into one gateway batch flush (docs/SERVING.md; '
+    'median > 4 is the serve-check gate on concurrent traffic)',
+    buckets=BATCH_OCCUPANCY_BUCKETS)
+
+# queue wait in MILLISECONDS: 0.001ms .. ~67s, log2
+QUEUE_WAIT_BUCKETS = tuple(1e-3 * 2 ** i for i in range(27))
+
+QUEUE_WAIT = registry.histogram(
+    'amtpu_queue_wait_ms',
+    'Milliseconds a mutating request waited in the gateway queue '
+    'between arrival and the start of its flush',
+    buckets=QUEUE_WAIT_BUCKETS)
+
 # escalation tier widths are powers of two: exact log2 bucket bounds
 ESCALATION_TIER_BUCKETS = tuple(float(2 ** i) for i in range(4, 15))
 
@@ -179,6 +221,23 @@ def _degraded_window_s():
 def metrics_reset():
     with _flat_lock:
         _flat.clear()
+
+
+# healthz payload extensions: long-lived subsystems (the serve gateway's
+# scheduler) register a section provider so BOTH healthz surfaces -- the
+# in-band `healthz` command and the HTTP /healthz listener -- report
+# their state without either transport knowing the subsystem exists.
+_healthz_sections = {}
+
+
+def register_healthz_section(name, provider):
+    """Adds `provider()` (returning a JSON-safe dict) under `name` in
+    every healthz payload; re-registering a name replaces it, None
+    removes it."""
+    if provider is None:
+        _healthz_sections.pop(name, None)
+    else:
+        _healthz_sections[name] = provider
 
 
 def metrics_snapshot():
@@ -319,7 +378,16 @@ def healthz():
         restarts = 0
     degraded_age = time.time() - _last_degraded_ts if _last_degraded_ts \
         else None
-    return {'ok': True, 'uptime_s': round(time.time() - _START_TIME, 3),
+    extra = {}
+    for name, provider in list(_healthz_sections.items()):
+        try:
+            extra[name] = provider()
+        except Exception as e:
+            # a broken section provider degrades ITS section, never the
+            # liveness answer itself
+            extra[name] = {'error': '%s: %s' % (type(e).__name__, e)}
+    return dict(extra, **{
+        'ok': True, 'uptime_s': round(time.time() - _START_TIME, 3),
             'telemetry_enabled': enabled(),
             'batches': BATCHES.snapshot() or {},
             'restarts': restarts,
@@ -327,7 +395,7 @@ def healthz():
                          and degraded_age < _degraded_window_s()),
             'last_degraded_age_s': (None if degraded_age is None
                                     else round(degraded_age, 3)),
-            'resilience': res}
+            'resilience': res})
 
 
 def bench_block():
@@ -346,10 +414,15 @@ def bench_block():
     resilience.update({k.split('.', 1)[1]: round(v, 6)
                        for k, v in flat.items()
                        if k.startswith('resilience.')})
+    scheduler = {r: 0.0 for r in KNOWN_SCHEDULER_KEYS}
+    scheduler.update({k.split('.', 1)[1]: round(v, 6)
+                      for k, v in flat.items()
+                      if k.startswith('scheduler.')})
     block = {
         'fallbacks': fallbacks,
         'collect': collect,
         'resilience': resilience,
+        'scheduler': scheduler,
         'device_s': round(flat.get('device.dispatch_sync_s', 0.0), 4),
         'device_dispatches': int(flat.get('device.dispatches', 0)),
         'batch_latency': BATCH_LATENCY.snapshot() or {},

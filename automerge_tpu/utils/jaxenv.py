@@ -9,6 +9,7 @@ enumerating devices initializes the default backend.
 """
 
 import os
+import re
 
 
 def pin_cpu(force=False):
@@ -27,3 +28,50 @@ def pin_cpu(force=False):
     import jax
     jax.config.update('jax_platforms', 'cpu')
     return True
+
+
+def ensure_cpu_devices(n_devices):
+    """Arranges for at least ``n_devices`` virtual CPU devices.
+
+    Newer jax exposes ``jax_num_cpu_devices`` (settable after
+    ``clear_backends()``); on versions without it the only working lever
+    is ``XLA_FLAGS=--xla_force_host_platform_device_count=N``, which the
+    XLA runtime parses ONCE per process at first backend init -- so the
+    fallback must run BEFORE anything enumerates devices.  Call this
+    before the first ``jax.devices()``; the caller still does the
+    config-option path itself when the backend is already initialized
+    (see ``__graft_entry__.dryrun_multichip``).
+
+    Returns 'config' when the config option exists (caller may use it
+    after a backend teardown), 'flags' when the XLA_FLAGS fallback was
+    applied or already satisfies the request.
+    """
+    import jax
+    if hasattr(jax.config, 'jax_num_cpu_devices'):
+        return 'config'
+    flags = os.environ.get('XLA_FLAGS', '')
+    m = re.search(r'--xla_force_host_platform_device_count=(\d+)', flags)
+    if m is None or int(m.group(1)) < n_devices:
+        flags = re.sub(r'--xla_force_host_platform_device_count=\d+',
+                       '', flags)
+        os.environ['XLA_FLAGS'] = (
+            flags + ' --xla_force_host_platform_device_count=%d'
+            % n_devices).strip()
+    return 'flags'
+
+
+def enable_cpu_collectives():
+    """Opts into jax's CPU cross-process collectives (the Gloo backend)
+    so ``multihost_utils.process_allgather`` works on CPU-only hosts --
+    without it, multi-process computations raise "Multiprocess
+    computations aren't implemented on the CPU backend".  Must run
+    before ``jax.distributed.initialize``.  Silently a no-op on jax
+    versions without the option (their CPU backend either supports
+    multiprocess natively or the caller's collective will surface the
+    real error)."""
+    import jax
+    try:
+        jax.config.update('jax_cpu_collectives_implementation', 'gloo')
+        return True
+    except (AttributeError, ValueError):
+        return False

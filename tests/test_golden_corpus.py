@@ -111,7 +111,12 @@ def test_sidecar_matches_reference_fixtures(case):
 def test_corpus_covers_the_reference_suite():
     """The corpus must track the reference file: every it-block is either
     extracted or explicitly skipped with a reason."""
-    src = open('/root/reference/test/backend_test.js').read()
+    ref = '/root/reference/test/backend_test.js'
+    if not os.path.exists(ref):
+        pytest.skip('reference suite %s not present on this host; the '
+                    'committed corpus is still replayed by the fixture '
+                    'tests above' % ref)
+    src = open(ref).read()
     its = re.findall(r"\bit\('([^']+)'", src)
     covered = {c['name'] for c in CASES} | \
         {s['name'] for s in _corpus['skipped']}

@@ -1,0 +1,469 @@
+"""Serve-gateway tests (ISSUE 5, docs/SERVING.md): admission-queue
+semantics, per-doc FIFO claims, coalesced-flush response routing (with
+quarantine), and the live multi-connection gateway over a real unix
+socket -- including a single SidecarClient shared across threads (the
+client demultiplexes out-of-order responses by id).
+"""
+
+import os
+import tempfile
+import threading
+import time
+
+import pytest
+
+from automerge_tpu import faults, telemetry
+from automerge_tpu.errors import OverloadedError
+from automerge_tpu.scheduler import AdmissionQueue, GatewayServer
+from automerge_tpu.scheduler.queue import Overloaded, PendingOp
+from automerge_tpu.sidecar.client import SidecarClient
+
+ROOT_ID = '00000000-0000-0000-0000-000000000000'
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _hygiene():
+    faults.disarm()
+    telemetry.metrics_reset()
+    yield
+    faults.disarm()
+    telemetry.metrics_reset()
+
+
+def change(actor, seq, key='k', value=None, n_ops=1):
+    return {'actor': actor, 'seq': seq, 'deps': {},
+            'ops': [{'action': 'set', 'obj': ROOT_ID,
+                     'key': '%s%d' % (key, i),
+                     'value': value if value is not None
+                     else '%s-%d' % (actor, seq)}
+                    for i in range(n_ops)]}
+
+
+class FakeConn(object):
+    """Captures responses the gateway would write to a socket."""
+
+    def __init__(self):
+        self.sent = []
+        self.closed = False
+
+    def send(self, resp):
+        self.sent.append(resp)
+
+    def by_id(self, rid):
+        return next(r for r in self.sent if r.get('id') == rid)
+
+
+def op(conn, rid, doc, changes, cmd='apply_changes'):
+    req = {'id': rid, 'cmd': cmd, 'doc': doc, 'changes': changes}
+    return PendingOp(conn, rid, cmd, req, (doc,), len(changes),
+                     batchable=True)
+
+
+class TestAdmissionQueue:
+    def test_watermark_shedding_and_recovery(self):
+        q = AdmissionQueue(max_ops=4, low_frac=0.5)
+        conn = FakeConn()
+        q.offer(op(conn, 1, 'a', [change('a', 1)]))
+        q.offer(op(conn, 2, 'b', [change('b', 1), change('b', 2)]))
+        # 3 queued ops; the next 2-op offer would cross max=4: shed
+        with pytest.raises(Overloaded) as ei:
+            q.offer(op(conn, 3, 'c', [change('c', 1), change('c', 2)]))
+        assert ei.value.retry_after_ms >= 1
+        assert q.shedding
+        # shedding latches: even a 1-op offer is refused until drain
+        with pytest.raises(Overloaded):
+            q.offer(op(conn, 4, 'd', [change('d', 1)]))
+        batch, execs = q.claim()
+        assert [o.rid for o in batch] == [1, 2] and not execs
+        # drained below low watermark (depth 0 <= 2): admission resumes
+        q.offer(op(conn, 5, 'e', [change('e', 1)]))
+        assert not q.shedding
+        assert telemetry.metrics_snapshot()['scheduler.shed'] == 2
+
+    def test_reads_admitted_while_shedding(self):
+        q = AdmissionQueue(max_ops=1, low_frac=0.0)
+        conn = FakeConn()
+        q.offer(op(conn, 1, 'a', [change('a', 1)]))
+        with pytest.raises(Overloaded):
+            q.offer(op(conn, 2, 'b', [change('b', 1)]))
+        read = PendingOp(conn, 3, 'get_patch',
+                         {'id': 3, 'cmd': 'get_patch', 'doc': 'a'},
+                         ('a',), 1, batchable=False)
+        q.offer(read, admit_always=True)     # never shed
+        batch, execs = q.claim()
+        assert [o.rid for o in batch] == [1] and not execs
+        # the read parks behind its doc's write, then claims as exec
+        _, execs2 = q.claim()
+        assert [o.rid for o in execs2] == [3]
+
+    def test_per_doc_fifo_parks_followers(self):
+        q = AdmissionQueue(max_ops=100)
+        conn = FakeConn()
+        q.offer(op(conn, 1, 'a', [change('a', 1)]))
+        q.offer(op(conn, 2, 'a', [change('a', 2)]))   # same doc: parks
+        q.offer(op(conn, 3, 'b', [change('b', 1)]))
+        batch, execs = q.claim()
+        assert [o.rid for o in batch] == [1, 3]
+        assert q.doc_pending('a') and q.doc_pending('b')
+        # rid 2 waits for the next flush, after its doc's batch
+        batch2, _ = q.claim()
+        assert [o.rid for o in batch2] == [2]
+        assert telemetry.metrics_snapshot()['scheduler.parked'] == 1
+
+    def test_parked_doc_blocks_later_multi_doc_op(self):
+        """An apply_batch whose doc set overlaps a parked doc must park
+        too, and its OTHER docs must then block later ops -- cross-doc
+        reordering never reorders one doc's ops."""
+        q = AdmissionQueue(max_ops=100)
+        conn = FakeConn()
+        q.offer(op(conn, 1, 'a', [change('a', 1)]))
+        q.offer(op(conn, 2, 'a', [change('a', 2)]))
+        multi = PendingOp(conn, 3, 'apply_batch',
+                          {'id': 3, 'cmd': 'apply_batch',
+                           'docs': {'a': [change('x', 1)],
+                                    'b': [change('x', 1)]}},
+                          ('a', 'b'), 2, batchable=True)
+        q.offer(multi)
+        q.offer(op(conn, 4, 'b', [change('b', 1)]))
+        batch, _ = q.claim()
+        assert [o.rid for o in batch] == [1]     # everyone else parked
+        batch2, _ = q.claim()
+        assert [o.rid for o in batch2] == [2]
+        batch3, _ = q.claim()
+        assert [o.rid for o in batch3] == [3]
+        batch4, _ = q.claim()
+        assert [o.rid for o in batch4] == [4]
+
+    def test_doc_cap_closes_the_window(self):
+        q = AdmissionQueue(max_ops=100)
+        conn = FakeConn()
+        for i in range(5):
+            q.offer(op(conn, i, 'd%d' % i, [change('a', 1)]))
+        batch, _ = q.claim(max_docs=3)
+        assert len(batch) == 3
+        batch2, _ = q.claim(max_docs=3)
+        assert len(batch2) == 2
+
+    def test_oversized_op_claims_alone(self):
+        """Caps bound ADDITIONAL coalescing: an op bigger than the
+        per-flush op cap must still claim into an empty flush (parking
+        it forever would wedge its doc and hot-spin the dispatcher)."""
+        q = AdmissionQueue(max_ops=1000)
+        conn = FakeConn()
+        big = [change('a', s) for s in range(1, 11)]     # 10 ops
+        q.offer(op(conn, 1, 'big', big))
+        q.offer(op(conn, 2, 'small', [change('b', 1)]))
+        batch, _ = q.claim(max_ops=4)
+        assert [o.rid for o in batch] == [1]     # alone, over the cap
+        batch2, _ = q.claim(max_ops=4)
+        assert [o.rid for o in batch2] == [2]
+
+    def test_single_request_larger_than_queue_admitted_when_empty(self):
+        """The watermark bounds backlog, not request size: a lone
+        request bigger than the whole queue is admitted (the serial
+        loop accepts it too) and served as its own flush."""
+        q = AdmissionQueue(max_ops=4)
+        conn = FakeConn()
+        huge = [change('a', s) for s in range(1, 9)]     # 8 > max 4
+        q.offer(op(conn, 1, 'huge', huge))               # empty: admit
+        with pytest.raises(Overloaded):                  # backlog: shed
+            q.offer(op(conn, 2, 'x', [change('b', 1)]))
+        batch, _ = q.claim()
+        assert [o.rid for o in batch] == [1]
+        assert q.depth_ops == 0
+
+
+class TestFlushRouting:
+    """Deterministic dispatcher semantics: submit through the routing
+    layer with the dispatcher thread NOT running, then claim + flush by
+    hand."""
+
+    def _gateway(self, **qkw):
+        path = os.path.join(tempfile.mkdtemp(), 'gw.sock')
+        return GatewayServer(path, queue=AdmissionQueue(**qkw))
+
+    def test_coalesced_flush_routes_by_conn_and_id(self):
+        gw = self._gateway()
+        conns = [FakeConn() for _ in range(3)]
+        for i, conn in enumerate(conns):
+            gw.submit(conn, {'id': 10 + i, 'cmd': 'apply_changes',
+                             'doc': 'doc-%d' % i,
+                             'changes': [change('a%d' % i, 1)]})
+        batch, execs = gw.queue.claim()
+        assert len(batch) == 3 and not execs
+        gw._flush(batch, execs)
+        for i, conn in enumerate(conns):
+            resp = conn.by_id(10 + i)
+            assert resp['result']['clock'] == {'a%d' % i: 1}
+        # the flush was ONE pool batch of 3 docs
+        assert telemetry.BATCH_OCCUPANCY.summary()['count'] == 1
+        snap = telemetry.metrics_snapshot()
+        assert snap['scheduler.batched_docs'] == 3
+        assert snap['scheduler.coalesced_ops'] == 3
+        assert telemetry.QUEUE_WAIT.summary()['count'] == 3
+        from automerge_tpu.native import live_batch_handles
+        assert live_batch_handles() == 0
+
+    def test_batched_patch_matches_serial_patch(self):
+        from automerge_tpu.native import NativeDocPool
+        gw = self._gateway()
+        conn = FakeConn()
+        streams = {'d%d' % i: [change('w%d' % i, 1, n_ops=3),
+                               change('w%d' % i, 2, n_ops=2)]
+                   for i in range(6)}
+        rid = 0
+        for r in range(2):
+            for doc, chs in streams.items():
+                rid += 1
+                gw.submit(conn, {'id': rid, 'cmd': 'apply_changes',
+                                 'doc': doc, 'changes': [chs[r]]})
+            gw._flush(*gw.queue.claim())
+        serial = NativeDocPool()
+        want = {}
+        for doc, chs in streams.items():
+            for ch in chs:
+                want[doc] = serial.apply_changes(doc, [ch])
+        # the SECOND round's responses must equal serial application
+        got = {r: conn.by_id(7 + i) for i, r in enumerate(streams)}
+        for i, doc in enumerate(streams):
+            assert conn.by_id(7 + i)['result'] == want[doc], doc
+        assert got
+
+    def test_read_bypass_vs_queued_read(self):
+        gw = self._gateway()
+        conn = FakeConn()
+        gw.submit(conn, {'id': 1, 'cmd': 'apply_changes', 'doc': 'd',
+                         'changes': [change('a', 1)]})
+        # pipelined read on the SAME doc: must queue behind the write
+        gw.submit(conn, {'id': 2, 'cmd': 'get_patch', 'doc': 'd'})
+        # read on an idle doc: answered inline, ahead of the flush
+        gw.submit(conn, {'id': 3, 'cmd': 'ping'})
+        assert [r['id'] for r in conn.sent] == [3]
+        gw._flush(*gw.queue.claim())
+        assert [r['id'] for r in conn.sent] == [3, 1]
+        # the read parked behind its doc's write; the next flush cycle
+        # answers it
+        gw._flush(*gw.queue.claim())
+        assert [r['id'] for r in conn.sent] == [3, 1, 2]
+        # the queued read observed the write (read-your-writes)
+        assert conn.by_id(2)['result']['diffs']
+        # doc released: the next read bypasses inline
+        gw.submit(conn, {'id': 4, 'cmd': 'get_patch', 'doc': 'd'})
+        assert conn.sent[-1]['id'] == 4
+        assert telemetry.metrics_snapshot()['scheduler.bypass_reads'] \
+            == 1
+        assert conn.by_id(4)['result'] == conn.by_id(2)['result']
+
+    def test_overload_envelope(self):
+        gw = self._gateway(max_ops=2)
+        conn = FakeConn()
+        gw.submit(conn, {'id': 1, 'cmd': 'apply_changes', 'doc': 'a',
+                         'changes': [change('a', 1), change('a', 2)]})
+        gw.submit(conn, {'id': 2, 'cmd': 'apply_changes', 'doc': 'b',
+                         'changes': [change('b', 1)]})
+        resp = conn.by_id(2)
+        assert resp['errorType'] == 'Overloaded'
+        assert resp['retryAfterMs'] >= 1
+        # the admitted request is untouched by the shed
+        gw._flush(*gw.queue.claim())
+        assert 'result' in conn.by_id(1)
+
+    def test_malformed_apply_changes_never_poisons_a_flush(self):
+        """A request whose changes payload the merge step could not
+        assemble answers its own protocol error inline; coalesced
+        siblings are untouched."""
+        gw = self._gateway()
+        good, bad = FakeConn(), FakeConn()
+        gw.submit(bad, {'id': 1, 'cmd': 'apply_changes', 'doc': 'b'})
+        resp = bad.by_id(1)
+        assert resp['errorType'] in ('RangeError', 'TypeError'), resp
+        gw.submit(bad, {'id': 2, 'cmd': 'apply_changes', 'doc': 'b',
+                        'changes': 'not-a-list'})
+        assert 'error' in bad.by_id(2)
+        gw.submit(bad, {'id': 3, 'cmd': 'apply_batch',
+                        'docs': {'b': 'not-a-list'}})
+        assert 'error' in bad.by_id(3)
+        # nothing queued; a healthy sibling flush is unaffected
+        gw.submit(good, {'id': 4, 'cmd': 'apply_changes', 'doc': 'g',
+                         'changes': [change('x', 1)]})
+        batch, execs = gw.queue.claim()
+        assert [o.rid for o in batch] == [4] and not execs
+        gw._flush(batch, execs)
+        assert good.by_id(4)['result']['clock'] == {'x': 1}
+
+    def test_quarantined_doc_answers_only_its_request(self):
+        """A doc-pinned permanent fault inside a coalesced flush: the
+        poisoned doc's request gets the resilience error envelope, every
+        other coalesced request commits (docs/RESILIENCE.md)."""
+        gw = self._gateway()
+        conns = {d: FakeConn() for d in ('ok-1', 'poison', 'ok-2')}
+        for i, doc in enumerate(conns):
+            gw.submit(conns[doc], {'id': i, 'cmd': 'apply_changes',
+                                   'doc': doc,
+                                   'changes': [change('w', 1)]})
+        batch, execs = gw.queue.claim()
+        assert len(batch) == 3
+        faults.arm('native.begin', 'permanent', 1.0, match='poison')
+        try:
+            gw._flush(batch, execs)
+        finally:
+            faults.disarm()
+        bad = conns['poison'].by_id(1)
+        assert bad['errorType'] == 'PermanentFault'
+        for doc, rid in (('ok-1', 0), ('ok-2', 2)):
+            assert conns[doc].by_id(rid)['result']['clock'] == {'w': 1}
+        snap = telemetry.metrics_snapshot()
+        assert snap['scheduler.quarantined'] == 1
+        assert snap['resilience.quarantined'] == 1
+
+    def test_whole_batch_protocol_error_replays_serially(self):
+        """A validation error (inconsistent seq reuse -- the pool's
+        whole-batch protocol raise) fails only ITS request after the
+        serial replay; sibling requests coalesced into the same flush
+        still commit."""
+        gw = self._gateway()
+        good, bad = FakeConn(), FakeConn()
+        gw.submit(bad, {'id': 1, 'cmd': 'apply_changes', 'doc': 'b',
+                        'changes': [change('a', 1)]})
+        gw._flush(*gw.queue.claim())
+        assert 'result' in bad.by_id(1)
+        # coalesce a healthy doc with a seq-1 REUSE carrying different
+        # content (AutomergeError; protocol errors re-raise whole-batch
+        # from the resilient path, post-rollback)
+        gw.submit(good, {'id': 2, 'cmd': 'apply_changes', 'doc': 'g',
+                         'changes': [change('x', 1)]})
+        gw.submit(bad, {'id': 3, 'cmd': 'apply_changes', 'doc': 'b',
+                        'changes': [change('a', 1, key='DIFFERENT')]})
+        gw._flush(*gw.queue.claim())
+        assert good.by_id(2)['result']['clock'] == {'x': 1}
+        resp = bad.by_id(3)
+        assert 'error' in resp, resp
+        assert telemetry.metrics_snapshot()[
+            'scheduler.serial_fallback'] == 1
+        # the failing doc is intact: its next valid change applies
+        gw.submit(bad, {'id': 4, 'cmd': 'apply_changes', 'doc': 'b',
+                        'changes': [change('a', 2)]})
+        gw._flush(*gw.queue.claim())
+        assert bad.by_id(4)['result']['clock'] == {'a': 2}
+
+
+class TestLiveGateway:
+    """End-to-end over a real unix socket with the dispatcher running."""
+
+    def _serve(self):
+        path = os.path.join(tempfile.mkdtemp(), 'gw.sock')
+        return GatewayServer(path).start(), path
+
+    def test_concurrent_connections_coalesce_and_converge(self):
+        gw, path = self._serve()
+        try:
+            results, errors = {}, []
+
+            def client(i):
+                try:
+                    with SidecarClient(sock_path=path) as c:
+                        doc = 'doc-%02d' % i
+                        for s in range(1, 5):
+                            p = c.apply_changes(doc, [change(
+                                'a%02d' % i, s)])
+                            assert p['clock'] == {'a%02d' % i: s}
+                        results[i] = c.get_patch(doc)
+                except Exception as e:          # surfaced after join
+                    errors.append((i, e))
+
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(10)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            assert not errors, errors
+            assert len(results) == 10
+            # serial parity for one stream
+            from automerge_tpu.native import NativeDocPool
+            ref = NativeDocPool()
+            for s in range(1, 5):
+                ref.apply_changes('doc-00', [change('a00', s)])
+            assert results[0] == ref.get_patch('doc-00')
+            # traffic actually coalesced and drained cleanly
+            occ = telemetry.BATCH_OCCUPANCY.summary()
+            assert occ['count'] >= 1
+            snap = telemetry.metrics_snapshot()
+            assert snap['scheduler.coalesced_ops'] == 40
+            from automerge_tpu.native import live_batch_handles
+            assert live_batch_handles() == 0
+            health = telemetry.healthz()
+            assert health['scheduler']['depth_ops'] == 0
+            assert not health['scheduler']['shedding']
+        finally:
+            gw.stop()
+
+    def test_one_client_shared_across_threads(self):
+        """The thread-safety satellite: ONE SidecarClient, many caller
+        threads, responses demultiplexed by id."""
+        gw, path = self._serve()
+        try:
+            with SidecarClient(sock_path=path) as c:
+                errors = []
+
+                def worker(i):
+                    try:
+                        doc = 'shared-%d' % i
+                        for s in range(1, 4):
+                            p = c.apply_changes(doc,
+                                                [change('t%d' % i, s)])
+                            assert p['clock'] == {'t%d' % i: s}
+                        patch = c.get_patch(doc)
+                        assert patch['clock'] == {'t%d' % i: 3}
+                    except Exception as e:
+                        errors.append((i, e))
+
+                threads = [threading.Thread(target=worker, args=(i,))
+                           for i in range(8)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=120)
+                assert not errors, errors
+                assert c.call('ping') == {'ok': True}
+        finally:
+            gw.stop()
+
+    def test_overloaded_error_type_over_the_wire(self):
+        path = os.path.join(tempfile.mkdtemp(), 'gw.sock')
+        gw = GatewayServer(path, queue=AdmissionQueue(max_ops=1)).start()
+        try:
+            with SidecarClient(sock_path=path) as c:
+                seen = []
+
+                def push(i):
+                    try:
+                        c.apply_changes('ov-%d' % i,
+                                        [change('a', 1),
+                                         change('a', 2)])
+                        seen.append('ok')
+                    except OverloadedError as e:
+                        assert e.retry_after_ms >= 1
+                        seen.append('overloaded')
+
+                threads = [threading.Thread(target=push, args=(i,))
+                           for i in range(4)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=60)
+                assert 'overloaded' in seen
+                # the server survives the burst and recovers
+                deadline = time.time() + 30
+                while time.time() < deadline:
+                    try:
+                        c.apply_changes('ov-after', [change('z', 1)])
+                        break
+                    except OverloadedError:
+                        time.sleep(0.01)
+                else:
+                    pytest.fail('gateway never recovered from shed')
+                assert c.healthz()['ok']
+        finally:
+            gw.stop()

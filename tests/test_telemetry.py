@@ -405,6 +405,10 @@ def test_bench_block_shape():
     for reason in telemetry.KNOWN_FALLBACK_REASONS:
         assert reason in block['fallbacks'], reason
     assert block['fallbacks']['oracle'] == 0
+    # the scheduler block is pre-seeded the same way (serve-check and
+    # dashboards read explicit zeros before the first gateway request)
+    for key in telemetry.KNOWN_SCHEDULER_KEYS:
+        assert block['scheduler'][key] == 0, key
     assert block['batch_latency']['engine']['count'] == 1
     assert block['ops_total'] >= 1 and block['docs_total'] >= 1
     assert 'engine.kernels' in block['phases']

@@ -1,0 +1,248 @@
+"""Serve-gateway gate (ISSUE 5, docs/SERVING.md): the continuous
+-batching gateway must actually coalesce concurrent traffic, stay
+byte-identical to serial application, and shed load with the typed
+Overloaded envelope instead of hanging or growing without bound.
+
+Three phases, each against a REAL server subprocess on a unix socket:
+
+  1. **coalescing + parity** -- 32 concurrent connections of mixed-doc
+     traffic (each connection owns one doc's actor stream and
+     interleaves reads).  Gates: median ``amtpu_batch_occupancy`` > 4
+     docs/flush; every per-request patch AND every final per-doc patch
+     byte-identical to the same traffic replayed serially through ONE
+     connection on a fresh server; ``fallback.oracle == 0``; no leaked
+     batch handles at drain (``native.live_batch_handles == 0``).
+  2. **overload** -- a fresh server with the queue capped low
+     (``AMTPU_QUEUE_MAX_OPS=8``): a burst of concurrent mutations must
+     produce typed ``Overloaded`` envelopes (no hang), and the server
+     must answer healthz and fresh mutations after the burst drains.
+  3. **drain hygiene** -- after both phases the phase-1 server's
+     healthz reports an empty queue, no shed state, zero live batch
+     handles, and a zero oracle-fallback count.
+
+Run: JAX_PLATFORMS=cpu python tools/serve_check.py    (make serve-check)
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N_CONNS = 32
+ROUNDS = 6
+ROOT_ID = '00000000-0000-0000-0000-000000000000'
+
+
+def spawn_server(path, extra_env=None):
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS='cpu')
+    env.update(extra_env or {})
+    proc = subprocess.Popen(
+        [sys.executable, '-m', 'automerge_tpu.sidecar.server',
+         '--socket', path], env=env, cwd=REPO)
+    deadline = time.time() + 60
+    while not os.path.exists(path):
+        if time.time() > deadline or proc.poll() is not None:
+            raise RuntimeError('gateway server did not come up')
+        time.sleep(0.05)
+    return proc
+
+
+def stop_server(proc):
+    proc.terminate()
+    try:
+        proc.wait(timeout=30)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait(timeout=30)
+
+
+def doc_stream(i):
+    """Connection i's traffic: one actor's changes on its own doc (so
+    per-request patches are deterministic under any cross-connection
+    interleaving), docs deliberately reused across rounds."""
+    doc = 'doc-%02d' % i
+    chs = [{'actor': 'w%02d' % i, 'seq': s, 'deps': {},
+            'ops': [{'action': 'set', 'obj': ROOT_ID,
+                     'key': 'k%d' % (s % 3),
+                     'value': '%d-%d' % (i, s)}]}
+           for s in range(1, ROUNDS + 1)]
+    return doc, chs
+
+
+def run_concurrent(path):
+    """32 threads, one connection each; returns per-conn response
+    patches + final per-doc patches."""
+    from automerge_tpu.sidecar.client import SidecarClient
+    patches = {}
+    finals = {}
+    errors = []
+    barrier = threading.Barrier(N_CONNS, timeout=120)
+
+    def client(i):
+        try:
+            doc, chs = doc_stream(i)
+            with SidecarClient(sock_path=path) as c:
+                barrier.wait()          # max concurrency from round 1
+                got = []
+                for s, ch in enumerate(chs, 1):
+                    got.append(c.apply_changes(doc, [ch]))
+                    if s % 3 == 0:      # mixed traffic: bypass reads
+                        c.get_patch(doc)
+                patches[i] = got
+                finals[i] = c.get_patch(doc)
+        except Exception as e:
+            errors.append((i, '%s: %s' % (type(e).__name__, e)))
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(N_CONNS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    if errors:
+        raise AssertionError('concurrent clients failed: %s' % errors)
+    assert len(patches) == N_CONNS
+    return patches, finals
+
+
+def run_serial(path):
+    """The SAME traffic through one connection, one request at a time."""
+    from automerge_tpu.sidecar.client import SidecarClient
+    patches = {}
+    finals = {}
+    with SidecarClient(sock_path=path) as c:
+        for i in range(N_CONNS):
+            doc, chs = doc_stream(i)
+            patches[i] = [c.apply_changes(doc, [ch]) for ch in chs]
+            finals[i] = c.get_patch(doc)
+    return patches, finals
+
+
+def check_phase1():
+    from automerge_tpu.sidecar.client import SidecarClient
+    tmp = tempfile.mkdtemp()
+    conc_path = os.path.join(tmp, 'gw-conc.sock')
+    serial_path = os.path.join(tmp, 'gw-serial.sock')
+
+    proc = spawn_server(conc_path,
+                        {'AMTPU_FLUSH_DEADLINE_MS': '5'})
+    try:
+        conc_patches, conc_finals = run_concurrent(conc_path)
+        with SidecarClient(sock_path=conc_path) as c:
+            health = c.healthz()
+            metrics = c.metrics()['body']
+        sched = health['scheduler']
+    finally:
+        stop_server(proc)
+
+    proc = spawn_server(serial_path)
+    try:
+        serial_patches, serial_finals = run_serial(serial_path)
+    finally:
+        stop_server(proc)
+
+    for i in range(N_CONNS):
+        assert json.dumps(conc_patches[i], sort_keys=True) == \
+            json.dumps(serial_patches[i], sort_keys=True), \
+            'per-request patch divergence on conn %d' % i
+        assert json.dumps(conc_finals[i], sort_keys=True) == \
+            json.dumps(serial_finals[i], sort_keys=True), \
+            'final patch divergence on doc of conn %d' % i
+    print('serve-check: parity OK (%d conns x %d rounds, per-request '
+          '+ final patches byte-identical to serial)'
+          % (N_CONNS, ROUNDS))
+
+    occ = sched['occupancy']
+    assert occ['count'] >= 1, 'no gateway flushes recorded'
+    assert occ['p50'] > 4, \
+        'median batch occupancy %.2f docs/flush (need > 4); summary %r' \
+        % (occ['p50'], occ)
+    assert sched['depth_ops'] == 0 and not sched['shedding'], sched
+    assert sched['live_batch_handles'] == 0, \
+        'leaked batch handles: %r' % sched
+    assert sched['fallback_oracle'] == 0, \
+        'oracle fallback fired: %r' % sched
+    assert 'amtpu_batch_occupancy_bucket' in metrics
+    assert 'amtpu_queue_wait_ms_bucket' in metrics
+    print('serve-check: occupancy OK (median %.1f docs/flush, %d '
+          'flushes; queue drained, 0 leaked handles, oracle=0)'
+          % (occ['p50'], occ['count']))
+
+
+def check_phase2():
+    from automerge_tpu.errors import OverloadedError
+    from automerge_tpu.sidecar.client import SidecarClient
+    path = os.path.join(tempfile.mkdtemp(), 'gw-ovl.sock')
+    # tiny queue + slow flush so the burst reliably crosses the
+    # watermark; each request carries several queued ops
+    proc = spawn_server(path, {'AMTPU_QUEUE_MAX_OPS': '8',
+                               'AMTPU_FLUSH_DEADLINE_MS': '25'})
+    try:
+        outcomes = []
+
+        def push(i):
+            try:
+                with SidecarClient(sock_path=path) as c:
+                    chs = [{'actor': 'b%02d' % i, 'seq': s, 'deps': {},
+                            'ops': [{'action': 'set', 'obj': ROOT_ID,
+                                     'key': 'k', 'value': s}]}
+                           for s in range(1, 5)]
+                    c.apply_changes('burst-%d' % i, chs)
+                    outcomes.append('ok')
+            except OverloadedError as e:
+                assert e.retry_after_ms and e.retry_after_ms >= 1, \
+                    'Overloaded without retryAfterMs'
+                outcomes.append('overloaded')
+
+        threads = [threading.Thread(target=push, args=(i,))
+                   for i in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert len(outcomes) == 16, \
+            'burst client hung (%d/16 returned)' % len(outcomes)
+        n_over = outcomes.count('overloaded')
+        assert n_over >= 1, 'queue capped at 8 ops never shed %r' \
+            % outcomes
+        # the server survives the burst: drains, clears shed state, and
+        # accepts fresh work
+        with SidecarClient(sock_path=path) as c:
+            deadline = time.time() + 60
+            while True:
+                try:
+                    p = c.apply_changes('after-burst', [{
+                        'actor': 'z', 'seq': 1, 'deps': {},
+                        'ops': [{'action': 'set', 'obj': ROOT_ID,
+                                 'key': 'k', 'value': 1}]}])
+                    break
+                except OverloadedError:
+                    assert time.time() < deadline, \
+                        'gateway never recovered from the shed state'
+                    time.sleep(0.05)
+            assert p['clock'] == {'z': 1}
+            health = c.healthz()
+            assert health['ok'] and not health['scheduler']['shedding']
+            assert health['scheduler']['depth_ops'] == 0
+        print('serve-check: overload OK (%d/16 burst requests shed '
+              'with typed envelopes, server healthy after drain)'
+              % n_over)
+    finally:
+        stop_server(proc)
+
+
+def main():
+    check_phase1()
+    check_phase2()
+    print('SERVE-CHECK GREEN')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
