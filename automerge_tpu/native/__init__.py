@@ -13,6 +13,7 @@ zero-Python wire path the sidecar serves.
 
 import ctypes
 import os
+import re
 import subprocess
 import threading
 import time
@@ -21,7 +22,7 @@ import msgpack
 import numpy as np
 
 from .. import faults, telemetry, trace
-from ..utils.common import doc_key
+from ..utils.common import doc_key, env_int
 from ..utils.wire import map_header as _map_header
 from ..utils.wire import read_map_header as _read_map_header
 
@@ -111,6 +112,13 @@ def _load():
     lib.amtpu_esc_mem_off.argtypes = [ctypes.c_void_p]
     lib.amtpu_esc_mem.restype = ctypes.POINTER(ctypes.c_int32)
     lib.amtpu_esc_mem.argtypes = [ctypes.c_void_p]
+    lib.amtpu_resclk_info.argtypes = [ctypes.c_void_p,
+                                      ctypes.POINTER(ctypes.c_int64)]
+    lib.amtpu_latch_defaults.argtypes = [ctypes.POINTER(ctypes.c_int64)]
+    lib.amtpu_resclk_tab.restype = ctypes.POINTER(ctypes.c_int32)
+    lib.amtpu_resclk_tab.argtypes = [ctypes.c_void_p]
+    lib.amtpu_resclk_batch_stats.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64)]
     lib.amtpu_mid_packed.restype = ctypes.c_int
     lib.amtpu_mid_packed.argtypes = [
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
@@ -347,6 +355,16 @@ def _collect_ready_order(entries, on_result=None, on_error=None):
             if on_result is not None:
                 on_result(key, result)
         except Exception as e:
+            # drain in-flight kernels BEFORE rollback+free: a phase-b
+            # failure (armed fault, device error) can leave dispatches
+            # that zero-copied the C++ batch columns the free below is
+            # about to delete -- the PR-4 alias class, same drain as
+            # the wave phase-a unwind
+            for arr in _ctx_pending_arrays(ctx):
+                try:
+                    arr.block_until_ready()
+                except Exception:
+                    pass    # already failing; kernel errors moot
             _rollback_batch(ctx['bh'], e)
             if on_error is not None:
                 on_error(key, e)
@@ -371,7 +389,11 @@ def apply_payloads_pipelined(pools_payloads):
     errors = []
     for pool, payload in pools_payloads:
         try:
-            ctxs.append((None, pool, pool._phase_a(payload)))
+            # overlapped: callers may pass the same pool more than once,
+            # so a later begin must not donate a table an earlier
+            # in-flight dispatch still reads
+            ctxs.append((None, pool, pool._phase_a(payload,
+                                                   overlapped=True)))
         except Exception as e:
             errors.append(e)
     _collect_ready_order(ctxs,
@@ -447,6 +469,23 @@ def _raise_last():
     raise (RangeError if kind == 1 else AutomergeError)(msg)
 
 
+def _pipeline_depth():
+    """Cross-batch staging depth of the double-buffered wave pipeline
+    (AMTPU_PIPELINE_DEPTH, default 2; 0/1 disables).  Each wave is a
+    doc-disjoint slice of the payload begun while earlier waves' device
+    kernels are still in flight -- wave k+1's C++ decode/begin (GIL
+    released) overlaps wave k's XLA compute, the cross-BATCH extension
+    of the cross-shard overlap `_collect_ready_order` already drives."""
+    return env_int('AMTPU_PIPELINE_DEPTH', 2)
+
+
+def _pipeline_min_docs():
+    """Smallest doc count worth splitting into waves: below this the
+    per-wave fixed cost (split pass, extra dispatch, jit shape) beats
+    the overlap.  AMTPU_PIPELINE_MIN_DOCS overrides (default 64)."""
+    return env_int('AMTPU_PIPELINE_MIN_DOCS', 64)
+
+
 def _devtime_on():
     """AMTPU_DEVTIME=1 turns on synchronous per-dispatch device timing
     (checked per call, not latched -- bench.py flips it for one pass).
@@ -469,6 +508,96 @@ def _host_dom_on():
         return env not in ('', '0')
     import jax
     return jax.default_backend() == 'cpu'
+
+
+#: resident-mode knobs that BIND at a process's first batch: C++ static
+#: latches (core.cpp resident_enabled_pre / resclk_enabled) + jit cache
+#: shapes.  AMTPU_HOST_FULL is deliberately absent -- it is re-read per
+#: batch (the exec-mode A/B tests flip it in-process).
+_RESIDENT_LATCH_KEYS = ('AMTPU_RESIDENT', 'AMTPU_RESIDENT_MIN',
+                        'AMTPU_RESIDENT_CLK', 'AMTPU_RESCLK_MAX_ACTORS',
+                        'AMTPU_RESCLK_MAX_ROWS', 'AMTPU_TRIVIAL_HOST')
+_resident_latch = None          # first-batch snapshot
+_latch_flips_warned = set()     # (key, new value) pairs already warned
+
+
+def _atoi(s):
+    """C atoi: leading integer or 0 -- the parse the C++ latches use."""
+    m = re.match(r'\s*[-+]?\d+', s or '')
+    return int(m.group()) if m else 0
+
+
+_latch_defaults_cache = None
+
+
+def _latch_defaults():
+    """(resident_min, resclk_max_actors, resclk_max_rows) defaults read
+    through the ABI (amtpu_latch_defaults): the flip guard's effective
+    values can never drift from the constants core.cpp latches on."""
+    global _latch_defaults_cache
+    if _latch_defaults_cache is None:
+        out = (ctypes.c_int64 * 3)()
+        lib().amtpu_latch_defaults(out)
+        _latch_defaults_cache = tuple(int(v) for v in out)
+    return _latch_defaults_cache
+
+
+def _latch_snapshot():
+    """(raw, effective) views of the latch knobs.  Effective values
+    mirror each knob's actual consumers, so a semantically no-op env
+    change (e.g. exporting a numeric knob's default) does not warn:
+
+    * AMTPU_RESIDENT stays raw -- the Python arena/dominance gates
+      distinguish unset (backend-dependent) from any set value;
+    * AMTPU_RESIDENT_CLK's only consumer is core.cpp's resclk_enabled:
+      atoi(CLK, falling back to RESIDENT) != 0, default on;
+    * the numeric knobs compare as parsed integers with the C++
+      defaults filled in;
+    * AMTPU_TRIVIAL_HOST mirrors core.cpp's trivial_host static:
+      atoi != 0, default on."""
+    raw = tuple(os.environ.get(k) for k in _RESIDENT_LATCH_KEYS)
+    res, rmin, clk, amax, arows, triv = raw
+    clk_src = clk if clk is not None else res
+    d_rmin, d_amax, d_arows = _latch_defaults()
+    eff = (res,
+           _atoi(rmin) if rmin is not None else d_rmin,
+           True if clk_src is None else _atoi(clk_src) != 0,
+           _atoi(amax) if amax is not None else d_amax,
+           _atoi(arows) if arows is not None else d_arows,
+           True if triv is None else _atoi(triv) != 0)
+    return raw, eff
+
+
+def _check_resident_latch():
+    """Enforce the latch-at-first-batch contract instead of silently
+    ignoring flips (ISSUE 6): the first batch snapshots the
+    AMTPU_RESIDENT* knobs; a later divergence warns once per (key,
+    value) and counts ``resident.latch_flip_ignored``.  The flipped env
+    stays ignored exactly as before -- the C++ statics latched and the
+    jit caches already compiled against the first-batch values; only a
+    process restart can apply it (bench.py's subprocess-per-config
+    protocol exists for this reason)."""
+    global _resident_latch
+    cur = _latch_snapshot()
+    if _resident_latch is None:
+        _resident_latch = cur
+        return
+    if cur[1] == _resident_latch[1]:    # effective values decide
+        return
+    import warnings
+    for key, was, now, was_eff, now_eff in zip(
+            _RESIDENT_LATCH_KEYS, _resident_latch[0], cur[0],
+            _resident_latch[1], cur[1]):
+        if was_eff == now_eff:
+            continue
+        trace.metric('resident.latch_flip_ignored')
+        if (key, now) not in _latch_flips_warned:
+            _latch_flips_warned.add((key, now))
+            warnings.warn(
+                '%s changed %r -> %r after the first batch; resident-'
+                'mode knobs latch at first use, so the flip is IGNORED '
+                '(restart the process to apply it)' % (key, was, now),
+                RuntimeWarning, stacklevel=3)
 
 
 def _host_full_on():
@@ -535,8 +664,10 @@ class NativeDocPool:
     def __init__(self):
         self._pool = lib().amtpu_pool_new()
         self._mode_set = False
+        from .batch_resident import PoolClockCache
         from .resident import ResidentCache
         self._resident = ResidentCache()
+        self._resclk = PoolClockCache()
 
     @staticmethod
     def _backend_is_cpu():
@@ -569,26 +700,174 @@ class NativeDocPool:
     def apply_batch_bytes(self, payload):
         """msgpack {doc_id: [change...]} -> msgpack {doc_id: patch}."""
         t0 = time.perf_counter()
+        if isinstance(payload, (bytes, bytearray)):
+            try:
+                docs = _read_map_header(payload)[0]
+            except (ValueError, IndexError):
+                # malformed header: skip pipelining and let C++ begin
+                # raise its typed validation error (the resilience and
+                # sidecar layers classify on that type)
+                docs = 0
+        else:
+            # shard sub-call: never pipelined (the sharded driver
+            # overlaps across shards itself) and the top level already
+            # counted docs for telemetry -- no header parse needed
+            docs = 0
+        if self._should_pipeline(payload, docs):
+            try:
+                out = self._apply_waves(payload, docs)
+            except Exception as e:
+                if getattr(e, 'amtpu_state_suspect', False):
+                    raise
+                # every begun wave rolled back pre-emit, so a serial
+                # replay is safe -- and it restores the unpipelined
+                # contract that a multi-error payload surfaces its
+                # FIRST error in application order (C++ begin), which
+                # wave hash-order begin would otherwise change with
+                # AMTPU_PIPELINE_DEPTH
+                trace.metric('pipeline.serial_replay')
+                out = self._apply_unpipelined(payload)
+        else:
+            out = self._apply_unpipelined(payload)
+        telemetry.observe_batch('native', time.perf_counter() - t0,
+                                docs=docs)
+        return out
+
+    def _apply_unpipelined(self, payload):
+        """One whole-payload phase a + b: the non-wave batch body."""
         ctx = self._phase_a(payload)
         try:
-            out = self._phase_b(ctx)
+            return self._phase_b(ctx)
         except Exception as e:
             _rollback_batch(ctx['bh'], e)
             raise
         finally:
             _free_batch(ctx['bh'])
-        # doc count comes free from the payload's map header; a tuple
-        # payload is a shard sub-call whose docs the sharded top level
-        # already counted
-        docs = _read_map_header(payload)[0] \
-            if isinstance(payload, (bytes, bytearray)) else 0
-        telemetry.observe_batch('native', time.perf_counter() - t0,
-                                docs=docs)
-        return out
 
-    def _phase_a(self, payload):
+    def _should_pipeline(self, payload, docs):
+        """Wave pipelining engages only where the overlap is real and the
+        semantics unchanged: enough docs to split, a device kernel to
+        overlap (the full host path has no async device work -- C++
+        begin and emit already saturate the core), no armed fault sites
+        (chaos lanes pin exact single-batch rollback semantics), and not
+        already inside a sharded driver's sub-call (tuple payloads),
+        which pipelines across shards itself."""
+        if isinstance(payload, tuple):
+            return False
+        if docs < max(2, _pipeline_min_docs()) or _pipeline_depth() < 2:
+            return False
+        if faults.ARMED:
+            return False
+        self._ensure_mode_flags()
+        return not _host_full_on()
+
+    def _apply_waves(self, payload, docs):
+        """Double-buffered cross-batch staging INSIDE one pool: the
+        payload splits into doc-disjoint waves (the same FNV doc hash as
+        the shard splitter), every wave's C++ begin + async kernel
+        dispatch runs before any wave blocks on results, and phase b
+        drains ready-first (`_collect_ready_order`) -- so wave k+1's
+        decode/begin/encode overlaps wave k's in-flight device compute
+        on the SAME NativeDocPool.  Doc-disjointness is what makes the
+        interleaved begins sound: the begin journal, register mirrors,
+        member windows, and arenas are all doc-scoped, and the pool-
+        global intern/clock tables are append-only.
+
+        Failure semantics: any phase-a error rolls back every begun wave
+        in reverse begin order -- nothing has emitted yet, so the call
+        stays atomic exactly like the unpipelined path (validation/
+        protocol errors all raise at begin).  A phase-b error
+        (unreachable for well-formed pools; fault injection disables
+        pipelining) rolls back the failed wave while healthy waves still
+        run to completion -- the sharded driver's semantics -- and the
+        re-raised exception is marked ``amtpu_state_suspect`` when any
+        wave committed, so the resilience layer refuses a blind
+        whole-payload re-apply instead of double-applying committed
+        docs."""
+        L = lib()
+        depth = min(_pipeline_depth(), docs)
+        # bytes only: _should_pipeline rejects shard sub-call views, and
+        # waves must never nest inside a shard split (doc-disjointness
+        # and failure semantics are reasoned per top-level payload)
+        assert isinstance(payload, (bytes, bytearray))
+        with trace.span('pipeline.split'):
+            # the splitter copies doc sub-payloads into its own buffers,
+            # so `payload` only needs to outlive this call
+            sp = L.amtpu_shard_split(payload, len(payload), depth)
+            if not sp:
+                _raise_last()
+        try:
+            subs = []
+            for s in range(depth):
+                sub_len = ctypes.c_int64()
+                ptr = L.amtpu_shard_buf(sp, s, ctypes.byref(sub_len))
+                if sub_len.value > 1:
+                    subs.append((ctypes.cast(ptr, ctypes.c_char_p),
+                                 sub_len.value))
+            ctxs = []
+            t_a0 = time.perf_counter()
+            try:
+                for i, sub in enumerate(subs):
+                    ctx = self._phase_a(sub, overlapped=True)
+                    ctxs.append((i, self, ctx))
+                    if i == 0:
+                        t_a0 = time.perf_counter()
+            except Exception as e:
+                # atomic unwind: reverse begin order, nothing emitted.
+                # Drain each wave's in-flight kernels BEFORE freeing:
+                # their dispatch zero-copied the C++ batch columns the
+                # free is about to delete (the PR-4 alias class).
+                for _i, _p, ctx in reversed(ctxs):
+                    for arr in _ctx_pending_arrays(ctx):
+                        try:
+                            arr.block_until_ready()
+                        except Exception:
+                            pass    # already unwinding; kernel errors moot
+                    _rollback_batch(ctx['bh'], e)
+                    _free_batch(ctx['bh'])
+                raise
+            if len(ctxs) > 1:
+                # host begin time of waves >0: the decode/begin work
+                # that ran while wave 0's kernels were already in flight
+                trace.metric('collect.overlap_s',
+                             time.perf_counter() - t_a0)
+            trace.metric('pipeline.batches')
+            trace.metric('pipeline.waves', len(ctxs))
+            results = [None] * len(ctxs)
+            errors = []
+
+            def keep(i, result):
+                results[i] = result
+
+            _collect_ready_order(
+                ctxs, on_result=keep,
+                on_error=lambda i, e: errors.append((i, e)))
+            if errors:
+                _i, err = errors[0]
+                # suspect if any wave committed OR any other wave's
+                # failure was itself marked suspect (post-emit rollback
+                # failure): the marker must survive raising errors[0]
+                if (any(r is not None for r in results)
+                        or any(getattr(e, 'amtpu_state_suspect', False)
+                               for _j, e in errors)):
+                    err.amtpu_state_suspect = True
+                raise err
+            total = 0
+            bodies = []
+            for r in results:
+                cnt, off = _read_map_header(r)
+                total += cnt
+                bodies.append(memoryview(r)[off:])
+            return _map_header(total) + b''.join(bodies)
+        finally:
+            L.amtpu_shard_free(sp)
+
+    def _phase_a(self, payload, overlapped=False):
         """Host begin + async device dispatch.  Returns a context dict;
         the caller MUST pass it to `_phase_b` and then free ctx['bh'].
+        `overlapped=True` (the wave-pipelined driver) forbids donating
+        the previous resident clock table: an earlier wave's in-flight
+        kernels may still read it.
 
         `payload` is msgpack bytes, or a zero-copy (ctypes char pointer,
         length) pair -- the sharded driver passes views into the C++
@@ -604,6 +883,7 @@ class NativeDocPool:
             data, n = payload
         else:
             data, n = payload, len(payload)
+        _check_resident_latch()
         self._ensure_mode_flags()
         with trace.span('host.begin'):
             bh = L.amtpu_begin(self._pool, data, n)
@@ -621,9 +901,9 @@ class NativeDocPool:
                 _rollback_batch(bh, e)
                 _free_batch(bh)
                 raise
-        return self._phase_a_rest(bh, fault_docs)
+        return self._phase_a_rest(bh, fault_docs, overlapped=overlapped)
 
-    def _phase_a_rest(self, bh, fault_docs=None):
+    def _phase_a_rest(self, bh, fault_docs=None, overlapped=False):
         """Post-begin half of phase a: read batch dims and dispatch the
         device kernels.  Shared by the batch and local-change entries."""
         L = lib()
@@ -638,7 +918,8 @@ class NativeDocPool:
             # (an undersized ctypes buffer is silent heap corruption)
             fdims = (ctypes.c_int64 * 6)()
             L.amtpu_fused_dims(bh, fdims)
-            fused_ok, W, dLp, dTp, resident_ok, _ = [int(x) for x in fdims]
+            (fused_ok, W, dLp, dTp, resident_ok,
+             res_clock) = [int(x) for x in fdims]
             trace.count('ops.register_rows', T)
             trace.count('ops.arena_elems', Larena)
             # member-window mode (hot keys): explicit candidate indexes +
@@ -707,6 +988,23 @@ class NativeDocPool:
                 ctx.update(mode='hostreg')
                 return ctx
 
+            if res_clock and Tp > 0:
+                # pool-resident clock table (tentpole a): sync the
+                # device copy -- usually a delta upload of just this
+                # batch's appended rows -- and stamp per-batch hit
+                # accounting.  Computed only on the kernel paths (the
+                # hostreg returns above never stage clocks).
+                ctx['ctab_dev'] = self._resclk.table(
+                    L, self._pool, donate_ok=not overlapped)
+                stats = (ctypes.c_int64 * 2)()
+                L.amtpu_resclk_batch_stats(bh, stats)
+                if stats[0]:
+                    trace.metric('resident.batch_hit_rows',
+                                 int(stats[0]))
+            elif not res_clock:
+                # actor cap crossed mid-pool: release the (possibly
+                # huge) device table the moment C++ disables the cache
+                self._resclk.drop_if_disabled(L, self._pool)
             if faults.ARMED:
                 faults.fire('device.dispatch', ctx['fault_docs'])
             devtime = _devtime_on()
@@ -721,7 +1019,8 @@ class NativeDocPool:
                 with trace.span('device.dispatch'):
                     reg_out, rank = self._run_resolver(
                         L, bh, Tp, Ap, CTp, Lp, max_obj, mem,
-                        weff=ctx['weff'])
+                        weff=ctx['weff'],
+                        ctab_dev=ctx.get('ctab_dev'))
                 ctx.update(mode='old', reg_out=reg_out, rank=rank)
                 # member-mode overflow flags are HOST-computed, so the
                 # escalation tiers dispatch here -- async, overlapping
@@ -758,17 +1057,23 @@ class NativeDocPool:
             _free_batch(bh)
             raise
 
-    def _register_views(self, L, bh, Tp, Ap, CTp):
+    def _register_views(self, L, bh, Tp, Ap, CTp, ctab_dev=None):
         """ctypes views of the register columns (single source of truth
-        for their shapes/dtypes)."""
+        for their shapes/dtypes).  `ctab_dev` (the pool-resident device
+        clock table) replaces the batch-local table view when the batch
+        was encoded against pool-global clock rows (CTp == 0)."""
+        if ctab_dev is not None:
+            ctab = ctab_dev
+        else:
+            ctab = np.ctypeslib.as_array(L.amtpu_col_clocktab(bh),
+                                         shape=(CTp, Ap))
         return dict(
             g=np.ctypeslib.as_array(L.amtpu_col_g(bh), shape=(Tp,)),
             t=np.ctypeslib.as_array(L.amtpu_col_t(bh), shape=(Tp,)),
             a=np.ctypeslib.as_array(L.amtpu_col_a(bh), shape=(Tp,)),
             s=np.ctypeslib.as_array(L.amtpu_col_s(bh), shape=(Tp,)),
             d=np.ctypeslib.as_array(L.amtpu_col_d(bh), shape=(Tp,)),
-            ctab=np.ctypeslib.as_array(L.amtpu_col_clocktab(bh),
-                                       shape=(CTp, Ap)),
+            ctab=ctab,
             cidx=np.ctypeslib.as_array(L.amtpu_col_clockidx(bh),
                                        shape=(Tp,)),
             si=np.ctypeslib.as_array(L.amtpu_col_sort(bh), shape=(Tp,)))
@@ -793,7 +1098,8 @@ class NativeDocPool:
             # ops there are no dominance timelines either -- no dispatch
             ctx.update(mode='fused', combo=None, reg_out=None, rank=None)
             return
-        r = self._register_views(L, bh, Tp, Ap, CTp)
+        r = self._register_views(L, bh, Tp, Ap, CTp,
+                                 ctab_dev=ctx.get('ctab_dev'))
         mem = ctx.get('mem')
 
         def dispatch_registers_only(hostdom=False):
@@ -886,7 +1192,8 @@ class NativeDocPool:
                                          n_now, dLp)
         if entry is None:
             return False
-        r = self._register_views(L, bh, Tp, Ap, CTp)
+        r = self._register_views(L, bh, Tp, Ap, CTp,
+                                 ctab_dev=ctx.get('ctab_dev'))
         oe = np.ctypeslib.as_array(L.amtpu_dom_oe(bh, 0), shape=(1, dTp))
         dom_src = np.ctypeslib.as_array(L.amtpu_fdom_domsrc(bh),
                                         shape=(1, dTp))
@@ -972,17 +1279,22 @@ class NativeDocPool:
                     conf_rows = np.zeros(0, np.int32)
                     conf_vals = np.zeros(0, np.int32)
                 else:
+                    from ..ops import registers as register_ops
                     combo = np.asarray(ctx['combo'])
                     packed = np.ascontiguousarray(combo[:Tp])
                     dom_idx = np.ascontiguousarray(combo[Tp:], np.int32)
-                    fallback = bool((packed >> 30 & 1).any())
+                    fallback = bool(
+                        (packed >> register_ops.PACKED_OVF_SHIFT
+                         & 1).any())
                     if not fallback:
                         # conflicts stay SPARSE: only rows whose register
                         # kept >1 member carry a conflict list (the
                         # dense-workload switch lives in
                         # _fetch_conflict_rows)
                         conf_rows = np.nonzero(
-                            (packed >> 24 & 0x3f) > 1)[0].astype(np.int32)
+                            (packed >> register_ops.PACKED_ALIVE_SHIFT
+                             & register_ops.PACKED_ALIVE_MASK)
+                            > 1)[0].astype(np.int32)
                         conf_vals = self._fetch_conflict_rows(
                             ctx['reg_out'], conf_rows, Tp)
             if fallback:
@@ -994,7 +1306,8 @@ class NativeDocPool:
                 trace.count('fused.fallback_overflow')
                 trace.metric('fallback.overflow_batches')
                 trace.metric('fallback.overflow_rows',
-                             int((packed >> 30 & 1).sum()))
+                             int((packed >> register_ops.PACKED_OVF_SHIFT
+                                  & 1).sum()))
                 trace.metric('collect.full_matrix_readback')
                 reg_out = ctx['reg_out']
                 winner = np.ascontiguousarray(reg_out['winner'], np.int32)
@@ -1124,10 +1437,13 @@ class NativeDocPool:
             for name, val in zip(('decode', 'schedule', 'encode',
                                   'mid', 'emit', 'domlay'), tr):
                 trace.add('cxx.' + name, float(val))
-            sc = (ctypes.c_int64 * 2)()
+            sc = (ctypes.c_int64 * 4)()
             L.amtpu_sched_counts(bh, sc)
             trace.count('sched.fast_path', int(sc[0]))
             trace.count('sched.queued', int(sc[1]))
+            if sc[2]:
+                trace.count('sched.trivial_rows', int(sc[2]))
+                trace.count('sched.trivial_groups', int(sc[3]))
         out_len = ctypes.c_int64()
         ptr = L.amtpu_result(bh, ctypes.byref(out_len))
         return ctypes.string_at(ptr, out_len.value) \
@@ -1174,7 +1490,8 @@ class NativeDocPool:
         from ..ops import registers as register_ops
         Tp, Ap = ctx['dims'][1], ctx['dims'][3]
         CTp = ctx['dims'][8]
-        r = self._register_views(L, ctx['bh'], Tp, Ap, CTp)
+        r = self._register_views(L, ctx['bh'], Tp, Ap, CTp,
+                                 ctab_dev=ctx.get('ctab_dev'))
         groups = self._esc_layout_groups(L, ctx['bh'])
         if groups is not None:
             return register_ops.escalate_dispatch_groups(
@@ -1226,10 +1543,10 @@ class NativeDocPool:
         Returns (packed [Tp] i32, conf_rows, conf_offs, conf_vals,
         residual u8 [Tp] | None)."""
         from ..ops import registers as register_ops
-        packed = np.asarray(reg_out['packed'])
         flagged = np.asarray(ctx['hovf']).astype(bool)
         residual = None
         esc_parts = []            # (global rows, global conflicts) pairs
+        esc = None
         if flagged.any():
             trace.metric('fallback.member_overflow_rows',
                          int(flagged.sum()))
@@ -1239,13 +1556,38 @@ class NativeDocPool:
                 # flags are host-computed, so phase a normally
                 # pre-dispatched the tiers; dispatch late if it could not
                 esc = self._escalation_dispatch(lib(), ctx, flagged)
-            packed = np.array(packed)            # writable copy
+        # Device-side tier merge (ISSUE 6 tentpole b): scatter each tier
+        # chunk's packed words into the base word ON DEVICE -- tier-local
+        # winners translate to global rows through the chunk's row map --
+        # so the ONE packed transfer below returns the word already
+        # resolved for every tier-escalated row; the host's remaining
+        # merge work is the residual vector + sparse conflicts.
+        dev_merge = (esc is not None and len(esc[0]) > 0
+                     and register_ops.device_merge_on())
+        if dev_merge:
+            base = reg_out['packed']
+            for _W, sub_rows, out in esc[0]:
+                Tn = int(out['packed'].shape[0])
+                rows_p = np.full(Tn, Tp, np.int32)       # Tp = dropped
+                rows_p[:len(sub_rows)] = sub_rows
+                sub_p = np.zeros(Tn, np.int32)
+                sub_p[:len(sub_rows)] = sub_rows
+                base = register_ops.merge_packed_rows(
+                    base, rows_p, out['packed'], sub_p)
+            trace.metric('collect.device_merge_chunks', len(esc[0]))
+            packed = np.asarray(base)
+        else:
+            packed = np.asarray(reg_out['packed'])
+        if flagged.any():
+            if not dev_merge:
+                packed = np.array(packed)        # writable copy
             residual = np.array(np.asarray(ctx['hovf']), np.uint8)
             if esc is not None:
                 for ch in register_ops.escalate_overflow_collect_arrays(
-                        esc[0]):
-                    packed[ch.rows] = register_ops.pack_register_word(
-                        ch.winner, ch.alive)
+                        esc[0], need_winner=not dev_merge):
+                    if not dev_merge:
+                        packed[ch.rows] = register_ops.pack_register_word(
+                            ch.winner, ch.alive)
                     residual[ch.rows] = 0
                     if ch.conf_rows.size:
                         esc_parts.append((ch.rows[ch.conf_rows],
@@ -1258,7 +1600,8 @@ class NativeDocPool:
         # base sparse conflicts: rows OUTSIDE flagged groups that kept
         # more than one member (flagged groups' base-kernel output is
         # invalid -- they re-resolved in the tiers or the oracle replay)
-        base_mask = ((packed >> 24) & 0x3f) > 1
+        base_mask = ((packed >> register_ops.PACKED_ALIVE_SHIFT)
+                     & register_ops.PACKED_ALIVE_MASK) > 1
         if flagged.any():
             base_mask &= ~flagged
         conf_rows_b = np.nonzero(base_mask)[0].astype(np.int32)
@@ -1332,14 +1675,15 @@ class NativeDocPool:
     # -- kernel dispatch ------------------------------------------------
 
     def _run_resolver(self, L, bh, Tp, Ap, CTp, Lp, max_obj_len,
-                      mem=None, weff=None):
+                      mem=None, weff=None, ctab_dev=None):
         """Register resolution + linearization, fused into one dispatch
         when both are needed (halves blocking round trips on the
         high-latency device link).  Returns (reg_out device dict | None,
         rank np.int32 [Lp])."""
         from ..ops import list_rank, registers as register_ops
         if Tp > 0:
-            r = self._register_views(L, bh, Tp, Ap, CTp)
+            r = self._register_views(L, bh, Tp, Ap, CTp,
+                                     ctab_dev=ctab_dev)
         if Lp > 0:
             e = self._arena_views(L, bh, Lp)
             # doubling depth: DFS chains never cross objects
@@ -1392,13 +1736,19 @@ class NativeDocPool:
     @staticmethod
     def _unpack_packed(packed):
         """Splits the packed [T] i32 register summary (24-bit winner,
-        0xffffff = none | 6-bit alive, saturated at 63 | overflow in bit
-        30) -- the single source of truth for the transfer-packed bit
-        layout (ops/registers.py PACKED_ALIVE_MAX)."""
-        winner = np.ascontiguousarray(packed & 0xffffff, np.int32)
-        winner[winner == 0xffffff] = -1
-        alive = np.ascontiguousarray((packed >> 24) & 0x3f, np.int32)
-        overflow = np.ascontiguousarray((packed >> 30) & 1, np.uint8)
+        PACKED_WINNER_NONE = none | 6-bit alive, saturated at
+        PACKED_ALIVE_MAX | overflow in bit PACKED_OVF_SHIFT) -- the
+        decode twin of ops/registers.pack_register_word; both sides read
+        the layout from the shared PACKED_* constants."""
+        from ..ops import registers as register_ops
+        winner = np.ascontiguousarray(
+            packed & register_ops.PACKED_WINNER_MASK, np.int32)
+        winner[winner == register_ops.PACKED_WINNER_NONE] = -1
+        alive = np.ascontiguousarray(
+            (packed >> register_ops.PACKED_ALIVE_SHIFT)
+            & register_ops.PACKED_ALIVE_MASK, np.int32)
+        overflow = np.ascontiguousarray(
+            (packed >> register_ops.PACKED_OVF_SHIFT) & 1, np.uint8)
         return winner, alive, overflow
 
     def _run_dominance(self, L, bh):
@@ -1482,6 +1832,11 @@ class NativeDocPool:
         canUndo/canRedo)."""
         key = self._doc_key(doc_id)
         payload = msgpack.packb(request, use_bin_type=True)
+        # local changes latch the C++ statics / jit caches exactly like
+        # batches do, so they must take (or check) the same snapshot --
+        # a gateway that serves local changes first would otherwise
+        # baseline the latch on post-flip values
+        _check_resident_latch()
         self._ensure_mode_flags()
         with trace.span('host.begin'):
             bh = lib().amtpu_begin_local(self._pool, key.encode(), payload,

@@ -32,6 +32,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.common import env_int
+
 # Window of predecessors considered per op in the base dispatch.  Conflict
 # sets larger than this overflow and escalate through the tier ladder.
 WINDOW = 8
@@ -40,19 +42,64 @@ WINDOW = 8
 # saturated here; every packed-path consumer only tests alive > 0 / > 1.
 PACKED_ALIVE_MAX = 63
 
+#: Bit layout of the packed register word, defined ONCE for every
+#: encoder/decoder (pack_register_word, _merge_packed_rows,
+#: NativeDocPool._unpack_packed; native/core.cpp mirrors it and
+#: docs/ARCHITECTURE.md pins it).  Plain ints: usable from numpy and
+#: traced jit code alike.
+PACKED_WINNER_MASK = 0xffffff    # low 24 bits; == mask means "no winner"
+PACKED_WINNER_NONE = 0xffffff
+PACKED_ALIVE_SHIFT = 24
+PACKED_ALIVE_MASK = 0x3f
+PACKED_OVF_SHIFT = 30
+
 
 def pack_register_word(winner, alive_after, overflow=None):
     """Encodes the packed [T] i32 transfer word: winner (24 bits,
-    0xffffff = none) | alive_after (6 bits, saturated at
-    PACKED_ALIVE_MAX) | overflow in bit 30.  Works on jnp and np arrays;
-    the decode twin is NativeDocPool._unpack_packed."""
+    PACKED_WINNER_NONE = none) | alive_after (6 bits, saturated at
+    PACKED_ALIVE_MAX) | overflow in bit PACKED_OVF_SHIFT.  Works on jnp
+    and np arrays; the decode twin is NativeDocPool._unpack_packed."""
     xp = jnp if isinstance(winner, jnp.ndarray) else np
-    word = (xp.where(winner >= 0, winner, 0xffffff).astype(xp.int32)
+    word = (xp.where(winner >= 0, winner,
+                     PACKED_WINNER_NONE).astype(xp.int32)
             | (xp.minimum(alive_after, PACKED_ALIVE_MAX).astype(xp.int32)
-               << 24))
+               << PACKED_ALIVE_SHIFT))
     if overflow is not None:
-        word = word | (overflow.astype(xp.int32) << 30)
+        word = word | (overflow.astype(xp.int32) << PACKED_OVF_SHIFT)
     return word
+
+
+def _pairwise_clock(m_actor, clock_table=None, m_cidx=None, m_clock=None):
+    """P[t, u, v] = clock of member u at the actor of member v -- the
+    pairwise supersession input.  Two formulations, bit-equal:
+
+      * one-hot einsum (batched matmul): MXU-shaped work, the right form
+        on accelerators;
+      * flat gather from the compact clock table (or take_along_axis on
+        an already-materialized [T, W+1, A] m_clock): measured 3.5x
+        faster than the int32 einsum on XLA:CPU at the config-4 shape,
+        and the table form never materializes m_clock at all.
+
+    Entries for invalid members are garbage under the gather forms (the
+    clipped indexes read arbitrary real rows); every consumer masks by
+    member validity, so the two forms stay bit-equal where it matters.
+    """
+    import jax as _jax
+    on_cpu = _jax.default_backend() == 'cpu'
+    if on_cpu and clock_table is not None:
+        A = clock_table.shape[1]
+        idx = m_cidx[:, :, None] * A + m_actor[:, None, :]
+        return clock_table.reshape(-1)[idx]
+    if m_clock is None:
+        m_clock = clock_table[m_cidx]
+    if on_cpu:
+        Wp1 = m_actor.shape[1]
+        idx = jnp.broadcast_to(m_actor[:, None, :],
+                               (m_actor.shape[0], Wp1, Wp1))
+        return jnp.take_along_axis(m_clock, idx, axis=2)
+    A = m_clock.shape[2]
+    onehot = jax.nn.one_hot(m_actor, A, dtype=jnp.int32)
+    return jnp.einsum('tua,tva->tuv', m_clock, onehot)
 
 
 def _order_by_paircount(m_actor, m_time, alive, m_src, W):
@@ -111,7 +158,6 @@ def resolve_registers_members(time, actor, seq, mem_idx, is_del,
     """
     T = time.shape[0]
     W = window
-    A = clock_table.shape[1]
 
     valid_m = mem_idx >= 0                                    # [T, W]
     midx = jnp.clip(mem_idx, 0, T - 1)
@@ -123,15 +169,13 @@ def resolve_registers_members(time, actor, seq, mem_idx, is_del,
     m_seq = seq[all_idx]
     m_time = time[all_idx]
     m_del = is_del[all_idx]
-    # member clocks gather INDICES first, then rows from the compact
-    # deduplicated table: [T, W+1] small gather + [T, W+1, A] gather out
-    # of CTp rows beats materializing [T, A] and gathering the blown-up
-    # matrix (measured ~2x on the whole kernel, XLA:CPU config 4)
+    # member clocks gather INDICES first, then pairwise values straight
+    # from the compact deduplicated table (_pairwise_clock: flat gather
+    # on CPU -- [T, W+1, A] never materializes -- one-hot einsum on
+    # accelerators): [T, W+1] small gather + the pairwise lookup beat
+    # materializing [T, A] and gathering the blown-up matrix
     m_cidx = clock_idx[all_idx]                               # [T, W+1]
-    m_clock = clock_table[m_cidx]                             # [T, W+1, A]
-
-    onehot = jax.nn.one_hot(m_actor, A, dtype=jnp.int32)
-    P = jnp.einsum('tua,tva->tuv', m_clock, onehot)           # [T,W+1,W+1]
+    P = _pairwise_clock(m_actor, clock_table, m_cidx)         # [T,W+1,W+1]
     u_clock_at_v = P
     v_clock_at_u = jnp.swapaxes(P, 1, 2)
     u_seq = m_seq[:, :, None]
@@ -218,9 +262,6 @@ def resolve_registers(group, time, actor, seq, clock=None, is_del=None,
             (clock_table is None) != (clock_idx is None):
         raise ValueError('pass exactly one of clock or '
                          '(clock_table, clock_idx)')
-    if clock_table is not None:
-        clock = clock_table[clock_idx]
-    A = clock.shape[1]
 
     # sort by (group, time); padding (group == -1) sorts first and is inert
     if sort_idx is None:
@@ -229,7 +270,6 @@ def resolve_registers(group, time, actor, seq, clock=None, is_del=None,
     t_s = time[sort_idx]
     a_s = actor[sort_idx]
     q_s = seq[sort_idx]
-    c_s = clock[sort_idx]
     d_s = is_del[sort_idx]
 
     # Window member w of op i lives at sorted position i - w (w in 1..W):
@@ -251,16 +291,20 @@ def resolve_registers(group, time, actor, seq, clock=None, is_del=None,
     m_del = members(d_s, False)
     m_group = members(g_s, -2)
     m_valid = (m_group == g_s[:, None]) & (g_s >= 0)[:, None]   # [T, W+1]
-    m_clock = members(c_s, 0)                                   # [T, W+1, A]
 
     # pairwise: does member u supersede member v?  (u applied later, and they
     # are NOT concurrent).  Member order by slot: slot 0 is the latest op,
     # larger slots are earlier.  u later than v  <=>  slot_u < slot_v.
     #
-    # clock_u[actor_v] via one-hot batched matmul (MXU work) instead of a
-    # [T, W+1, W+1] random gather:  P[t, u, v] = m_clock[t, u, actor_v].
-    onehot = jax.nn.one_hot(m_actor, A, dtype=jnp.int32)        # [T, W+1, A]
-    P = jnp.einsum('tua,tva->tuv', m_clock, onehot)             # [T,W+1,W+1]
+    # P[t, u, v] = clock of member u at the actor of member v; formulation
+    # picked per backend in _pairwise_clock (flat table gather on CPU,
+    # one-hot batched matmul on accelerators).  Invalid-member entries are
+    # masked by m_valid below.
+    if clock_table is not None:
+        m_cidx = members(clock_idx[sort_idx], 0)                # [T, W+1]
+        P = _pairwise_clock(m_actor, clock_table, m_cidx)
+    else:
+        P = _pairwise_clock(m_actor, m_clock=members(clock[sort_idx], 0))
     u_clock_at_v = P
     v_clock_at_u = jnp.swapaxes(P, 1, 2)
     u_seq = m_seq[:, :, None]
@@ -321,6 +365,52 @@ def resolve_registers(group, time, actor, seq, clock=None, is_del=None,
 def gather_rows(mat, rows):
     """Row gather for the lazy conflicts fetch."""
     return mat[rows]
+
+
+def _merge_packed_rows(base, rows_p, tier_packed, sub_p):
+    """Scatters one escalation-tier chunk's packed words into the base
+    packed array ON DEVICE (ISSUE 6 tentpole b): tier-local winner
+    indexes translate to global batch rows through `sub_p` (the chunk's
+    row map), alive bits carry over, and the overflow bit stays clear --
+    the scattered rows are, by construction, resolved.  Padding slots of
+    `rows_p` carry an out-of-bounds index and drop.  After the chain of
+    chunk merges, ONE device->host transfer returns the packed word
+    already resolved for every tier-escalated row; the host's only
+    remaining merge work is the residual (oracle) flag vector."""
+    win = tier_packed & PACKED_WINNER_MASK
+    n = sub_p.shape[0]
+    win_g = jnp.where(win == PACKED_WINNER_NONE, PACKED_WINNER_NONE,
+                      sub_p[jnp.clip(win, 0, n - 1)])
+    word = (((tier_packed >> PACKED_ALIVE_SHIFT) & PACKED_ALIVE_MASK)
+            << PACKED_ALIVE_SHIFT) | win_g
+    return base.at[rows_p].set(word, mode='drop')
+
+
+_merge_packed_jit = None
+_merge_packed_donated = None
+
+
+def device_merge_on():
+    """AMTPU_DEVICE_MERGE=0 keeps the escalation-tier merge on the host
+    (the PR-3 scatter); default on (checked per batch, not latched --
+    the A/B parity lane flips it)."""
+    return os.environ.get('AMTPU_DEVICE_MERGE', '1') not in ('', '0')
+
+
+def merge_packed_rows(base, rows_p, tier_packed, sub_p):
+    """Backend-dispatched `_merge_packed_rows`: the base word is DONATED
+    on accelerators (each chunk merge reuses the previous buffer instead
+    of allocating -- the donate_argnums pattern proven on the tier
+    staging path); on CPU donation buys nothing and jit aliases anyway."""
+    global _merge_packed_jit, _merge_packed_donated
+    if jax.default_backend() == 'cpu':
+        if _merge_packed_jit is None:
+            _merge_packed_jit = jax.jit(_merge_packed_rows)
+        return _merge_packed_jit(base, rows_p, tier_packed, sub_p)
+    if _merge_packed_donated is None:
+        _merge_packed_donated = jax.jit(_merge_packed_rows,
+                                        donate_argnums=(0,))
+    return _merge_packed_donated(base, rows_p, tier_packed, sub_p)
 
 
 def _resolve(group, time, actor, seq, clock_table, clock_idx, is_del,
@@ -498,6 +588,22 @@ DEFAULT_MAX_TIER = 1024
 #: matches the dominance kernel's slab cap.  AMTPU_ESCALATE_BUDGET_MB
 #: overrides.
 DEFAULT_ESCALATION_BUDGET = 256 << 20
+
+
+#: row cap per tier-chunk dispatch (AMTPU_ESC_CHUNK overrides).  Shape
+#: bucketing pads each chunk to the next power of two, so one huge chunk
+#: wastes up to ~2x its rows in padding compute (config 4: 80k flagged
+#: rows padded to 131k); capping chunks at a power-of-two row count
+#: bounds the waste to the LAST chunk, keeps the jit cache on one shape
+#: per tier, and turns the tier into several async dispatches that
+#: overlap the driver's other host work.  A lone group wider than the
+#: cap still dispatches alone (groups are indivisible).
+DEFAULT_ESC_CHUNK = 32768
+
+
+def _esc_chunk_rows():
+    n = env_int('AMTPU_ESC_CHUNK', DEFAULT_ESC_CHUNK)
+    return n if n > 0 else DEFAULT_ESC_CHUNK
 
 
 def _escalation_budget():
@@ -739,14 +845,17 @@ def escalate_dispatch_groups(groups, time, actor, seq, is_del,
         tiers.setdefault(W, []).append(grp)
         telemetry.ESCALATION_TIER.observe(W)
 
+    chunk_cap = _esc_chunk_rows()
     for W, entries in sorted(tiers.items()):
         # chunk the tier so each dispatch's [Tn, W+1, W+1] intermediate
         # stays under the scratch budget (a lone group always fits: the
-        # bucketing above sent oversized ones to the oracle)
+        # bucketing above sent oversized ones to the oracle) AND under
+        # the row cap (padding-waste bound, see DEFAULT_ESC_CHUNK)
         chunks, cur, cur_rows = [], [], 0
         for entry in entries:
             n_rows = len(entry[0])
-            if cur and _dispatch_cost(cur_rows + n_rows, W) > budget:
+            if cur and (_dispatch_cost(cur_rows + n_rows, W) > budget
+                        or cur_rows + n_rows > chunk_cap):
                 chunks.append(cur)
                 cur, cur_rows = [], 0
             cur.append(entry)
@@ -807,17 +916,22 @@ EscalatedChunk = namedtuple(
      'visible_before'])
 
 
-def escalate_overflow_collect_arrays(pending):
+def escalate_overflow_collect_arrays(pending, need_winner=True):
     """The collect half, vectorized: awaits each tier chunk's O(Tn)
     outputs and translates tier-local indices to global batch rows.
     Conflicts are row-gathered ON DEVICE only where a register kept >1
     member (the tiers' packed epilogue: the [Tn, W] matrix never
-    transfers whole).  Returns a list of EscalatedChunk."""
+    transfers whole).  Returns a list of EscalatedChunk.
+
+    `need_winner=False` skips the winner transfer + translation (chunk
+    .winner is None): the device-merge path (`merge_packed_rows`)
+    already scattered the tier winners into the packed word on device,
+    so the collect half only owes conflicts + aliveness."""
     chunks = []
     for W, sub_rows, out in pending:
         n = len(sub_rows)
         sub = np.ascontiguousarray(sub_rows, np.int64)
-        win = np.asarray(out['winner'])[:n]
+        win = np.asarray(out['winner'])[:n] if need_winner else None
         alive = np.ascontiguousarray(np.asarray(out['alive_after'])[:n],
                                      np.int32)
         if 'visible_before' in out:
@@ -837,8 +951,10 @@ def escalate_overflow_collect_arrays(pending):
                                           rows_p))[:conf_rows.size]
             conf_g = np.where(conf >= 0, sub[np.clip(conf, 0, n - 1)],
                               -1).astype(np.int32)
-        win_g = np.where(win >= 0, sub[np.clip(win, 0, n - 1)],
-                         -1).astype(np.int32)
+        win_g = None
+        if win is not None:
+            win_g = np.where(win >= 0, sub[np.clip(win, 0, n - 1)],
+                             -1).astype(np.int32)
         chunks.append(EscalatedChunk(sub.astype(np.int32), win_g,
                                      conf_rows, conf_g, alive, vb))
     return chunks

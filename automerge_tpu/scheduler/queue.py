@@ -30,14 +30,7 @@ import threading
 import time
 
 from .. import telemetry
-
-
-def _env_int(name, default):
-    try:
-        v = os.environ.get(name, '')
-        return int(v) if v else default
-    except ValueError:
-        return default
+from ..utils.common import env_int as _env_int
 
 
 def _env_float(name, default):

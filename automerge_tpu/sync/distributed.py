@@ -96,10 +96,14 @@ class ProcessMesh:
             # capped exponential backoff under one overall deadline: a
             # slow-starting peer (cold jax init, supervised restart)
             # must not abort the whole mesh, while a genuinely absent
-            # one still fails within 30s.  Early attempts stay cheap
-            # (short connect timeout, short sleep); later ones back off
-            # so P processes don't hammer a struggling listener.
-            deadline = time.time() + 30
+            # one still fails within the deadline (default 60s -- a
+            # loaded CI host cold-starting P jax processes can eat most
+            # of 30; AMTPU_MESH_CONNECT_DEADLINE_S overrides).  Early
+            # attempts stay cheap (short connect timeout, short sleep);
+            # later ones back off so P processes don't hammer a
+            # struggling listener.
+            deadline = time.time() + float(
+                os.environ.get('AMTPU_MESH_CONNECT_DEADLINE_S', 60))
             delay, timeout = 0.05, 1.0
             while True:
                 try:
@@ -468,12 +472,24 @@ def _worker(pid, n_processes, coord_port, mesh_port_base):
     print('DISTRIBUTED-OK pid=%d rounds=%s' % (pid, rounds), flush=True)
 
 
-def launch(n_processes=2, timeout=240, _retries=1):
+#: output signatures of the Gloo/coordination-service infrastructure
+#: flake cascade: the size-mismatch race aborts one worker at random
+#: ("op.preamble.length <= op.nbytes"), and every OTHER worker then dies
+#: of heartbeat timeout / shutdown-barrier failure -- so the victim a
+#: caller inspects first rarely shows the preamble text itself.
+_FLAKY_SIGNATURES = ('op.preamble.length', 'heartbeat timeout',
+                     'Shutdown barrier', 'coordination service')
+
+
+def launch(n_processes=2, timeout=300, _retries=2):
     """Spawns the dryrun workers; returns their outputs.  Raises on any
-    non-zero exit.  One retry absorbs the Gloo TCP transport's known
-    size-mismatch race ("op.preamble.length <= op.nbytes"), which
-    aborts a worker process at random under back-to-back collectives of
-    varying shapes -- an infrastructure flake, not a convergence bug."""
+    non-zero exit.  Bounded retries absorb the Gloo TCP transport's
+    known size-mismatch race, which aborts a worker process at random
+    under back-to-back collectives of varying shapes (and takes the
+    rest of the mesh down with coordination-service cascade errors) --
+    an infrastructure flake, not a convergence bug.  ALL outputs are
+    collected before deciding: the flake signature may sit in a later
+    worker's output than the first non-zero exit."""
     import subprocess
     with socket.socket() as probe:
         probe.bind(('127.0.0.1', 0))
@@ -490,21 +506,40 @@ def launch(n_processes=2, timeout=240, _retries=1):
             env=dict(os.environ, JAX_PLATFORMS='cpu'))
         for pid in range(n_processes)]
     outs = []
+    failed = None
     for p in procs:
         try:
             out, _ = p.communicate(timeout=timeout)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
+            for q in procs:
+                try:
+                    o, _ = q.communicate(timeout=10)
+                except Exception:
+                    o = ''
+                outs.append(o or '')
+            # a wedged mesh (one worker died pre-abort) hangs the rest
+            # at a collective until the deadline.  Retry ONLY that
+            # shape: a worker that exited by itself (not our SIGKILL)
+            # or a flake signature in any partial output -- a mesh
+            # where EVERY worker hangs is a real deadlock and must
+            # surface, not burn retries
+            died_alone = any(q.returncode not in (0, -9) for q in procs)
+            flaky = any(sig in o for o in outs
+                        for sig in _FLAKY_SIGNATURES)
+            if _retries > 0 and (died_alone or flaky):
+                return launch(n_processes, timeout, _retries - 1)
             raise
         outs.append(out)
-        if p.returncode != 0:
-            if _retries > 0 and 'op.preamble.length' in out:
-                for q in procs:
-                    q.kill()
-                return launch(n_processes, timeout, _retries - 1)
-            raise RuntimeError('worker failed (rc=%d):\n%s'
-                               % (p.returncode, out))
+        if p.returncode != 0 and failed is None:
+            failed = (p.returncode, out)
+    if failed is not None:
+        rc, out = failed
+        if _retries > 0 and any(sig in o for o in outs
+                                for sig in _FLAKY_SIGNATURES):
+            return launch(n_processes, timeout, _retries - 1)
+        raise RuntimeError('worker failed (rc=%d):\n%s' % (rc, out))
     return outs
 
 

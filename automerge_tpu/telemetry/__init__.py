@@ -104,7 +104,25 @@ KNOWN_FALLBACK_REASONS = ('layout_batches', 'overflow_batches',
 #                           blocked on the oldest submission
 KNOWN_COLLECT_KEYS = ('packed_member_batches', 'full_matrix_readback',
                       'conflict_sparse', 'conflict_dense',
-                      'ready_reorder', 'wait_in_order')
+                      'ready_reorder', 'wait_in_order',
+                      'device_merge_chunks', 'overlap_s')
+
+# pool-resident batch state (ISSUE 6; glossary: docs/OBSERVABILITY.md),
+# pre-seeded so the perf-smoke resident gate reads zeros -- not
+# missing keys -- when the cache is disabled or cold
+KNOWN_RESIDENT_BATCH_KEYS = ('batch_hits', 'batch_noop',
+                             'batch_full_uploads',
+                             'batch_full_upload_rows',
+                             'batch_delta_rows', 'batch_hit_rows',
+                             'batch_gen_invalidation',
+                             'batch_grow_uploads',
+                             'batch_cache_dropped',
+                             'latch_flip_ignored')
+
+# cross-batch wave pipelining (ISSUE 6 tentpole c), pre-seeded so bench
+# artifacts distinguish "never engaged" (explicit zeros) from "not
+# recorded": batches that took the wave path / total doc-disjoint waves
+KNOWN_PIPELINE_KEYS = ('batches', 'waves', 'serial_replay')
 
 # resilience counters (`telemetry.metric('resilience.<name>')` call
 # sites; glossary: docs/RESILIENCE.md), pre-seeded into every
@@ -418,11 +436,21 @@ def bench_block():
     scheduler.update({k.split('.', 1)[1]: round(v, 6)
                       for k, v in flat.items()
                       if k.startswith('scheduler.')})
+    resident = {r: 0.0 for r in KNOWN_RESIDENT_BATCH_KEYS}
+    resident.update({k.split('.', 1)[1]: round(v, 6)
+                     for k, v in flat.items()
+                     if k.startswith('resident.')})
+    pipeline = {r: 0.0 for r in KNOWN_PIPELINE_KEYS}
+    pipeline.update({k.split('.', 1)[1]: round(v, 6)
+                     for k, v in flat.items()
+                     if k.startswith('pipeline.')})
     block = {
         'fallbacks': fallbacks,
         'collect': collect,
         'resilience': resilience,
         'scheduler': scheduler,
+        'resident': resident,
+        'pipeline': pipeline,
         'device_s': round(flat.get('device.dispatch_sync_s', 0.0), 4),
         'device_dispatches': int(flat.get('device.dispatches', 0)),
         'batch_latency': BATCH_LATENCY.snapshot() or {},
@@ -433,6 +461,20 @@ def bench_block():
         block['phases'] = {k: {'s': round(v['s'], 4), 'n': v['n']}
                            for k, v in phase_snapshot().items()}
     return block
+
+
+def collect_share(block):
+    """(share, collect_s, basis_s) of `device.collect` against the
+    summed native batch time, read from one bench_block-shaped dict.
+    The ONE definition both bench.py's `collect_share` artifact field
+    and the perf-smoke gate divide by -- if the latency-block shape or
+    the native-vs-sharded fallback rule changes, it changes for both."""
+    lat = block.get('batch_latency') or {}
+    basis = ((lat.get('native') or {}).get('sum', 0.0)
+             or (lat.get('sharded') or {}).get('sum', 0.0))
+    coll = ((block.get('phases') or {}).get('device.collect')
+            or {}).get('s', 0.0)
+    return (coll / basis if basis else 0.0), coll, basis
 
 
 def reset_all():

@@ -1,6 +1,20 @@
 """Shared constants and helpers (reference: `/root/reference/src/common.js`)."""
 
+import os
+
 ROOT_ID = '00000000-0000-0000-0000-000000000000'
+
+
+def env_int(name, default):
+    """Integer env knob with the shared fallback semantics: unset,
+    empty, or unparsable -> default (defined ONCE; the scheduler queue,
+    the wave pipeline, and the escalation chunk cap all read through
+    this)."""
+    try:
+        v = os.environ.get(name, '')
+        return int(v) if v else default
+    except ValueError:
+        return default
 
 
 def is_object(value):

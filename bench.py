@@ -364,6 +364,35 @@ def _measure_mode(make_pool, payload, total_ops, label):
                                    device['dispatches']), file=sys.stderr)
     telemetry_block['device_s'] = device['sync_dispatch_s']
     telemetry_block['device_dispatches'] = device['dispatches']
+
+    # ---- phase pass ------------------------------------------------------
+    # One extra TRACED run: per-phase seconds land in the BENCH line
+    # machine-readable (the quickbench --phases table), so phase-share
+    # claims -- device.collect above all -- are attributable from the
+    # artifact alone (ISSUE 6).  Runs outside the timed window because
+    # tracing costs a few percent; `collect_share` is pre-divided
+    # against the summed native batch time, the share basis the
+    # quickbench table prints.
+    was_enabled = telemetry.enabled()
+    telemetry.reset_all()
+    telemetry.enable()
+    try:
+        ph_pool = make_pool()
+        t0 = time.perf_counter()
+        ph_pool.apply_batch_bytes(payload)
+        ph_wall = time.perf_counter() - t0
+        ph_block = telemetry.bench_block()
+    finally:
+        if not was_enabled:
+            telemetry.disable()
+        telemetry.reset_all()
+    telemetry_block['phases'] = ph_block.get('phases') or {}
+    telemetry_block['phase_wall_s'] = round(ph_wall, 4)
+    share, _coll, _basis = telemetry.collect_share(ph_block)
+    telemetry_block['collect_share'] = round(share, 4)
+    print('[%s] phase pass: %.2fs wall, device.collect share %.1f%%'
+          % (label, ph_wall, 100 * telemetry_block['collect_share']),
+          file=sys.stderr)
     return rate, pool, {'fallbacks': fallbacks, 'device': device,
                         'telemetry': telemetry_block}
 

@@ -346,6 +346,16 @@ static bool is_assign(u8 a) { return a <= A_LINK; }
 
 static const u32 NONE = 0xffffffffu;
 
+// Defaults of the NUMERIC latch-at-first-batch env knobs, exported via
+// amtpu_latch_defaults so the Python latch-flip guard derives effective
+// values from the SAME constants the latching lambdas below use -- a
+// default changed here can never silently drift from the warning logic.
+// (The boolean knobs AMTPU_RESIDENT / AMTPU_RESIDENT_CLK /
+// AMTPU_TRIVIAL_HOST all default ON and latch atoi(env) != 0.)
+static const i64 DEF_RESIDENT_MIN = 16384;
+static const i64 DEF_RESCLK_MAX_ACTORS = 512;
+static const i64 DEF_RESCLK_MAX_ROWS = 1LL << 20;
+
 // Values are interned raw msgpack spans (vid into Pool::vals): op records
 // stay POD-copyable and identical values (e.g. single chars of a Text)
 // dedup to one entry.
@@ -519,6 +529,71 @@ struct Error : std::runtime_error {
 // pool
 // ---------------------------------------------------------------------------
 
+// ---------------------------------------------------------------------------
+// Pool-resident clock table (ISSUE 6 tentpole a).
+//
+// The per-batch clock table re-densifies and re-stages every change's
+// all_deps row host->device on every batch, even though a row keyed
+// (doc, actor, seq) is immutable once its change is applied.  This pool-
+// LIFETIME table persists densified rows across batches: the batch's
+// clock_idx then references pool-global rows, and the Python driver
+// keeps a device-resident copy, uploading only the rows appended since
+// the last batch (delta upload) -- the host->device clock traffic of a
+// steady-state batch drops to its own new changes.
+//
+// Consistency contract (generation counter `gen`):
+//   * rows densify against POOL-lifetime actor ranks (string lex order,
+//     width Ap).  Registering ANY new actor invalidates every cached
+//     row -- existing rows lack the new actor's column values (a row's
+//     sparse all_deps may well contain an actor this table had never
+//     ranked when the row was densified).  Steady actor populations
+//     (serving traffic) keep the cache hot; a new actor costs one full
+//     re-upload.
+//   * a batch ROLLBACK invalidates: rows appended for its (now undone)
+//     changes would go stale, and re-applied changes must re-densify.
+//   * row count and Ap growth are append-only between invalidations, so
+//     (gen, n_rows, Ap) is a complete validity token for the device
+//     copy.
+//   * pools past AMTPU_RESCLK_MAX_ACTORS (default 512) disable the
+//     table permanently (row width is Ap: unbounded actor populations
+//     would make every row pay for every actor ever seen); row count
+//     past AMTPU_RESCLK_MAX_ROWS (default 1M) clears and restarts (a
+//     rolling cache, bounding steady-state memory).
+// ---------------------------------------------------------------------------
+struct ResClockKey {
+  const void* doc; u32 actor, seq;
+  bool operator==(const ResClockKey& o) const {
+    return doc == o.doc && actor == o.actor && seq == o.seq;
+  }
+};
+struct ResClockKeyHash {
+  size_t operator()(const ResClockKey& k) const {
+    u64 h = reinterpret_cast<u64>(k.doc) ^ (u64(k.actor) << 21) ^ k.seq;
+    h ^= h >> 33; h *= 0xff51afd7ed558ccdULL; h ^= h >> 33;
+    return static_cast<size_t>(h);
+  }
+};
+
+struct ResClock {
+  std::vector<u32> actor_order;   // actor sids, string lex order
+  std::vector<i32> rank_of;       // sid -> pool rank or -1
+  i64 A = 0, Ap = 0;              // actor count, padded rank capacity
+  std::vector<i32> tab;           // [n_rows * Ap] densified clock rows
+  std::unordered_map<ResClockKey, u32, ResClockKeyHash> rows;
+  u64 gen = 1;
+  bool disabled = false;          // actor-population cap exceeded
+
+  i64 n_rows() const {
+    return Ap ? static_cast<i64>(tab.size()) / Ap : 0;
+  }
+
+  void invalidate() {
+    tab.clear();
+    rows.clear();
+    ++gen;
+  }
+};
+
 struct Pool {
   Interner intern;
   Interner vals;     // raw msgpack value spans, interned (vid)
@@ -533,6 +608,8 @@ struct Pool {
   // full host path (amtpu_pool_set_hostfull): the Python driver sets
   // this once per pool from the resolved jax backend (CPU -> on)
   bool host_full = false;
+  // pool-resident clock table (ISSUE 6 tentpole a)
+  ResClock resclk;
 
   Pool() {
     root_sid = intern.id_of(ROOT_ID);
@@ -1112,16 +1189,27 @@ struct Batch {
   i64 T = 0, Tp = 0;
   std::vector<i32> g_col, t_col, a_col, s_col, sort_idx;
   std::vector<u8> d_col;
-  // deduplicated clock rows: ops of one change share one table row
+  // deduplicated clock rows: ops of one change share one table row.
+  // res_clock: clock_idx references the POOL-resident table instead
+  // (clock_tab stays empty, CTp == 0; see ResClock)
   std::vector<i32> clock_tab;   // [CTp*Ap]
   std::vector<i32> clock_idx;   // [Tp] -> table row
   i64 CT = 0, CTp = 0;
+  bool res_clock = false;
+  bool resclk_appended = false;  // rollback must invalidate the pool table
+  i64 resclk_hits = 0;           // rows served from persisted entries
+  // trivial-group routing (ISSUE 6): single-stream register groups skip
+  // the device batch and resolve in emit against the live mirror
+  i64 n_triv_rows = 0, n_triv_groups = 0;
   // batch-owned copies of state register records: register mirrors are
   // REPLACED during emit, so src_records must never point into
   // st.registers (dangling after the first mirror update of a group)
   std::deque<OpRec> state_rec_store;
   std::vector<const OpRec*> src_records;  // row -> op record
-  std::vector<i64> assign_row_of_op;      // op_idx -> row or -1
+  // op_idx -> register row; -1 = no row (non-assign), TRIVIAL_ROW = the
+  // group resolves in emit via host_resolve_step (trivial-group routing)
+  static constexpr i64 TRIVIAL_ROW = -2;
+  std::vector<i64> assign_row_of_op;
 
   // arenas
   i64 L = 0, Lp = 0;
@@ -1357,6 +1445,13 @@ struct BeginJournal {
     }
     for (u32 d = 0; d < b.bdocs.size(); ++d) b.bdocs[d]->queue.clear();
     for (auto& [d, q] : queues) b.bdocs[d]->queue = std::move(q);
+    // pool-resident clock rows appended for the rolled-back changes are
+    // now stale (and a retry must re-densify them): cross-path
+    // invalidation via the generation counter
+    if (b.resclk_appended) {
+      b.pool->resclk.invalidate();
+      b.resclk_appended = false;
+    }
   }
 };
 
@@ -1662,12 +1757,69 @@ static void encode(Pool& pool, Batch& b) {
   if (inv_sids.empty()) inv_sids.push_back(in.id_of(""));
   std::sort(inv_sids.begin(), inv_sids.end(),
             [&](u32 a, u32 c) { return in.str(a) < in.str(c); });
-  b.rank_of.assign(in.size(), -1);
-  b.rank_to_sid = inv_sids;
-  for (size_t i = 0; i < inv_sids.size(); ++i)
-    b.rank_of[inv_sids[i]] = static_cast<i32>(i);
-  b.A = static_cast<i64>(inv_sids.size());
-  b.Ap = bucket(b.A, 4);
+
+  // Resident clock table eligibility (latched env, like AMTPU_RESIDENT):
+  // kernel-path batches share the pool-lifetime table; the full host
+  // path never stages clocks at all.
+  static const bool resclk_enabled = []() {
+    const char* e = getenv("AMTPU_RESIDENT_CLK");
+    if (!e) e = getenv("AMTPU_RESIDENT");
+    return !e || atoi(e) != 0;     // default ON (follows the latch)
+  }();
+  static const i64 resclk_max_actors = []() {
+    const char* e = getenv("AMTPU_RESCLK_MAX_ACTORS");
+    return e ? atoll(e) : DEF_RESCLK_MAX_ACTORS;
+  }();
+  static const i64 resclk_max_rows = []() {
+    const char* e = getenv("AMTPU_RESCLK_MAX_ROWS");
+    return e ? atoll(e) : DEF_RESCLK_MAX_ROWS;
+  }();
+  ResClock& rc = pool.resclk;
+  b.res_clock = resclk_enabled && !b.host_full && !rc.disabled;
+  if (b.res_clock) {
+    // register new actors into the pool order; ANY new actor
+    // invalidates cached rows (their densified columns lack the new
+    // actor's all_deps values)
+    bool grew = false;
+    for (u32 sid : inv_sids) {
+      if (sid < rc.rank_of.size() && rc.rank_of[sid] >= 0) continue;
+      auto pos = std::lower_bound(
+          rc.actor_order.begin(), rc.actor_order.end(), sid,
+          [&](u32 a, u32 c) { return in.str(a) < in.str(c); });
+      rc.actor_order.insert(pos, sid);
+      grew = true;
+    }
+    if (static_cast<i64>(rc.actor_order.size()) > resclk_max_actors) {
+      rc.disabled = true;
+      rc.invalidate();
+      b.res_clock = false;
+    } else {
+      if (grew) {
+        rc.invalidate();
+        rc.rank_of.assign(in.size(), -1);
+        for (size_t i = 0; i < rc.actor_order.size(); ++i)
+          rc.rank_of[rc.actor_order[i]] = static_cast<i32>(i);
+        rc.A = static_cast<i64>(rc.actor_order.size());
+        rc.Ap = bucket(rc.A, 4);
+      } else if (rc.rank_of.size() < in.size()) {
+        rc.rank_of.resize(in.size(), -1);
+      }
+      if (rc.n_rows() > resclk_max_rows) rc.invalidate();
+    }
+  }
+  if (b.res_clock) {
+    b.rank_of = rc.rank_of;
+    b.rank_to_sid = rc.actor_order;
+    b.A = rc.A;
+    b.Ap = rc.Ap;
+  } else {
+    b.rank_of.assign(in.size(), -1);
+    b.rank_to_sid = inv_sids;
+    for (size_t i = 0; i < inv_sids.size(); ++i)
+      b.rank_of[inv_sids[i]] = static_cast<i32>(i);
+    b.A = static_cast<i64>(inv_sids.size());
+    b.Ap = bucket(b.A, 4);
+  }
 
   // --- register rows ------------------------------------------------------
   auto densify = [&](const Clock& c, i32* row) {
@@ -1678,9 +1830,31 @@ static void encode(Pool& pool, Batch& b) {
     }
   };
 
-  // clock rows dedup to one table entry per (doc, actor, seq)
+  // clock rows dedup to one table entry per (doc, actor, seq).  In
+  // resident mode the table is the POOL's (rows persist across batches,
+  // keyed by the doc's stable address; a row for an applied change is
+  // immutable); otherwise it is batch-local, as before.
   std::unordered_map<K3, u32, K3Hash> clock_cache;
+  // rows below this index were persisted by EARLIER batches; hits on
+  // rows this batch itself appended are intra-batch dedup, not resident
+  // service, and must not satisfy the perf-smoke resident gate
+  const u32 resclk_n0 = b.res_clock ? static_cast<u32>(rc.n_rows()) : 0;
   auto clock_row_of = [&](u32 doc, DocState& st, u32 actor, u32 seq) {
+    if (b.res_clock) {
+      ResClockKey rk{static_cast<const void*>(&st), actor, seq};
+      auto rit = rc.rows.find(rk);
+      if (rit != rc.rows.end()) {
+        if (rit->second < resclk_n0) ++b.resclk_hits;
+        return rit->second;
+      }
+      u32 idx = static_cast<u32>(rc.tab.size() / rc.Ap);
+      rc.tab.resize(rc.tab.size() + rc.Ap);
+      densify(all_deps_of(st, actor, seq),
+              rc.tab.data() + rc.tab.size() - rc.Ap);
+      rc.rows.emplace(rk, idx);
+      b.resclk_appended = true;
+      return idx;
+    }
     K3 ck{doc, actor, seq};
     auto cit = clock_cache.find(ck);
     if (cit != clock_cache.end()) return cit->second;
@@ -1697,11 +1871,72 @@ static void encode(Pool& pool, Batch& b) {
   // via host_resolve_step and list indexes via the in-emit Fenwick.
   // Arena columns below are still built (host_rank's sibling sort
   // consumes them).
+  // 1 = the group resolves in emit (trivial-group routing below); empty
+  // when the routing is disabled or host-full short-circuits
+  std::vector<u8> gid_trivial;
+
   if (b.host_full) {
     b.T = 0;
     b.Tp = 0;
     b.assign_row_of_op.assign(b.ops.size(), -1);
     goto arena_columns;
+  }
+
+  // --- trivial-group routing (ISSUE 6) ------------------------------------
+  // A register group whose rows form ONE totally-ordered actor stream
+  // (<=1 mirror prior, every batch op from that same actor, no same-
+  // change duplicate assign) has no concurrency to resolve: each op
+  // simply supersedes its predecessor.  Shipping such groups through
+  // the kernel pays padding + pairwise compute for a foregone
+  // conclusion -- on the table workload they are ~60% of all register
+  // rows.  Route them to the in-emit incremental resolver instead
+  // (host_resolve_step, the same reference-semantics code the full host
+  // path runs): their rows are never emitted into the batch columns, so
+  // the device batch shrinks to the genuinely concurrent groups.
+  // assign_row_of_op == TRIVIAL_ROW marks the ops; emit resolves them
+  // against the live mirror in op order, byte-identical by construction
+  // (host/kernel parity is pinned by the A/B fuzz lanes).  List-element
+  // assigns are excluded: dominance timelines read aliveness through
+  // their register row (dom_src feeds the DEVICE mirror fill), so they
+  // keep kernel rows.  AMTPU_TRIVIAL_HOST=0 disables (latched).
+  {
+    static const bool trivial_host = []() {
+      const char* e = getenv("AMTPU_TRIVIAL_HOST");
+      return !e || atoi(e) != 0;
+    }();
+    if (trivial_host) {
+      const u32 NOACT = ~0u;
+      gid_trivial.assign(gid_order.size(), 1);
+      std::vector<u32> g_actor(gid_order.size(), NOACT);
+      std::vector<u32> g_seq(gid_order.size(), 0);
+      for (u32 gid = 0; gid < gid_order.size(); ++gid) {
+        if (gid_regs[gid] == nullptr) continue;
+        auto& recs = *gid_regs[gid];
+        if (recs.size() > 1) { gid_trivial[gid] = 0; continue; }
+        // a del that covered the sole prior leaves an EMPTY register
+        // in the mirror (host_resolve_step drops it; the other mirror
+        // readers all guard !empty()): no prior stream to seed
+        if (recs.empty()) continue;
+        g_actor[gid] = recs[0].actor;
+        g_seq[gid] = recs[0].seq;
+      }
+      for (size_t op_idx = 0; op_idx < b.ops.size(); ++op_idx) {
+        auto& f = b.ops[op_idx];
+        const OpRec& op = *f.op;
+        if (!is_assign(op.action)) continue;
+        u32 gid = *doc_gids[f.doc].find(DocState::rkey(op.obj, op.key));
+        if (!gid_trivial[gid]) continue;
+        if (b.pre_eidx[op_idx] != -2) { gid_trivial[gid] = 0; continue; }
+        if (g_actor[gid] == NOACT) {
+          g_actor[gid] = op.actor;
+          g_seq[gid] = op.seq;
+        } else if (op.actor != g_actor[gid] || op.seq == g_seq[gid]) {
+          gid_trivial[gid] = 0;   // second stream / same-change dup
+        } else {
+          g_seq[gid] = op.seq;
+        }
+      }
+    }
   }
 
   // state rows
@@ -1710,6 +1945,10 @@ static void encode(Pool& pool, Batch& b) {
     (void)obj; (void)key;
     DocState& st = *b.bdocs[doc];
     if (gid_regs[gid] == nullptr) continue;
+    if (!gid_trivial.empty() && gid_trivial[gid]) {
+      b.n_triv_rows += static_cast<i64>(gid_regs[gid]->size());
+      continue;
+    }
     auto& recs = *gid_regs[gid];
     // REVERSED iteration: the mirror stores winner-first (= newest-first
     // within an actor's ties) and the kernel orders ties by time
@@ -1738,7 +1977,7 @@ static void encode(Pool& pool, Batch& b) {
   b.assign_row_of_op.assign(b.ops.size(), -1);
   {
     u32 c_doc = ~0u, c_actor = NONE, c_seq = 0;
-    i32 c_crow = 0, c_rank = 0;
+    i32 c_crow = -1, c_rank = 0;
     for (size_t op_idx = 0; op_idx < b.ops.size(); ++op_idx) {
       auto& f = b.ops[op_idx];
       const OpRec& op = *f.op;
@@ -1746,10 +1985,25 @@ static void encode(Pool& pool, Batch& b) {
       DocState& st = *b.bdocs[f.doc];
       if (f.doc != c_doc || op.actor != c_actor || op.seq != c_seq) {
         c_doc = f.doc; c_actor = op.actor; c_seq = op.seq;
-        c_crow = static_cast<i32>(clock_row_of(f.doc, st, op.actor, op.seq));
+        c_crow = -1;   // lazy: resolved when a kernel row needs it
         c_rank = b.rank_of[op.actor];
       }
       u32 gid = *doc_gids[f.doc].find(DocState::rkey(op.obj, op.key));
+      if (!gid_trivial.empty() && gid_trivial[gid]) {
+        b.assign_row_of_op[op_idx] = Batch::TRIVIAL_ROW;
+        ++b.n_triv_rows;
+        if (gid_trivial[gid] == 1) {   // count each group once
+          gid_trivial[gid] = 2;
+          ++b.n_triv_groups;
+        }
+        continue;
+      }
+      // densify the change's clock row only when a kernel row consumes
+      // it: fully-trivial changes (~60% of table-workload rows) would
+      // otherwise append pool-resident rows nothing reads, inflating
+      // delta uploads and burning toward AMTPU_RESCLK_MAX_ROWS
+      if (c_crow < 0)
+        c_crow = static_cast<i32>(clock_row_of(f.doc, st, op.actor, op.seq));
       b.assign_row_of_op[op_idx] = static_cast<i64>(b.g_col.size());
       b.g_col.push_back(static_cast<i32>(gid));
       b.t_col.push_back(static_cast<i32>(op_idx));
@@ -1770,10 +2024,17 @@ static void encode(Pool& pool, Batch& b) {
     b.s_col.resize(b.Tp, 0);
     b.d_col.resize(b.Tp, 0);
     b.clock_idx.resize(b.Tp, 0);
-    b.CT = static_cast<i64>(b.clock_tab.size() / b.Ap);
-    if (b.CT == 0) { b.clock_tab.resize(b.Ap, 0); b.CT = 1; }
-    b.CTp = bucket(b.CT, 4);
-    b.clock_tab.resize(b.CTp * b.Ap, 0);
+    if (b.res_clock) {
+      // pool table: Python reads dims via amtpu_resclk_info and keeps
+      // the device copy itself; CTp == 0 marks "no batch-local table"
+      b.CT = rc.n_rows();
+      b.CTp = 0;
+    } else {
+      b.CT = static_cast<i64>(b.clock_tab.size() / b.Ap);
+      if (b.CT == 0) { b.clock_tab.resize(b.Ap, 0); b.CT = 1; }
+      b.CTp = bucket(b.CT, 4);
+      b.clock_tab.resize(b.CTp * b.Ap, 0);
+    }
     // host sort by (group, time), padding (g=-1) first.  Rows are already
     // emitted in time order within each group (state rows carry negative
     // times and precede batch rows, which are appended in op order), so a
@@ -2037,7 +2298,7 @@ static void dom_layout(Pool& pool, Batch& b) {
   // er_src from resident columns
   static const i64 resident_min_pre = []() {
     const char* e = getenv("AMTPU_RESIDENT_MIN");
-    return e ? atoll(e) : 16384;
+    return e ? atoll(e) : DEF_RESIDENT_MIN;
   }();
   static const bool resident_enabled_pre = []() {
     const char* e = getenv("AMTPU_RESIDENT");
@@ -3079,7 +3340,9 @@ static void emit(Pool& pool, Batch& b) {
     i64 row = b.assign_row_of_op[op_idx];
     Register* prior = nullptr;
     bool prior_known = false;
-    if (b.host_reg_mode) {
+    if (b.host_reg_mode || row == Batch::TRIVIAL_ROW) {
+      // trivial-group routing: the group's whole stream resolves here,
+      // incrementally against the live mirror (reference semantics)
       prior = host_resolve_step(pool, b, f.doc, st, op, reg);
       prior_known = true;
     } else {
@@ -3921,7 +4184,8 @@ int amtpu_mid_packed(void* bp, const int32_t* packed, int window,
   return 0;
 }
 
-// fused eligibility + single-class dims: [fused_ok, W, Lp, Tp]
+// fused eligibility + single-class dims: [fused_ok, W, Lp, Tp,
+// resident_ok, res_clock]
 void amtpu_fused_dims(void* bp, int64_t* out) {
   Batch& b = static_cast<BatchHandle*>(bp)->batch;
   out[0] = b.fused_ok ? 1 : 0;
@@ -3932,7 +4196,41 @@ void amtpu_fused_dims(void* bp, int64_t* out) {
     out[1] = out[2] = out[3] = 0;
   }
   out[4] = b.resident_ok ? 1 : 0;
-  out[5] = 0;
+  out[5] = b.res_clock ? 1 : 0;
+}
+
+// Defaults of the numeric latch-at-first-batch knobs:
+// [AMTPU_RESIDENT_MIN, AMTPU_RESCLK_MAX_ACTORS, AMTPU_RESCLK_MAX_ROWS].
+// The Python latch-flip guard reads these instead of re-hardcoding them
+// (the boolean knobs default ON, atoi != 0 -- mirrored directly).
+void amtpu_latch_defaults(int64_t* out) {
+  out[0] = DEF_RESIDENT_MIN;
+  out[1] = DEF_RESCLK_MAX_ACTORS;
+  out[2] = DEF_RESCLK_MAX_ROWS;
+}
+
+// Pool-resident clock table state: [n_rows, Ap, gen, disabled].  The
+// Python driver keys its device-resident copy on (gen, n_rows, Ap):
+// same gen + same Ap + grown n_rows = delta-upload just the appended
+// rows; anything else = full re-upload (see ResClock).
+void amtpu_resclk_info(void* pool_ptr, int64_t* out) {
+  ResClock& rc = static_cast<Pool*>(pool_ptr)->resclk;
+  out[0] = rc.n_rows();
+  out[1] = rc.Ap;
+  out[2] = static_cast<int64_t>(rc.gen);
+  out[3] = rc.disabled ? 1 : 0;
+}
+
+const int32_t* amtpu_resclk_tab(void* pool_ptr) {
+  return static_cast<Pool*>(pool_ptr)->resclk.tab.data();
+}
+
+// per-batch resident-clock accounting: [rows served from persisted
+// entries, 0/1 whether this batch appended any rows]
+void amtpu_resclk_batch_stats(void* bp, int64_t* out) {
+  Batch& b = static_cast<BatchHandle*>(bp)->batch;
+  out[0] = b.resclk_hits;
+  out[1] = b.resclk_appended ? 1 : 0;
 }
 
 // Resident-path metadata for dom block `blk`: per object, FOUR i64s
@@ -4084,10 +4382,12 @@ void amtpu_batch_trace(void* bp, double* out) {
   out[3] = b.tr_mid; out[4] = b.tr_emit; out[5] = b.tr_domlay;
 }
 
-// scheduler coverage: [fast-path admits, queue-machinery admits]
+// scheduler coverage: [fast-path admits, queue-machinery admits,
+// trivial-routed register rows, trivial-routed groups]
 void amtpu_sched_counts(void* bp, int64_t* out) {
   Batch& b = static_cast<BatchHandle*>(bp)->batch;
   out[0] = b.n_sched_fast; out[1] = b.n_sched_queued;
+  out[2] = b.n_triv_rows; out[3] = b.n_triv_groups;
 }
 
 const uint8_t* amtpu_result(void* bp, int64_t* len) {

@@ -243,3 +243,326 @@ def test_sharded_resident_on_virtual_mesh():
                          timeout=300)
     assert out.returncode == 0, out.stdout + out.stderr
     assert 'SHARDED-RESIDENT-OK' in out.stdout
+
+
+# ---------------------------------------------------------------------------
+# Pool-level resident batch state (ISSUE 6): the register/clock path's
+# cross-batch cache.  Same subprocess pattern as the arena lanes: the
+# AMTPU_RESIDENT* knobs latch per process.
+# ---------------------------------------------------------------------------
+
+# Multi-doc, multi-actor table workload: concurrent writes to shared
+# root keys (kernel groups), single-actor private keys (the trivial
+# route), a same-change duplicate assign (escalation food), deletes.
+# Emitted as one builder so every lane below sees the same shape.
+BATCH_WORKLOAD = r"""
+ROOT = '00000000-0000-0000-0000-000000000000'
+
+def build_round(r, docs=24, actors=4):
+    payload = {}
+    for d in range(docs):
+        chs = []
+        for a in range(actors):
+            ops = [{'action': 'set', 'obj': ROOT,
+                    'key': 'shared%d' % (r % 3),
+                    'value': 'a%d r%d' % (a, r)},
+                   {'action': 'set', 'obj': ROOT,
+                    'key': 'p%d_%d' % (a, r), 'value': d * r + a}]
+            if a == 0 and d % 5 == 0:
+                # same-change duplicate assign: both survive as conflicts
+                ops.append({'action': 'set', 'obj': ROOT,
+                            'key': 'dup', 'value': 'x%d' % r})
+                ops.append({'action': 'set', 'obj': ROOT,
+                            'key': 'dup', 'value': 'y%d' % r})
+            if a == 1 and r > 1:
+                ops.append({'action': 'del', 'obj': ROOT,
+                            'key': 'p0_%d' % (r - 1)})
+            # deps empty: actors are mutually concurrent every round
+            chs.append({'actor': 'w%d' % a, 'seq': r, 'deps': {},
+                        'ops': ops})
+        payload['doc%d' % d] = chs
+    return payload
+"""
+
+BATCH_RESIDENT = r"""
+import sys
+sys.path.insert(0, REPO_PATH)
+import jax; jax.config.update('jax_platforms', 'cpu')
+from automerge_tpu import backend as Backend
+from automerge_tpu import faults, trace
+from automerge_tpu.native import NativeDocPool
+trace.ENABLED = True
+WORKLOAD
+
+pool = NativeDocPool()
+states = {}
+
+def apply_round(r, docs=24):
+    payload = build_round(r, docs=docs)
+    pool.apply_batch(payload)
+    for d, chs in payload.items():
+        st = states.get(d) or Backend.init()
+        states[d], _ = Backend.apply_changes(st, chs)
+
+def assert_parity(tag):
+    for d, st in states.items():
+        got, want = pool.get_patch(d), Backend.get_patch(st)
+        assert got == want, '%s: %s diverged' % (tag, d)
+
+# round 1 seeds the table; round 2 is SMALLER than round 1's pow2
+# capacity slack, so it must be served by persisted rows (C++ hits)
+# with a delta upload of only its own appends
+apply_round(1)
+apply_round(2, docs=6)
+m = trace.metrics_snapshot()
+assert m.get('resident.batch_full_uploads', 0) >= 1, m
+assert m.get('resident.batch_hits', 0) >= 1, m
+assert m.get('resident.batch_hit_rows', 0) >= 1, m
+assert_parity('steady')
+
+# cross-path invalidation: a failed batch ROLLS BACK -> the rows it
+# appended are stale, the generation bumps, the next batch re-uploads
+spec = faults.arm('native.mid', 'permanent')
+try:
+    pool.apply_batch(build_round(3, docs=6))
+    raise SystemExit('armed fault did not fire')
+except faults.InjectedFault:
+    pass
+finally:
+    faults.disarm(spec)
+apply_round(3, docs=6)   # the SAME round re-applies after rollback
+m = trace.metrics_snapshot()
+assert m.get('resident.batch_gen_invalidation', 0) >= 1, m
+assert_parity('post-rollback')
+print('BATCH-RESIDENT-OK')
+""".replace('WORKLOAD', BATCH_WORKLOAD).replace('REPO_PATH', repr(REPO))
+
+
+def test_batch_resident_steady_state_and_rollback_invalidation():
+    """Pool-level clock cache: steady-state batches hit persisted rows
+    (delta uploads only), rollback invalidates via the generation
+    counter, and every patch stays byte-identical to the oracle."""
+    env = dict(os.environ, JAX_PLATFORMS='cpu', AMTPU_HOST_FULL='0',
+               AMTPU_RESILIENCE='0')
+    out = subprocess.run([sys.executable, '-c', BATCH_RESIDENT], env=env,
+                         cwd=REPO, capture_output=True, text=True,
+                         timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert 'BATCH-RESIDENT-OK' in out.stdout
+
+
+WAVE_ERROR_IDENTITY = r"""
+import sys
+sys.path.insert(0, REPO_PATH)
+import os
+import jax; jax.config.update('jax_platforms', 'cpu')
+from automerge_tpu import trace
+from automerge_tpu.errors import AutomergeError
+from automerge_tpu.native import NativeDocPool
+trace.ENABLED = True
+ROOT = '00000000-0000-0000-0000-000000000000'
+
+def build_payload():
+    '''70 docs; payload-order doc 0 and doc 60 each carry a validation
+    error on a DIFFERENT unknown object.  The serial contract: the
+    FIRST error in application order surfaces (missing-early).'''
+    payload = {}
+    for d in range(70):
+        obj = ROOT
+        if d == 0:
+            obj = 'missing-early'
+        elif d == 60:
+            obj = 'missing-late'
+        payload['doc%03d' % d] = [
+            {'actor': 'w0', 'seq': 1, 'deps': {},
+             'ops': [{'action': 'set', 'obj': obj, 'key': 'k',
+                      'value': d}]}]
+    return payload
+
+os.environ['AMTPU_PIPELINE_MIN_DOCS'] = '8'
+errs = {}
+for depth in ('1', '4'):
+    os.environ['AMTPU_PIPELINE_DEPTH'] = depth
+    pool = NativeDocPool()
+    try:
+        pool.apply_batch(build_payload())
+        raise SystemExit('multi-error payload did not raise')
+    except AutomergeError as e:
+        errs[depth] = str(e)
+assert 'missing-early' in errs['1'], errs['1']
+assert errs['4'] == errs['1'], (
+    'wave path surfaced a different error than serial:\n%r\n%r'
+    % (errs['4'], errs['1']))
+m = trace.metrics_snapshot()
+assert m.get('pipeline.serial_replay', 0) >= 1, m
+print('WAVE-ERROR-IDENTITY-OK')
+""".replace('REPO_PATH', repr(REPO))
+
+
+def test_wave_pipeline_error_identity_matches_serial():
+    """A multi-error payload must surface the SAME error on the wave
+    path as on the serial path (first in application order): pre-emit
+    wave failures roll back atomically and replay unpipelined."""
+    env = dict(os.environ, JAX_PLATFORMS='cpu', AMTPU_HOST_FULL='0',
+               AMTPU_RESILIENCE='0')
+    out = subprocess.run([sys.executable, '-c', WAVE_ERROR_IDENTITY],
+                         env=env, cwd=REPO, capture_output=True,
+                         text=True, timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert 'WAVE-ERROR-IDENTITY-OK' in out.stdout
+    assert 'RuntimeWarning' not in out.stderr, out.stderr
+
+
+ACTOR_CAP_DROP = r"""
+import sys
+sys.path.insert(0, REPO_PATH)
+import jax; jax.config.update('jax_platforms', 'cpu')
+from automerge_tpu import backend as Backend
+from automerge_tpu import trace
+from automerge_tpu.native import NativeDocPool
+trace.ENABLED = True
+WORKLOAD
+
+pool = NativeDocPool()
+states = {}
+
+def apply(payload):
+    pool.apply_batch(payload)
+    for d, chs in payload.items():
+        st = states.get(d) or Backend.init()
+        states[d], _ = Backend.apply_changes(st, chs)
+
+# 4 actors <= AMTPU_RESCLK_MAX_ACTORS=5: the pool table seeds on device
+apply(build_round(1))
+m = trace.metrics_snapshot()
+assert m.get('resident.batch_full_uploads', 0) >= 1, m
+assert pool._resclk.tab is not None
+
+# two NEW actors push the pool past the cap: C++ permanently disables
+# the cache, and the driver must release the device table (the buffer
+# is pool-lifetime large and will never be read again)
+over = {}
+for d in range(6):
+    over['doc%d' % d] = [
+        {'actor': 'z%d' % a, 'seq': 1, 'deps': {},
+         'ops': [{'action': 'set', 'obj': ROOT, 'key': 'shared0',
+                  'value': 'z%d' % a}]}
+        for a in (0, 1)]
+apply(over)
+m = trace.metrics_snapshot()
+assert m.get('resident.batch_cache_dropped', 0) >= 1, m
+assert pool._resclk.tab is None
+
+# the pool keeps serving (non-resident) batches with oracle parity
+apply(build_round(2))
+m = trace.metrics_snapshot()
+assert m.get('resident.batch_cache_dropped', 0) == 1, m
+for d, st in states.items():
+    assert pool.get_patch(d) == Backend.get_patch(st), d
+print('CAP-DROP-OK')
+""".replace('WORKLOAD', BATCH_WORKLOAD).replace('REPO_PATH', repr(REPO))
+
+
+def test_batch_resident_actor_cap_releases_device_table():
+    """Crossing AMTPU_RESCLK_MAX_ACTORS permanently disables the C++
+    cache; the driver must drop its device copy of the clock table (it
+    can be hundreds of MB and is never read again) and keep serving
+    batches non-resident with oracle parity."""
+    env = dict(os.environ, JAX_PLATFORMS='cpu', AMTPU_HOST_FULL='0',
+               AMTPU_RESILIENCE='0', AMTPU_RESCLK_MAX_ACTORS='5')
+    out = subprocess.run([sys.executable, '-c', ACTOR_CAP_DROP], env=env,
+                         cwd=REPO, capture_output=True, text=True,
+                         timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert 'CAP-DROP-OK' in out.stdout
+
+
+AB_PATCHES = r"""
+import sys
+sys.path.insert(0, REPO_PATH)
+import jax; jax.config.update('jax_platforms', 'cpu')
+from automerge_tpu.native import NativeDocPool
+WORKLOAD
+
+pool = NativeDocPool()
+for r in (1, 2, 3):
+    pool.apply_batch(build_round(r))
+for d in sorted('doc%d' % i for i in range(24)):
+    sys.stdout.write('%s %r\n' % (d, pool.get_patch(d)))
+""".replace('WORKLOAD', BATCH_WORKLOAD).replace('REPO_PATH', repr(REPO))
+
+
+def _ab_run(**env_over):
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS='cpu', **env_over)
+    out = subprocess.run([sys.executable, '-c', AB_PATCHES], env=env,
+                         cwd=REPO, capture_output=True, text=True,
+                         timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    return out.stdout
+
+
+def test_batch_resident_ab_parity_both_exec_modes():
+    """Byte parity of every patch across the resident-clock latch and
+    both execution modes: the resident table must be unobservable."""
+    ref = _ab_run(AMTPU_HOST_FULL='0', AMTPU_RESIDENT_CLK='1')
+    assert ref == _ab_run(AMTPU_HOST_FULL='0', AMTPU_RESIDENT_CLK='0')
+    assert ref == _ab_run(AMTPU_HOST_FULL='1')
+
+
+def test_wave_pipeline_parity_and_staging_alias():
+    """Cross-batch double-buffering (ISSUE 6 tentpole c): the wave path
+    must be byte-identical to the unpipelined path, and the resident
+    delta-upload staging must tolerate host-side mutation as soon as
+    the scatter dispatch returns (jax zero-copying a still-in-flight
+    numpy buffer is the PR-4 regression class this lane pins)."""
+    script = r"""
+import sys
+sys.path.insert(0, REPO_PATH)
+import os
+import jax; jax.config.update('jax_platforms', 'cpu')
+from automerge_tpu.native import NativeDocPool
+import automerge_tpu.native.batch_resident as br
+WORKLOAD
+
+def run_rounds():
+    pool = NativeDocPool()
+    for r in (1, 2, 3):
+        pool.apply_batch(build_round(r))
+    return [pool.get_patch('doc%d' % i) for i in range(24)]
+
+# uncorrupted reference FIRST (unpipelined, no hostile wrapper): the
+# hostile arms below must match it, not merely each other -- identical
+# corruption in both arms would otherwise pass
+os.environ['AMTPU_PIPELINE_DEPTH'] = '1'
+os.environ['AMTPU_PIPELINE_MIN_DOCS'] = '4'
+ref = run_rounds()
+
+# scribble over the delta-upload staging arrays the moment the scatter
+# dispatch returns: if jax zero-copied them, the async execution reads
+# garbage and parity below breaks
+_orig = br._jit_row_scatter
+def _hostile(donate):
+    fn = _orig(donate)
+    def run(tab, idx, rows):
+        out = fn(tab, idx, rows)
+        idx.fill(127)
+        rows.fill(127)
+        return out
+    return run
+br._jit_row_scatter = _hostile
+
+results = {}
+for depth in ('1', '4'):
+    os.environ['AMTPU_PIPELINE_DEPTH'] = depth
+    results[depth] = run_rounds()
+assert results['1'] == ref, 'hostile staging mutation corrupted results'
+assert results['4'] == ref, 'wave path diverged from clean reference'
+print('WAVE-PARITY-OK')
+""".replace('WORKLOAD', BATCH_WORKLOAD).replace('REPO_PATH', repr(REPO))
+    env = dict(os.environ, JAX_PLATFORMS='cpu', AMTPU_HOST_FULL='0')
+    out = subprocess.run([sys.executable, '-c', script], env=env,
+                         cwd=REPO, capture_output=True, text=True,
+                         timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert 'WAVE-PARITY-OK' in out.stdout

@@ -15,7 +15,9 @@ honest A/B on this single-core host (runs drift +-15% between windows;
 see tools/quickbench.py).  MINIMA compare (the minimum of N identical
 runs is the least-contended sample, the robust statistic for a shared
 host); the target is ~2% overhead, the assert threshold defaults to 6%
-to absorb residual jitter (AMTPU_TCHECK_TOL overrides).  A final
+to absorb residual jitter (AMTPU_TCHECK_TOL overrides).  The gate takes
+the MEDIAN of AMTPU_TCHECK_TRIALS (default 3) independent overhead
+estimates, so one unlucky scheduling window cannot fail it alone.  A final
 enabled-path pass sanity-checks
 that tracing actually records (an accidentally dead telemetry layer
 must not pass the overhead gate by being dead).
@@ -44,6 +46,7 @@ from automerge_tpu.telemetry.spans import NULL_SPAN  # noqa: E402
 
 PAIRS = int(os.environ.get('AMTPU_TCHECK_PAIRS', 5))
 TOL = float(os.environ.get('AMTPU_TCHECK_TOL', 0.06))
+TRIALS = int(os.environ.get('AMTPU_TCHECK_TRIALS', 3))
 
 
 def _noop(*args, **kwargs):
@@ -105,21 +108,30 @@ def main():
 
     telemetry.disable()
     run_once()                      # warmup: jit compiles, allocator heat
-    raw_times, dis_times = [], []
-    for _ in range(PAIRS):
-        with raw_mode():
-            raw_times.append(run_once())
-        dis_times.append(run_once())
-    raw_best = min(raw_times)
-    dis_best = min(dis_times)
-    overhead = (dis_best - raw_best) / raw_best
-    print('raw (no-op patched): %s' % ['%.3f' % t for t in raw_times],
-          file=sys.stderr)
-    print('disabled telemetry:  %s' % ['%.3f' % t for t in dis_times],
-          file=sys.stderr)
+    # median-of-TRIALS overhead estimates (each from its own interleaved
+    # minima): one unlucky scheduling window can no longer fail the gate
+    # on its own -- the jitter this deflakes is documented at +-15%
+    # between windows on this host
+    overheads = []
+    for t in range(TRIALS):
+        raw_times, dis_times = [], []
+        for _ in range(PAIRS):
+            with raw_mode():
+                raw_times.append(run_once())
+            dis_times.append(run_once())
+        raw_best = min(raw_times)
+        dis_best = min(dis_times)
+        overheads.append((dis_best - raw_best) / raw_best)
+        print('trial %d: raw %s | disabled %s -> %.2f%%'
+              % (t, ['%.3f' % x for x in raw_times],
+                 ['%.3f' % x for x in dis_times], 100 * overheads[-1]),
+              file=sys.stderr)
+    overhead = sorted(overheads)[len(overheads) // 2]
     print('telemetry-check: disabled-path overhead %.2f%% '
-          '(best %.3fs vs %.3fs; tolerance %.0f%%)'
-          % (100 * overhead, dis_best, raw_best, 100 * TOL))
+          '(median of %d trials %s; tolerance %.0f%%)'
+          % (100 * overhead, TRIALS,
+             ['%.1f%%' % (100 * o) for o in sorted(overheads)],
+             100 * TOL))
 
     # enabled-path sanity: tracing must actually record when on
     telemetry.reset_all()
