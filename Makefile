@@ -1,7 +1,8 @@
 # Top-level developer entry points.
 
-.PHONY: all native test bench bench-all bench-tpu check clean wheel \
-	telemetry-check fallback-check perf-smoke chaos-check serve-check
+.PHONY: all native test bench bench-all bench-tpu bench-multichip check \
+	clean wheel telemetry-check fallback-check perf-smoke chaos-check \
+	serve-check mesh-check
 
 all: native
 
@@ -53,6 +54,7 @@ check: native
 	$(MAKE) perf-smoke
 	$(MAKE) chaos-check
 	$(MAKE) serve-check
+	$(MAKE) mesh-check
 	@echo "CHECK GREEN"
 
 # Escalation-ladder gate (ISSUE 2): a config-4-shaped smoke on the
@@ -95,6 +97,22 @@ serve-check: native
 # device-independent and a wedged tunnel must not hang the gate.
 telemetry-check: native
 	JAX_PLATFORMS=cpu python tools/telemetry_check.py
+
+# Mesh-execution gate (ISSUE 7, docs/ARCHITECTURE.md mesh section):
+# MeshDocPool under AMTPU_MESH=4 must serve a mixed real workload with
+# per-doc byte parity vs a serial replay and fallback.oracle == 0, and
+# dp=4 must beat dp=1 by >= 1.5x on the MULTICHIP scaling workload
+# (interleaved A/B, bounded retries; the JSON records the physical-core
+# ceiling this CPU stand-in can offer).
+mesh-check: native
+	JAX_PLATFORMS=cpu python tools/mesh_check.py
+
+# The MULTICHIP artifact through the first-class pool mode (ISSUE 7):
+# one fresh subprocess per dp in {1,2,4,8} + the sp-crossover probe,
+# JSON lines with per-phase seconds and the mesh.* telemetry block.
+# Replaces the dryrun tail-scrape as the source of MULTICHIP_r0N.json.
+bench-multichip: native
+	python bench.py --multichip --out MULTICHIP.json
 
 wheel: native
 	python -m pip wheel --no-deps -w dist .

@@ -22,7 +22,7 @@ import msgpack
 import numpy as np
 
 from .. import faults, telemetry, trace
-from ..utils.common import doc_key, env_int
+from ..utils.common import doc_key, env_int, parse_mesh_env
 from ..utils.wire import map_header as _map_header
 from ..utils.wire import read_map_header as _read_map_header
 
@@ -325,6 +325,36 @@ def _ctx_pending_arrays(ctx):
     return out
 
 
+def _run_phase_b_entry(key, pool, ctx, on_result=None, on_error=None):
+    """Phase b of ONE (key, pool, ctx) entry, with the full failure
+    protocol: drain in-flight kernels, roll the batch back, free the
+    handle.  Shared by the serial ready-order collector below and the
+    mesh pool's threaded collector (mesh_pool._collect_ready_parallel),
+    so the two drivers cannot drift on error semantics."""
+    try:
+        result = pool._phase_b(ctx)
+        if on_result is not None:
+            on_result(key, result)
+    except Exception as e:
+        # drain in-flight kernels BEFORE rollback+free: a phase-b
+        # failure (armed fault, device error) can leave dispatches
+        # that zero-copied the C++ batch columns the free below is
+        # about to delete -- the PR-4 alias class, same drain as
+        # the wave phase-a unwind
+        for arr in _ctx_pending_arrays(ctx):
+            try:
+                arr.block_until_ready()
+            except Exception:
+                pass    # already failing; kernel errors moot
+        _rollback_batch(ctx['bh'], e)
+        if on_error is not None:
+            on_error(key, e)
+        else:
+            raise
+    finally:
+        _free_batch(ctx['bh'])
+
+
 def _collect_ready_order(entries, on_result=None, on_error=None):
     """Drives phase b over (key, pool, ctx) entries READY-FIRST: each
     round picks the first entry whose dispatched device outputs have
@@ -350,28 +380,7 @@ def _collect_ready_order(entries, on_result=None, on_error=None):
         elif pick > 0:
             trace.metric('collect.ready_reorder')
         key, pool, ctx = pending.pop(pick)
-        try:
-            result = pool._phase_b(ctx)
-            if on_result is not None:
-                on_result(key, result)
-        except Exception as e:
-            # drain in-flight kernels BEFORE rollback+free: a phase-b
-            # failure (armed fault, device error) can leave dispatches
-            # that zero-copied the C++ batch columns the free below is
-            # about to delete -- the PR-4 alias class, same drain as
-            # the wave phase-a unwind
-            for arr in _ctx_pending_arrays(ctx):
-                try:
-                    arr.block_until_ready()
-                except Exception:
-                    pass    # already failing; kernel errors moot
-            _rollback_batch(ctx['bh'], e)
-            if on_error is not None:
-                on_error(key, e)
-            else:
-                raise
-        finally:
-            _free_batch(ctx['bh'])
+        _run_phase_b_entry(key, pool, ctx, on_result, on_error)
 
 
 def apply_payloads_pipelined(pools_payloads):
@@ -514,9 +523,18 @@ def _host_dom_on():
 #: latches (core.cpp resident_enabled_pre / resclk_enabled) + jit cache
 #: shapes.  AMTPU_HOST_FULL is deliberately absent -- it is re-read per
 #: batch (the exec-mode A/B tests flip it in-process).
+# AMTPU_MESH is latched like the resident knobs: the pool factory's
+# choice and each chip's device binding are fixed at construction, so a
+# later env flip must warn, not silently serve the old topology.  (The
+# sp-fence threshold AMTPU_MESH_SP_MIN is deliberately NOT here -- the
+# fence reads it live per dispatch, so flips genuinely apply.)
 _RESIDENT_LATCH_KEYS = ('AMTPU_RESIDENT', 'AMTPU_RESIDENT_MIN',
                         'AMTPU_RESIDENT_CLK', 'AMTPU_RESCLK_MAX_ACTORS',
-                        'AMTPU_RESCLK_MAX_ROWS', 'AMTPU_TRIVIAL_HOST')
+                        'AMTPU_RESCLK_MAX_ROWS', 'AMTPU_TRIVIAL_HOST',
+                        'AMTPU_MESH')
+# flips of the mesh-topology knob count under mesh.*; everything else
+# stays resident.latch_flip_ignored
+_LATCH_COUNTER_NS = {'AMTPU_MESH': 'mesh'}
 _resident_latch = None          # first-batch snapshot
 _latch_flips_warned = set()     # (key, new value) pairs already warned
 
@@ -554,17 +572,25 @@ def _latch_snapshot():
     * the numeric knobs compare as parsed integers with the C++
       defaults filled in;
     * AMTPU_TRIVIAL_HOST mirrors core.cpp's trivial_host static:
-      atoi != 0, default on."""
+      atoi != 0, default on;
+    * AMTPU_MESH compares as the normalized (dp, sp) the pool factory
+      parses (malformed values compare raw -- they never built a
+      mesh)."""
     raw = tuple(os.environ.get(k) for k in _RESIDENT_LATCH_KEYS)
-    res, rmin, clk, amax, arows, triv = raw
+    res, rmin, clk, amax, arows, triv, mesh = raw
     clk_src = clk if clk is not None else res
     d_rmin, d_amax, d_arows = _latch_defaults()
+    try:
+        mesh_eff = parse_mesh_env()
+    except ValueError:
+        mesh_eff = mesh
     eff = (res,
            _atoi(rmin) if rmin is not None else d_rmin,
            True if clk_src is None else _atoi(clk_src) != 0,
            _atoi(amax) if amax is not None else d_amax,
            _atoi(arows) if arows is not None else d_arows,
-           True if triv is None else _atoi(triv) != 0)
+           True if triv is None else _atoi(triv) != 0,
+           mesh_eff)
     return raw, eff
 
 
@@ -590,7 +616,8 @@ def _check_resident_latch():
             _resident_latch[1], cur[1]):
         if was_eff == now_eff:
             continue
-        trace.metric('resident.latch_flip_ignored')
+        trace.metric('%s.latch_flip_ignored'
+                     % _LATCH_COUNTER_NS.get(key, 'resident'))
         if (key, now) not in _latch_flips_warned:
             _latch_flips_warned.add((key, now))
             warnings.warn(
@@ -1202,14 +1229,17 @@ class NativeDocPool:
         # entry.dirty until the post-emit visibility sync lands: a batch
         # that errors in between leaves the device ev unsynced
         entry.dirty = True
-        from .resident import _jit_kernel_sharded, _sp_sharding
-        if _sp_sharding(dLp) is not None:
-            # multi-device with a capacity the mesh divides: element
-            # axis sharded over sp -- the quadratic dominance stage
-            # splits across devices (the promoted AMTPU_BENCH_C1_MESH
-            # path, now the default)
-            fn = _jit_kernel_sharded(n_iters, ctx['weff'], 64)
+        from .resident import (_jit_kernel_sharded, _sp_device_cap,
+                               _sp_sharding)
+        if _sp_sharding(dLp, count_fenced=True) is not None:
+            # multi-device with a capacity the mesh divides AND past the
+            # sp fence's long-list crossover: element axis sharded over
+            # sp -- the quadratic dominance stage splits across devices
+            # (the promoted AMTPU_BENCH_C1_MESH path)
+            fn = _jit_kernel_sharded(n_iters, ctx['weff'], 64,
+                                     _sp_device_cap())
             trace.count('resident.sharded_dispatch')
+            trace.metric('mesh.sp_engaged')
         else:
             fn = _jit_kernel(n_iters, ctx['weff'], 64)
         reg_out, rank, combo = fn(
@@ -2085,10 +2115,7 @@ class ShardedNativePool:
                 subs.append((ctypes.cast(ptr, ctypes.c_char_p), n.value)
                             if n.value > 1 else None)
             with trace.span('shard.run'):
-                if self.mode == 'pipeline':
-                    results, errors = self._run_pipelined(subs)
-                else:
-                    results, errors = self._run_threaded(subs)
+                results, errors = self._run(subs)
             if errors:
                 # poison-batch isolation at SHARD granularity: a failed
                 # shard rolled its pool back, so its whole sub-payload
@@ -2113,9 +2140,22 @@ class ShardedNativePool:
         # whole-batch series; shard sub-batches land under pool="native"
         # (threads mode) or not at all (pipeline mode drives _phase_a/b
         # directly), so the two label values never double-count one level
-        telemetry.observe_batch('sharded', time.perf_counter() - t_batch,
+        telemetry.observe_batch(self._batch_label,
+                                time.perf_counter() - t_batch,
                                 docs=_read_map_header(payload)[0])
         return out
+
+    #: batch-latency series label (`MeshDocPool` overrides with 'mesh'
+    #: so its lines are attributable; `telemetry.collect_share` knows
+    #: every value)
+    _batch_label = 'sharded'
+
+    def _run(self, subs):
+        """Drive-mode dispatch for one split payload; subclasses (the
+        mesh pool) override with their own drive."""
+        if self.mode == 'pipeline':
+            return self._run_pipelined(subs)
+        return self._run_threaded(subs)
 
     def _run_pipelined(self, subs):
         """Phase a for every shard, then phase b READY-FIRST: shards
@@ -2233,3 +2273,27 @@ class ShardedNativePool:
     def get_changes_for_actor_bytes(self, doc_id, actor, after_seq=0):
         return self.pools[self._shard_of(doc_id)] \
             .get_changes_for_actor_bytes(doc_id, actor, after_seq)
+
+
+def make_pool():
+    """The execution-mode-aware pool factory (ISSUE 7): `MeshDocPool`
+    when ``AMTPU_MESH=dp[,sp]`` requests mesh execution, else a plain
+    `NativeDocPool`.  The sidecar backend and the CI gates construct
+    through this, so flipping one env var moves a whole serving stack
+    (gateway, resilience, sidecar) onto the device mesh unchanged."""
+    mesh = parse_mesh_env()
+    if mesh is None:
+        return NativeDocPool()
+    from .mesh_pool import MeshDocPool
+    return MeshDocPool(dp=mesh[0], sp=mesh[1])
+
+
+def __getattr__(name):
+    # lazy so importing the native driver never drags the mesh module
+    # (and through it jax device enumeration) into processes that only
+    # serve single-device traffic
+    if name in ('MeshDocPool', 'MeshChipPool'):
+        from . import mesh_pool
+        return getattr(mesh_pool, name)
+    raise AttributeError('module %r has no attribute %r'
+                         % (__name__, name))

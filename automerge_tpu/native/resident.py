@@ -33,6 +33,53 @@ from functools import lru_cache, partial
 import numpy as np
 
 from .. import trace
+from ..utils.common import env_int, parse_mesh_env
+
+#: Default long-list crossover for the sp (sequence-parallel) axis, in
+#: arena elements (ISSUE 7 satellite: sp-axis triage).  Below this the
+#: linearization all-gather + per-device dispatch overhead outweighs
+#: the sharded dominance win and sp REGRESSES hard -- measured on the
+#: 2-core CI stand-in (steady-state resident edit batches, sp=2,
+#: interleaved A/B; bench.py --multichip re-records the probe per
+#: host): 3.4x slower at 8k elements, ~2x at 32k, ~1.3x at 64k,
+#: break-even (0.85-1.1x, noise-dominated) at 128k+.  The stand-in can
+#: never show a WIN -- its virtual devices share the two cores XLA's
+#: intra-op parallelism already saturates at sp=1 -- so the default
+#: threshold marks where sharding stops HURTING; real multi-chip
+#: hardware (where sp buys actual extra silicon and O(L/sp) resident
+#: memory) is expected to move it down, and the hardware-day run
+#: re-measures it.  AMTPU_MESH_SP_MIN overrides; arenas below the
+#: threshold stay on the single-chip resident kernel and count
+#: ``mesh.sp_fenced``.
+SP_CROSSOVER_ELEMS = 1 << 17
+
+
+def _sp_min():
+    """Element threshold under which sp sharding is fenced off."""
+    return env_int('AMTPU_MESH_SP_MIN', SP_CROSSOVER_ELEMS)
+
+
+def _sp_device_cap():
+    """How many devices the sp axis may claim: None = every local
+    device (legacy auto policy, no AMTPU_MESH set), 0 = fenced off
+    entirely, else the explicit sp extent of ``AMTPU_MESH=dp,sp``.
+
+    With dp > 1 every device belongs to a dp chip, and a global sp
+    mesh would shard one chip's arena across devices other chips own
+    -- so mesh mode enables sp only for the dp=1 topology (the
+    single-big-doc showcase the sp axis exists for); composing per-
+    chip sp sub-meshes is deferred until the path validates on real
+    hardware."""
+    try:
+        env = parse_mesh_env()
+    except ValueError:
+        return 0          # malformed AMTPU_MESH: never shard on a typo
+    if env is None:
+        return None
+    dp, sp = env
+    if sp <= 1 or dp > 1:
+        return 0
+    return sp
 
 
 class ResidentArena:
@@ -69,16 +116,19 @@ def _jit_kernel(n_iters, window, chunk):
 
 
 @lru_cache(maxsize=None)
-def _sp_mesh():
+def _sp_mesh(n_cap=None):
     """A 1-D ('sp',) mesh over the largest power-of-two subset of local
-    devices, or None single-device.  The pool's resident dispatch shards
-    big arenas over it -- the promotion of the AMTPU_BENCH_C1_MESH
-    showcase path into the default pool entry point (VERDICT r2 #4).
-    Power-of-two so the pow2-bucketed arena capacities divide evenly."""
+    devices (capped at `n_cap` when the AMTPU_MESH topology reserves
+    devices for dp chips), or None single-device.  The pool's resident
+    dispatch shards big arenas over it -- the promotion of the
+    AMTPU_BENCH_C1_MESH showcase path into the default pool entry point
+    (VERDICT r2 #4).  Power-of-two so the pow2-bucketed arena
+    capacities divide evenly."""
     import jax
     devices = jax.devices()
+    limit = len(devices) if n_cap is None else min(n_cap, len(devices))
     n = 1
-    while n * 2 <= len(devices):
+    while n * 2 <= limit:
         n *= 2
     if n < 2:
         return None
@@ -86,27 +136,44 @@ def _sp_mesh():
     return Mesh(np.array(devices[:n]), ('sp',))
 
 
-def _sp_sharding(capacity=None):
+def _sp_sharding(capacity=None, count_fenced=False):
     """Element-axis sharding for a resident column of `capacity` rows,
-    or None when sharding is unavailable/indivisible (the caller then
-    keeps the column replicated and uses the unsharded kernel)."""
-    mesh = _sp_mesh()
+    or None when sharding is unavailable/indivisible -- or FENCED (the
+    caller then keeps the column replicated and uses the unsharded
+    kernel).  The fence is the sp-axis triage (ISSUE 7): sp>1 routes
+    only past the measured long-list crossover (`_sp_min`), and only
+    over devices the AMTPU_MESH topology has not claimed for dp chips
+    (`_sp_device_cap`).  `count_fenced` records a fenced would-be
+    sharding as ``mesh.sp_fenced`` -- passed ONLY by the dispatch
+    decision site, so fenced counts one per dispatch exactly like its
+    ``mesh.sp_engaged`` counterpart (placement/sync callers would
+    otherwise inflate it 3-4x)."""
+    cap = _sp_device_cap()
+    if cap == 0:
+        return None
+    mesh = _sp_mesh(cap)
     if mesh is None:
         return None
     if capacity is not None and capacity % mesh.size != 0:
+        return None
+    if capacity is not None and capacity < _sp_min():
+        if count_fenced:
+            trace.metric('mesh.sp_fenced')
         return None
     from jax.sharding import NamedSharding, PartitionSpec
     return NamedSharding(mesh, PartitionSpec('sp'))
 
 
 @lru_cache(maxsize=None)
-def _jit_kernel_sharded(n_iters, window, chunk):
+def _jit_kernel_sharded(n_iters, window, chunk, n_cap=None):
     """The resident resolver with the arena element axis SHARDED over the
     sp mesh: linearize all-gathers the (tiny) parent/ctr/act columns for
     pointer doubling, while the quadratic dominance stage -- the dominant
     cost for long lists -- computes only each device's local partial
     counts, completed with one psum (`ops/list_rank.dominance_indexes`
-    sequence-parallel mode, same formulation as parallel/mesh.py)."""
+    sequence-parallel mode, same formulation as parallel/mesh.py).
+    `n_cap` keys the cache on the AMTPU_MESH device cap so the compiled
+    mesh always matches the sharding decision that routed here."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
@@ -115,7 +182,7 @@ def _jit_kernel_sharded(n_iters, window, chunk):
     from ..ops import registers as register_ops
     from ..parallel.mesh import shard_map
 
-    mesh = _sp_mesh()
+    mesh = _sp_mesh(n_cap)
     rep = P()
     shd = P('sp')
     reg_spec = {k: rep for k in ('winner', 'conflicts', 'alive_after',

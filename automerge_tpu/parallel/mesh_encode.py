@@ -85,6 +85,20 @@ def demo_text_workload(n_docs, n_actors=4, n_rounds=2, ops_per_change=8,
     }
 
 
+def scaling_workload(n_docs):
+    """The MULTICHIP scaling workload: n_docs small concurrent text
+    docs (one round, 4 actors, every 7th slot a delete) -- the dp
+    axis's reason to exist.  The ONE definition behind the dryrun
+    scaling table, `bench.py --multichip`, and the `make mesh-check`
+    gate, so the gate can never silently desynchronize from the
+    artifact it validates."""
+    return {
+        't-%d' % d: text_doc_changes(
+            't-%d' % d, 4, 1, 8, lambda i, a, has: (i % 7 == 3) and has)
+        for d in range(n_docs)
+    }
+
+
 def demo_map_workload(n_docs=4, n_actors=4, n_rounds=2, keys=6):
     """Config-2-shaped fixture: concurrent map writers on a shared key
     space (kept under the register window so the mesh path is exact)."""

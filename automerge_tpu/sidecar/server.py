@@ -79,8 +79,11 @@ class SidecarBackend:
 
     def __init__(self, pool=None):
         if pool is None:
-            from ..native import NativeDocPool
-            pool = NativeDocPool()
+            # AMTPU_MESH=dp[,sp] moves the whole serving stack (gateway
+            # coalescing, resilience, this sidecar) onto the device
+            # mesh; default stays the single-device pool
+            from ..native import make_pool
+            pool = make_pool()
         self.pool = pool
 
     # -- commands -------------------------------------------------------

@@ -124,6 +124,33 @@ KNOWN_RESIDENT_BATCH_KEYS = ('batch_hits', 'batch_noop',
 # recorded": batches that took the wave path / total doc-disjoint waves
 KNOWN_PIPELINE_KEYS = ('batches', 'waves', 'serial_replay')
 
+# mesh execution mode (ISSUE 7; `trace.metric('mesh.<name>')` call
+# sites in native/mesh_pool.py + the sp fence in native/resident.py;
+# glossary: docs/OBSERVABILITY.md), pre-seeded into every bench_block
+# so a MULTICHIP line always carries the full mesh story:
+# batches / shards        mesh-driven batches and the dp chips that
+#                           carried payload across them
+# chip_docs               docs placed on chips (sum; / shards = mean
+#                           per-chip occupancy)
+# occupancy_skew          per-batch max-min docs across chips (FNV
+#                           routing imbalance)
+# encode_shard_skew_s     per-batch max-min of the chips' threaded
+#                           phase-a (host decode/begin+dispatch) walls
+# collective_wait_s       time a collector blocked on a chip whose
+#                           device outputs had not resolved (nothing
+#                           else was ready)
+# device_shortfall        mesh pools built with fewer devices than
+#                           dp x sp (round-robin placement degradation)
+# sp_fenced / sp_engaged  resident dispatches the sp-axis crossover
+#                           fence kept single-chip vs routed sharded
+# latch_flip_ignored      AMTPU_MESH* env flips after the first batch
+#                           (warned once, ignored -- the topology and
+#                           jit caches latched)
+KNOWN_MESH_KEYS = ('batches', 'shards', 'chip_docs', 'occupancy_skew',
+                   'encode_shard_skew_s', 'collective_wait_s',
+                   'device_shortfall', 'sp_fenced', 'sp_engaged',
+                   'latch_flip_ignored')
+
 # resilience counters (`telemetry.metric('resilience.<name>')` call
 # sites; glossary: docs/RESILIENCE.md), pre-seeded into every
 # bench_block and the healthz payload so gates and dashboards see
@@ -444,6 +471,10 @@ def bench_block():
     pipeline.update({k.split('.', 1)[1]: round(v, 6)
                      for k, v in flat.items()
                      if k.startswith('pipeline.')})
+    mesh = {r: 0.0 for r in KNOWN_MESH_KEYS}
+    mesh.update({k.split('.', 1)[1]: round(v, 6)
+                 for k, v in flat.items()
+                 if k.startswith('mesh.')})
     block = {
         'fallbacks': fallbacks,
         'collect': collect,
@@ -451,6 +482,7 @@ def bench_block():
         'scheduler': scheduler,
         'resident': resident,
         'pipeline': pipeline,
+        'mesh': mesh,
         'device_s': round(flat.get('device.dispatch_sync_s', 0.0), 4),
         'device_dispatches': int(flat.get('device.dispatches', 0)),
         'batch_latency': BATCH_LATENCY.snapshot() or {},
@@ -471,7 +503,8 @@ def collect_share(block):
     the native-vs-sharded fallback rule changes, it changes for both."""
     lat = block.get('batch_latency') or {}
     basis = ((lat.get('native') or {}).get('sum', 0.0)
-             or (lat.get('sharded') or {}).get('sum', 0.0))
+             or (lat.get('sharded') or {}).get('sum', 0.0)
+             or (lat.get('mesh') or {}).get('sum', 0.0))
     coll = ((block.get('phases') or {}).get('device.collect')
             or {}).get('s', 0.0)
     return (coll / basis if basis else 0.0), coll, basis

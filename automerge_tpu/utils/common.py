@@ -17,6 +17,34 @@ def env_int(name, default):
         return default
 
 
+def parse_mesh_env(raw=None):
+    """Parses the mesh execution-mode knob ``AMTPU_MESH=dp[,sp]`` into
+    ``(dp, sp)``, or None when unset/empty/zero (mesh mode off).  The
+    ONE parse shared by the pool factory (`native.make_pool`), the
+    sp-axis fence (`native.resident`), and the latch-flip guard -- the
+    three consumers can never disagree on what a value means.
+
+    Raises ValueError on malformed values: a typo'd topology silently
+    serving single-device traffic is the failure mode this knob exists
+    to prevent."""
+    if raw is None:
+        raw = os.environ.get('AMTPU_MESH')
+    if raw is None or not raw.strip():
+        return None
+    parts = raw.split(',')
+    try:
+        dp = int(parts[0])
+        sp = int(parts[1]) if len(parts) > 1 and parts[1].strip() else 1
+        if len(parts) > 2:
+            raise ValueError
+    except ValueError:
+        raise ValueError('AMTPU_MESH must be dp[,sp] (e.g. "4" or '
+                         '"4,2"), got %r' % (raw,))
+    if dp <= 0:
+        return None
+    return dp, max(sp, 1)
+
+
 def is_object(value):
     """True for values that map to Automerge objects (dict/list/Text/Table)."""
     return isinstance(value, (dict, list)) or hasattr(value, '_am_object')

@@ -42,12 +42,22 @@ def ensure_cpu_devices(n_devices):
     config-option path itself when the backend is already initialized
     (see ``__graft_entry__.dryrun_multichip``).
 
-    Returns 'config' when the config option exists (caller may use it
-    after a backend teardown), 'flags' when the XLA_FLAGS fallback was
+    Returns 'config' when the config option exists (applied here when
+    the backend is still uninitialized; after an init, the caller must
+    tear the backend down first -- see ``__graft_entry__``'s
+    clear_backends path), 'flags' when the XLA_FLAGS fallback was
     applied or already satisfies the request.
     """
     import jax
     if hasattr(jax.config, 'jax_num_cpu_devices'):
+        try:
+            if jax.config.jax_num_cpu_devices < n_devices:
+                jax.config.update('jax_num_cpu_devices', n_devices)
+        except Exception:
+            # backend already initialized: the option is frozen; callers
+            # that can afford a teardown (the dryrun) handle it, pool
+            # construction degrades with a counted+warned shortfall
+            pass
         return 'config'
     flags = os.environ.get('XLA_FLAGS', '')
     m = re.search(r'--xla_force_host_platform_device_count=(\d+)', flags)

@@ -699,6 +699,247 @@ def run_config_1_mesh(rng):
             'baseline': BASELINE_NAME, 'mode': 'mesh'}
 
 
+def _scaling_workload_payload(n_docs):
+    """MULTICHIP scaling workload as a wire payload (the one builder
+    lives in mesh_encode.scaling_workload, shared with the mesh-check
+    gate and the dryrun)."""
+    import msgpack
+
+    from automerge_tpu.parallel import mesh_encode
+    docs = mesh_encode.scaling_workload(n_docs)
+    total_ops = sum(len(c['ops']) for chs in docs.values() for c in chs)
+    return msgpack.packb(docs, use_bin_type=True), total_ops
+
+
+def run_multichip_child(dp):
+    """One MULTICHIP line: the scaling workload through the first-class
+    mesh pool mode (`make_pool` under AMTPU_MESH=dp, exported by the
+    parent together with the matching device count) on the full
+    `_measure_mode` protocol -- warmup, 3 fresh-pool timed steps,
+    device-time pass, TRACED phase pass."""
+    import jax
+
+    from automerge_tpu.native import make_pool
+    n_docs = env_int('AMTPU_MC_DOCS', 2048)
+    payload, total_ops = _scaling_workload_payload(n_docs)
+    if os.environ.get('AMTPU_MC_LIGHT'):
+        # light re-measurement round (parent interleaves these across
+        # the dp ladder to cancel host drift): warm + 3 timed steps,
+        # no device/phase passes
+        make_pool().apply_batch_bytes(payload)
+        walls = []
+        for _ in range(3):
+            pool = make_pool()
+            t0 = time.perf_counter()
+            pool.apply_batch_bytes(payload)
+            walls.append(time.perf_counter() - t0)
+        med = sorted(walls)[1]
+        print(json.dumps({'metric': 'multichip_pool_ops_per_sec',
+                          'light': True, 'dp': dp,
+                          'value': round(total_ops / med, 1),
+                          'step_wall_s': round(med, 4)}))
+        return 0
+    rate, _pool, stats = _measure_mode(make_pool, payload, total_ops,
+                                       'mesh dp=%d' % dp)
+    result = {
+        'metric': 'multichip_pool_ops_per_sec',
+        'value': round(rate, 1), 'unit': 'ops/sec', 'mode': 'mesh',
+        'baseline': 'mesh_dp1',       # parent fills vs_baseline from dp=1
+        'dp': dp, 'sp': 1,
+        'devices': len(jax.devices()), 'cores': os.cpu_count(),
+        'docs': n_docs, 'ops': total_ops,
+        'step_wall_s': round(total_ops / rate, 4) if rate else 0.0,
+        'fallbacks': stats['fallbacks'],
+        'device': stats['device'],
+        'telemetry': stats['telemetry'],
+    }
+    print(json.dumps(result))
+    return 0
+
+
+def run_multichip_sp_child(sp_min):
+    """sp-crossover probe arm: steady-state resident edit batches on one
+    long Text doc per arena size, with the sp fence pinned by the parent
+    (AMTPU_MESH_SP_MIN=16 -> sharded arm, huge -> dp-only arm).  Prints
+    {'rows': {elems: median_edit_s}, 'sp_engaged': ...}."""
+    from automerge_tpu import telemetry
+    from automerge_tpu.native import NativeDocPool
+    sizes = [int(s) for s in os.environ.get(
+        'AMTPU_MC_SP_SIZES', '8192,32768,131072,262144').split(',')]
+    pool = NativeDocPool()
+    telemetry.metrics_reset()
+    rows = {}
+    for n_elems in sizes:
+        doc = 'sp-%d' % n_elems
+        chs = [{'actor': 'a0', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'makeText', 'obj': 't'},
+            {'action': 'link', 'obj': ROOT_ID, 'key': 'text',
+             'value': 't'}]}]
+        prev, e, ops = '_head', 0, []
+        for _ in range(n_elems):
+            e += 1
+            ops.append({'action': 'ins', 'obj': 't', 'key': prev,
+                        'elem': e})
+            ops.append({'action': 'set', 'obj': 't', 'key': 'a0:%d' % e,
+                        'value': 'x'})
+            prev = 'a0:%d' % e
+        chs.append({'actor': 'a0', 'seq': 2, 'deps': {}, 'ops': ops})
+        pool.apply_changes(doc, chs)
+        seq = 2
+        times = []
+        for k in range(6):
+            seq += 1
+            e += 1
+            edit = [{'actor': 'a0', 'seq': seq, 'deps': {}, 'ops': [
+                {'action': 'ins', 'obj': 't', 'key': prev, 'elem': e},
+                {'action': 'set', 'obj': 't', 'key': 'a0:%d' % e,
+                 'value': 'y'}]}]
+            prev = 'a0:%d' % e
+            t0 = time.perf_counter()
+            pool.apply_changes(doc, edit)
+            if k:                          # first edit pays jit compile
+                times.append(time.perf_counter() - t0)
+        rows[n_elems] = round(sorted(times)[len(times) // 2], 4)
+    snap = telemetry.metrics_snapshot()
+    print(json.dumps({'rows': rows, 'sp_min': sp_min,
+                      'sp_engaged': int(snap.get('mesh.sp_engaged', 0)),
+                      'sp_fenced': int(snap.get('mesh.sp_fenced', 0))}))
+    return 0
+
+
+def run_multichip(args):
+    """--multichip: the MULTICHIP artifact through the first-class pool
+    mode (ISSUE 7 satellite 2) -- retires the dryrun tail-scrape.  One
+    fresh subprocess per dp (the device count, AMTPU_MESH topology, and
+    resident knobs all latch at first backend init), plus the two-arm
+    sp-crossover probe that justifies the sp fence
+    (resident.SP_CROSSOVER_ELEMS)."""
+    import re as _re
+    import subprocess
+
+    def spawn(extra_args, n_devices, extra_env):
+        env = dict(os.environ, JAX_PLATFORMS='cpu')
+        flags = _re.sub(r'--xla_force_host_platform_device_count=\d+',
+                        '', env.get('XLA_FLAGS', ''))
+        env['XLA_FLAGS'] = (flags + ' --xla_force_host_platform_'
+                            'device_count=%d' % n_devices).strip()
+        env.update(extra_env)
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)] + extra_args,
+            env=env, capture_output=True, text=True)
+        sys.stderr.write(proc.stderr)
+        line = (proc.stdout.strip().splitlines() or ['{}'])[-1]
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            rec = {'error': 'rc=%d no-json' % proc.returncode}
+        if proc.returncode != 0:
+            rec.setdefault('error', 'rc=%d' % proc.returncode)
+        return rec
+
+    lines = []
+    env_dp = os.environ.get('AMTPU_MULTICHIP_DP')
+    dps = [int(d) for d in (env_dp or '1,2,4,8').split(',')]
+    if env_dp is None:
+        # the dp axis parallelizes HOST work on this CPU stand-in, so
+        # chips past the physical-core ceiling only add thread
+        # contention and per-chip fixed cost (measured: dp=8 on 2 cores
+        # regresses below dp=4); the default ladder stops where the
+        # host can still show real scaling.  Real multi-chip hardware
+        # runs the full ladder (AMTPU_MULTICHIP_DP=1,2,4,8).
+        cap = max(4, 2 * (os.cpu_count() or 1))
+        dropped = [d for d in dps if d > cap]
+        if dropped:
+            print('multichip: dp %s dropped (past the %d-core host\'s '
+                  'x%d parallelism ceiling; set AMTPU_MULTICHIP_DP to '
+                  'force)' % (dropped, os.cpu_count() or 1, cap),
+                  file=sys.stderr)
+        dps = [d for d in dps if d <= cap]
+    # round 0: one FULL child per dp (device/phase passes, telemetry-
+    # rich line); rounds 1..R-1: LIGHT children interleaved across the
+    # ladder so minute-scale host drift hits every dp equally.  The
+    # line's headline value is the best round (noise on a shared box
+    # only ever adds time; every round is kept in `round_values`).
+    rounds = env_int('AMTPU_MULTICHIP_ROUNDS', 3)
+    by_dp = {}
+    for dp in dps:
+        print('== multichip dp=%d ==' % dp, file=sys.stderr)
+        rec = spawn(['--multichip-child', str(dp)], dp,
+                    {'AMTPU_MESH': str(dp)})
+        rec['round_values'] = [rec.get('value', 0.0)]
+        by_dp[dp] = rec
+        lines.append(rec)
+    for r in range(1, rounds):
+        for dp in dps:
+            print('== multichip dp=%d (light round %d) ==' % (dp, r),
+                  file=sys.stderr)
+            light = spawn(['--multichip-child', str(dp)], dp,
+                          {'AMTPU_MESH': str(dp), 'AMTPU_MC_LIGHT': '1'})
+            if light.get('value'):
+                by_dp[dp]['round_values'].append(light['value'])
+    for dp, rec in by_dp.items():
+        # a failed full child has no 'ops' (and no meaning to update);
+        # its light rounds still print, but the error line stands
+        if rec.get('round_values') and rec.get('ops'):
+            best = max(rec['round_values'])
+            if best > rec.get('value', 0.0):
+                rec['value'] = best
+                rec['step_wall_s'] = round(rec['ops'] / best, 4)
+    base = next((r for r in lines if r.get('dp') == 1 and r.get('value')),
+                None)
+    for rec in lines:
+        if base and rec.get('value'):
+            rec['vs_baseline'] = round(rec['value'] / base['value'], 3)
+        print(json.dumps({k: rec[k] for k in
+                          ('metric', 'value', 'dp', 'vs_baseline',
+                           'round_values') if k in rec}))
+
+    # sp-crossover probe: sharded arm vs dp-only arm, 2 devices each
+    print('== multichip sp probe ==', file=sys.stderr)
+    sharded = spawn(['--multichip-sp-child', '16'], 2,
+                    {'AMTPU_RESIDENT': '1', 'AMTPU_RESIDENT_MIN': '16',
+                     'AMTPU_MESH_SP_MIN': '16'})
+    fenced = spawn(['--multichip-sp-child', '1073741824'], 2,
+                   {'AMTPU_RESIDENT': '1', 'AMTPU_RESIDENT_MIN': '16',
+                    'AMTPU_MESH_SP_MIN': '1073741824'})
+    from automerge_tpu.native.resident import SP_CROSSOVER_ELEMS
+    rows = []
+    crossover = None
+    for elems in sorted(int(k) for k in (sharded.get('rows') or {})):
+        a = (fenced.get('rows') or {}).get(str(elems)) or \
+            (fenced.get('rows') or {}).get(elems)
+        b = sharded['rows'].get(str(elems)) or sharded['rows'].get(elems)
+        if not a or not b:
+            continue
+        rows.append({'elems': elems, 'dp_only_s': a, 'sp_s': b,
+                     'sp_speedup': round(a / b, 3)})
+        if crossover is None and a >= b:
+            crossover = elems
+    sp_line = {
+        'metric': 'multichip_sp_crossover',
+        'rows': rows,
+        'crossover_elems': crossover,
+        'fence_default_elems': SP_CROSSOVER_ELEMS,
+        'policy': 'sp>1 engages only past AMTPU_MESH_SP_MIN (default '
+                  'fence_default_elems) or AMTPU_MESH=1,sp opt-in; '
+                  'below it the dp-only kernel serves (mesh.sp_fenced)',
+        'sp_probe_engaged': sharded.get('sp_engaged', 0),
+    }
+    if 'error' in sharded or 'error' in fenced:
+        sp_line['error'] = sharded.get('error') or fenced.get('error')
+    lines.append(sp_line)
+    print(json.dumps(sp_line))
+
+    if args.out:
+        with open(args.out, 'w') as f:
+            for rec in lines:
+                f.write(json.dumps(rec) + '\n')
+        print('wrote %d lines -> %s' % (len(lines), args.out),
+              file=sys.stderr)
+    bad = [r for r in lines if 'error' in r]
+    return 1 if bad else 0
+
+
 BUILDERS = {1: build_config_1, 2: build_config_2, 3: build_config_3,
             4: build_config_4}
 
@@ -755,6 +996,13 @@ def run_all(args):
 
 
 def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    # internal child entries (spawned by run_multichip with the device
+    # count / AMTPU_MESH / resident knobs already in the env)
+    if argv[:1] == ['--multichip-child']:
+        return run_multichip_child(int(argv[1]))
+    if argv[:1] == ['--multichip-sp-child']:
+        return run_multichip_sp_child(int(argv[1]))
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument('--config', type=int,
                     default=env_int('AMTPU_BENCH_CONFIG', 3),
@@ -770,8 +1018,14 @@ def main(argv=None):
                     help='run every config in every mode (fresh '
                          'subprocess each) and write a JSON-lines '
                          'artifact (--out)')
+    ap.add_argument('--multichip', action='store_true',
+                    help='MULTICHIP artifact through the first-class '
+                         'mesh pool mode: one subprocess per dp '
+                         '(AMTPU_MULTICHIP_DP, default 1,2,4,8) + the '
+                         'sp-crossover probe; write with --out')
     ap.add_argument('--out', default='',
-                    help='with --all: artifact path (JSON lines)')
+                    help='with --all/--multichip: artifact path '
+                         '(JSON lines)')
     args = ap.parse_args(argv)
     # argparse skips the choices check for non-string DEFAULTS, so an
     # env-supplied AMTPU_BENCH_CONFIG needs explicit validation
@@ -780,6 +1034,8 @@ def main(argv=None):
                  % (args.config,))
     if args.all:
         return run_all(args)
+    if args.multichip:
+        return run_multichip(args)
     if args.mode == 'host':
         os.environ['AMTPU_HOST_FULL'] = '1'
     elif args.mode == 'kernel':

@@ -234,9 +234,12 @@ print('SHARDED-RESIDENT-OK')
 def test_sharded_resident_on_virtual_mesh():
     """The promoted sp path: the pool's default entry point shards the
     element axis over every local device (8 virtual CPU devices here)
-    with oracle-identical patches (VERDICT r2 #4)."""
+    with oracle-identical patches (VERDICT r2 #4).  AMTPU_MESH_SP_MIN
+    routes this tiny arena past the sp fence (ISSUE 7): the lane pins
+    the sharded kernel's PARITY, while the fence's routing policy has
+    its own lanes in test_meshpool.py."""
     env = dict(os.environ, JAX_PLATFORMS='cpu', AMTPU_RESIDENT='1',
-               AMTPU_RESIDENT_MIN='16',
+               AMTPU_RESIDENT_MIN='16', AMTPU_MESH_SP_MIN='16',
                XLA_FLAGS='--xla_force_host_platform_device_count=8')
     out = subprocess.run([sys.executable, '-c', SHARDED], env=env,
                          cwd=REPO, capture_output=True, text=True,

@@ -41,9 +41,18 @@ pin_cpu()
 import msgpack  # noqa: E402
 
 from automerge_tpu import faults, resilience, telemetry  # noqa: E402
-from automerge_tpu.native import NativeDocPool  # noqa: E402
+from automerge_tpu.native import NativeDocPool, make_pool  # noqa: E402
 
 ROOT_ID = '00000000-0000-0000-0000-000000000000'
+
+
+def _per_doc(raw):
+    """{doc: packed patch bytes} -- the chaos lanes compare per doc so
+    they hold for ANY configured pool (the mesh pool's shard merge is
+    doc-order-free; byte identity is per-doc, exactly what clients
+    see)."""
+    out = msgpack.unpackb(raw, raw=False, strict_map_key=False)
+    return {d: msgpack.packb(p, use_bin_type=True) for d, p in out.items()}
 
 
 def _config3_payload():
@@ -54,13 +63,13 @@ def _config3_payload():
     return msgpack.packb(keyed, use_bin_type=True), list(keyed)
 
 
-def lane_transient(payload, want_bytes, problems):
+def lane_transient(payload, want, problems):
     telemetry.metrics_reset()
     faults.reset('device.dispatch:transient:1.0:2')   # the env syntax
-    got = NativeDocPool().apply_batch_bytes_resilient(payload)
+    got = _per_doc(make_pool().apply_batch_bytes_resilient(payload))
     faults.disarm()
     snap = telemetry.metrics_snapshot()
-    if got != want_bytes:
+    if got != want:
         problems.append('transient lane: result bytes differ from the '
                         'fault-free run')
     if snap.get('resilience.retry.success', 0) < 1:
@@ -73,17 +82,17 @@ def lane_transient(payload, want_bytes, problems):
     return snap
 
 
-def lane_permanent(payload, want_bytes, doc_keys, problems):
+def lane_permanent(payload, want, doc_keys, problems):
     poison = doc_keys[len(doc_keys) // 2]
-    want = msgpack.unpackb(want_bytes, raw=False, strict_map_key=False)
     telemetry.metrics_reset()
     faults.arm('device.dispatch', 'permanent', 1.0, match=poison)
-    got = msgpack.unpackb(
-        NativeDocPool().apply_batch_bytes_resilient(payload),
+    got_raw = msgpack.unpackb(
+        make_pool().apply_batch_bytes_resilient(payload),
         raw=False, strict_map_key=False)
     faults.disarm()
     snap = telemetry.metrics_snapshot()
-    quarantined = [d for d in got if resilience.is_quarantined(got[d])]
+    quarantined = [d for d in got_raw
+                   if resilience.is_quarantined(got_raw[d])]
     if quarantined != [poison]:
         problems.append('permanent lane: quarantined %r (want exactly '
                         '[%r])' % (quarantined, poison))
@@ -91,8 +100,7 @@ def lane_permanent(payload, want_bytes, doc_keys, problems):
         problems.append('permanent lane: resilience.quarantined = %s '
                         '(want 1)' % snap.get('resilience.quarantined'))
     bad = [d for d in want if d != poison and
-           msgpack.packb(got[d], use_bin_type=True) !=
-           msgpack.packb(want[d], use_bin_type=True)]
+           msgpack.packb(got_raw[d], use_bin_type=True) != want[d]]
     if bad:
         problems.append('permanent lane: %d healthy docs lost parity '
                         '(e.g. %r)' % (len(bad), bad[0]))
@@ -139,10 +147,12 @@ def main():
     problems = []
     payload, doc_keys = _config3_payload()
     faults.disarm()
-    want_bytes = NativeDocPool().apply_batch_bytes(payload)
+    # fault-free reference from the plain serial pool: the configured
+    # pool (AMTPU_MESH included) must reproduce it per doc under faults
+    want = _per_doc(NativeDocPool().apply_batch_bytes(payload))
 
-    t_snap = lane_transient(payload, want_bytes, problems)
-    p_snap = lane_permanent(payload, want_bytes, doc_keys, problems)
+    t_snap = lane_transient(payload, want, problems)
+    p_snap = lane_permanent(payload, want, doc_keys, problems)
     restarts = lane_sidecar(problems)
 
     if problems:
