@@ -2,7 +2,7 @@
 
 .PHONY: all native test bench bench-all bench-tpu bench-multichip check \
 	clean wheel telemetry-check fallback-check perf-smoke chaos-check \
-	serve-check mesh-check
+	serve-check mesh-check static-check asan-check
 
 all: native
 
@@ -50,11 +50,13 @@ check: native
 	        % (r['mode'], r['value'], k['value']))"
 	JAX_PLATFORMS=cpu python -c "import __graft_entry__ as g; \
 	  g.dryrun_multichip(8); print('dryrun ok')"
+	$(MAKE) static-check
 	$(MAKE) fallback-check
 	$(MAKE) perf-smoke
 	$(MAKE) chaos-check
 	$(MAKE) serve-check
 	$(MAKE) mesh-check
+	$(MAKE) asan-check
 	@echo "CHECK GREEN"
 
 # Escalation-ladder gate (ISSUE 2): a config-4-shaped smoke on the
@@ -97,6 +99,24 @@ serve-check: native
 # device-independent and a wedged tunnel must not hang the gate.
 telemetry-check: native
 	JAX_PLATFORMS=cpu python tools/telemetry_check.py
+
+# Static-analysis gate (ISSUE 8, docs/ANALYSIS.md): the four
+# project-specific checkers -- env-latch spec/ABI/docs lockstep,
+# telemetry-key pre-seed + glossary lockstep, dispatch-alias (post-
+# dispatch mutation of jax-staged host buffers), lock-discipline
+# (`# guarded-by:` annotations) -- plus the generic ruff/pyflakes
+# baseline when installed.  Needs the native build: the env checker
+# cross-checks spec defaults against the amtpu_latch_defaults ABI.
+static-check: native
+	python tools/static_check.py
+
+# Native-sanitizer gate (ISSUE 8, docs/ANALYSIS.md): core.cpp rebuilt
+# with -fsanitize=address,undefined and driven by the native-heavy test
+# subset (driver + atomicity + differential) through AMTPU_NATIVE_LIB
+# with libasan LD_PRELOADed -- the batch-column use-after-free and OOB
+# classes every hardening round re-found by hand now fail CI.
+asan-check: native
+	JAX_PLATFORMS=cpu python tools/asan_check.py
 
 # Mesh-execution gate (ISSUE 7, docs/ARCHITECTURE.md mesh section):
 # MeshDocPool under AMTPU_MESH=4 must serve a mixed real workload with

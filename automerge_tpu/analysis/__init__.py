@@ -1,0 +1,27 @@
+"""automerge_tpu.analysis -- project-specific static analysis (ISSUE 8).
+
+Every hardening round since PR 4 re-caught the same four bug classes by
+manual review; this package turns them into CI failures (`make
+static-check`, docs/ANALYSIS.md):
+
+  * **env-latch** (`check_env`): one machine-readable spec of every
+    ``AMTPU_*`` flag (`env_spec.ENV_FLAGS`) cross-verified against the
+    call-site defaults, raw ``os.environ`` reads, the C++ ``getenv``
+    sites, the ``amtpu_latch_defaults`` ABI, the latch-flip-guard key
+    list, and the env rows in docs/OBSERVABILITY.md.
+  * **telemetry-key** (`check_telemetry`): every statically reachable
+    flat-counter key must be pre-seeded in its ``KNOWN_*_KEYS`` block
+    and documented; documented keys with no emit site are dead.
+  * **dispatch-alias** (`check_alias`): host numpy buffers handed to a
+    jax dispatch and then mutated in the same scope -- the PR-4/PR-6
+    zero-copy alias class.  `sanitize.py` is the runtime sibling
+    (``AMTPU_SANITIZE=1`` poisons staging buffers after dispatch).
+  * **lock-discipline** (`check_locks`): ``# guarded-by: <lock>``
+    attribute annotations enforced -- annotated attributes may only be
+    touched inside ``with <lock>``.
+
+The engine (`engine.py`) parses each file once and hands the shared
+sources to every checker; `tools/static_check.py` is the CLI.
+"""
+
+from .engine import Finding, run_checks  # noqa: F401

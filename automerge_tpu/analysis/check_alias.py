@@ -1,0 +1,286 @@
+"""dispatch-alias checker: post-dispatch mutation of staged host
+buffers (docs/ANALYSIS.md) -- the PR-4 / PR-6 regression class.
+
+jax zero-copies 64-byte-aligned numpy inputs on the CPU backend, and
+even `jnp.array`'s "copy" can defer past dispatch (measured on jax
+0.4.37), so a host array handed to an async dispatch is NOT reusable
+when the call returns: mutating it corrupts the in-flight computation.
+The safe idioms are a PRIVATE synchronous copy at the call site
+(`np.array(x)` / `x.copy()` / `np.ascontiguousarray(x)`) or simply
+never touching the buffer again.
+
+This checker flags, per function scope:
+
+  * a bare name passed to a dispatch-like call (`jnp.array`,
+    `jnp.asarray`, `device_put`, a jitted callable -- any `_jit*` /
+    `*jitted*` name, including `_jit_foo(...)(args)` factories) that is
+    later MUTATED in the same scope (`x[...] = ...`, `x += ...` on a
+    subscript, `x.fill/sort/put/partition/resize(...)`, `np.copyto(x,
+    ...)`, or an `out=x` keyword);
+  * thread-local staging reuse: an attribute read from a `*_tls` /
+    `*local*` holder passed to a dispatch without a private-copy wrap
+    (the tier-staging bug PR 4 fixed and PR 6 re-found).
+
+Rebinding (`x = ...`) releases the capture -- a fresh object is not the
+staged buffer.  A dispatch INSIDE a loop additionally flags mutations
+of its captured names anywhere in the same loop body, even on earlier
+lines: `for chunk: buf[:n] = chunk; jitted(tab, buf)` refills the
+buffer iteration k's async dispatch may still be reading (the exact
+PR-6 tier-staging shape) -- unless the name is rebound inside the loop
+body (a fresh buffer per iteration is safe by construction).
+`# static-ok: dispatch-alias` suppresses a reviewed line.  The runtime
+sibling is `analysis.sanitize` (AMTPU_SANITIZE=1), which poisons
+staging buffers after dispatch so any alias the static scan cannot see
+fails parity loudly in tests.
+"""
+
+import ast
+import re
+
+from .engine import Finding, register
+
+CHECKER = 'dispatch-alias'
+
+#: callee names (terminal identifier) treated as a device dispatch
+DISPATCH_NAMES = {'array', 'asarray', 'device_put', 'frombuffer'}
+#: terminal names counted as dispatch only when the VALUE is jnp/jax
+DISPATCH_MODULES = {'jnp', 'jax'}
+#: local callables that are jitted dispatches by convention
+JIT_NAME_RE = re.compile(r'(^_?jit)|jitted|dispatch$')
+#: safe private-copy wrappers at the call site
+COPY_WRAPPERS = {'array', 'copy', 'ascontiguousarray', 'copyto'}
+#: mutating method calls on a captured buffer
+MUTATING_METHODS = {'fill', 'sort', 'put', 'partition', 'resize',
+                    'setfield', 'itemset'}
+#: attribute holders that mark a value as thread-local staging
+TLS_NAME_RE = re.compile(r'(_tls|_local\b|threadlocal)', re.I)
+
+
+def _terminal_name(func):
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _is_dispatch_call(node):
+    """True when `node` (a Call) submits work to the device."""
+    func = node.func
+    name = _terminal_name(func)
+    if name is None:
+        # `_jit_row_scatter(donate)(tab, idx, rows)`: func is a Call
+        if isinstance(func, ast.Call):
+            inner = _terminal_name(func.func)
+            return inner is not None and bool(JIT_NAME_RE.search(inner))
+        return False
+    if isinstance(func, ast.Attribute):
+        base = func.value
+        base_name = base.id if isinstance(base, ast.Name) else None
+        if name in DISPATCH_NAMES:
+            # device_put is unambiguous on any base; the np-shared
+            # names (array/asarray/...) only count on jnp/jax
+            return base_name in DISPATCH_MODULES or name == 'device_put'
+        return bool(JIT_NAME_RE.search(name))
+    if name in DISPATCH_NAMES:
+        return False            # bare np-style array() is host work
+    return bool(JIT_NAME_RE.search(name))
+
+
+def _is_copy_wrapped(arg):
+    """np.array(x) / x.copy() / np.ascontiguousarray(x) at the call."""
+    if not isinstance(arg, ast.Call):
+        return False
+    name = _terminal_name(arg.func)
+    return name in COPY_WRAPPERS
+
+
+def _captured_names(node):
+    """Names a dispatch call captures: bare-Name positional args."""
+    out = []
+    for arg in node.args:
+        if isinstance(arg, ast.Name):
+            out.append(arg.id)
+    return out
+
+
+def _tls_args(node):
+    """Attribute args whose holder looks thread-local (self._tls.buf)."""
+    out = []
+    for arg in node.args:
+        if isinstance(arg, ast.Attribute):
+            chain = []
+            cur = arg
+            while isinstance(cur, ast.Attribute):
+                chain.append(cur.attr)
+                cur = cur.value
+            if isinstance(cur, ast.Name):
+                chain.append(cur.id)
+            if any(TLS_NAME_RE.search(part) for part in chain):
+                out.append(ast.unparse(arg))
+    return out
+
+
+def _scope_statements(fn):
+    """Every statement in the function in source order (nested defs
+    stay separate scopes and are walked on their own)."""
+    stmts = []
+
+    def walk(body):
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            stmts.append(stmt)
+            for field in ('body', 'orelse', 'finalbody', 'handlers'):
+                sub = getattr(stmt, field, None)
+                if sub:
+                    for h in sub:
+                        if isinstance(h, ast.excepthandler):
+                            walk(h.body)
+                    if not isinstance(sub[0], ast.excepthandler):
+                        walk(sub)
+    walk(fn.body)
+    return stmts
+
+
+def _mutations_of(stmt, name):
+    """Line numbers where `stmt` mutates the buffer bound to `name`."""
+    hits = []
+    for node in ast.walk(stmt):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Subscript) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == name:
+                    hits.append(node.lineno)
+        elif isinstance(node, ast.Call):
+            fname = _terminal_name(node.func)
+            if fname in MUTATING_METHODS \
+                    and isinstance(node.func, ast.Attribute) \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id == name:
+                hits.append(node.lineno)
+            elif fname == 'copyto' and node.args \
+                    and isinstance(node.args[0], ast.Name) \
+                    and node.args[0].id == name:
+                hits.append(node.lineno)
+            for kw in node.keywords:
+                if kw.arg == 'out' and isinstance(kw.value, ast.Name) \
+                        and kw.value.id == name:
+                    hits.append(node.lineno)
+    return hits
+
+
+def _rebound(stmt, name):
+    for node in ast.walk(stmt):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == name:
+                    return True
+        elif isinstance(node, ast.AugAssign):
+            if isinstance(node.target, ast.Name) \
+                    and node.target.id == name:
+                return True
+    return False
+
+
+def _enclosing_loops(fn):
+    """{loop_node: set(statements lexically inside it)} for every
+    for/while in `fn`'s own scope (nested defs excluded)."""
+    loops = {}
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not fn:
+            continue
+        if isinstance(node, (ast.For, ast.While)):
+            body = set()
+            for sub in ast.walk(node):
+                body.add(sub)
+            loops[node] = body
+    return loops
+
+
+def _bound_in(nodes, name):
+    """True when `name` is (re)bound by a plain assignment within the
+    node set -- a fresh object per iteration, not the staged buffer."""
+    for node in nodes:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == name:
+                    return True
+    return False
+
+
+def _check_function(src, fn, findings):
+    stmts = _scope_statements(fn)
+    loops = _enclosing_loops(fn)
+    # nested statements appear both via their parent (ast.walk) and as
+    # their own stmts entry, so findings dedupe on (code, site, line)
+    seen = set()
+
+    def emit(code, line, message):
+        key = (code, line, message)
+        if key not in seen:
+            seen.add(key)
+            findings.append(Finding(CHECKER, code, src.path, line,
+                                    message))
+
+    for i, stmt in enumerate(stmts):
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call) \
+                    or not _is_dispatch_call(node):
+                continue
+            for attr_src in _tls_args(node):
+                emit('tls-staging', node.lineno,
+                     'thread-local staging buffer %s passed to a '
+                     'dispatch without a private synchronous copy '
+                     '(np.array(...)) -- jax may still be reading it '
+                     'when the slot is reused' % attr_src)
+            for name in _captured_names(node):
+                released = False
+                for later in stmts[i:]:
+                    if later.lineno < node.lineno:
+                        continue
+                    for mline in _mutations_of(later, name):
+                        if mline > node.lineno and not released:
+                            emit('post-dispatch-mutation', mline,
+                                 '%r was passed to a dispatch at line '
+                                 '%d and is mutated here -- jax may '
+                                 'alias the buffer past dispatch; hand '
+                                 'the call np.array(%s) or drop the '
+                                 'mutation' % (name, node.lineno, name))
+                    if later.lineno > node.lineno \
+                            and _rebound(later, name):
+                        released = True
+                        break
+                # dispatch inside a loop: a refill ANYWHERE in the same
+                # loop body mutates the buffer an earlier iteration's
+                # async dispatch may still read -- unless the name is
+                # rebound fresh inside the loop
+                for loop, body in loops.items():
+                    if node not in body or _bound_in(body, name):
+                        continue
+                    for body_stmt in loop.body:
+                        for mline in _mutations_of(body_stmt, name):
+                            if mline <= node.lineno:
+                                emit('loop-staging-reuse', mline,
+                                     '%r is refilled here and '
+                                     'dispatched at line %d inside the '
+                                     'same loop -- iteration k+1\'s '
+                                     'fill races iteration k\'s async '
+                                     'dispatch; allocate a fresh '
+                                     'buffer per iteration or hand the '
+                                     'dispatch np.array(%s)'
+                                     % (name, node.lineno, name))
+
+
+@register(CHECKER)
+def check(sources, ctx):
+    findings = []
+    for src in sources:
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _check_function(src, node, findings)
+    return findings

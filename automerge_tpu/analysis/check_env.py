@@ -1,0 +1,303 @@
+"""env-latch checker: AMTPU_* flag discipline (docs/ANALYSIS.md).
+
+Cross-verifies `env_spec.ENV_FLAGS` against five surfaces:
+
+  1. **call sites** -- every `env_int/env_float/env_bool/env_str/
+     env_raw('AMTPU_X', default)` call in the package must name a spec
+     flag, use the helper matching the spec type, and pass the spec
+     default (literals and same-module integer constants resolve);
+  2. **raw reads** -- `os.environ` / `os.getenv` touching an AMTPU key
+     anywhere but `utils/common.py` is a violation (that module IS the
+     helper layer);
+  3. **C++** -- `getenv("AMTPU_X")` sites in native/core.cpp must be
+     spec flags naming core.cpp as a consumer, and vice versa;
+  4. **the latch ABI + flip guard** -- spec rows marked `latched` must
+     exactly match `native._RESIDENT_LATCH_KEYS` (the PR-6/7 flip
+     guard), and the numeric latch defaults must match what the built
+     library's `amtpu_latch_defaults` reports;
+  5. **docs** -- every spec flag needs a row in docs/OBSERVABILITY.md's
+     env-variable table, and every AMTPU token in that table must be a
+     spec flag (harness-prefix knobs excepted).
+"""
+
+import ast
+import ctypes
+import os
+import re
+
+from .engine import Finding, register
+from .env_spec import (ABI_LATCH_DEFAULTS, HARNESS_PREFIXES, SPEC)
+
+CHECKER = 'env-latch'
+
+#: helper name -> spec type it serves (underscore-prefixed aliases from
+#: `from ..utils.common import env_float as _env_float` included)
+HELPER_TYPES = {'env_int': 'int', 'env_float': 'float',
+                'env_bool': 'bool', 'env_str': 'str', 'env_raw': 'raw'}
+
+#: modules allowed to touch os.environ for AMTPU keys: the helper layer
+#: itself (env_* + parse_mesh_env)
+RAW_READ_ALLOWED = ('utils/common.py',)
+
+
+def _terminal_name(func):
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _module_int_constants(tree):
+    """{NAME: int} for simple module-level integer constants -- resolves
+    defaults like env_int('AMTPU_MAX_TIER', DEFAULT_MAX_TIER)."""
+    out = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, (int, float)) \
+                and not isinstance(node.value.value, bool):
+            out[node.targets[0].id] = node.value.value
+    return out
+
+
+def _is_environ(node):
+    """True for the expression `os.environ`."""
+    return (isinstance(node, ast.Attribute) and node.attr == 'environ'
+            and isinstance(node.value, ast.Name)
+            and node.value.id == 'os')
+
+
+def _amtpu_key(node):
+    """The literal AMTPU_* key of an expression, else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+            and node.value.startswith('AMTPU_'):
+        return node.value
+    return None
+
+
+def _check_helper_calls(src, findings):
+    consts = _module_int_constants(src.tree)
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _terminal_name(node.func)
+        helper = HELPER_TYPES.get((name or '').lstrip('_'))
+        if helper is None:
+            continue
+        # positional or keyword spellings both count (env_int('X', 7),
+        # env_int(name='X', default=7), env_int('X', default=7))
+        kw = {k.arg: k.value for k in node.keywords if k.arg}
+        args = list(node.args)
+        key_node = args[0] if args else kw.get('name')
+        dflt_node = args[1] if len(args) > 1 else kw.get('default')
+        if key_node is None:
+            continue
+        key = _amtpu_key(key_node)
+        if key is None:
+            continue
+        flag = SPEC.get(key)
+        if flag is None:
+            findings.append(Finding(
+                CHECKER, 'unknown-flag', src.path, node.lineno,
+                '%s is not in env_spec.ENV_FLAGS -- register it (and '
+                'its OBSERVABILITY.md row) before reading it' % key))
+            continue
+        if helper == 'raw' or flag.type == 'special':
+            # env_raw imposes no default/type semantics, so it is legal
+            # for any flag (diagnostics, latch snapshots); parse_mesh_env
+            # owns the 'special' flags
+            continue
+        if flag.type != helper:
+            findings.append(Finding(
+                CHECKER, 'type-drift', src.path, node.lineno,
+                '%s is a %r flag but is read through env_%s'
+                % (key, flag.type, helper)))
+            continue
+        if dflt_node is None:
+            continue
+        dflt = dflt_node
+        value = None
+        if isinstance(dflt, ast.Constant):
+            value = dflt.value
+        elif isinstance(dflt, ast.Name) and dflt.id in consts:
+            value = consts[dflt.id]
+        elif isinstance(dflt, ast.UnaryOp) \
+                and isinstance(dflt.op, ast.USub) \
+                and isinstance(dflt.operand, ast.Constant):
+            value = -dflt.operand.value
+        else:
+            continue          # computed default: the spec can't compare
+        if value != flag.default or (isinstance(value, bool)
+                                     != isinstance(flag.default, bool)):
+            findings.append(Finding(
+                CHECKER, 'default-drift', src.path, node.lineno,
+                '%s call-site default %r != spec default %r'
+                % (key, value, flag.default)))
+
+
+def _check_raw_reads(src, findings):
+    allowed = src.relpath.replace(os.sep, '/').endswith(RAW_READ_ALLOWED)
+    if allowed:
+        return
+    for node in ast.walk(src.tree):
+        key = None
+        if isinstance(node, ast.Subscript) and _is_environ(node.value):
+            key = _amtpu_key(node.slice)
+        elif isinstance(node, ast.Call):
+            name = _terminal_name(node.func)
+            if name == 'get' and isinstance(node.func, ast.Attribute) \
+                    and _is_environ(node.func.value) and node.args:
+                key = _amtpu_key(node.args[0])
+            elif name == 'getenv' and isinstance(node.func, ast.Attribute) \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id == 'os' and node.args:
+                key = _amtpu_key(node.args[0])
+        if key is not None:
+            findings.append(Finding(
+                CHECKER, 'direct-read', src.path, node.lineno,
+                'direct os.environ read of %s -- route it through the '
+                'utils/common env helpers' % key))
+
+
+def _parse_latch_guard(sources):
+    """The `_RESIDENT_LATCH_KEYS` tuple from native/__init__.py."""
+    for src in sources:
+        if not src.relpath.replace(os.sep, '/').endswith(
+                'native/__init__.py'):
+            continue
+        for node in src.tree.body:
+            if isinstance(node, ast.Assign) \
+                    and any(isinstance(t, ast.Name)
+                            and t.id == '_RESIDENT_LATCH_KEYS'
+                            for t in node.targets) \
+                    and isinstance(node.value, (ast.Tuple, ast.List)):
+                keys = [e.value for e in node.value.elts
+                        if isinstance(e, ast.Constant)]
+                return src, node.lineno, keys
+    return None, 0, None
+
+
+def _check_latch_guard(sources, findings):
+    src, lineno, guard = _parse_latch_guard(sources)
+    if guard is None:
+        findings.append(Finding(
+            CHECKER, 'guard-missing', '<native/__init__.py>', 0,
+            'could not locate _RESIDENT_LATCH_KEYS'))
+        return
+    spec_latched = {f.name for f in SPEC.values() if f.latched}
+    for key in sorted(spec_latched - set(guard)):
+        findings.append(Finding(
+            CHECKER, 'unguarded-latch', src.path, lineno,
+            '%s is a first-batch latch in env_spec but missing from '
+            '_RESIDENT_LATCH_KEYS -- post-batch flips would be '
+            'silently ignored' % key))
+    for key in sorted(set(guard) - spec_latched):
+        findings.append(Finding(
+            CHECKER, 'guard-drift', src.path, lineno,
+            '%s is in _RESIDENT_LATCH_KEYS but env_spec does not mark '
+            'it latched' % key))
+
+
+def _check_cpp(ctx, findings):
+    cpp_path = os.path.join(ctx.root, 'native', 'core.cpp')
+    try:
+        with open(cpp_path, encoding='utf-8') as f:
+            cpp = f.read()
+    except OSError:
+        return
+    seen = set()
+    for m in re.finditer(r'getenv\("(AMTPU_[A-Z0-9_]+)"\)', cpp):
+        key = m.group(1)
+        seen.add(key)
+        line = cpp.count('\n', 0, m.start()) + 1
+        flag = SPEC.get(key)
+        if flag is None:
+            findings.append(Finding(
+                CHECKER, 'unknown-flag', cpp_path, line,
+                'C++ getenv(%s) is not in env_spec.ENV_FLAGS' % key))
+        elif 'core.cpp' not in flag.consumer:
+            findings.append(Finding(
+                CHECKER, 'consumer-drift', cpp_path, line,
+                '%s is read by core.cpp but its spec row does not name '
+                'core.cpp as a consumer' % key))
+    for flag in SPEC.values():
+        if 'core.cpp' in flag.consumer and flag.name not in seen:
+            findings.append(Finding(
+                CHECKER, 'consumer-drift', cpp_path, 1,
+                'env_spec names core.cpp as a consumer of %s but '
+                'core.cpp never reads it' % flag.name))
+
+
+def _check_abi_defaults(ctx, findings):
+    lib_path = os.path.join(ctx.root, 'automerge_tpu', 'native',
+                            'libamtpu_core.so')
+    if not os.path.exists(lib_path):
+        findings.append(Finding(
+            CHECKER, 'abi-unavailable', lib_path, 0,
+            'libamtpu_core.so is not built -- run `make native` first '
+            '(the latch-default cross-check needs the ABI)'))
+        return
+    lib = ctypes.CDLL(lib_path)
+    out = (ctypes.c_int64 * len(ABI_LATCH_DEFAULTS))()
+    lib.amtpu_latch_defaults(out)
+    for i, name in enumerate(ABI_LATCH_DEFAULTS):
+        if int(out[i]) != SPEC[name].default:
+            findings.append(Finding(
+                CHECKER, 'abi-drift', lib_path, 0,
+                'amtpu_latch_defaults reports %s=%d but env_spec says '
+                '%r -- core.cpp and the spec drifted'
+                % (name, int(out[i]), SPEC[name].default)))
+
+
+def _env_table_tokens(ctx):
+    """AMTPU tokens in OBSERVABILITY.md's env-variable table, with the
+    table's starting line."""
+    text = ctx.doc_text('docs/OBSERVABILITY.md')
+    m = re.search(r'^## Environment variables$', text, re.M)
+    if not m:
+        return None, 0
+    start_line = text.count('\n', 0, m.start()) + 1
+    section = text[m.end():]
+    nxt = re.search(r'^## ', section, re.M)
+    if nxt:
+        section = section[:nxt.start()]
+    tokens = set(re.findall(r'AMTPU_[A-Z0-9_]+', section))
+    return tokens, start_line
+
+
+def _check_docs(ctx, findings):
+    doc_path = os.path.join(ctx.root, 'docs', 'OBSERVABILITY.md')
+    tokens, line = _env_table_tokens(ctx)
+    if tokens is None:
+        findings.append(Finding(
+            CHECKER, 'docs-missing', doc_path, 0,
+            'docs/OBSERVABILITY.md has no "## Environment variables" '
+            'section'))
+        return
+    for name in sorted(SPEC):
+        if name not in tokens:
+            findings.append(Finding(
+                CHECKER, 'undocumented-flag', doc_path, line,
+                '%s (consumer: %s) has no row in the OBSERVABILITY.md '
+                'env table' % (name, SPEC[name].consumer)))
+    for tok in sorted(tokens - set(SPEC)):
+        if not tok.startswith(HARNESS_PREFIXES):
+            findings.append(Finding(
+                CHECKER, 'dead-doc-row', doc_path, line,
+                '%s is documented in the env table but is not a spec '
+                'flag (stale row, or register it in env_spec)' % tok))
+
+
+@register(CHECKER)
+def check(sources, ctx):
+    findings = []
+    for src in sources:
+        _check_helper_calls(src, findings)
+        _check_raw_reads(src, findings)
+    _check_latch_guard(sources, findings)
+    _check_cpp(ctx, findings)
+    _check_abi_defaults(ctx, findings)
+    _check_docs(ctx, findings)
+    return findings

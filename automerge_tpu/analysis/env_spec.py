@@ -1,0 +1,134 @@
+"""The ONE machine-readable spec of every ``AMTPU_*`` environment flag.
+
+Each flag records its type (which `utils/common` helper reads it), its
+default (cross-checked against the literal at every call site AND, for
+the C++ latches, against the ``amtpu_latch_defaults`` ABI), whether it
+LATCHES at the process's first batch (cross-checked against
+``native._RESIDENT_LATCH_KEYS`` -- the flip-guard list), and its
+consumers.  `check_env` fails `make static-check` when any of those
+drifts, and when a flag here is missing from the env-variable table in
+docs/OBSERVABILITY.md (or vice versa).
+
+Registering a new flag (docs/ANALYSIS.md has the walkthrough):
+  1. add an `EnvFlag` row here;
+  2. read it ONLY through the `utils/common` helper matching its type;
+  3. add its row to docs/OBSERVABILITY.md's env table;
+  4. if it latches at first batch, add it to `_RESIDENT_LATCH_KEYS`.
+`make static-check` verifies you did all four.
+"""
+
+import collections
+
+#: type -> the utils/common helper that must read it.  `raw` flags are
+#: tri-state (consumers distinguish unset from any value); `special`
+#: flags have a dedicated parser (AMTPU_MESH -> parse_mesh_env).
+EnvFlag = collections.namedtuple(
+    'EnvFlag', ('name', 'type', 'default', 'latched', 'consumer'))
+
+ENV_FLAGS = (
+    # -- observability ------------------------------------------------------
+    EnvFlag('AMTPU_TRACE', 'bool', False, False, 'telemetry/spans.py'),
+    EnvFlag('AMTPU_TRACE_FILE', 'str', '', False, 'telemetry/spans.py'),
+    EnvFlag('AMTPU_DEVTIME', 'bool', False, False, 'telemetry/__init__.py'),
+    EnvFlag('AMTPU_DEGRADED_WINDOW_S', 'float', 300.0, False,
+            'telemetry/__init__.py'),
+    EnvFlag('AMTPU_SIDECAR_RESTARTS', 'int', 0, False,
+            'telemetry/__init__.py (exported by sidecar/client.py)'),
+    EnvFlag('AMTPU_METRICS_PORT', 'int', -1, False, 'sidecar/server.py'),
+    EnvFlag('AMTPU_METRICS_HOST', 'str', '127.0.0.1', False,
+            'sidecar/server.py'),
+    # -- kernel path --------------------------------------------------------
+    EnvFlag('AMTPU_PACKED_EPILOGUE', 'bool', True, False,
+            'native/__init__.py'),
+    EnvFlag('AMTPU_CONF_DENSE_THRESH', 'int', 4, False,
+            'native/__init__.py'),
+    EnvFlag('AMTPU_HOST_DOM', 'raw', None, False, 'native/__init__.py'),
+    EnvFlag('AMTPU_HOST_FULL', 'raw', None, False,
+            'native/__init__.py, native/mesh_pool.py'),
+    EnvFlag('AMTPU_HOST_REG', 'bool', True, False, 'native/__init__.py'),
+    EnvFlag('AMTPU_WEFF', 'raw', None, False,
+            'native/__init__.py (test-only window narrowing)'),
+    EnvFlag('AMTPU_SHARD_MODE', 'str', '', False, 'native/__init__.py'),
+    EnvFlag('AMTPU_NO_PALLAS', 'bool', False, False,
+            'ops/pallas_common.py'),
+    EnvFlag('AMTPU_ESCALATE', 'bool', True, False, 'ops/registers.py'),
+    EnvFlag('AMTPU_MAX_TIER', 'int', 1024, False, 'ops/registers.py'),
+    EnvFlag('AMTPU_ESCALATE_BUDGET_MB', 'int', -1, False,
+            'ops/registers.py (unset -> built-in 256MB; explicit 0 '
+            'forces the oracle)'),
+    EnvFlag('AMTPU_ESC_CHUNK', 'int', 32768, False, 'ops/registers.py'),
+    EnvFlag('AMTPU_DEVICE_MERGE', 'bool', True, False, 'ops/registers.py'),
+    EnvFlag('AMTPU_PIPELINE_DEPTH', 'int', 2, False, 'native/__init__.py'),
+    EnvFlag('AMTPU_PIPELINE_MIN_DOCS', 'int', 64, False,
+            'native/__init__.py'),
+    EnvFlag('AMTPU_NATIVE_LIB', 'str', '', False,
+            'native/__init__.py (alternate .so path; the asan gate)'),
+    # -- resident-state latches (C++ statics; bind at first batch) ----------
+    EnvFlag('AMTPU_RESIDENT', 'raw', None, True,
+            'native/__init__.py, native/core.cpp'),
+    EnvFlag('AMTPU_RESIDENT_MIN', 'int', 16384, True, 'native/core.cpp'),
+    EnvFlag('AMTPU_RESIDENT_CLK', 'raw', None, True, 'native/core.cpp'),
+    EnvFlag('AMTPU_RESCLK_MAX_ACTORS', 'int', 512, True,
+            'native/core.cpp'),
+    EnvFlag('AMTPU_RESCLK_MAX_ROWS', 'int', 1048576, True,
+            'native/core.cpp'),
+    EnvFlag('AMTPU_TRIVIAL_HOST', 'bool', True, True, 'native/core.cpp'),
+    EnvFlag('AMTPU_TRACE_BEGIN', 'raw', None, False,
+            'native/core.cpp (per-begin debug trace)'),
+    # -- mesh ---------------------------------------------------------------
+    EnvFlag('AMTPU_MESH', 'special', None, True,
+            'utils/common.py parse_mesh_env (factory + fence + guard)'),
+    EnvFlag('AMTPU_MESH_SP_MIN', 'int', 131072, False,
+            'native/resident.py (default SP_CROSSOVER_ELEMS)'),
+    EnvFlag('AMTPU_MESH_CONNECT_DEADLINE_S', 'float', 60, False,
+            'sync/distributed.py'),
+    # -- resilience / faults ------------------------------------------------
+    EnvFlag('AMTPU_RESILIENCE', 'bool', True, False, 'resilience.py'),
+    EnvFlag('AMTPU_RETRY_MAX', 'int', 3, False, 'resilience.py'),
+    EnvFlag('AMTPU_RETRY_BACKOFF_S', 'float', 0.05, False,
+            'resilience.py'),
+    EnvFlag('AMTPU_DEGRADE', 'bool', False, False, 'resilience.py'),
+    EnvFlag('AMTPU_FAULT', 'str', '', False, 'faults.py'),
+    EnvFlag('AMTPU_FAULT_SEED', 'raw', None, False, 'faults.py'),
+    # -- sidecar client -----------------------------------------------------
+    EnvFlag('AMTPU_WAL_COMPACT', 'int', 32, False, 'sidecar/client.py'),
+    EnvFlag('AMTPU_SIDECAR_DEADLINE_S', 'float', 0, False,
+            'sidecar/client.py (0 -> no deadline)'),
+    EnvFlag('AMTPU_SIDECAR_HEARTBEAT_S', 'float', 0, False,
+            'sidecar/client.py (0 -> no heartbeat)'),
+    EnvFlag('AMTPU_SIDECAR_MAX_RESPAWNS', 'int', 3, False,
+            'sidecar/client.py'),
+    EnvFlag('AMTPU_SIDECAR_RESPAWN_DEADLINE_S', 'float', 30.0, False,
+            'sidecar/client.py'),
+    # -- serve gateway ------------------------------------------------------
+    EnvFlag('AMTPU_GATEWAY', 'bool', True, False, 'sidecar/server.py'),
+    EnvFlag('AMTPU_FLUSH_DEADLINE_MS', 'float', 2.0, False,
+            'scheduler/queue.py'),
+    EnvFlag('AMTPU_MAX_BATCH_DOCS', 'int', 256, False,
+            'scheduler/queue.py'),
+    EnvFlag('AMTPU_MAX_BATCH_OPS', 'int', 2048, False,
+            'scheduler/queue.py'),
+    EnvFlag('AMTPU_QUEUE_MAX_OPS', 'int', 4096, False,
+            'scheduler/queue.py'),
+    EnvFlag('AMTPU_QUEUE_LOW_FRAC', 'float', 0.5, False,
+            'scheduler/queue.py'),
+    # -- analysis / sanitizer ----------------------------------------------
+    EnvFlag('AMTPU_SANITIZE', 'bool', False, False,
+            'analysis/sanitize.py (poisons staging buffers post-dispatch)'),
+)
+
+SPEC = {f.name: f for f in ENV_FLAGS}
+
+#: the three numeric C++ latch defaults exposed through the
+#: `amtpu_latch_defaults` ABI, in ABI order -- check_env compares the
+#: spec rows against the built library so a core.cpp constant bump
+#: cannot drift past this table (or the flip guard reading the ABI)
+ABI_LATCH_DEFAULTS = ('AMTPU_RESIDENT_MIN', 'AMTPU_RESCLK_MAX_ACTORS',
+                      'AMTPU_RESCLK_MAX_ROWS')
+
+#: bench/tools harness knob families: allowed in the docs env table and
+#: in harness code without individual spec rows (they configure the
+#: measurement harnesses, not the serving process)
+HARNESS_PREFIXES = ('AMTPU_BENCH_', 'AMTPU_TCHECK_', 'AMTPU_MESHCHECK_',
+                    'AMTPU_MC_', 'AMTPU_MULTICHIP_', 'AMTPU_DRYRUN_',
+                    'AMTPU_SMOKE_')

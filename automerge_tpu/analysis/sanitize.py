@@ -1,0 +1,77 @@
+"""Runtime alias sanitizer (``AMTPU_SANITIZE=1``; docs/ANALYSIS.md).
+
+The static dispatch-alias checker sees lexical reuse; this is the
+dynamic net for everything else.  It generalizes the hostile-mutation
+wrapper tests/test_resident.py pins the wave pipeline with: every
+staging buffer a dispatch site hands to jax is POISONED (filled with a
+sentinel) the moment the dispatch returns.  The dispatch contract says
+jax received either a private copy or a buffer nobody will touch
+again, so poisoning is invisible -- unless some path aliased a
+caller-visible array into the async computation, in which case the
+in-flight kernel reads sentinel garbage and the parity/fuzz lanes fail
+LOUDLY instead of shipping silent corruption (exactly how the PR-4 and
+PR-6 alias bugs would have surfaced at CI time).
+
+Usage at a staging call site (wired today through the pool-resident
+delta scatter, `native/batch_resident.py` -- the one dispatch whose
+contract is "jax received private copies"; the escalation tier staging
+hands its fresh buffers OVER to jax instead, so poisoning there would
+corrupt legitimately aliased memory):
+
+    out = jitted(tab, np.array(idx), np.array(rows))
+    sanitize.poison(idx, rows)      # no-op unless AMTPU_SANITIZE=1
+    return out
+
+`poison` costs one module-attribute check when disarmed (the
+trace.ENABLED shim pattern), so it is free on the hot path.
+"""
+
+import numpy as np
+
+from ..utils.common import env_bool
+
+#: sentinel byte pattern: 0x5B per byte -> int32 0x5B5B5B5B, a value no
+#: workload emits, so corrupted output is unmistakable in a diff
+POISON_BYTE = 0x5B
+
+#: armed flag, refreshed from AMTPU_SANITIZE at import and via
+#: refresh() -- tests arm it per subprocess
+ARMED = env_bool('AMTPU_SANITIZE', False)
+
+_poisoned = 0
+
+
+def refresh():
+    """Re-reads AMTPU_SANITIZE (subprocess lanes set it before import;
+    in-process tests flip the env then call this)."""
+    global ARMED
+    ARMED = env_bool('AMTPU_SANITIZE', False)
+    return ARMED
+
+
+def poison(*arrays):
+    """Overwrites each writable numpy array with the sentinel pattern
+    when armed.  Call it on the HOST staging buffers right after the
+    dispatch that consumed them returns."""
+    if not ARMED:
+        return
+    global _poisoned
+    n = 0
+    for a in arrays:
+        if isinstance(a, np.ndarray) and a.flags.writeable and a.size:
+            if a.flags.c_contiguous:
+                a.view(np.uint8).fill(POISON_BYTE)
+            else:
+                # strided view: byte reinterpretation is illegal; the
+                # elementwise sentinel still poisons every slot
+                a.fill(POISON_BYTE)
+            n += 1
+    if n:
+        _poisoned += n
+        from .. import trace
+        trace.count('sanitize.poisoned_buffers', n)
+
+
+def poisoned_count():
+    """Total buffers poisoned since import (test observability)."""
+    return _poisoned

@@ -39,11 +39,11 @@ no call, no dict lookup.  ``make perf-smoke`` / ``make fallback-check``
 run with the hooks in place and gate that the fast paths are unchanged.
 """
 
-import os
 import random
 import threading
 
 from . import telemetry
+from .utils.common import env_raw, env_str
 
 #: the site universe -- arm() rejects anything else so a typo'd env spec
 #: fails loudly instead of never firing
@@ -153,8 +153,8 @@ def load_env(value=None):
     each spec.  A malformed spec raises (a chaos run with a typo'd fault
     must not silently test nothing)."""
     if value is None:
-        value = os.environ.get('AMTPU_FAULT', '')
-    seed = os.environ.get('AMTPU_FAULT_SEED')
+        value = env_str('AMTPU_FAULT', '')
+    seed = env_raw('AMTPU_FAULT_SEED')
     if seed:
         _rng.seed(seed)
     for part in filter(None, (p.strip() for p in value.split(','))):

@@ -22,13 +22,18 @@ import msgpack
 import numpy as np
 
 from .. import faults, telemetry, trace
-from ..utils.common import doc_key, env_int, parse_mesh_env
+from ..utils.common import (doc_key, env_bool, env_int, env_raw, env_str,
+                            parse_mesh_env)
 from ..utils.wire import map_header as _map_header
 from ..utils.wire import read_map_header as _read_map_header
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(os.path.dirname(os.path.dirname(_DIR)), 'native')
-_LIB_PATH = os.path.join(_DIR, 'libamtpu_core.so')
+# AMTPU_NATIVE_LIB loads an alternate build of the SAME ABI -- the asan
+# gate (tools/asan_check.py) points it at the -fsanitize=address,
+# undefined .so; an override is trusted as-is (no mtime rebuild)
+_LIB_OVERRIDE = env_str('AMTPU_NATIVE_LIB', '')
+_LIB_PATH = _LIB_OVERRIDE or os.path.join(_DIR, 'libamtpu_core.so')
 
 
 def _build():
@@ -37,10 +42,10 @@ def _build():
 
 
 def _load():
-    if not os.path.exists(_LIB_PATH) or (
+    if not _LIB_OVERRIDE and (not os.path.exists(_LIB_PATH) or (
             os.path.exists(os.path.join(_SRC, 'core.cpp')) and
             os.path.getmtime(os.path.join(_SRC, 'core.cpp')) >
-            os.path.getmtime(_LIB_PATH)):
+            os.path.getmtime(_LIB_PATH))):
         _build()
     lib = ctypes.CDLL(_LIB_PATH)
     lib.amtpu_pool_new.restype = ctypes.c_void_p
@@ -287,7 +292,7 @@ def _packed_epilogue_on():
     """AMTPU_PACKED_EPILOGUE=0 forces the full-matrix member epilogue
     (the pre-packed readback path, kept as the parity A/B arm); default
     on.  Checked per batch, not latched."""
-    return os.environ.get('AMTPU_PACKED_EPILOGUE', '1') not in ('', '0')
+    return env_bool('AMTPU_PACKED_EPILOGUE', True)
 
 
 def _conf_dense_thresh():
@@ -295,10 +300,7 @@ def _conf_dense_thresh():
     nothing once `conf_rows * thresh > Tp` -- transfer the whole matrix
     and slice host-side instead.  AMTPU_CONF_DENSE_THRESH overrides the
     default factor 4 (0 disables the dense path entirely)."""
-    try:
-        return int(os.environ.get('AMTPU_CONF_DENSE_THRESH', '4'))
-    except ValueError:
-        return 4
+    return env_int('AMTPU_CONF_DENSE_THRESH', 4)
 
 
 def _ctx_ready(ctx):
@@ -512,7 +514,7 @@ def _host_dom_on():
     single-big-doc latency.  Default: host path on CPU, device path on
     accelerators; AMTPU_HOST_DOM=1/0 forces either way (checked per
     batch, not latched)."""
-    env = os.environ.get('AMTPU_HOST_DOM')
+    env = env_raw('AMTPU_HOST_DOM')
     if env is not None:
         return env not in ('', '0')
     import jax
@@ -576,7 +578,7 @@ def _latch_snapshot():
     * AMTPU_MESH compares as the normalized (dp, sp) the pool factory
       parses (malformed values compare raw -- they never built a
       mesh)."""
-    raw = tuple(os.environ.get(k) for k in _RESIDENT_LATCH_KEYS)
+    raw = tuple(env_raw(k) for k in _RESIDENT_LATCH_KEYS)
     res, rmin, clk, amax, arows, triv, mesh = raw
     clk_src = clk if clk is not None else res
     d_rmin, d_amax, d_arows = _latch_defaults()
@@ -637,12 +639,12 @@ def _host_full_on():
     point of the framework); a forced AMTPU_RESIDENT=1 also keeps it,
     so the resident tests and the multichip dryrun still drive the
     device-resident dispatch on CPU.  AMTPU_HOST_FULL=1/0 forces."""
-    env = os.environ.get('AMTPU_HOST_FULL')
+    env = env_raw('AMTPU_HOST_FULL')
     if env is not None:
         return env not in ('', '0')
     # any truthy AMTPU_RESIDENT forces the resident kernel path -- same
     # parse as the C++ gate (atoi != 0), not just the literal '1'
-    res = os.environ.get('AMTPU_RESIDENT')
+    res = env_raw('AMTPU_RESIDENT')
     if res is not None and res not in ('', '0'):
         return False
     import jax
@@ -971,7 +973,7 @@ class NativeDocPool:
                 weff = 2
                 while weff < max_group:
                     weff *= 2
-            wenv = os.environ.get('AMTPU_WEFF')
+            wenv = env_raw('AMTPU_WEFF')
             if wenv and not use_members:
                 # test-only: force a narrower window so the overflow
                 # branch is REACHABLE (the dynamic sizing above makes
@@ -1006,8 +1008,7 @@ class NativeDocPool:
             # the canonical CPU case.
             from ..ops.registers import escalation_enabled
             if (use_members and n_blocks == 0 and 2 * pre_ovf >= T
-                    and os.environ.get('AMTPU_HOST_REG', '1')
-                    not in ('', '0')
+                    and env_bool('AMTPU_HOST_REG', True)
                     and (not escalation_enabled()
                          or self._backend_is_cpu())):
                 trace.count('hostreg.batches')
@@ -1203,7 +1204,7 @@ class NativeDocPool:
         # Default: on for accelerators, off for CPU; AMTPU_RESIDENT=1/0
         # overrides either way (C++ skips its O(arena) layout fills
         # optimistically and refills lazily when Python declines).
-        env = os.environ.get('AMTPU_RESIDENT')
+        env = env_raw('AMTPU_RESIDENT')
         if env is None:
             import jax
             if jax.default_backend() == 'cpu':
@@ -2021,7 +2022,7 @@ class ShardedNativePool:
     def resolve_mode(mode=None):
         cores = os.cpu_count() or 1
         if mode is None:
-            mode = os.environ.get('AMTPU_SHARD_MODE', '')
+            mode = env_str('AMTPU_SHARD_MODE', '')
         if not mode:
             mode = 'pipeline' if cores == 1 else 'threads'
         if mode not in ('pipeline', 'threads'):
@@ -2059,12 +2060,15 @@ class ShardedNativePool:
         # host with a wedged device tunnel that can block indefinitely,
         # and merely CONSTRUCTING a pool must never hang (same lazy
         # convention as NativeDocPool._ensure_mode_flags)
-        self._n_shards = n_shards
-        self._pools = None
+        self._n_shards = n_shards        # guarded-by(w): self._pools_lock
+        self._pools = None               # guarded-by(w): self._pools_lock
         # materialization lock: ANY entry point may be the first to touch
         # the lazy properties from concurrent threads; without it two
         # racers could each build a pool list and apply shards to pools
-        # the losing assignment discards
+        # the losing assignment discards.  Reads stay lock-free (the
+        # double-checked publish pattern: a reference load is atomic
+        # under the GIL), so the guarded-by annotation covers WRITES --
+        # `make static-check` enforces it (docs/ANALYSIS.md).
         import threading
         self._pools_lock = threading.Lock()
 

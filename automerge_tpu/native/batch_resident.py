@@ -36,6 +36,7 @@ from functools import lru_cache
 import numpy as np
 
 from .. import trace
+from ..analysis import sanitize
 from .resident import _bucket_pow2
 
 
@@ -63,7 +64,14 @@ def _jit_row_scatter(donate):
         # computation PRIVATE synchronous host copies instead: jax may
         # alias them freely because no caller ever sees them, so the
         # staging arrays are reusable the moment dispatch returns.
-        return jitted(tab, np.array(idx), np.array(rows))
+        out = jitted(tab, np.array(idx), np.array(rows))
+        # AMTPU_SANITIZE=1: poison the caller-visible staging arrays the
+        # moment dispatch returns -- if the private-copy contract above
+        # ever regresses (jax aliasing idx/rows), the in-flight scatter
+        # reads sentinel garbage and the parity lanes fail loudly
+        # instead of shipping silent corruption (docs/ANALYSIS.md)
+        sanitize.poison(idx, rows)
+        return out
     return dispatch
 
 

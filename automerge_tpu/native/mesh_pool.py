@@ -46,13 +46,12 @@ chips' results stand.
 """
 
 import ctypes
-import os
 import threading
 import time
 import warnings
 
 from .. import trace
-from ..utils.common import parse_mesh_env
+from ..utils.common import env_raw, parse_mesh_env
 from ..utils.jaxenv import ensure_cpu_devices
 from . import (NativeDocPool, ShardedNativePool, _ctx_pending_arrays,
                _ctx_ready, _run_phase_b_entry, _read_map_header, lib)
@@ -82,7 +81,7 @@ class MeshChipPool(NativeDocPool):
 
     def _ensure_mode_flags(self):
         if not self._mode_set:
-            env = os.environ.get('AMTPU_HOST_FULL')
+            env = env_raw('AMTPU_HOST_FULL')
             host_full = env is not None and env not in ('', '0')
             lib().amtpu_pool_set_hostfull(self._pool,
                                           1 if host_full else 0)

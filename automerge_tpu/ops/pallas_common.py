@@ -7,10 +7,10 @@ kernel's module.
 """
 
 import functools
-import os
 import sys
 
 import jax
+from ..utils.common import env_bool
 
 
 def _on_tpu():
@@ -26,7 +26,7 @@ def on_tpu_cached():
 
 
 def pallas_enabled():
-    if os.environ.get('AMTPU_NO_PALLAS'):
+    if env_bool('AMTPU_NO_PALLAS', False):
         return False
     return on_tpu_cached()
 

@@ -24,7 +24,6 @@ All ops across all docs are flattened into one array; groups are globally
 unique ids for (doc, obj, key), so no per-doc padding is needed.
 """
 
-import os
 from collections import namedtuple
 from functools import partial
 
@@ -32,7 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..utils.common import env_int
+from ..utils.common import env_bool, env_int
 
 # Window of predecessors considered per op in the base dispatch.  Conflict
 # sets larger than this overflow and escalate through the tier ladder.
@@ -394,7 +393,7 @@ def device_merge_on():
     """AMTPU_DEVICE_MERGE=0 keeps the escalation-tier merge on the host
     (the PR-3 scatter); default on (checked per batch, not latched --
     the A/B parity lane flips it)."""
-    return os.environ.get('AMTPU_DEVICE_MERGE', '1') not in ('', '0')
+    return env_bool('AMTPU_DEVICE_MERGE', True)
 
 
 def merge_packed_rows(base, rows_p, tier_packed, sub_p):
@@ -607,15 +606,18 @@ def _esc_chunk_rows():
 
 
 def _escalation_budget():
-    mb = os.environ.get('AMTPU_ESCALATE_BUDGET_MB')
-    return (int(mb) << 20) if mb else DEFAULT_ESCALATION_BUDGET
+    # unset -> the built-in default; an EXPLICIT 0 is a zero-byte
+    # budget, forcing every overflowed group to the host oracle (the
+    # A/B hook the parity lanes use) -- distinct sentinels keep that
+    mb = env_int('AMTPU_ESCALATE_BUDGET_MB', -1)
+    return (mb << 20) if mb >= 0 else DEFAULT_ESCALATION_BUDGET
 
 
 def escalation_enabled():
     """AMTPU_ESCALATE=0 disables the ladder (every overflowed group then
     takes the host oracle, the pre-escalation behaviour) -- an A/B and
     parity-test hook, checked per batch."""
-    return os.environ.get('AMTPU_ESCALATE', '1') not in ('', '0')
+    return env_bool('AMTPU_ESCALATE', True)
 
 
 def _tier_of(n, floor=ESCALATION_FLOOR):
@@ -822,7 +824,7 @@ def escalate_dispatch_groups(groups, time, actor, seq, is_del,
         # which roll the pool back -- retry/bisect stay byte-safe
         faults.fire('escalation.tier')
     if max_tier is None:
-        max_tier = int(os.environ.get('AMTPU_MAX_TIER', DEFAULT_MAX_TIER))
+        max_tier = env_int('AMTPU_MAX_TIER', DEFAULT_MAX_TIER)
     time = np.asarray(time)
     actor = np.asarray(actor)
     seq = np.asarray(seq)

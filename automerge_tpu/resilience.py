@@ -41,33 +41,27 @@ errorType -- rather than falsely claiming "nothing applied".
 post-rollback).
 """
 
-import os
 import time
 
 import msgpack
 
 from . import faults, telemetry
 from .errors import AutomergeError
+from .utils.common import env_bool, env_float, env_int
 from .utils.wire import map_header as _map_header
 from .utils.wire import read_map_header as _read_map_header
 
 
 def enabled():
-    return os.environ.get('AMTPU_RESILIENCE', '1') not in ('', '0')
+    return env_bool('AMTPU_RESILIENCE', True)
 
 
 def _retry_max():
-    try:
-        return int(os.environ.get('AMTPU_RETRY_MAX', '3'))
-    except ValueError:
-        return 3
+    return env_int('AMTPU_RETRY_MAX', 3)
 
 
 def _backoff_base_s():
-    try:
-        return float(os.environ.get('AMTPU_RETRY_BACKOFF_S', '0.05'))
-    except ValueError:
-        return 0.05
+    return env_float('AMTPU_RETRY_BACKOFF_S', 0.05)
 
 
 #: exponential backoff ceiling -- a wedged device should not turn one
@@ -76,7 +70,7 @@ _BACKOFF_CAP_S = 1.0
 
 
 def _degrade_on():
-    return os.environ.get('AMTPU_DEGRADE', '0') not in ('', '0')
+    return env_bool('AMTPU_DEGRADE', False)
 
 
 def should_isolate(exc):

@@ -25,20 +25,12 @@ live here:
     queue instead of the inline bypass.
 """
 
-import os
 import threading
 import time
 
 from .. import telemetry
+from ..utils.common import env_float as _env_float
 from ..utils.common import env_int as _env_int
-
-
-def _env_float(name, default):
-    try:
-        v = os.environ.get(name, '')
-        return float(v) if v else default
-    except ValueError:
-        return default
 
 
 def flush_deadline_s():
@@ -107,13 +99,19 @@ class AdmissionQueue(object):
         self.max_ops = max(1, int(max_ops))
         self.low_ops = max(0, min(self.max_ops - 1,
                                   int(self.max_ops * low_frac)))
+        # `_work` is a Condition ON `_lock`: holding either IS holding
+        # the one queue lock, so the guarded-by annotations (enforced
+        # by `make static-check`, docs/ANALYSIS.md) accept both.
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
-        self._items = []          # arrival order; parked ops stay put
-        self.depth_ops = 0        # queued (unclaimed) ops
-        self.shedding = False
-        self._pending_docs = {}   # doc -> mutating ops not yet answered
-        self._closed = False
+        # arrival order; parked ops stay put
+        self._items = []          # guarded-by: self._lock|self._work
+        # queued (unclaimed) ops
+        self.depth_ops = 0        # guarded-by: self._lock|self._work
+        self.shedding = False     # guarded-by: self._lock|self._work
+        # doc -> mutating ops not yet answered
+        self._pending_docs = {}   # guarded-by: self._lock|self._work
+        self._closed = False      # guarded-by: self._lock|self._work
 
     # -- producer side (connection reader threads) ----------------------
 

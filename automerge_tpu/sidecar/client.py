@@ -30,6 +30,7 @@ import threading
 import time
 
 from .. import telemetry
+from ..utils.common import env_float, env_int
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -40,14 +41,6 @@ WAL_CMDS = ('apply_changes', 'apply_batch', 'apply_local_change', 'load')
 
 class SidecarTimeout(ConnectionError):
     """The server produced no response within the request deadline."""
-
-
-def _env_float(name, default):
-    try:
-        v = os.environ.get(name, '')
-        return float(v) if v else default
-    except ValueError:
-        return default
 
 
 class CheckpointWAL:
@@ -68,11 +61,7 @@ class CheckpointWAL:
 
     def __init__(self, compact_every=None):
         if compact_every is None:
-            try:
-                compact_every = int(os.environ.get('AMTPU_WAL_COMPACT',
-                                                   '32') or 32)
-            except ValueError:
-                compact_every = 32
+            compact_every = env_int('AMTPU_WAL_COMPACT', 32)
         self.compact_every = max(1, compact_every)
         self.snapshots = {}      # doc -> checkpoint_b64
         self.log = []            # (cmd, kwargs) in ack order
@@ -177,11 +166,11 @@ class SidecarClient:
         self._respawns = 0
         self._last_ok = time.monotonic()
         self._deadline_s = deadline_s if deadline_s is not None else \
-            (_env_float('AMTPU_SIDECAR_DEADLINE_S', 0) or None)
+            (env_float('AMTPU_SIDECAR_DEADLINE_S', 0) or None)
         self._heartbeat_s = heartbeat_s if heartbeat_s is not None else \
-            (_env_float('AMTPU_SIDECAR_HEARTBEAT_S', 0) or None)
+            (env_float('AMTPU_SIDECAR_HEARTBEAT_S', 0) or None)
         if max_respawns is None:
-            max_respawns = int(_env_float('AMTPU_SIDECAR_MAX_RESPAWNS', 3))
+            max_respawns = env_int('AMTPU_SIDECAR_MAX_RESPAWNS', 3)
         self._max_respawns = max_respawns
         if sock_path or proc is not None:
             # healing means killing + respawning the server from OUR
@@ -278,9 +267,13 @@ class SidecarClient:
         self._w_lock = threading.Lock()
         self._life_lock = threading.RLock()   # heal/WAL serialization
         self._resp_cond = threading.Condition()
-        self._resp = {}           # rid -> parked response frame
-        self._reader_live = False
-        self._rx_exc = None
+        # demux state: rid -> parked response frame, the reader-role
+        # election flag, and the sticky transport error -- all owned by
+        # the response condition (`make static-check` enforces the
+        # guarded-by annotations, docs/ANALYSIS.md)
+        self._resp = {}           # guarded-by: self._resp_cond
+        self._reader_live = False  # guarded-by: self._resp_cond
+        self._rx_exc = None       # guarded-by: self._resp_cond
 
     def _await_response(self):
         """Blocks until the first byte of the response is available (or
@@ -423,7 +416,7 @@ class SidecarClient:
         into the fresh process."""
         self._respawns += 1
         telemetry.metric('sidecar.client.respawns')
-        deadline = time.monotonic() + _env_float(
+        deadline = time.monotonic() + env_float(
             'AMTPU_SIDECAR_RESPAWN_DEADLINE_S', 30.0)
         delay = 0.05
         while True:

@@ -65,6 +65,7 @@ import time
 
 from .. import faults, telemetry
 from ..errors import AutomergeError, RangeError
+from ..utils.common import env_bool, env_int, env_raw, env_str
 from ..telemetry import httpd as telemetry_httpd
 from ..utils.jaxenv import pin_cpu
 
@@ -278,19 +279,21 @@ def main(argv=None):
                          'JSON lines')
     # a set-but-empty/garbage AMTPU_METRICS_PORT must not kill a server
     # that never asked for metrics -- fall back to off
-    try:
-        env_port = int(os.environ.get('AMTPU_METRICS_PORT', -1))
-    except ValueError:
-        print('sidecar: ignoring non-integer AMTPU_METRICS_PORT=%r'
-              % os.environ['AMTPU_METRICS_PORT'], file=sys.stderr)
-        env_port = -1
+    env_port = env_int('AMTPU_METRICS_PORT', -1)
+    raw_port = env_raw('AMTPU_METRICS_PORT')
+    if env_port == -1 and raw_port not in (None, ''):
+        try:
+            int(raw_port)       # an explicit -1 is a valid "off"
+        except ValueError:
+            print('sidecar: ignoring non-integer AMTPU_METRICS_PORT=%r'
+                  % raw_port, file=sys.stderr)
     ap.add_argument('--metrics-port', type=int, default=env_port,
                     help='serve Prometheus /metrics + /healthz on this '
                          'HTTP port (0 = ephemeral; default: off, or '
                          'AMTPU_METRICS_PORT)')
     ap.add_argument('--metrics-host',
-                    default=os.environ.get('AMTPU_METRICS_HOST',
-                                           '127.0.0.1'),
+                    default=env_str('AMTPU_METRICS_HOST',
+                                    '127.0.0.1'),
                     help='bind address for the metrics listener '
                          '(default loopback; 0.0.0.0 for a remote '
                          'Prometheus fleet scrape)')
@@ -304,7 +307,7 @@ def main(argv=None):
                          'AMTPU_TRACE=1; pair with AMTPU_TRACE_FILE for '
                          'JSONL export)')
     args = ap.parse_args(argv)
-    if os.environ.get('AMTPU_GATEWAY', '1') in ('', '0'):
+    if not env_bool('AMTPU_GATEWAY', True):
         args.serial = True          # env kill-switch for the gateway
 
     if args.trace:

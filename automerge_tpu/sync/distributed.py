@@ -38,6 +38,8 @@ import time
 
 import numpy as np
 
+from ..utils.common import env_float
+
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
@@ -102,8 +104,8 @@ class ProcessMesh:
             # attempts stay cheap (short connect timeout, short sleep);
             # later ones back off so P processes don't hammer a
             # struggling listener.
-            deadline = time.time() + float(
-                os.environ.get('AMTPU_MESH_CONNECT_DEADLINE_S', 60))
+            deadline = time.time() + env_float(
+                'AMTPU_MESH_CONNECT_DEADLINE_S', 60)
             delay, timeout = 0.05, 1.0
             while True:
                 try:
@@ -476,12 +478,22 @@ def _worker(pid, n_processes, coord_port, mesh_port_base):
 #: flake cascade: the size-mismatch race aborts one worker at random
 #: ("op.preamble.length <= op.nbytes"), and every OTHER worker then dies
 #: of heartbeat timeout / shutdown-barrier failure -- so the victim a
-#: caller inspects first rarely shows the preamble text itself.
+#: caller inspects first rarely shows the preamble text itself.  The
+#: widened set (ISSUE 8 deflake) adds the transport-teardown shapes the
+#: same cascade surfaces on this host (peer reset / broken pipe when
+#: the aborted worker's sockets die first, and the TCP-store bind race
+#: when a retry reuses a port the kernel still holds in TIME_WAIT).
+#: Deliberately NOT bare gRPC status tokens (UNAVAILABLE etc.): those
+#: appear in too many REAL failure texts, and burning retries on a
+#: deterministic regression both slows the lane 4x and reports the
+#: wrong attempt's error.
 _FLAKY_SIGNATURES = ('op.preamble.length', 'heartbeat timeout',
-                     'Shutdown barrier', 'coordination service')
+                     'Shutdown barrier', 'coordination service',
+                     'Connection reset by peer', 'Broken pipe',
+                     'Address already in use')
 
 
-def launch(n_processes=2, timeout=300, _retries=2):
+def launch(n_processes=2, timeout=300, _retries=3):
     """Spawns the dryrun workers; returns their outputs.  Raises on any
     non-zero exit.  Bounded retries absorb the Gloo TCP transport's
     known size-mismatch race, which aborts a worker process at random

@@ -29,9 +29,9 @@ working.
 Metric catalog: docs/OBSERVABILITY.md.
 """
 
-import os
 import threading
 import time
+from ..utils.common import env_bool, env_float, env_int
 
 from .metrics import (DEFAULT_BUCKETS, MetricRegistry,  # noqa: F401
                       format_value)
@@ -117,7 +117,8 @@ KNOWN_RESIDENT_BATCH_KEYS = ('batch_hits', 'batch_noop',
                              'batch_gen_invalidation',
                              'batch_grow_uploads',
                              'batch_cache_dropped',
-                             'latch_flip_ignored')
+                             'latch_flip_ignored',
+                             'dispatches')
 
 # cross-batch wave pipelining (ISSUE 6 tentpole c), pre-seeded so bench
 # artifacts distinguish "never engaged" (explicit zeros) from "not
@@ -256,11 +257,7 @@ def note_degraded():
 
 
 def _degraded_window_s():
-    try:
-        v = os.environ.get('AMTPU_DEGRADED_WINDOW_S', '')
-        return float(v) if v else 300.0
-    except ValueError:
-        return 300.0
+    return env_float('AMTPU_DEGRADED_WINDOW_S', 300.0)
 
 
 def metrics_reset():
@@ -310,7 +307,7 @@ def observe_batch(pool, seconds, docs=0, ops=0):
 def devtime_on():
     """AMTPU_DEVTIME=1: synchronous per-dispatch device timing (checked
     per call, not latched -- bench.py flips it for one dedicated pass)."""
-    return os.environ.get('AMTPU_DEVTIME', '0') not in ('', '0')
+    return env_bool('AMTPU_DEVTIME', False)
 
 
 def observe_device_dispatch(seconds, n=1):
@@ -417,10 +414,7 @@ def healthz():
     res = {k: 0.0 for k in KNOWN_RESILIENCE_KEYS}
     res.update({k.split('.', 1)[1]: v for k, v in flat.items()
                 if k.startswith('resilience.')})
-    try:
-        restarts = int(os.environ.get('AMTPU_SIDECAR_RESTARTS', '0') or 0)
-    except ValueError:
-        restarts = 0
+    restarts = env_int('AMTPU_SIDECAR_RESTARTS', 0)
     degraded_age = time.time() - _last_degraded_ts if _last_degraded_ts \
         else None
     extra = {}

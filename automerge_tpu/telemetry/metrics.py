@@ -187,7 +187,7 @@ class MetricFamily(object):
         self.labelnames = tuple(labelnames)
         self.buckets = tuple(buckets) if buckets else DEFAULT_BUCKETS
         self._lock = lock
-        self._children = {}
+        self._children = {}       # guarded-by: self._lock
         if not self.labelnames:
             self.labels()   # materialize the single series eagerly
 
@@ -297,7 +297,7 @@ class MetricRegistry(object):
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._families = {}
+        self._families = {}       # guarded-by: self._lock
 
     def _get_or_make(self, name, help_, type_, labelnames, buckets=None):
         with self._lock:

@@ -29,6 +29,7 @@ import os
 import sys
 import threading
 import time
+from ..utils.common import env_bool, env_str
 
 _current = contextvars.ContextVar('amtpu_current_span', default=None)
 
@@ -48,7 +49,7 @@ class _State(object):
 
 
 _state = _State()
-_state.on = os.environ.get('AMTPU_TRACE', '0') not in ('', '0')
+_state.on = env_bool('AMTPU_TRACE', False)
 
 
 def enabled():
@@ -199,8 +200,9 @@ def _export(sp, dur):
             _export_file = None
 
 
-if os.environ.get('AMTPU_TRACE_FILE'):
-    set_trace_file(os.environ['AMTPU_TRACE_FILE'])
+_trace_file_env = env_str('AMTPU_TRACE_FILE', '')
+if _trace_file_env:
+    set_trace_file(_trace_file_env)
 
 
 # ---------------------------------------------------------------------------

@@ -4,6 +4,16 @@ import os
 
 ROOT_ID = '00000000-0000-0000-0000-000000000000'
 
+# ---------------------------------------------------------------------------
+# Environment access.  Every `AMTPU_*` read in the package routes through
+# these helpers (plus `parse_mesh_env` below); the env-latch checker
+# (`automerge_tpu/analysis/check_env.py`, `make static-check`) fails any
+# direct `os.environ` AMTPU read elsewhere and cross-checks the literal
+# defaults at each call site against the one spec in
+# `automerge_tpu/analysis/env_spec.py` -- a hardcoded default can no
+# longer drift between consumers.
+# ---------------------------------------------------------------------------
+
 
 def env_int(name, default):
     """Integer env knob with the shared fallback semantics: unset,
@@ -15,6 +25,39 @@ def env_int(name, default):
         return int(v) if v else default
     except ValueError:
         return default
+
+
+def env_float(name, default):
+    """Float env knob, same fallback semantics as :func:`env_int`."""
+    try:
+        v = os.environ.get(name, '')
+        return float(v) if v else default
+    except ValueError:
+        return default
+
+
+def env_bool(name, default):
+    """Boolean env knob: unset -> `default`; set -> the shared truthy
+    parse (anything but '' and '0' is on).  Matches the historical
+    ``not in ('', '0')`` idiom at every boolean call site."""
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v not in ('', '0')
+
+
+def env_str(name, default):
+    """String env knob: unset or empty -> `default`."""
+    v = os.environ.get(name, '')
+    return v if v else default
+
+
+def env_raw(name):
+    """Raw tri-state read: None when unset, else the verbatim string.
+    For knobs whose consumers distinguish *unset* (backend-dependent
+    default) from any set value (AMTPU_HOST_FULL / AMTPU_RESIDENT /
+    AMTPU_HOST_DOM and the latch-guard snapshot)."""
+    return os.environ.get(name)
 
 
 def parse_mesh_env(raw=None):

@@ -1,0 +1,58 @@
+"""Seeded dispatch-alias violations (tests/test_analysis.py): the
+post-dispatch staging mutation the PR-4/PR-6 hardening rounds kept
+re-finding by hand."""
+
+import numpy as np
+import jax.numpy as jnp
+
+
+def _jit_scatter(donate):
+    raise NotImplementedError('fixture only')
+
+
+def post_dispatch_mutation(tab):
+    idx = np.arange(16, dtype=np.int32)
+    rows = np.zeros((16, 4), np.int32)
+    out = _jit_scatter(False)(tab, idx, rows)
+    # violations: both staging arrays are refilled while the dispatch
+    # may still be reading them
+    rows.fill(0)
+    idx[0] = 7
+    return out
+
+
+def jnp_array_alias(host):
+    dev = jnp.array(host)
+    host[0] = -1          # violation: jnp.array's copy can defer
+    return dev
+
+
+def tls_staging(self_like, vals):
+    # violation: thread-local staging buffer without a private copy
+    return jnp.asarray(self_like._tls.buf)
+
+
+def loop_staging_reuse(tab, chunks):
+    buf = np.empty(64, np.int32)
+    out = []
+    for chunk in chunks:
+        buf[:16] = chunk          # violation: refills the buffer the
+        out.append(jnp.array(buf))  # previous iteration still stages
+    return out
+
+
+def loop_fresh_buffer(tab, chunks):
+    out = []
+    for chunk in chunks:
+        buf = np.array(chunk, np.int32)   # NOT flagged: fresh per
+        out.append(jnp.array(buf))        # iteration (rebound in loop)
+    return out
+
+
+def clean_private_copy(tab, idx, rows):
+    # NOT flagged: the dispatch gets private synchronous copies, and
+    # rebinding releases the capture
+    out = _jit_scatter(True)(tab, np.array(idx), np.array(rows))
+    idx = np.arange(4)
+    idx[0] = 1
+    return out

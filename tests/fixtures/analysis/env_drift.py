@@ -1,0 +1,26 @@
+"""Seeded env-latch violations (tests/test_analysis.py): the checker
+must flag every block below; nothing here is ever imported."""
+
+import os
+
+from automerge_tpu.utils.common import env_float, env_int
+
+
+def direct_read():
+    # violation: raw os.environ read outside utils/common
+    return os.environ.get('AMTPU_RESIDENT')
+
+
+def unknown_flag():
+    # violation: flag not registered in env_spec.ENV_FLAGS
+    return env_int('AMTPU_FIXTURE_BOGUS_FLAG', 1)
+
+
+def default_drift():
+    # violation: spec default for AMTPU_PIPELINE_DEPTH is 2
+    return env_int('AMTPU_PIPELINE_DEPTH', 3)
+
+
+def type_drift():
+    # violation: AMTPU_MAX_TIER is an int flag
+    return env_float('AMTPU_MAX_TIER', 1024)

@@ -1,0 +1,29 @@
+"""Seeded lock-discipline violations (tests/test_analysis.py)."""
+
+import threading
+
+
+class Guarded(object):
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = {}          # guarded-by: self._lock
+        self._depth = 0           # guarded-by(w): self._lock
+
+    def ok(self):
+        with self._lock:
+            self._state['x'] = self._depth
+            return len(self._state)
+
+    def ok_writes_only_read(self):
+        return self._depth        # NOT flagged: guarded-by(w)
+
+    def ok_holder(self):          # holds-lock: self._lock
+        return self._state.get('x')
+
+    def bad_load(self):
+        return self._state.get('x')     # violation
+
+    def bad_store(self):
+        with self._lock:
+            pass
+        self._depth += 1                # violation (outside the with)

@@ -1,0 +1,14 @@
+"""Seeded telemetry-key violations (tests/test_analysis.py)."""
+
+from automerge_tpu import trace
+
+
+def unseeded_counter():
+    # violation: not in KNOWN_RESIDENT_BATCH_KEYS (and undocumented)
+    trace.metric('resident.batch_fixture_bogus')
+
+
+def undeclared_dynamic():
+    # violation: formatted key in a pre-seeded namespace that matches
+    # no DYNAMIC_KEY_PATTERNS family
+    trace.metric('scheduler.fixture_%d' % 3)

@@ -1,0 +1,234 @@
+"""Static-analysis engine lanes (ISSUE 8, docs/ANALYSIS.md).
+
+Two-sided per checker: it must FIRE on its seeded-violation fixture
+(tests/fixtures/analysis/) and stay SILENT on the real tree -- a
+checker that cannot fire is dead weight, and one that fires on the
+tree means the tree (or the spec) regressed.  Plus the runtime
+sanitizer lane: AMTPU_SANITIZE=1 must be invisible while the
+private-copy contract holds and must catch a deliberately re-opened
+zero-copy alias (the PR-4/PR-6 class) as loud parity divergence.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, 'tests', 'fixtures', 'analysis')
+sys.path.insert(0, REPO)
+
+from automerge_tpu.analysis import run_checks  # noqa: E402
+from automerge_tpu.analysis.env_spec import (  # noqa: E402
+    ABI_LATCH_DEFAULTS, ENV_FLAGS, SPEC)
+
+
+def _fixture(name):
+    return os.path.join(FIXTURES, name)
+
+
+def _codes(findings, path=None):
+    return sorted({f.code for f in findings
+                   if path is None or f.path == path})
+
+
+# ---------------------------------------------------------------------------
+# the tree itself must be clean (every checker, in one pass)
+# ---------------------------------------------------------------------------
+
+def test_tree_is_clean():
+    findings = run_checks(REPO)
+    assert findings == [], '\n'.join(f.format(REPO) for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# per-checker fixture lanes: must fire on the seed, only on the seed
+# ---------------------------------------------------------------------------
+
+def _run_fixture(checker, name):
+    path = _fixture(name)
+    findings = run_checks(REPO, checkers=[checker], extra_files=[path])
+    on_fixture = [f for f in findings if f.path == path]
+    off_fixture = [f for f in findings if f.path != path]
+    assert off_fixture == [], '\n'.join(f.format(REPO)
+                                        for f in off_fixture)
+    return on_fixture
+
+
+def test_env_checker_fires_on_fixture():
+    hits = _run_fixture('env-latch', 'env_drift.py')
+    codes = _codes(hits)
+    assert 'direct-read' in codes, hits
+    assert 'unknown-flag' in codes, hits
+    assert 'default-drift' in codes, hits
+    assert 'type-drift' in codes, hits
+
+
+def test_telemetry_checker_fires_on_fixture():
+    hits = _run_fixture('telemetry-key', 'telemetry_unseeded.py')
+    codes = _codes(hits)
+    assert 'unseeded-key' in codes, hits
+    assert 'undeclared-dynamic-key' in codes, hits
+
+
+def test_alias_checker_fires_on_fixture():
+    hits = _run_fixture('dispatch-alias', 'alias_mutation.py')
+    codes = _codes(hits)
+    assert 'post-dispatch-mutation' in codes, hits
+    assert 'tls-staging' in codes, hits
+    assert 'loop-staging-reuse' in codes, hits
+    # the clean arms (private copies, fresh per-iteration buffer) must
+    # NOT be flagged
+    text = open(_fixture('alias_mutation.py')).read().splitlines()
+    for f in hits:
+        assert 'NOT flagged' not in text[f.line - 1], f.format(REPO)
+    # exactly the seeded sites fire: 3 mutations + 1 tls + 1 loop
+    assert len(hits) == 5, '\n'.join(f.format(REPO) for f in hits)
+
+
+def test_lock_checker_fires_on_fixture():
+    hits = _run_fixture('lock-discipline', 'lock_unguarded.py')
+    assert _codes(hits) == ['unguarded-access'], hits
+    # exactly the two bad_* methods, nothing in ok_*
+    assert len(hits) == 2, '\n'.join(f.format(REPO) for f in hits)
+
+
+def test_suppression_comment_silences(tmp_path):
+    src = ("import os\n"
+           "def f():\n"
+           "    return os.environ.get('AMTPU_RESIDENT')"
+           "  # static-ok: env-latch\n")
+    p = tmp_path / 'suppressed.py'
+    p.write_text(src)
+    findings = run_checks(REPO, checkers=['env-latch'],
+                          extra_files=[str(p)])
+    assert [f for f in findings if f.path == str(p)] == []
+
+
+# ---------------------------------------------------------------------------
+# env spec sanity: the ABI defaults the flip guard reads are the spec's
+# ---------------------------------------------------------------------------
+
+def test_env_spec_matches_latch_abi():
+    import ctypes
+    lib_path = os.path.join(REPO, 'automerge_tpu', 'native',
+                            'libamtpu_core.so')
+    if not os.path.exists(lib_path):
+        pytest.skip('native library not built')
+    out = (ctypes.c_int64 * 3)()
+    ctypes.CDLL(lib_path).amtpu_latch_defaults(out)
+    for i, name in enumerate(ABI_LATCH_DEFAULTS):
+        assert int(out[i]) == SPEC[name].default, name
+
+
+def test_env_spec_names_are_unique_and_sorted_types():
+    assert len({f.name for f in ENV_FLAGS}) == len(ENV_FLAGS)
+    for f in ENV_FLAGS:
+        assert f.type in ('int', 'float', 'bool', 'str', 'raw',
+                          'special'), f
+
+
+# ---------------------------------------------------------------------------
+# runtime alias sanitizer (AMTPU_SANITIZE=1)
+# ---------------------------------------------------------------------------
+
+# clock rows are keyed (doc, actor, seq), so docs x actors fresh rows
+# append per round while the ACTOR population stays at 8 (well under
+# AMTPU_RESCLK_MAX_ACTORS -- the cache must stay enabled).  The delta
+# scatter's staging arrays must clear jax's synchronous-completion
+# window for the alias to be observable: ~4096 rows measures 10/10
+# corruption on this host, below ~1k the tiny kernel finishes before
+# the poison lands (the bug class is exactly as timing-dependent in
+# production, which is why the sanitizer exists).
+BATCH_WORKLOAD = r"""
+ROOT = '00000000-0000-0000-0000-000000000000'
+
+def build_round(r, docs=512, actors=8):
+    payload = {}
+    for d in range(docs):
+        chs = []
+        for a in range(actors):
+            ops = [{'action': 'set', 'obj': ROOT,
+                    'key': 'shared%d' % (r % 3),
+                    'value': 'a%d r%d' % (a, r)}]
+            chs.append({'actor': 'w%d' % a, 'seq': r,
+                        'deps': {}, 'ops': ops})
+        payload['doc%d' % d] = chs
+    return payload
+"""
+
+SANITIZE_LANE = r"""
+import sys
+sys.path.insert(0, REPO_PATH)
+import os
+import jax; jax.config.update('jax_platforms', 'cpu')
+import numpy as np
+from automerge_tpu.native import NativeDocPool
+import automerge_tpu.native.batch_resident as br
+from automerge_tpu.analysis import sanitize
+WORKLOAD
+
+def run_rounds():
+    pool = NativeDocPool()
+    for r in (1, 2, 3):
+        pool.apply_batch(build_round(r))
+    # a corrupted clock scatter skews conflict resolution across the
+    # whole batch; a 64-doc sample is ample to observe divergence
+    return [pool.get_patch('doc%d' % i) for i in range(64)]
+
+# reference: sanitizer off, clean pipeline
+ref = run_rounds()
+
+# arm the sanitizer: with the private-copy contract intact the poison
+# is invisible (jax only ever aliased buffers no caller sees)
+os.environ['AMTPU_SANITIZE'] = '1'
+assert sanitize.refresh()
+clean = run_rounds()
+assert clean == ref, 'sanitizer corrupted a CLEAN pipeline'
+assert sanitize.poisoned_count() > 0, \
+    'sanitizer never engaged (delta staging path not hit?)'
+
+# deliberately re-open the PR-4/PR-6 alias: hand the scatter the RAW
+# staging buffers (no private np.array copies).  The sanitizer's poison
+# now scribbles over memory the async dispatch may still read -- the
+# corruption the alias would cause in production becomes a loud,
+# deterministic parity failure here.
+import jax as _jax
+def _aliasing(donate):
+    def scatter(tab, idx, rows):
+        return tab.at[idx].set(rows, mode='drop')
+    jitted = _jax.jit(scatter)
+    def run(tab, idx, rows):
+        out = jitted(tab, idx, rows)        # raw buffers: may zero-copy
+        sanitize.poison(idx, rows)
+        return out
+    return run
+br._jit_row_scatter = _aliasing
+
+caught = False
+for attempt in range(3):
+    if run_rounds() != ref:
+        caught = True
+        break
+assert caught, 'sanitizer failed to catch the deliberate alias'
+print('SANITIZE-OK')
+""".replace('WORKLOAD', BATCH_WORKLOAD)
+
+
+def test_sanitizer_catches_deliberate_alias():
+    """AMTPU_SANITIZE=1: invisible on the clean pipeline, loud on a
+    deliberately re-opened zero-copy alias (the exact PR-4/PR-6
+    staging-buffer class)."""
+    script = SANITIZE_LANE.replace('REPO_PATH', repr(REPO))
+    # kernel path (the scatter only exists there), no wave pipelining
+    # (512 docs would otherwise split; the lane pins the single-batch
+    # delta scatter), resilience off (corruption must surface, not heal)
+    env = dict(os.environ, JAX_PLATFORMS='cpu', AMTPU_HOST_FULL='0',
+               AMTPU_PIPELINE_DEPTH='1', AMTPU_RESILIENCE='0')
+    env.pop('AMTPU_SANITIZE', None)
+    out = subprocess.run([sys.executable, '-c', script], env=env,
+                         cwd=REPO, capture_output=True, text=True,
+                         timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert 'SANITIZE-OK' in out.stdout

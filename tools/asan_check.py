@@ -1,0 +1,98 @@
+"""Native-sanitizer gate (`make asan-check`; docs/ANALYSIS.md).
+
+Builds native/core.cpp with ``-fsanitize=address,undefined``
+(`make -C native asan` -> libamtpu_core_asan.so), then runs the
+native-heavy test subset against it: ``AMTPU_NATIVE_LIB`` points the
+loader at the instrumented build and ``LD_PRELOAD`` injects libasan
+into the (uninstrumented) Python interpreter so the runtime's
+interceptors are live before dlopen.
+
+This is the gate that catches the recurring C++ bug classes at CI time
+instead of review round 5: the batch-column use-after-free family (an
+error path freeing C++ memory before draining in-flight kernels -- hit
+twice in PR 6), the `recs[0]` empty-mirror OOB, and any UB the
+undefined sanitizer can prove (which aborts: -fno-sanitize-recover).
+
+The subset is the native driver + rollback/atomicity lanes -- the
+paths that exercise begin/rollback/mid/emit and the escalation tiers
+hardest per second.  Leak checking is off (CPython and jax hold
+intentional globals); the win is heap/stack/global corruption + UB.
+"""
+
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ASAN_LIB = os.path.join(ROOT, 'automerge_tpu', 'native',
+                        'libamtpu_core_asan.so')
+
+#: the native-heavy subset: driver + overflow/escalation paths
+#: (test_native), rollback byte-atomicity (test_atomicity), and the
+#: C++-vs-oracle differential (test_backend) -- broad begin/emit
+#: coverage without the slow subprocess lanes
+SUBSET = ('tests/test_native.py', 'tests/test_atomicity.py',
+          'tests/test_backend.py')
+
+
+def _gxx_lib(name):
+    out = subprocess.run(['g++', '-print-file-name=%s' % name],
+                         capture_output=True, text=True, check=True)
+    path = out.stdout.strip()
+    if not os.path.isabs(path):
+        raise SystemExit('asan-check: %s not found (g++ says %r)'
+                         % (name, path))
+    return path
+
+
+def main():
+    subprocess.run(['make', '-C', os.path.join(ROOT, 'native'), 'asan'],
+                   check=True)
+    # libstdc++ rides along in LD_PRELOAD: CPython does not link it, so
+    # without an early load ASan's __cxa_throw interceptor cannot
+    # resolve the real symbol and aborts the process the first time the
+    # C++ runtime throws ("real___cxa_throw != 0" CHECK)
+    preload = '%s %s' % (_gxx_lib('libasan.so'),
+                         _gxx_lib('libstdc++.so.6'))
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS='cpu',
+        AMTPU_NATIVE_LIB=ASAN_LIB,
+        LD_PRELOAD=preload,
+        # no leak pass (CPython/jax hold intentional globals); abort on
+        # the first real report so pytest can't swallow it
+        ASAN_OPTIONS='detect_leaks=0:abort_on_error=1',
+        UBSAN_OPTIONS='halt_on_error=1:print_stacktrace=1',
+    )
+
+    # sanity: the instrumented library must actually load through the
+    # override and the asan runtime must be live in-process
+    probe = subprocess.run(
+        [sys.executable, '-c',
+         'import ctypes\n'
+         'assert ctypes.CDLL(None).__asan_region_is_poisoned\n'
+         'from automerge_tpu import native\n'
+         'assert native._LIB_PATH.endswith("_asan.so"), native._LIB_PATH\n'
+         'native.lib()\n'
+         'print("asan-check: instrumented library loaded")\n'],
+        cwd=ROOT, env=env)
+    if probe.returncode != 0:
+        print('asan-check: FAIL -- could not load the instrumented '
+              'library under the asan runtime')
+        return 1
+
+    cmd = [sys.executable, '-m', 'pytest', '-q', '-p', 'no:cacheprovider',
+           *SUBSET]
+    print('asan-check: running %s under ASan+UBSan' % ' '.join(SUBSET),
+          file=sys.stderr)
+    rc = subprocess.run(cmd, cwd=ROOT, env=env).returncode
+    if rc != 0:
+        print('asan-check: FAIL (rc=%d) -- a sanitizer report or test '
+              'failure above' % rc)
+        return 1
+    print('asan-check: PASS')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -16,9 +16,11 @@ see tools/quickbench.py).  MINIMA compare (the minimum of N identical
 runs is the least-contended sample, the robust statistic for a shared
 host); the target is ~2% overhead, the assert threshold defaults to 6%
 to absorb residual jitter (AMTPU_TCHECK_TOL overrides).  The gate takes
-the MEDIAN of AMTPU_TCHECK_TRIALS (default 3) independent overhead
-estimates, so one unlucky scheduling window cannot fail it alone.  A final
-enabled-path pass sanity-checks
+the MEDIAN of AMTPU_TCHECK_TRIALS (default 5) independent overhead
+estimates, so one unlucky scheduling window cannot fail it alone, and
+accepts a clean best-trial (<= TOL/2) even when the median is over --
+a real regression inflates every window, host contention does not
+deflate one (ISSUE 8 deflake).  A final enabled-path pass sanity-checks
 that tracing actually records (an accidentally dead telemetry layer
 must not pass the overhead gate by being dead).
 
@@ -46,7 +48,7 @@ from automerge_tpu.telemetry.spans import NULL_SPAN  # noqa: E402
 
 PAIRS = int(os.environ.get('AMTPU_TCHECK_PAIRS', 5))
 TOL = float(os.environ.get('AMTPU_TCHECK_TOL', 0.06))
-TRIALS = int(os.environ.get('AMTPU_TCHECK_TRIALS', 3))
+TRIALS = int(os.environ.get('AMTPU_TCHECK_TRIALS', 5))
 
 
 def _noop(*args, **kwargs):
@@ -148,11 +150,24 @@ def main():
     print('telemetry-check: enabled-path sanity ok (%d phases)'
           % len(snap), file=sys.stderr)
 
-    if overhead > TOL:
+    # Acceptance (deflaked, ISSUE 8): the gate measures the DISABLED
+    # telemetry layer, whose true overhead is ~0-2% -- a failure mode is
+    # "every interleaved window this run was contended", not "the layer
+    # got slow".  So fail only when the median exceeds tolerance AND no
+    # single trial came in clean (<= TOL/2): a real regression inflates
+    # every trial including the least-contended one, while host jitter
+    # cannot suppress a genuine +6% in all five windows at once.
+    clean_min = min(overheads)
+    if overhead > TOL and clean_min > TOL / 2:
         print('telemetry-check: FAIL -- disabled path is %.1f%% slower '
-              'than the no-op pipeline (tolerance %.0f%%)'
-              % (100 * overhead, 100 * TOL))
+              'than the no-op pipeline (tolerance %.0f%%; best trial '
+              '%.1f%%)' % (100 * overhead, 100 * TOL, 100 * clean_min))
         return 1
+    if overhead > TOL:
+        print('telemetry-check: PASS (median %.1f%% is over tolerance '
+              'but the best trial measured %.1f%% -- host contention, '
+              'not instrument cost)' % (100 * overhead, 100 * clean_min))
+        return 0
     print('telemetry-check: PASS')
     return 0
 
