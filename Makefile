@@ -2,7 +2,8 @@
 
 .PHONY: all native test bench bench-all bench-tpu bench-multichip check \
 	clean wheel telemetry-check fallback-check perf-smoke chaos-check \
-	serve-check mesh-check static-check asan-check
+	serve-check mesh-check static-check asan-check fanout-check \
+	bench-fanout
 
 all: native
 
@@ -55,6 +56,7 @@ check: native
 	$(MAKE) perf-smoke
 	$(MAKE) chaos-check
 	$(MAKE) serve-check
+	$(MAKE) fanout-check
 	$(MAKE) mesh-check
 	$(MAKE) asan-check
 	@echo "CHECK GREEN"
@@ -90,6 +92,21 @@ chaos-check: native
 # the burst; no oracle fallback, no leaked batch handles at drain.
 serve-check: native
 	JAX_PLATFORMS=cpu python tools/serve_check.py
+
+# Batched-sync-fan-out gate (ISSUE 9, docs/SERVING.md fan-out section):
+# 1 popular doc x 200 subscribers must show encode_reuse >= 199 (the
+# coalesced delta encodes once), every subscriber's received-change
+# stream byte-identical to a serial per-Connection replay (incl. a
+# mid-run straggler at a stale clock), change->fanout p99 under the
+# smoke gate, and fallback.oracle == 0.
+fanout-check: native
+	JAX_PLATFORMS=cpu python tools/fanout_check.py
+
+# The BENCH_FANOUT artifact (ISSUE 9): RGA-heavy text edits under
+# zipfian doc popularity fanned to 1k+ subscribed peers, with the
+# vectorized-vs-scalar missing-changes A/B in the same session.
+bench-fanout: native
+	JAX_PLATFORMS=cpu python bench.py --fanout --out BENCH_FANOUT.json
 
 # Observability gate (docs/OBSERVABILITY.md): idle telemetry must be
 # free.  Interleaved A/B of the disabled path vs a no-op-patched "raw"

@@ -33,7 +33,9 @@ from .engine import Finding, register
 CHECKER = 'telemetry-key'
 
 #: flat-counter prefix -> the telemetry/__init__.py KNOWN tuple that
-#: pre-seeds it into every bench_block / healthz payload
+#: pre-seeds it into every bench_block / healthz payload.  Prefixes may
+#: span multiple dot segments (`sync.fanout`); the LONGEST matching
+#: prefix owns a key, and the seeded suffix is what follows it.
 PRESEED_BLOCKS = {
     'fallback': 'KNOWN_FALLBACK_REASONS',
     'collect': 'KNOWN_COLLECT_KEYS',
@@ -42,7 +44,18 @@ PRESEED_BLOCKS = {
     'mesh': 'KNOWN_MESH_KEYS',
     'resilience': 'KNOWN_RESILIENCE_KEYS',
     'scheduler': 'KNOWN_SCHEDULER_KEYS',
+    'sync.fanout': 'KNOWN_FANOUT_KEYS',
 }
+
+
+def _preseed_ns_of(key):
+    """The longest PRESEED_BLOCKS prefix owning `key`, or None."""
+    best = None
+    for ns in PRESEED_BLOCKS:
+        if key.startswith(ns + '.') and (best is None
+                                         or len(ns) > len(best)):
+            best = ns
+    return best
 
 #: dynamic key families that are deliberately NOT pre-seeded row by row
 #: (`*` matches within and across dots); everything else formatted at
@@ -55,7 +68,9 @@ DYNAMIC_KEY_PATTERNS = (
 )
 
 #: counter namespaces whose doc glossary rows are checked for deadness
-DOC_NAMESPACES = tuple(PRESEED_BLOCKS) + (
+#: (first dot segment of each preseed prefix, plus the un-seeded ones)
+DOC_NAMESPACES = tuple(sorted({ns.split('.')[0]
+                               for ns in PRESEED_BLOCKS})) + (
     'sched', 'sidecar', 'device', 'host', 'hostfull', 'hostreg',
     'sanitize', 'pallas', 'ops')
 
@@ -230,9 +245,10 @@ def check(sources, ctx):
 
     # 1. every literal flat emit with a pre-seeded prefix is in KNOWN
     for key, (path, line) in sorted(flats.items()):
-        ns, _, suffix = key.partition('.')
-        block = PRESEED_BLOCKS.get(ns) if suffix else None
+        ns = _preseed_ns_of(key)
+        block = PRESEED_BLOCKS.get(ns) if ns else None
         if block is not None:
+            suffix = key[len(ns) + 1:]
             keys, _bp, _bl = known.get(block, (set(), None, 0))
             if suffix not in keys \
                     and not any(r.match(key) for r in dynamic_res):

@@ -112,6 +112,12 @@ ENV_FLAGS = (
             'scheduler/queue.py'),
     EnvFlag('AMTPU_QUEUE_LOW_FRAC', 'float', 0.5, False,
             'scheduler/queue.py'),
+    # -- batched sync fan-out -----------------------------------------------
+    EnvFlag('AMTPU_FANOUT', 'bool', True, False, 'scheduler/gateway.py'),
+    EnvFlag('AMTPU_FANOUT_VECTOR', 'bool', True, False,
+            'sync/fanout.py (0 = per-peer scalar loop; A/B + oracle)'),
+    EnvFlag('AMTPU_FANOUT_PRESENCE', 'bool', True, False,
+            'sync/fanout.py'),
     # -- analysis / sanitizer ----------------------------------------------
     EnvFlag('AMTPU_SANITIZE', 'bool', False, False,
             'analysis/sanitize.py (poisons staging buffers post-dispatch)'),

@@ -462,13 +462,16 @@ def _apply_batch_dicts(pool, changes_by_doc):
 def _raise_if_quarantined(doc_id, result):
     """Single-doc entry points keep their raise contract: a one-doc
     batch has nothing to isolate FROM, so a quarantine envelope there
-    surfaces as the exception it stands for."""
-    from ..resilience import is_quarantined
+    surfaces as the exception it stands for.  The message embeds
+    ``resilience.QUARANTINE_RAISE_MARKER`` -- the gateway's fan-out
+    recognizes this surface to keep its 'envelope, not silence'
+    promise to subscribers."""
+    from ..resilience import QUARANTINE_RAISE_MARKER, is_quarantined
     if is_quarantined(result):
         from ..errors import AutomergeError
-        raise AutomergeError('doc %r quarantined: [%s] %s'
-                             % (doc_id, result['errorType'],
-                                result['error']))
+        raise AutomergeError('doc %r%s%s] %s'
+                             % (doc_id, QUARANTINE_RAISE_MARKER,
+                                result['errorType'], result['error']))
 
 
 def _raise_last():

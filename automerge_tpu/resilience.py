@@ -110,6 +110,25 @@ def is_quarantined(result):
         and 'error' in result and 'clock' not in result
 
 
+#: the message shape `native._raise_if_quarantined` uses when a
+#: SINGLE-doc entry point surfaces a quarantine envelope as its raise
+#: contract -- defined here (the quarantine authority) so consumers
+#: recognizing that surface (the gateway's fan-out, which owes
+#: subscribers the envelope even when the doc was mutated through a
+#: singleton path) share one contract with the raiser
+QUARANTINE_RAISE_MARKER = ' quarantined: ['
+
+
+def is_quarantine_error(resp):
+    """True when a protocol error response is the single-doc surface of
+    a quarantine (`_raise_if_quarantined`) rather than a validation
+    error -- the fan-out test for 'envelope, not silence' on the
+    exec/serial-fallback path."""
+    return isinstance(resp, dict) \
+        and resp.get('errorType') == 'AutomergeError' \
+        and QUARANTINE_RAISE_MARKER in str(resp.get('error', ''))
+
+
 def apply_payload(pool, payload, first_exc=None):
     """``apply_batch_bytes`` with retry/bisect/quarantine semantics.
 

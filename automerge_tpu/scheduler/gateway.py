@@ -44,6 +44,16 @@ Overload: past the queue's high watermark mutating requests answer the
 typed ``{"errorType": "Overloaded", "retryAfterMs": ...}`` envelope
 instead of growing memory; ``healthz`` gains a ``scheduler`` section
 (queue depth, shed state, occupancy summary, live batch handles).
+
+Fan-out (ISSUE 9, docs/SERVING.md fan-out section): ``subscribe`` /
+``unsubscribe`` / ``presence`` requests route through the same flush
+cycle (ordered against their doc's mutations), and every flush hands
+its per-doc post clocks + quarantine envelopes to the batched
+:class:`~automerge_tpu.sync.fanout.FanoutEngine`, which classifies all
+subscribers of all dirty docs in one vectorized (peer x doc) clock
+-matrix pass and fans each doc's delta out encode-once.  Change->fanout
+latency is therefore bounded by the flush window; ``AMTPU_FANOUT=0``
+disables the engine (subscribe answers a typed error).
 """
 
 import json
@@ -55,7 +65,8 @@ import threading
 import time
 
 from .. import faults, telemetry
-from ..resilience import is_quarantined
+from ..resilience import is_quarantine_error, is_quarantined
+from ..utils.common import env_bool
 from .queue import (READ_CMDS, AdmissionQueue,  # noqa: F401 (re-export)
                     Overloaded, PendingOp, flush_deadline_s,
                     max_batch_docs, max_batch_ops)
@@ -72,6 +83,12 @@ BATCH_CMDS = ('apply_changes', 'apply_batch')
 
 #: mutating commands executed as ordered singletons within a flush
 EXEC_CMDS = ('apply_local_change', 'load')
+
+#: fan-out control plane (ISSUE 9): ordered through the flush cycle so
+#: subscribe/backfill serializes with the doc's mutations; presence
+#: admits normally (sheddable -- it is ephemeral by definition), the
+#: subscription lifecycle admits always (control plane)
+FANOUT_CMDS = ('subscribe', 'unsubscribe', 'presence')
 
 
 def _op_weight(cmd, req):
@@ -126,6 +143,12 @@ class _Conn(object):
         self.wfile = sock.makefile('wb')
         self._wlock = threading.Lock()
         self.closed = False
+        # ONE stable bound reference (attribute access would mint a new
+        # bound-method object per call): the fan-out engine groups
+        # subscription rows sharing a transport by callable identity,
+        # so peers multiplexed on this connection receive their k
+        # copies of a coalesced frame as a single write
+        self.raw_send = self.send_raw
 
     def send(self, resp):
         """Writes one response frame atomically; a dead peer marks the
@@ -140,6 +163,19 @@ class _Conn(object):
                 frame = struct.pack('>I', len(body)) + body
             else:
                 frame = (json.dumps(resp) + '\n').encode()
+            with self._wlock:
+                self.wfile.write(frame)
+                self.wfile.flush()
+        except (BrokenPipeError, ConnectionError, OSError, ValueError):
+            self.close()
+
+    def send_raw(self, frame):
+        """Writes an ALREADY-encoded frame atomically -- the fan-out
+        engine's encode-once path: one doc's delta is serialized once
+        and these bytes fan out to every subscriber."""
+        if self.closed:
+            return
+        try:
             with self._wlock:
                 self.wfile.write(frame)
                 self.wfile.flush()
@@ -246,6 +282,7 @@ class GatewayServer(object):
         # flushes serialize on this lock (the C++ pool and the jax
         # client are driven single-threaded, as they always were)
         self.pool_lock = threading.RLock()
+        self.fanout = None
         self._srv = None
         self._conns = {}
         self._conns_lock = threading.Lock()
@@ -264,6 +301,12 @@ class GatewayServer(object):
         self._srv.listen(self.backlog)
         telemetry.register_healthz_section('scheduler',
                                            self._healthz_section)
+        if env_bool('AMTPU_FANOUT', True):
+            from ..sync.fanout import FanoutEngine
+            self.fanout = FanoutEngine(self.backend.pool,
+                                       self._encode_frame)
+            telemetry.register_healthz_section(
+                'fanout', self.fanout.healthz_section)
         self._dispatch_thread = threading.Thread(
             target=self._dispatch_loop, name='amtpu-gw-dispatch',
             daemon=True)
@@ -301,6 +344,7 @@ class GatewayServer(object):
         if self._dispatch_thread is not None:
             self._dispatch_thread.join(timeout=30)
         telemetry.register_healthz_section('scheduler', None)
+        telemetry.register_healthz_section('fanout', None)
 
     def _healthz_section(self):
         from ..native import live_batch_handles
@@ -333,6 +377,17 @@ class GatewayServer(object):
     def _conn_gone(self, conn):
         with self._conns_lock:
             self._conns.pop(conn.cid, None)
+        if self.fanout is not None:
+            self.fanout.drop_conn(conn.cid)
+
+    def _encode_frame(self, obj):
+        """One wire frame in this server's framing -- the fan-out
+        engine encodes each doc's delta through this exactly once."""
+        if self.use_msgpack:
+            import msgpack
+            body = msgpack.packb(obj, use_bin_type=True)
+            return struct.pack('>I', len(body)) + body
+        return (json.dumps(obj) + '\n').encode()
 
     # -- request routing ------------------------------------------------
 
@@ -344,6 +399,30 @@ class GatewayServer(object):
         rid = req.get('id')
         if cmd in PURE_CMDS:
             conn.send(self.backend.handle(req))
+            return
+        if cmd in FANOUT_CMDS:
+            if self.fanout is None:
+                conn.send({'id': rid,
+                           'error': 'fan-out is disabled on this '
+                                    'server (AMTPU_FANOUT=0)',
+                           'errorType': 'RangeError'})
+                return
+            docs = _op_docs(cmd, req)
+            if docs is None:
+                conn.send({'id': rid,
+                           'error': "missing required field: 'doc'",
+                           'errorType': 'RangeError'})
+                return
+            op = PendingOp(conn, rid, cmd, req, docs, 1, batchable=False)
+            try:
+                # presence is ephemeral -- shedding it under overload is
+                # the correct behaviour; the subscription lifecycle is
+                # control plane and always admits
+                self.queue.offer(op, admit_always=(cmd != 'presence'))
+            except Overloaded as e:
+                conn.send({'id': rid, 'error': str(e),
+                           'errorType': 'Overloaded',
+                           'retryAfterMs': e.retry_after_ms})
             return
         if cmd in READ_CMDS:
             docs = _op_docs(cmd, req)
@@ -415,17 +494,26 @@ class GatewayServer(object):
         with telemetry.span('scheduler.flush', batched=len(batch),
                             exec_ops=len(execs)) as fsp:
             with self.pool_lock:
+                # per-flush fan-out inputs: doc -> post clock /
+                # quarantine envelope / earliest admission time /
+                # originator (conn, submitted-clock) for echo
+                # suppression
+                fan = {'updates': {}, 'quarantined': {}, 'enq': {},
+                       'origins': {}} \
+                    if self.fanout is not None else None
                 if batch:
-                    self._run_batch(batch, fsp)
+                    self._run_batch(batch, fsp, fan)
                 for op in execs:
-                    self._run_exec(op)
+                    self._run_exec(op, fan=fan)
+                if fan is not None:
+                    self._fanout_flush(fan, fsp)
 
     def _observe_wait(self, ops):
         now = time.perf_counter()
         for op in ops:
             telemetry.QUEUE_WAIT.observe((now - op.enq_t) * 1000.0)
 
-    def _run_batch(self, ops, fsp=None):
+    def _run_batch(self, ops, fsp=None, fan=None):
         """One coalesced pool pass over disjoint-doc mutating ops, per
         -request responses routed back by (conn, id)."""
         self._observe_wait(ops)
@@ -454,7 +542,7 @@ class GatewayServer(object):
                 raise
             telemetry.metric('scheduler.serial_fallback')
             for op in ops:
-                self._run_exec(op, count=False)
+                self._run_exec(op, count=False, fan=fan)
             return
         dt = time.perf_counter() - t0
         flush_id = getattr(fsp, 'span_id', None)
@@ -467,12 +555,17 @@ class GatewayServer(object):
                             'errorType': res['errorType']}
                 else:
                     resp = {'id': op.rid, 'result': res}
+                if fan is not None:
+                    self._fan_note(fan, op, op.req['doc'], res)
             else:
                 sub = {d: out[d] for d in op.req['docs']}
                 nq = sum(1 for r in sub.values() if is_quarantined(r))
                 if nq:
                     telemetry.metric('scheduler.quarantined', nq)
                 resp = {'id': op.rid, 'result': sub}
+                if fan is not None:
+                    for d, r in sub.items():
+                        self._fan_note(fan, op, d, r)
             # the per-command request series the serial server emits in
             # handle(): batched requests record the shared flush apply
             # time (docs/OBSERVABILITY.md)
@@ -489,14 +582,142 @@ class GatewayServer(object):
                     batched=True, flush=flush_id):
                 self._finish(op, resp)
 
-    def _run_exec(self, op, count=True):
+    def _run_exec(self, op, count=True, fan=None):
         """One ordered singleton through the serial backend dispatch --
         identical result envelope (and telemetry) to the pre-gateway
-        server."""
+        server.  Fan-out control-plane ops dispatch into the engine
+        instead (they never touch the pool's mutation path)."""
         if count:
             telemetry.metric('scheduler.exec_ops')
             self._observe_wait([op])
-        self._finish(op, self.backend.handle(op.req))
+        if op.cmd in FANOUT_CMDS:
+            self._finish(op, self._fanout_cmd(op))
+            return
+        resp = self.backend.handle(op.req)
+        if fan is not None and op.cmd in BATCH_CMDS + EXEC_CMDS:
+            if 'error' not in resp:
+                result = resp.get('result')
+                if op.cmd == 'apply_batch' and isinstance(result, dict):
+                    for d, r in result.items():
+                        self._fan_note(fan, op, d, r)
+                else:
+                    self._fan_note(fan, op, op.req.get('doc'), result)
+            elif is_quarantine_error(resp):
+                # a single-doc entry point surfaced a quarantine as its
+                # raise contract: subscribers still get the envelope,
+                # not silence (the batch path gets this for free from
+                # its per-doc envelopes)
+                for d in op.docs:
+                    self._fan_note(fan, op, d,
+                                   {'error': resp['error'],
+                                    'errorType': resp['errorType']})
+        self._finish(op, resp)
+
+    @staticmethod
+    def _submitted_clock(op, doc, result):
+        """The {actor: seq} clock of what THIS request itself shipped
+        for `doc` -- the originating connection's peers advance by
+        exactly this before classification (echo suppression), never by
+        concurrent changes they may not have seen."""
+        try:
+            if op.cmd == 'apply_changes':
+                changes = op.req['changes']
+            elif op.cmd == 'apply_batch':
+                changes = op.req['docs'][doc]
+            elif op.cmd == 'apply_local_change':
+                actor = result.get('actor') if isinstance(result, dict) \
+                    else None
+                return {actor: result['seq']} if actor else {}
+            elif op.cmd == 'load':
+                # the loader shipped the whole checkpoint: it holds
+                # everything the doc now contains
+                return dict(result.get('clock') or {}) \
+                    if isinstance(result, dict) else {}
+            else:
+                return {}
+            out = {}
+            for c in changes:
+                if isinstance(c, dict) and 'actor' in c:
+                    out[c['actor']] = max(out.get(c['actor'], 0),
+                                          int(c.get('seq', 0)))
+            return out
+        except (TypeError, KeyError, ValueError):
+            return {}
+
+    def _fan_note(self, fan, op, doc, result):
+        """Records one committed per-doc result into the flush's fan-out
+        inputs: the post clock for healthy docs, the error envelope for
+        quarantined ones."""
+        if doc is None:
+            return
+        if is_quarantined(result):
+            fan['quarantined'][doc] = result
+        else:
+            clock = result.get('clock') \
+                if isinstance(result, dict) else None
+            if clock is None:
+                # results without an embedded clock (e.g. a load's
+                # whole-state patch shape changing) resolve against the
+                # pool -- we hold the pool lock
+                try:
+                    clock = self.backend.pool.get_clock(doc) \
+                        .get('clock') or {}
+                except Exception:
+                    return
+            fan['updates'][doc] = clock
+            fan['origins'].setdefault(doc, []).append(
+                (op.conn.cid, self._submitted_clock(op, doc, result)))
+        prev = fan['enq'].get(doc)
+        if prev is None or op.enq_t < prev:
+            fan['enq'][doc] = op.enq_t
+
+    def _fanout_cmd(self, op):
+        """subscribe/unsubscribe/presence dispatch into the fan-out
+        engine, answered with the protocol's result/error envelope."""
+        from ..errors import AutomergeError, RangeError
+        req, rid = op.req, op.rid
+        peer = (op.conn.cid, str(req.get('peer') or ''))
+        doc = op.docs[0]
+        try:
+            if op.cmd == 'subscribe':
+                clock = req.get('clock') or {}
+                if not isinstance(clock, dict):
+                    raise RangeError('subscribe clock must be a '
+                                     '{actor: seq} map')
+                res = self.fanout.subscribe(
+                    peer, doc, clock, op.conn.raw_send,
+                    backfill=bool(req.get('backfill', True)))
+            elif op.cmd == 'unsubscribe':
+                res = {'ok': True,
+                       'removed': self.fanout.unsubscribe(peer, doc)}
+            else:
+                res = self.fanout.presence(peer, doc, req.get('state'))
+            return {'id': rid, 'result': res}
+        except (AutomergeError, RangeError, TypeError) as e:
+            return {'id': rid, 'error': str(e),
+                    'errorType': type(e).__name__}
+        except Exception as e:
+            telemetry.metric('sync.fanout.errors')
+            return {'id': rid,
+                    'error': '%s: %s' % (type(e).__name__, e),
+                    'errorType': 'InternalError'}
+
+    def _fanout_flush(self, fan, fsp):
+        """Hands the flush's committed docs to the fan-out engine; the
+        span nests under scheduler.flush (contextvars) and carries the
+        flush span id, exactly like the pool's batch spans."""
+        try:
+            with telemetry.span('sync.fanout', docs=len(fan['updates']),
+                                flush=getattr(fsp, 'span_id', None)):
+                self.fanout.on_flush(fan['updates'],
+                                     fan['quarantined'], fan['enq'],
+                                     fan['origins'])
+        except Exception as e:
+            # fan-out failures must never re-answer (or hang) the
+            # flush's already-answered requests
+            telemetry.metric('sync.fanout.errors')
+            print('gateway: fan-out failed: %s: %s'
+                  % (type(e).__name__, e), file=sys.stderr)
 
     def _finish(self, op, resp):
         op.conn.send(resp)

@@ -20,6 +20,7 @@ for them a transport error marks the client dead so reuse raises a
 clear error instead of desyncing request ids.
 """
 
+import collections
 import json
 import os
 import socket
@@ -139,6 +140,9 @@ class SidecarClient:
     _resp = None
     _reader_live = False
     _rx_exc = None
+    _events = None
+    _pump = None
+    _inflight = None
 
     def __init__(self, proc=None, sock_path=None, use_msgpack=False,
                  deadline_s=None, heal=None, max_respawns=None,
@@ -274,6 +278,14 @@ class SidecarClient:
         self._resp = {}           # guarded-by: self._resp_cond
         self._reader_live = False  # guarded-by: self._resp_cond
         self._rx_exc = None       # guarded-by: self._resp_cond
+        # unsolicited fan-out event frames (docs/SERVING.md fan-out
+        # section) parked by the pump for next_event()
+        self._events = collections.deque()  # guarded-by: self._resp_cond
+        self._pump = None         # guarded-by: self._resp_cond
+        # rids awaiting a response: the pump attributes an id-less
+        # parse-error frame to the OLDEST of these (ids are monotonic;
+        # a serial server answers in order)
+        self._inflight = set()    # guarded-by: self._resp_cond
 
     def _await_response(self):
         """Blocks until the first byte of the response is available (or
@@ -300,9 +312,13 @@ class SidecarClient:
             self._w.write(frame)
             self._w.flush()
 
-    def _read_frame(self):
-        """One framed response off the transport (reader role only)."""
-        self._await_response()
+    def _read_frame(self, apply_deadline=True):
+        """One framed response off the transport (reader role only).
+        The pump reads with `apply_deadline=False`: between events there
+        is legitimately no traffic, and per-request deadlines are
+        enforced by the waiters' condition timeout instead."""
+        if apply_deadline:
+            self._await_response()
         if self._msgpack:
             import msgpack
             head = self._r.read(4)
@@ -329,6 +345,15 @@ class SidecarClient:
         if self._resp_cond is None:
             self._init_locks()
         rid = req['id']
+        with self._resp_cond:
+            self._inflight.add(rid)
+        try:
+            return self._roundtrip_inner(req, rid)
+        finally:
+            with self._resp_cond:
+                self._inflight.discard(rid)
+
+    def _roundtrip_inner(self, req, rid):
         self._write_frame(req)
         deadline = None if self._deadline_s is None else \
             time.monotonic() + self._deadline_s
@@ -382,6 +407,79 @@ class SidecarClient:
             self._rx_exc = None
             self._reader_live = False
             self._resp_cond.notify_all()
+
+    # -- the event pump (fan-out subscriber mode) ------------------------
+
+    def _ensure_pump(self):
+        """Starts the dedicated frame pump subscriber mode needs: fan
+        -out event frames arrive at ANY time (not in response to a
+        request), so a background thread permanently owns the reader
+        role, parking responses by id for RPC waiters and event frames
+        for `next_event()`.  Idempotent; RPC threads then never read
+        the transport themselves."""
+        if self._resp_cond is None:
+            self._init_locks()
+        with self._resp_cond:
+            if self._pump is not None:
+                return
+            while self._reader_live:    # an RPC thread is mid-read;
+                self._resp_cond.wait()  # take over once it finishes
+            self._reader_live = True
+            self._pump = threading.Thread(target=self._pump_loop,
+                                          name='amtpu-sidecar-pump',
+                                          daemon=True)
+            self._pump.start()
+
+    def _pump_loop(self):
+        while True:
+            try:
+                resp = self._read_frame(apply_deadline=False)
+            except BaseException as e:
+                with self._resp_cond:
+                    self._rx_exc = e
+                    self._reader_live = False
+                    self._pump = None
+                    self._resp_cond.notify_all()
+                return
+            with self._resp_cond:
+                if isinstance(resp, dict) and 'event' in resp:
+                    self._events.append(resp)
+                else:
+                    r = resp.get('id') if isinstance(resp, dict) \
+                        else None
+                    if r is None:
+                        # a parse-error frame carries no id: attribute
+                        # it to the oldest outstanding request (ids are
+                        # monotonic); with none outstanding, drop it --
+                        # handing it to a LATER arbitrary waiter would
+                        # misattribute the error
+                        r = min(self._inflight) if self._inflight \
+                            else None
+                        if r is None:
+                            self._resp_cond.notify_all()
+                            continue
+                    self._resp[r] = resp
+                self._resp_cond.notify_all()
+
+    def next_event(self, timeout=None):
+        """Blocks for the next unsolicited fan-out event frame
+        (``{"event": "change"|"presence"|"quarantined", "doc": ...}``;
+        docs/SERVING.md fan-out section).  Returns None on timeout."""
+        self._ensure_pump()
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        with self._resp_cond:
+            while True:
+                if self._events:
+                    return self._events.popleft()
+                if self._rx_exc is not None:
+                    raise ConnectionError(
+                        'sidecar transport failed: %s' % self._rx_exc)
+                wait = None if deadline is None \
+                    else deadline - time.monotonic()
+                if wait is not None and wait <= 0:
+                    return None
+                self._resp_cond.wait(wait)
 
     def _call_raw(self, cmd, kwargs):
         """Request + protocol error mapping, NO healing and NO WAL
@@ -503,6 +601,38 @@ class SidecarClient:
     def get_missing_changes(self, doc, have_deps):
         return self.call('get_missing_changes', doc=doc,
                          have_deps=have_deps)
+
+    # -- fan-out subscription surface (gateway socket mode) --------------
+
+    def subscribe(self, doc, clock=None, peer=None, backfill=True):
+        """Subscribes this connection (optionally as named `peer`) to
+        `doc`'s flush fan-out; returns the backfill
+        ``{"doc", "clock", "changes"}``.  Event frames then arrive via
+        `next_event()`.  ``backfill=False`` registers at the advertised
+        clock without shipping history (the next flush serves the gap
+        through the straggler filter)."""
+        self._ensure_pump()
+        kwargs = {'doc': doc, 'clock': clock or {}}
+        if peer is not None:
+            kwargs['peer'] = peer
+        if not backfill:
+            kwargs['backfill'] = False
+        return self.call('subscribe', **kwargs)
+
+    def unsubscribe(self, doc, peer=None):
+        kwargs = {'doc': doc}
+        if peer is not None:
+            kwargs['peer'] = peer
+        return self.call('unsubscribe', **kwargs)
+
+    def presence(self, doc, state, peer=None):
+        """Ships ephemeral per-peer state (cursor position, selection)
+        that rides the next flush's fan-out frames without touching the
+        pool."""
+        kwargs = {'doc': doc, 'state': state}
+        if peer is not None:
+            kwargs['peer'] = peer
+        return self.call('presence', **kwargs)
 
     # -- observability ---------------------------------------------------
 

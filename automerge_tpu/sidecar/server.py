@@ -33,6 +33,15 @@ Requests (fields beyond `cmd`/`id` per command):
   {"id": 9, "cmd": "load",               "doc": d, "data": <checkpoint>}
   {"id": 10, "cmd": "metrics"}
   {"id": 11, "cmd": "healthz"}
+  {"id": 12, "cmd": "subscribe",   "doc": d, "clock": {...}, "peer": p?}
+  {"id": 13, "cmd": "unsubscribe", "doc": d, "peer": p?}
+  {"id": 14, "cmd": "presence",    "doc": d, "state": ..., "peer": p?}
+
+The last three are the batched fan-out control plane (ISSUE 9,
+docs/SERVING.md fan-out section) and are served only by the gateway
+(socket mode): subscribers receive unsolicited event frames (no `id`;
+an `event` key instead) whenever a flush commits changes to their doc.
+Stdio/--serial mode answers them with a RangeError.
 
 Observability: `metrics` answers {"contentType": ..., "body": <Prometheus
 text exposition>} for the whole process (docs/OBSERVABILITY.md), and
@@ -142,7 +151,8 @@ class SidecarBackend:
     COMMANDS = ('ping', 'apply_changes', 'apply_batch',
                 'apply_local_change', 'get_patch', 'save', 'load',
                 'get_missing_deps', 'get_missing_changes',
-                'get_changes_for_actor', 'metrics', 'healthz')
+                'get_changes_for_actor', 'metrics', 'healthz',
+                'subscribe', 'unsubscribe', 'presence')
 
     def handle(self, req):
         """Wraps dispatch in the per-request telemetry: a span resuming
@@ -194,6 +204,13 @@ class SidecarBackend:
             elif cmd == 'get_changes_for_actor':
                 result = self.get_changes_for_actor(
                     req['doc'], req['actor'], req.get('after_seq', 0))
+            elif cmd in ('subscribe', 'unsubscribe', 'presence'):
+                # the fan-out control plane lives in the gateway's flush
+                # cycle; a serial/stdio server has no dispatcher to ride
+                raise RangeError(
+                    '%s requires the continuous-batching gateway '
+                    '(socket mode without --serial/AMTPU_GATEWAY=0)'
+                    % cmd)
             else:
                 raise RangeError('Unknown command: %r' % (cmd,))
             return {'id': rid, 'result': result}

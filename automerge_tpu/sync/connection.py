@@ -17,14 +17,19 @@ from ..utils.common import less_or_equal
 
 def clock_union(clock_map, doc_id, clock):
     """Merges `clock` into clock_map[doc_id] componentwise-max
-    (reference: connection.js:9-12)."""
-    merged = dict(clock_map.get(doc_id, {}))
+    (reference: connection.js:9-12).
+
+    The reference rebuilds the whole immutable multi-doc map per merge;
+    only per-DOC isolation is observable (messages copy the clock they
+    embed), so this updates the map in place and rebuilds just the one
+    doc's entry -- O(actors) per send instead of O(docs), which is what
+    lets one Connection track thousands of documents."""
+    merged = dict(clock_map.get(doc_id) or {})
     for actor, seq in clock.items():
         if seq > merged.get(actor, 0):
             merged[actor] = seq
-    new_map = dict(clock_map)
-    new_map[doc_id] = merged
-    return new_map
+    clock_map[doc_id] = merged
+    return clock_map
 
 
 class Connection:
@@ -35,7 +40,11 @@ class Connection:
         self._our_clock = {}
 
     def open(self):
-        """(reference: connection.js:42-45)"""
+        """Advertises every doc in one batched pass, then registers for
+        changes (reference: connection.js:42-45).  Each doc's backend
+        state is fetched once and threaded through validation AND the
+        missing-changes decision (the per-doc serial path fetched it
+        twice per advertisement)."""
         for doc_id in self._doc_set.doc_ids:
             self.doc_changed(doc_id, self._doc_set.get_doc(doc_id))
         self._doc_set.register_handler(self.doc_changed)
@@ -54,11 +63,12 @@ class Connection:
                             changes=len(changes) if changes else 0):
             self._send_msg(msg)
 
-    def maybe_send_changes(self, doc_id):
+    def maybe_send_changes(self, doc_id, _state=None):
         """Ships changes the peer is missing, or advertises our clock
-        (reference: connection.js:58-73)."""
-        doc = self._doc_set.get_doc(doc_id)
-        state = Frontend.get_backend_state(doc)
+        (reference: connection.js:58-73).  `_state` lets doc_changed
+        pass the backend state it already fetched for validation."""
+        state = _state if _state is not None else \
+            Frontend.get_backend_state(self._doc_set.get_doc(doc_id))
         clock = state['opSet']['clock']
 
         if doc_id in self._their_clock:
@@ -82,7 +92,7 @@ class Connection:
         clock = state['opSet']['clock']
         if not less_or_equal(self._our_clock.get(doc_id, {}), clock):
             raise AssertionError('Cannot pass an old state object to a connection')
-        self.maybe_send_changes(doc_id)
+        self.maybe_send_changes(doc_id, _state=state)
 
     def receive_msg(self, msg):
         """(reference: connection.js:91-108)"""

@@ -15,6 +15,7 @@ class DocSet:
     def __init__(self):
         self.docs = {}
         self.handlers = []
+        self._dirty = set()
 
     @property
     def doc_ids(self):
@@ -27,8 +28,24 @@ class DocSet:
 
     def set_doc(self, doc_id, doc):
         self.docs[doc_id] = doc
+        self._dirty.add(doc_id)
         for handler in list(self.handlers):
             handler(doc_id, doc)
+
+    @property
+    def dirty_docs(self):
+        """Docs changed since the last `drain_dirty()` (read-only)."""
+        return frozenset(self._dirty)
+
+    def drain_dirty(self):
+        """Returns-and-clears the set of docs changed since the last
+        drain.  The per-mutation handler fan-in above invokes EVERY
+        registered handler for EVERY doc change -- O(handlers x
+        changes); a batched consumer (the flush-coupled fan-out engine,
+        a replica catch-up pass) registers NO handler and instead
+        drains dirtiness once per flush window."""
+        dirty, self._dirty = self._dirty, set()
+        return dirty
 
     def apply_changes(self, doc_id, changes):
         """(reference: doc_set.js:25-33)"""

@@ -1,0 +1,564 @@
+"""Batched sync fan-out: vectorized missing-changes over a
+(peer x doc) clock matrix + encode-once delta coalescing (ISSUE 9,
+ROADMAP #4; docs/SERVING.md fan-out section).
+
+The reference's peer-sync machinery (`Connection.maybe_send_changes`,
+PAPER.md section 1) evaluates ONE peer at a time: a dict compare of the
+peer's believed clock against the doc's clock, then a per-peer
+`getMissingChanges` walk.  A production server faces thousands of
+subscribed peers per popular doc; evaluating them serially per mutation
+is the same scalar wall the pool already tore down for op resolution.
+This engine applies the pool's batching insight to the sync protocol
+itself:
+
+  * **(peer x doc) clock matrix** -- every subscription owns a row in a
+    dense ``believed[sub, actor]`` int64 matrix (actors interned into
+    shared columns, the pool-resident clock-table layout from ISSUE 6);
+    the pool's authoritative clocks live in a parallel
+    ``auth[doc, actor]`` matrix.  One flush classifies ALL subscribers
+    of ALL dirty docs in one vectorized pass (`numpy` comparisons over
+    the gathered rows) instead of per-peer dict algebra:
+
+      - ``behind``  : any actor column where believed < auth
+      - ``exact``   : believed == the doc's pre-flush clock exactly
+
+  * **encode-once delta coalescing** -- a flush's new changes for doc d
+    are fetched ONCE (`pool.get_missing_changes(d, pre_flush_clock)`),
+    built into ONE event frame, and encoded to wire bytes ONCE; every
+    ``behind & exact`` subscriber receives the same bytes
+    (`sync.fanout.encode_reuse` counts the reuses).  Only stragglers --
+    peers whose believed clock diverged from the pre-flush clock
+    (reconnects, partial histories) -- take a per-peer
+    ``get_missing_changes`` filter, and the transitive-deps closure
+    inside that query keeps an under-advertised clock safe: a peer
+    never receives a change twice, never misses one.
+
+  * **flush coupling** -- the serve gateway hands each flush's per-doc
+    post clocks (and quarantine envelopes) to `on_flush` while still
+    holding the pool lock, so change->fanout latency is bounded by the
+    flush window and subscribe/backfill serializes with flushes (a peer
+    resubscribing mid-burst gets a full backfill, never a coalesced
+    delta that assumes state it lost).  Presence/ephemeral (cursor)
+    state piggybacks on the same frames without ever touching the pool.
+
+Wire surface (gateway socket mode; docs/SERVING.md):
+
+  {"cmd": "subscribe",   "doc": d, "clock": {...}, "peer": label?}
+      -> {"result": {"doc": d, "clock": {...}, "changes": [...]}}
+  {"cmd": "unsubscribe", "doc": d, "peer": label?}
+  {"cmd": "presence",    "doc": d, "state": ..., "peer": label?}
+
+Event frames (no ``id``; clients demux by the ``event`` key):
+
+  {"event": "change", "doc": d, "clock": {...}, "changes": [...],
+   "presence": {peer: state}?}
+  {"event": "presence", "doc": d, "presence": {peer: state}}
+  {"event": "quarantined", "doc": d, "error": ..., "errorType": ...}
+
+`AMTPU_FANOUT_VECTOR=0` flips classification to the per-peer scalar
+dict loop (the reference shape) -- the parity oracle for tests and the
+A/B baseline `bench.py --fanout` measures the vectorized pass against.
+"""
+
+import sys
+import threading
+import time
+
+import numpy as np
+
+from .. import telemetry
+from ..utils.common import env_bool
+
+#: amortized-doubling floor for matrix capacities
+_MIN_CAP = 8
+
+
+def classify_vector(believed, pre, post):
+    """Vectorized missing-changes classification over gathered matrix
+    rows: (behind, exact) boolean vectors for ``believed`` (n x A)
+    against the per-row pre-/post-flush authoritative clocks."""
+    behind = (believed < post).any(axis=1)
+    exact = (believed == pre).all(axis=1)
+    return behind, exact
+
+
+def classify_scalar(believed, pre, post):
+    """The per-peer scalar loop (reference `Connection` shape): one
+    dict comparison per subscriber.  Semantically identical to
+    `classify_vector` -- the parity oracle and the A/B baseline."""
+    n = len(believed)
+    behind = np.zeros(n, dtype=bool)
+    exact = np.zeros(n, dtype=bool)
+    for i in range(n):
+        b = {a: int(s) for a, s in enumerate(believed[i]) if s}
+        pr = {a: int(s) for a, s in enumerate(pre[i]) if s}
+        po = {a: int(s) for a, s in enumerate(post[i]) if s}
+        behind[i] = any(b.get(a, 0) < s for a, s in po.items())
+        exact[i] = b == pr
+    return behind, exact
+
+
+class FanoutEngine(object):
+    """The batched fan-out engine one gateway owns.
+
+    Thread model: `on_flush`/`subscribe`/`unsubscribe`/`presence` run on
+    the gateway's dispatcher thread (which also holds the pool lock, so
+    pool queries here serialize with flushes); `drop_conn` runs on
+    connection reader threads at teardown.  All matrix/registry state is
+    guarded by one engine lock (`make static-check` enforces the
+    annotations, docs/ANALYSIS.md).
+    """
+
+    def __init__(self, pool, encode):
+        self._pool = pool
+        self._encode = encode        # frame dict -> wire bytes (framing
+        self._lock = threading.Lock()  # owned by the gateway)
+        # -- actor interning (shared columns) --
+        self._actor_col = {}      # guarded-by: self._lock
+        self._actor_names = []    # guarded-by: self._lock
+        # -- doc rows (authoritative clocks) --
+        self._doc_row = {}        # guarded-by: self._lock
+        self._auth = np.zeros((_MIN_CAP, _MIN_CAP),
+                              np.int64)          # guarded-by: self._lock
+        # -- subscription rows (believed clocks) --
+        self._believed = np.zeros((_MIN_CAP, _MIN_CAP),
+                                  np.int64)      # guarded-by: self._lock
+        self._sub_doc = np.zeros(_MIN_CAP,
+                                 np.int64)       # guarded-by: self._lock
+        self._free_rows = []      # guarded-by: self._lock
+        self._n_rows = 0          # guarded-by: self._lock
+        # -- registries --
+        self._row_peer = {}       # guarded-by: self._lock
+        self._peer_row = {}       # guarded-by: self._lock
+        self._doc_subs = {}       # guarded-by: self._lock
+        self._peer_send = {}      # guarded-by: self._lock
+        self._conn_peers = {}     # guarded-by: self._lock
+        self._presence = {}       # guarded-by: self._lock
+
+    # -- interning ------------------------------------------------------
+
+    def _col(self, actor):  # holds-lock: self._lock
+        """Column of `actor`, interning (and growing the matrices) on
+        first sight."""
+        col = self._actor_col.get(actor)
+        if col is None:
+            col = len(self._actor_names)
+            if col >= self._auth.shape[1]:
+                cap = max(_MIN_CAP, 2 * self._auth.shape[1])
+                self._auth = self._grow(self._auth, cols=cap)
+                self._believed = self._grow(self._believed, cols=cap)
+            self._actor_col[actor] = col
+            self._actor_names.append(actor)
+        return col
+
+    def _drow(self, doc_id):  # holds-lock: self._lock
+        row = self._doc_row.get(doc_id)
+        if row is None:
+            row = len(self._doc_row)
+            if row >= self._auth.shape[0]:
+                self._auth = self._grow(self._auth,
+                                        rows=2 * self._auth.shape[0])
+            self._doc_row[doc_id] = row
+        return row
+
+    @staticmethod
+    def _grow(mat, rows=None, cols=None):
+        out = np.zeros((rows or mat.shape[0], cols or mat.shape[1]),
+                       mat.dtype)
+        out[:mat.shape[0], :mat.shape[1]] = mat
+        return out
+
+    def _clock_vec(self, clock):  # holds-lock: self._lock
+        """Dense row vector of a {actor: seq} clock (interns actors).
+        Interning happens BEFORE the vector is sized: a first-seen
+        actor can grow the column capacity mid-call."""
+        cols = {self._col(actor): int(seq)
+                for actor, seq in (clock or {}).items()}
+        vec = np.zeros(self._auth.shape[1], np.int64)
+        for col, seq in cols.items():
+            vec[col] = seq
+        return vec
+
+    def _vec_clock(self, vec):  # holds-lock: self._lock
+        """{actor: seq} of a dense row (zero columns omitted, like the
+        reference's clock maps)."""
+        (cols,) = np.nonzero(vec)
+        return {self._actor_names[c]: int(vec[c]) for c in cols}
+
+    # -- subscription management ---------------------------------------
+
+    def subscribe(self, peer, doc_id, clock, send, backfill=True):
+        """Registers/refreshes `peer`'s subscription to `doc_id` with
+        its advertised believed clock and returns the backfill: the
+        authoritative clock plus every change the peer is missing
+        (computed under the gateway's pool lock, so it serializes with
+        flushes -- a peer resubscribing mid-burst can never observe a
+        gap between its backfill and the next coalesced delta).
+
+        ``backfill=False`` registers the subscription at the advertised
+        clock WITHOUT shipping history -- the peer is then a straggler
+        the next flush serves through the per-peer filter (test and
+        resume-elsewhere hook)."""
+        auth = self._pool.get_clock(doc_id).get('clock') or {}
+        changes = []
+        if backfill and auth:
+            changes = self._pool.get_missing_changes(doc_id,
+                                                     dict(clock or {}))
+            telemetry.metric('sync.fanout.backfills')
+        with self._lock:
+            row = self._peer_row.get((peer, doc_id))
+            if row is None:
+                row = self._alloc_row(peer, doc_id)
+            # refresh the doc's authoritative row: the engine's pre
+            # -flush baseline must match what coalesced subscribers
+            # hold, and it may not have seen this doc since startup
+            drow = self._drow(doc_id)
+            self._auth[drow] = np.maximum(self._auth[drow],
+                                          self._clock_vec(auth))
+            if backfill:
+                # after the backfill the peer holds everything we do
+                self._believed[row] = np.maximum(self._clock_vec(clock),
+                                                 self._clock_vec(auth))
+            else:
+                auth = dict(clock or {})
+                self._believed[row] = self._clock_vec(clock)
+            self._peer_send[peer] = send
+            self._conn_peers.setdefault(peer[0], set()).add(peer)
+            telemetry.metric('sync.fanout.subscribes')
+        return {'doc': doc_id, 'clock': auth, 'changes': changes}
+
+    def _alloc_row(self, peer, doc_id):  # holds-lock: self._lock
+        if self._free_rows:
+            row = self._free_rows.pop()
+        else:
+            row = self._n_rows
+            if row >= self._believed.shape[0]:
+                cap = 2 * self._believed.shape[0]
+                self._believed = self._grow(self._believed, rows=cap)
+                grown = np.zeros(cap, np.int64)
+                grown[:len(self._sub_doc)] = self._sub_doc
+                self._sub_doc = grown
+            self._n_rows += 1
+        self._believed[row] = 0
+        self._sub_doc[row] = self._drow(doc_id)
+        self._row_peer[row] = peer
+        self._peer_row[(peer, doc_id)] = row
+        self._doc_subs.setdefault(doc_id, set()).add(row)
+        return row
+
+    def unsubscribe(self, peer, doc_id=None):
+        """Removes one subscription (or, with doc_id=None, every
+        subscription the peer holds)."""
+        with self._lock:
+            keys = [(peer, doc_id)] if doc_id is not None else \
+                [k for k in self._peer_row if k[0] == peer]
+            removed = 0
+            for key in keys:
+                row = self._peer_row.pop(key, None)
+                if row is None:
+                    continue
+                removed += 1
+                self._row_peer.pop(row, None)
+                subs = self._doc_subs.get(key[1])
+                if subs is not None:
+                    subs.discard(row)
+                    if not subs:
+                        self._doc_subs.pop(key[1], None)
+                self._free_rows.append(row)
+            if removed:
+                telemetry.metric('sync.fanout.unsubscribes', removed)
+            if not any(k[0] == peer for k in self._peer_row):
+                self._peer_send.pop(peer, None)
+                conn = self._conn_peers.get(peer[0])
+                if conn is not None:
+                    conn.discard(peer)
+                    if not conn:
+                        self._conn_peers.pop(peer[0], None)
+        return removed
+
+    def drop_conn(self, cid):
+        """Connection teardown: every peer the connection carried is
+        unsubscribed (reader-thread safe)."""
+        with self._lock:
+            peers = list(self._conn_peers.get(cid, ()))
+        dropped = 0
+        for peer in peers:
+            dropped += self.unsubscribe(peer)
+        if dropped:
+            telemetry.metric('sync.fanout.drops', dropped)
+        return dropped
+
+    def presence(self, peer, doc_id, state):
+        """Stages ephemeral per-peer state (cursors, selections) for
+        `doc_id`; it rides the NEXT flush's fan-out frames -- never the
+        pool.  ``AMTPU_FANOUT_PRESENCE=0`` sheds it server-side."""
+        if not env_bool('AMTPU_FANOUT_PRESENCE', True):
+            return {'ok': True, 'shed': True}
+        with self._lock:
+            self._presence.setdefault(doc_id, {})['%s/%s' % peer] = state
+        return {'ok': True}
+
+    # -- the batched flush pass ----------------------------------------
+
+    def on_flush(self, updates, quarantined=None, enq=None,
+                 origins=None):
+        """One fan-out pass for one gateway flush.
+
+        `updates`: {doc_id: post-flush clock dict} for every doc the
+        flush mutated; `quarantined`: {doc_id: error envelope} for docs
+        the resilient path refused; `enq`: {doc_id: earliest admission
+        perf_counter} for the change->fanout latency histogram;
+        `origins`: {doc_id: [(cid, submitted_clock)]} -- the
+        originating connection's subscriptions advance by exactly what
+        they shipped BEFORE classification, so a writer never receives
+        its own change back (the reference's receive-side clock union).
+        Caller holds the pool lock (straggler backfills query it).
+        """
+        quarantined = quarantined or {}
+        enq = enq or {}
+        origins = origins or {}
+        with self._lock:
+            frames = self._flush_locked(updates, quarantined, enq,
+                                        origins)
+        return frames
+
+    def _note_origins(self, origins):  # holds-lock: self._lock
+        """Echo suppression: every subscription the originating
+        connection holds on the doc advances by the clock of the
+        changes that connection itself submitted."""
+        for doc_id, subs in origins.items():
+            rows = self._doc_subs.get(doc_id)
+            if not rows:
+                continue
+            for cid, submitted in subs:
+                if not submitted:
+                    continue
+                vec = self._clock_vec(submitted)
+                for row in rows:
+                    peer = self._row_peer.get(row)
+                    if peer is not None and peer[0] == cid:
+                        np.maximum(self._believed[row], vec,
+                                   out=self._believed[row])
+
+    def _flush_locked(self, updates, quarantined, enq, origins):  # holds-lock: self._lock
+        presence, self._presence = self._presence, {}
+        # 0. echo suppression (may intern new actors -- must precede
+        #    the pre-flush row snapshots, which growth would reallocate)
+        self._note_origins(origins)
+        # 1. intern + advance authoritative clocks, snapshotting the
+        #    pre-flush rows (intern FIRST: growth reallocates matrices)
+        for post in updates.values():
+            for actor in (post or {}):
+                self._col(actor)
+        acap = self._auth.shape[1]
+        dirty = []                     # (doc_id, drow, pre_vec)
+        for doc_id, post in updates.items():
+            known = doc_id in self._doc_row or doc_id in self._doc_subs
+            if not known and doc_id not in presence:
+                continue               # nobody ever cared about it
+            drow = self._drow(doc_id)
+            pre = self._auth[drow].copy()
+            self._auth[drow] = np.maximum(pre, self._clock_vec(post))
+            # NOTE: a pre == post doc still classifies (no early skip):
+            # a subscribe that refreshed the auth row between the
+            # mutation and this pass would otherwise make the flush
+            # look like a duplicate apply and silently starve older
+            # subscribers -- classification already yields zero frames
+            # for a genuinely clean doc (nobody is behind)
+            dirty.append((doc_id, drow, pre))
+        for doc_id, env in quarantined.items():
+            if not any(d[0] == doc_id for d in dirty) \
+                    and (doc_id in self._doc_subs):
+                dirty.append((doc_id, self._drow(doc_id), None))
+        if not dirty and not presence:
+            return 0
+        telemetry.metric('sync.fanout.flushes')
+
+        # 2. classify EVERY subscriber of EVERY dirty doc in one pass
+        rows_per_doc = []
+        all_rows, doc_of = [], []
+        for i, (doc_id, drow, pre) in enumerate(dirty):
+            rows = sorted(self._doc_subs.get(doc_id, ()))
+            rows_per_doc.append(rows)
+            all_rows.extend(rows)
+            doc_of.extend([i] * len(rows))
+        behind = exact = None
+        if all_rows:
+            rows_arr = np.asarray(all_rows, np.int64)
+            bel = self._believed[rows_arr, :acap]
+            post_m = self._auth[self._sub_doc[rows_arr], :acap]
+            pre_m = np.stack([
+                dirty[i][2] if dirty[i][2] is not None
+                else self._auth[dirty[i][1]]
+                for i in doc_of])[:, :acap]
+            if env_bool('AMTPU_FANOUT_VECTOR', True):
+                telemetry.metric('sync.fanout.vector_passes')
+                behind, exact = classify_vector(bel, pre_m, post_m)
+            else:
+                telemetry.metric('sync.fanout.scalar_passes')
+                behind, exact = classify_scalar(bel, pre_m, post_m)
+        telemetry.metric('sync.fanout.docs', len(dirty))
+
+        # 3. per dirty doc: fetch the delta once, encode once, fan out
+        n_frames = 0
+        offset = 0
+        for i, (doc_id, drow, pre) in enumerate(dirty):
+            rows = rows_per_doc[i]
+            cls = slice(offset, offset + len(rows))
+            offset += len(rows)
+            n_frames += self._fanout_doc(
+                doc_id, drow, pre, rows,
+                behind[cls] if rows else (), exact[cls] if rows else (),
+                quarantined.get(doc_id), presence.pop(doc_id, None),
+                enq.get(doc_id))
+
+        # 4. presence-only docs (no mutation this flush)
+        for doc_id, states in presence.items():
+            rows = self._doc_subs.get(doc_id)
+            if not rows:
+                continue
+            buf = self._encode({'event': 'presence', 'doc': doc_id,
+                                'presence': states})
+            telemetry.metric('sync.fanout.bytes_encoded', len(buf))
+            for row in sorted(rows):
+                if self._send_row(row, buf):
+                    n_frames += 1
+            telemetry.metric('sync.fanout.presence_frames', len(rows))
+        if n_frames:
+            telemetry.metric('sync.fanout.frames', n_frames)
+        return n_frames
+
+    def _fanout_doc(self, doc_id, drow, pre, rows, behind, exact,  # holds-lock: self._lock
+                    envelope, presence, enq_t):
+        """Fan one dirty doc out to its classified subscribers; returns
+        frames written."""
+        if envelope is not None:
+            # quarantined: every subscriber gets the resilience
+            # envelope, not silence -- believed clocks stay put (the
+            # doc state they describe did not advance)
+            buf = self._encode({'event': 'quarantined', 'doc': doc_id,
+                                'error': envelope.get('error'),
+                                'errorType': envelope.get('errorType')})
+            telemetry.metric('sync.fanout.bytes_encoded', len(buf))
+            sent = 0
+            for row in rows:
+                if self._send_row(row, buf, enq_t):
+                    sent += 1
+            telemetry.metric('sync.fanout.quarantine_frames', sent)
+            return sent
+        if not rows:
+            return 0
+        post_vec = self._auth[drow]
+        post = self._vec_clock(post_vec)
+        served = []
+        n_frames = 0
+        coalesced = [row for row, b, e in zip(rows, behind, exact)
+                     if b and e]
+        stragglers = [row for row, b, e in zip(rows, behind, exact)
+                      if b and not e]
+        uptodate = len(rows) - len(coalesced) - len(stragglers)
+        if coalesced:
+            # THE encode-once path: one pool delta fetch, one wire
+            # encoding, N sends of the same bytes.  Rows sharing a
+            # transport (one connection multiplexing many peers) ship
+            # their k copies as ONE write -- k frames on the wire, one
+            # syscall
+            delta = self._pool.get_missing_changes(
+                doc_id, self._vec_clock(pre))
+            frame = {'event': 'change', 'doc': doc_id, 'clock': post,
+                     'changes': delta}
+            if presence:
+                frame['presence'] = presence
+            buf = self._encode(frame)
+            telemetry.metric('sync.fanout.bytes_encoded', len(buf))
+            by_send = {}
+            for row in coalesced:
+                send = self._peer_send.get(self._row_peer.get(row))
+                if send is not None:
+                    by_send.setdefault(id(send), (send, []))[1] \
+                        .append(row)
+            sent = 0
+            now = time.perf_counter()
+            for send, rows_c in by_send.values():
+                try:
+                    send(buf * len(rows_c))
+                except Exception as e:
+                    print('fanout: send failed: %s' % e,
+                          file=sys.stderr)
+                    continue
+                sent += len(rows_c)
+                served.extend(rows_c)
+                telemetry.metric('sync.fanout.bytes_on_wire',
+                                 len(buf) * len(rows_c))
+                if enq_t is not None:
+                    for _ in rows_c:
+                        telemetry.FANOUT_LATENCY.observe(
+                            (now - enq_t) * 1000.0)
+            n_frames += sent
+            telemetry.metric('sync.fanout.coalesced_peers', sent)
+            if sent > 1:
+                telemetry.metric('sync.fanout.encode_reuse', sent - 1)
+        for row in stragglers:
+            # divergent clock: per-peer filter through the transitive
+            # -deps closure (a reconnecting peer gets its FULL backfill)
+            delta = self._pool.get_missing_changes(
+                doc_id, self._vec_clock(self._believed[row]))
+            if not delta:
+                uptodate += 1
+                served.append(row)   # transitively complete already
+                continue
+            frame = {'event': 'change', 'doc': doc_id, 'clock': post,
+                     'changes': delta}
+            if presence:
+                frame['presence'] = presence
+            buf = self._encode(frame)
+            telemetry.metric('sync.fanout.bytes_encoded', len(buf))
+            if self._send_row(row, buf, enq_t):
+                n_frames += 1
+                served.append(row)
+        if stragglers:
+            telemetry.metric('sync.fanout.straggler_peers',
+                             len(stragglers))
+        if uptodate:
+            telemetry.metric('sync.fanout.uptodate_peers', uptodate)
+        for row in served:
+            np.maximum(self._believed[row], post_vec,
+                       out=self._believed[row])
+        return n_frames
+
+    def _send_row(self, row, buf, enq_t=None):  # holds-lock: self._lock
+        peer = self._row_peer.get(row)
+        send = self._peer_send.get(peer)
+        if send is None:
+            return False
+        try:
+            send(buf)
+        except Exception as e:       # a dead peer must not stall the
+            print('fanout: send to %r failed: %s' % (peer, e),  # flush
+                  file=sys.stderr)
+            return False
+        telemetry.metric('sync.fanout.bytes_on_wire', len(buf))
+        if enq_t is not None:
+            telemetry.FANOUT_LATENCY.observe(
+                (time.perf_counter() - enq_t) * 1000.0)
+        return True
+
+    # -- observability --------------------------------------------------
+
+    def healthz_section(self):
+        flat = telemetry.metrics_snapshot()
+        with self._lock:
+            # `live_*` prefixes: the flat sync.fanout.* counters merged
+            # below own the bare names
+            stats = {
+                'live_subscriptions': len(self._peer_row),
+                'live_peers': len(self._peer_send),
+                'live_docs': len(self._doc_subs),
+                'matrix_shape': list(self._believed.shape),
+                'actors': len(self._actor_names),
+            }
+        stats['latency_ms'] = telemetry.FANOUT_LATENCY.summary() or {}
+        stats.update({k.split('sync.fanout.', 1)[1]: v
+                      for k, v in flat.items()
+                      if k.startswith('sync.fanout.')})
+        return stats

@@ -198,6 +198,42 @@ KNOWN_SCHEDULER_KEYS = ('flushes', 'coalesced_ops', 'batched_docs',
                         'exec_ops', 'bypass_reads', 'parked', 'shed',
                         'serial_fallback', 'quarantined')
 
+# batched sync fan-out counters (`telemetry.metric('sync.fanout.<name>')`
+# call sites in sync/fanout.py + scheduler/gateway.py; glossary:
+# docs/OBSERVABILITY.md, architecture: docs/SERVING.md), pre-seeded into
+# every bench_block's `fanout` sub-object so the fanout-check gate and
+# the BENCH_FANOUT artifact read explicit zeros, never missing keys:
+# flushes / docs        fan-out passes that had work, and the dirty
+#                         docs they evaluated
+# frames                event frames written to subscriber connections
+# encode_reuse          coalesced sends served from an ALREADY-encoded
+#                         frame (N subscribers -> N-1 reuses); the
+#                         encode-once proof fanout-check gates
+# coalesced_peers       subscribers served the shared coalesced frame
+# straggler_peers       subscribers with divergent clocks served a
+#                         per-peer filtered delta
+# uptodate_peers        subscribers whose clock already covered the
+#                         flush (incl. the originator echo)
+# bytes_encoded /       wire bytes encoded vs written; on_wire /
+#   bytes_on_wire         encoded = the fan-out amplification factor
+# subscribes /          subscription lifecycle events (drops = peers
+#   unsubscribes / drops   torn down with their connection)
+# backfills             subscribe-time missing-changes backfills
+# presence_frames       ephemeral (cursor) frames, incl. piggybacked
+# quarantine_frames     resilience envelopes fanned to subscribers of a
+#                         quarantined doc
+# vector_passes /       classification passes served by the vectorized
+#   scalar_passes         matrix vs the per-peer scalar loop
+#                         (AMTPU_FANOUT_VECTOR=0)
+# errors                fan-out passes that raised (flush survived)
+KNOWN_FANOUT_KEYS = ('flushes', 'docs', 'frames', 'encode_reuse',
+                     'coalesced_peers', 'straggler_peers',
+                     'uptodate_peers', 'bytes_encoded',
+                     'bytes_on_wire', 'subscribes', 'unsubscribes',
+                     'drops', 'backfills', 'presence_frames',
+                     'quarantine_frames', 'vector_passes',
+                     'scalar_passes', 'errors')
+
 # docs per gateway flush are effectively powers of two: exact log2 bounds
 BATCH_OCCUPANCY_BUCKETS = tuple(float(2 ** i) for i in range(13))
 
@@ -214,6 +250,14 @@ QUEUE_WAIT = registry.histogram(
     'amtpu_queue_wait_ms',
     'Milliseconds a mutating request waited in the gateway queue '
     'between arrival and the start of its flush',
+    buckets=QUEUE_WAIT_BUCKETS)
+
+# change->fanout latency shares the queue-wait bucket layout (ms, log2)
+FANOUT_LATENCY = registry.histogram(
+    'amtpu_fanout_latency_ms',
+    'Milliseconds from a mutating request\'s gateway admission to a '
+    'subscriber fan-out frame write for its doc (docs/SERVING.md '
+    'fan-out section; bounded by the flush window + flush execution)',
     buckets=QUEUE_WAIT_BUCKETS)
 
 # escalation tier widths are powers of two: exact log2 bucket bounds
@@ -469,6 +513,11 @@ def bench_block():
     mesh.update({k.split('.', 1)[1]: round(v, 6)
                  for k, v in flat.items()
                  if k.startswith('mesh.')})
+    fanout = {r: 0.0 for r in KNOWN_FANOUT_KEYS}
+    fanout.update({k.split('sync.fanout.', 1)[1]: round(v, 6)
+                   for k, v in flat.items()
+                   if k.startswith('sync.fanout.')})
+    fanout['latency_ms'] = FANOUT_LATENCY.summary() or {}
     block = {
         'fallbacks': fallbacks,
         'collect': collect,
@@ -477,6 +526,7 @@ def bench_block():
         'resident': resident,
         'pipeline': pipeline,
         'mesh': mesh,
+        'fanout': fanout,
         'device_s': round(flat.get('device.dispatch_sync_s', 0.0), 4),
         'device_dispatches': int(flat.get('device.dispatches', 0)),
         'batch_latency': BATCH_LATENCY.snapshot() or {},

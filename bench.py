@@ -944,6 +944,172 @@ BUILDERS = {1: build_config_1, 2: build_config_2, 3: build_config_3,
             4: build_config_4}
 
 
+def run_fanout(args):
+    """--fanout (ISSUE 9): the real collaboration workload -- RGA-heavy
+    text edits under zipfian doc popularity fanned out to 1k+
+    subscribed peers through a live in-process gateway -- plus the
+    vectorized-vs-scalar missing-changes classification A/B in the
+    same session.  Emits one BENCH_FANOUT JSON line with p50/p99
+    change->fanout latency, fan-out amplification (bytes-on-wire /
+    bytes-encoded), both A/B throughputs, and the embedded telemetry
+    block."""
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from automerge_tpu import telemetry
+    from automerge_tpu.parallel.mesh_encode import text_doc_changes
+    from automerge_tpu.scheduler import GatewayServer
+    from automerge_tpu.sidecar.client import SidecarClient
+    from automerge_tpu.sidecar.server import SidecarBackend
+    from automerge_tpu.sync.fanout import classify_scalar, classify_vector
+
+    n_peers = env_int('AMTPU_BENCH_FANOUT_PEERS', 1024)
+    n_docs = env_int('AMTPU_BENCH_FANOUT_DOCS', 24)
+    n_conns = env_int('AMTPU_BENCH_FANOUT_CONNS', 16)
+    n_rounds = env_int('AMTPU_BENCH_FANOUT_ROUNDS', 96)
+    zipf_s = float(os.environ.get('AMTPU_BENCH_FANOUT_ZIPF', '1.2'))
+    rng = random.Random(SEED)
+
+    # zipfian doc popularity: weight 1/k^s for doc rank k
+    weights = [1.0 / (k + 1) ** zipf_s for k in range(n_docs)]
+    doc_of_peer = rng.choices(range(n_docs), weights=weights, k=n_peers)
+    write_docs = rng.choices(range(n_docs), weights=weights, k=n_rounds)
+    subs_per_doc = [doc_of_peer.count(d) for d in range(n_docs)]
+
+    # RGA-heavy edit streams: one change per write round per doc
+    per_doc_changes = {}
+    for d in range(n_docs):
+        need = write_docs.count(d)
+        rounds = max(1, (need + 1) // 2)
+        per_doc_changes[d] = text_doc_changes(
+            'text-%d' % d, 2, rounds, 40,
+            lambda i, a, has: rng.random() < 0.15 and has)
+
+    path = os.path.join(tempfile.mkdtemp(), 'bench-fanout.sock')
+    telemetry.reset_all()
+    gw = GatewayServer(path, backend=SidecarBackend()).start()
+    drainers, counts, stop = [], [0] * n_conns, threading.Event()
+    try:
+        conns = [SidecarClient(sock_path=path) for _ in range(n_conns)]
+        for i, doc in enumerate(doc_of_peer):
+            conns[i % n_conns].subscribe('doc-%d' % doc,
+                                         peer='p%04d' % i)
+
+        def drain(ci):
+            while not stop.is_set():
+                try:
+                    e = conns[ci].next_event(timeout=0.2)
+                except ConnectionError:
+                    return
+                if e is not None and e.get('event') == 'change':
+                    counts[ci] += 1
+
+        drainers = [threading.Thread(target=drain, args=(ci,),
+                                     daemon=True)
+                    for ci in range(n_conns)]
+        for t in drainers:
+            t.start()
+
+        writer = SidecarClient(sock_path=path)
+        cursor = {d: 0 for d in range(n_docs)}
+        expected = 0
+        t0 = time.perf_counter()
+        for d in write_docs:
+            chs = per_doc_changes[d]
+            if cursor[d] < len(chs):
+                writer.apply_changes('doc-%d' % d, [chs[cursor[d]]])
+                cursor[d] += 1
+                expected += subs_per_doc[d]
+        # frames lag the final response by at most one flush window;
+        # wait for the server-side frame counter to reach/settle
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            got = telemetry.metrics_snapshot() \
+                .get('sync.fanout.frames', 0)
+            if got >= expected:
+                break
+            time.sleep(0.1)
+        wall = time.perf_counter() - t0
+        stop.set()
+        for t in drainers:
+            t.join(timeout=10)
+        for c in conns + [writer]:
+            c.close()
+    finally:
+        stop.set()
+        gw.stop()
+
+    snap = telemetry.metrics_snapshot()
+    lat = telemetry.FANOUT_LATENCY.summary() or {}
+    enc = snap.get('sync.fanout.bytes_encoded', 0.0)
+    wire = snap.get('sync.fanout.bytes_on_wire', 0.0)
+
+    # -- the vectorized-vs-scalar classification A/B (same session) ------
+    npr = np.random.RandomState(SEED)
+    A = 64
+    post = npr.randint(1, 50, size=(n_peers, A)).astype(np.int64)
+    pre = np.maximum(post - npr.randint(0, 3, size=(n_peers, A)), 0)
+    bel = np.where(npr.random_sample((n_peers, A)) < 0.9, pre,
+                   np.maximum(pre - 1, 0))
+
+    def rate(fn, min_s=0.8):
+        fn(bel, pre, post)                       # warm
+        n, t = 0, time.perf_counter()
+        while time.perf_counter() - t < min_s:
+            fn(bel, pre, post)
+            n += 1
+        return n_peers * n / (time.perf_counter() - t)
+
+    vec_rate = rate(classify_vector)
+    scal_rate = rate(classify_scalar)
+    speedup = vec_rate / scal_rate if scal_rate else float('inf')
+
+    line = {
+        'bench': 'fanout',
+        'peers': n_peers, 'docs': n_docs, 'conns': n_conns,
+        'write_rounds': n_rounds, 'zipf_s': zipf_s,
+        'hot_doc_subscribers': max(subs_per_doc),
+        'frames': int(snap.get('sync.fanout.frames', 0)),
+        'frames_drained': sum(counts),
+        'encode_reuse': int(snap.get('sync.fanout.encode_reuse', 0)),
+        'coalesced_peers': int(snap.get('sync.fanout.coalesced_peers',
+                                        0)),
+        'straggler_peers': int(snap.get('sync.fanout.straggler_peers',
+                                        0)),
+        'p50_ms': lat.get('p50'), 'p95_ms': lat.get('p95'),
+        'p99_ms': lat.get('p99'),
+        'amplification': round(wire / enc, 2) if enc else None,
+        'write_wall_s': round(wall, 3),
+        'classify_ab': {
+            'matrix_peers': n_peers, 'actors': A,
+            'vector_peers_per_s': round(vec_rate),
+            'scalar_peers_per_s': round(scal_rate),
+            'speedup': round(speedup, 1),
+        },
+        'fallback_oracle': snap.get('fallback.oracle', 0),
+        'telemetry': telemetry.bench_block(),
+    }
+    out = json.dumps(line)
+    print(out)
+    if args.out:
+        with open(args.out, 'w') as f:
+            f.write(out + '\n')
+        print('wrote BENCH_FANOUT line -> %s' % args.out,
+              file=sys.stderr)
+    print('fanout bench: %d peers, hot doc %d subs, p50 %.1fms p99 '
+          '%.1fms, amplification %.1fx, classify A/B %.0fk vs %.0fk '
+          'peers/s (%.1fx)'
+          % (n_peers, max(subs_per_doc), lat.get('p50', -1),
+             lat.get('p99', -1), line['amplification'] or 0,
+             vec_rate / 1e3, scal_rate / 1e3, speedup),
+          file=sys.stderr)
+    # the acceptance floor: the vectorized pass must beat the per-peer
+    # scalar loop by >= 5x on the 1k-peer shape
+    return 0 if speedup >= 5.0 and line['frames'] > 0 else 1
+
+
 def run_all(args):
     """--all: every config in every execution mode, one JSON-lines
     artifact (VERDICT r4 #5: a committed all-config file per round).
@@ -1023,6 +1189,12 @@ def main(argv=None):
                          'mesh pool mode: one subprocess per dp '
                          '(AMTPU_MULTICHIP_DP, default 1,2,4,8) + the '
                          'sp-crossover probe; write with --out')
+    ap.add_argument('--fanout', action='store_true',
+                    help='BENCH_FANOUT artifact (ISSUE 9): RGA-heavy '
+                         'text edits under zipfian doc popularity '
+                         'fanned to 1k+ subscribed peers through a '
+                         'live gateway + the vectorized-vs-scalar '
+                         'missing-changes A/B; write with --out')
     ap.add_argument('--out', default='',
                     help='with --all/--multichip: artifact path '
                          '(JSON lines)')
@@ -1036,6 +1208,8 @@ def main(argv=None):
         return run_all(args)
     if args.multichip:
         return run_multichip(args)
+    if args.fanout:
+        return run_fanout(args)
     if args.mode == 'host':
         os.environ['AMTPU_HOST_FULL'] = '1'
     elif args.mode == 'kernel':

@@ -1,0 +1,572 @@
+"""Batched sync fan-out (ISSUE 9): byte-parity of the vectorized
+(peer x doc) clock-matrix engine against a serial per-`Connection`
+replay, encode-once reuse accounting, straggler/reconnect backfills,
+quarantine envelopes, presence piggybacking, and the gateway wiring --
+plus the satellite fixes (in-place per-doc clock_union, DocSet dirty
+-doc draining).
+"""
+
+import json
+import os
+import tempfile
+import threading
+import time
+
+import pytest
+
+import automerge_tpu.backend as Backend
+import automerge_tpu.frontend as Frontend
+from automerge_tpu import telemetry
+from automerge_tpu.native import NativeDocPool
+from automerge_tpu.sync.connection import Connection, clock_union
+from automerge_tpu.sync.doc_set import DocSet
+from automerge_tpu.sync.fanout import (FanoutEngine, classify_scalar,
+                                       classify_vector)
+
+ROOT = '00000000-0000-0000-0000-000000000000'
+DOC = 'fan-doc'
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_hygiene():
+    """The live-gateway lanes observe registry histograms
+    (occupancy, fanout latency) that later suites assert fresh counts
+    on -- leave the whole registry as a fresh process would."""
+    yield
+    telemetry.reset_all()
+
+
+def ch(actor, seq, key, value, deps=None):
+    return {'actor': actor, 'seq': seq, 'deps': dict(deps or {}),
+            'ops': [{'action': 'set', 'obj': ROOT, 'key': key,
+                     'value': value}]}
+
+
+def canon(changes):
+    return json.dumps(changes, sort_keys=True, default=str)
+
+
+def history(n_actors=3, seqs=4):
+    """Concurrent multi-actor history: every change causally ready."""
+    out = []
+    for s in range(1, seqs + 1):
+        for a in range(n_actors):
+            out.append(ch('a%d' % a, s, 'k%d' % a, s * 10 + a))
+    return out
+
+
+def peer_clocks(n):
+    """n peers with empty / stale / divergent / exact clocks."""
+    clocks = {}
+    full = {'a0': 4, 'a1': 4, 'a2': 4}
+    for i in range(n):
+        kind = i % 4
+        if kind == 0:
+            clocks['p%03d' % i] = {}
+        elif kind == 1:
+            clocks['p%03d' % i] = {'a0': 1 + i % 3}
+        elif kind == 2:
+            clocks['p%03d' % i] = {'a0': 2, 'a1': 3, 'a2': 1 + i % 2}
+        else:
+            clocks['p%03d' % i] = dict(full)
+    return clocks
+
+
+class EngineHarness(object):
+    """FanoutEngine over a real NativeDocPool with captured frames."""
+
+    def __init__(self):
+        self.pool = NativeDocPool()
+        self.engine = FanoutEngine(
+            self.pool, lambda obj: (json.dumps(obj) + '\n').encode())
+        self.frames = {}
+
+    def send_for(self, peer):
+        def send(buf):
+            self.frames.setdefault(peer, []).append(buf)
+        return send
+
+    def subscribe(self, peer, clock, doc=DOC, **kw):
+        return self.engine.subscribe((1, peer), doc, clock,
+                                     self.send_for(peer), **kw)
+
+    def apply_and_flush(self, batch, doc=DOC, origins=None):
+        res = self.pool.apply_changes(doc, batch)
+        self.engine.on_flush({doc: res['clock']},
+                             enq={doc: time.perf_counter()},
+                             origins=origins)
+        return res
+
+    def received(self, peer, backfill=()):
+        out = list(backfill)
+        for buf in self.frames.get(peer, ()):
+            frame = json.loads(buf)
+            if frame.get('event') == 'change':
+                out.extend(frame['changes'])
+        return out
+
+
+def serial_replay(hist, clocks, batches):
+    """The reference shape: one `Connection` per peer over a DocSet,
+    every mutation fanned through the per-peer scalar handler chain.
+    Returns {peer: [change, ...]} in delivery order."""
+    ds = DocSet()
+    if hist:
+        ds.apply_changes(DOC, hist)
+    sent = {}
+    for peer, clock in clocks.items():
+        msgs = []
+        sent[peer] = msgs
+        conn = Connection(ds, msgs.append)
+        conn.open()
+        # the peer advertises its clock; the connection answers with
+        # exactly the changes it is missing (connection.js:91-108)
+        conn.receive_msg({'docId': DOC, 'clock': dict(clock)})
+    for batch in batches:
+        ds.apply_changes(DOC, batch)
+    return {peer: [c for m in msgs if m.get('changes')
+                   for c in m['changes']]
+            for peer, msgs in sent.items()}
+
+
+# ---------------------------------------------------------------------------
+# byte-parity lane: batched fan-out vs serial per-Connection replay
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize('vector', [True, False],
+                         ids=['vectorized', 'scalar-oracle'])
+def test_parity_batched_vs_serial_replay(vector, monkeypatch):
+    """50+ peers with divergent/stale/empty/exact clocks across several
+    flushes: every peer's received-change stream is byte-identical to
+    the serial per-Connection replay of the same traffic."""
+    monkeypatch.setenv('AMTPU_FANOUT_VECTOR', '1' if vector else '0')
+    hist = history()
+    clocks = peer_clocks(56)
+    batches = [
+        [ch('a0', 5, 'k0', 50), ch('a1', 5, 'k1', 51)],
+        [ch('w', 1, 'w', 1)],
+        [ch('a2', 5, 'k2', 52), ch('w', 2, 'w', 2)],
+    ]
+    h = EngineHarness()
+    h.pool.apply_changes(DOC, hist)
+    backfills = {p: h.subscribe(p, c)['changes']
+                 for p, c in clocks.items()}
+    for batch in batches:
+        h.apply_and_flush(batch)
+    expected = serial_replay(hist, clocks, batches)
+    for peer in clocks:
+        got = h.received(peer, backfills[peer])
+        assert canon(got) == canon(expected[peer]), \
+            'received-change divergence for %s (clock %r)' \
+            % (peer, clocks[peer])
+    snap = telemetry.metrics_snapshot()
+    key = 'sync.fanout.%s_passes' % ('vector' if vector else 'scalar')
+    assert snap.get(key, 0) >= len(batches)
+
+
+def test_vector_scalar_classify_identical():
+    """The two classification kernels agree bitwise on random clock
+    matrices (the A/B arms compute the same thing)."""
+    import numpy as np
+    rng = np.random.RandomState(7)
+    for _ in range(20):
+        n, a = rng.randint(1, 40), rng.randint(1, 9)
+        post = rng.randint(0, 5, size=(n, a)).astype(np.int64)
+        pre = np.maximum(post - rng.randint(0, 3, size=(n, a)), 0)
+        bel = np.maximum(post - rng.randint(0, 4, size=(n, a)), 0)
+        bv, ev = classify_vector(bel, pre, post)
+        bs, es = classify_scalar(bel, pre, post)
+        assert (bv == bs).all() and (ev == es).all()
+
+
+# ---------------------------------------------------------------------------
+# encode-once coalescing
+# ---------------------------------------------------------------------------
+
+def test_encode_once_reuse_counts_and_shared_bytes():
+    h = EngineHarness()
+    h.pool.apply_changes(DOC, [ch('a', 1, 'k', 1)])
+    telemetry.metrics_reset()
+    n = 60
+    for i in range(n):
+        h.subscribe('p%02d' % i, {'a': 1})
+    h.apply_and_flush([ch('a', 2, 'k', 2)])
+    bufs = {p: h.frames[p][-1] for p in h.frames}
+    assert len(bufs) == n
+    assert len(set(bufs.values())) == 1, \
+        'coalesced subscribers received different bytes'
+    snap = telemetry.metrics_snapshot()
+    assert snap['sync.fanout.encode_reuse'] == n - 1
+    assert snap['sync.fanout.coalesced_peers'] == n
+    assert snap.get('sync.fanout.straggler_peers', 0) == 0
+    # amplification: one encode, n sends
+    assert snap['sync.fanout.bytes_on_wire'] == \
+        n * snap['sync.fanout.bytes_encoded']
+
+
+def test_straggler_gets_filtered_delta_not_coalesced_bytes():
+    h = EngineHarness()
+    h.pool.apply_changes(DOC, [ch('a', 1, 'k', 1), ch('a', 2, 'k', 2)])
+    h.subscribe('fresh', {'a': 2})
+    # straggler registers at a stale clock with no backfill
+    h.subscribe('stale', {'a': 1}, backfill=False)
+    h.apply_and_flush([ch('b', 1, 'k2', 9)])
+    fresh = json.loads(h.frames['fresh'][-1])
+    stale = json.loads(h.frames['stale'][-1])
+    assert [(c['actor'], c['seq']) for c in fresh['changes']] == \
+        [('b', 1)]
+    assert sorted((c['actor'], c['seq']) for c in stale['changes']) == \
+        [('a', 2), ('b', 1)]
+    # both now converged: the next flush coalesces them together
+    telemetry.metrics_reset()
+    h.apply_and_flush([ch('b', 2, 'k2', 10)])
+    snap = telemetry.metrics_snapshot()
+    assert snap['sync.fanout.coalesced_peers'] == 2
+    assert snap['sync.fanout.encode_reuse'] == 1
+
+
+def test_reconnect_mid_flush_full_backfill():
+    """A peer that lost its connection re-subscribes (stale clock)
+    between a mutation and the flush pass: its backfill is complete and
+    the other subscribers still receive the flush's delta."""
+    h = EngineHarness()
+    h.pool.apply_changes(DOC, [ch('a', 1, 'k', 1)])
+    h.subscribe('steady', {'a': 1})
+    h.subscribe('flaky', {'a': 1})
+    h.engine.drop_conn(1)               # connection died entirely
+    h.frames.clear()
+    # the mutation lands, and BEFORE its on_flush the peers return
+    res = h.pool.apply_changes(DOC, [ch('a', 2, 'k', 2)])
+    back_flaky = h.subscribe('flaky', {'a': 1})
+    back_steady = h.subscribe('steady', {'a': 1})
+    # full backfill, not a coalesced delta that assumes pre-drop state
+    assert [(c['actor'], c['seq']) for c in back_flaky['changes']] == \
+        [('a', 2)]
+    assert back_flaky['clock'] == {'a': 2} == back_steady['clock']
+    h.engine.on_flush({DOC: res['clock']})
+    # flush after the re-subscribe: nobody is behind, nothing resent
+    assert not h.frames.get('flaky') and not h.frames.get('steady')
+    # and the engine keeps serving subsequent flushes
+    h.apply_and_flush([ch('a', 3, 'k', 3)])
+    assert [(c['actor'], c['seq'])
+            for c in json.loads(h.frames['flaky'][-1])['changes']] == \
+        [('a', 3)]
+
+
+def test_echo_suppression_via_origins():
+    h = EngineHarness()
+    h.subscribe('writer', {})                      # conn id 1
+    h.engine.subscribe((2, 'reader'), DOC, {},     # a DIFFERENT conn
+                       h.send_for('reader'))
+    h.apply_and_flush([ch('w', 1, 'k', 1)],
+                      origins={DOC: [(1, {'w': 1})]})
+    # origins carries the writer's OWN connection id (1): no echo
+    assert 'writer' not in h.frames
+    assert [(c['actor'], c['seq'])
+            for c in json.loads(h.frames['reader'][-1])['changes']] == \
+        [('w', 1)]
+
+
+def test_shared_transport_ships_copies_in_one_write():
+    """Peers registered with the SAME send callable (one connection
+    multiplexing many subscriptions -- the gateway passes each conn's
+    stable `raw_send`) receive their k copies of a coalesced frame as
+    ONE write of k concatenated frames."""
+    h = EngineHarness()
+    calls = []
+    shared = calls.append
+    for i in range(3):
+        h.engine.subscribe((1, 'm%d' % i), DOC, {}, shared)
+    h.engine.subscribe((2, 'solo'), DOC, {}, h.send_for('solo'))
+    h.apply_and_flush([ch('a', 1, 'k', 1)])
+    assert len(calls) == 1, 'expected ONE write for the shared conn'
+    single = h.frames['solo'][-1]
+    assert calls[0] == single * 3, 'shared write is not k frames'
+    snap = telemetry.metrics_snapshot()
+    assert snap['sync.fanout.coalesced_peers'] == 4
+    assert snap['sync.fanout.encode_reuse'] == 3
+
+
+def test_gateway_conn_raw_send_is_stable():
+    """The _Conn sender the gateway hands the engine must be ONE stable
+    object per connection, or the write-grouping above can never
+    engage (bound-method attribute access mints a new object)."""
+    from automerge_tpu.scheduler.gateway import _Conn
+
+    class _Sock(object):
+        def makefile(self, mode):
+            import io
+            return io.BytesIO()
+
+    conn = _Conn(_Sock(), gateway=None, cid=1)
+    assert conn.raw_send is conn.raw_send
+    assert conn.send_raw is not conn.send_raw     # the trap raw_send
+    # exists to avoid
+
+
+def test_exec_path_quarantine_still_fans_envelope():
+    """A quarantine surfaced through a SINGLE-doc entry point (serial
+    fallback replay, apply_local_change) is recognized from its raise
+    contract and still fans the envelope -- not silence."""
+    from automerge_tpu.native import _raise_if_quarantined
+    from automerge_tpu.resilience import is_quarantine_error
+    from automerge_tpu.errors import AutomergeError
+    # the raise contract round-trips through the protocol error shape
+    with pytest.raises(AutomergeError) as ei:
+        _raise_if_quarantined('d', {'error': 'device poisoned',
+                                    'errorType': 'AutomergeError'})
+    resp = {'id': 1, 'error': str(ei.value),
+            'errorType': 'AutomergeError'}
+    assert is_quarantine_error(resp)
+    assert not is_quarantine_error({'id': 1, 'error': 'bad seq',
+                                    'errorType': 'RangeError'})
+    assert not is_quarantine_error({'id': 1, 'error': 'plain failure',
+                                    'errorType': 'AutomergeError'})
+
+    # drive the gateway exec path with a backend that answers the
+    # quarantine raise shape: subscribers get the quarantined frame
+    from automerge_tpu.scheduler import GatewayServer
+    from automerge_tpu.scheduler.queue import PendingOp
+
+    class _QuarantineBackend(object):
+        class pool(object):
+            pass
+
+        def handle(self, req):
+            return {'id': req.get('id'), 'error': str(ei.value),
+                    'errorType': 'AutomergeError'}
+
+    class _FakeConn(object):
+        cid = 7
+        sent = None
+
+        def send(self, resp):
+            self.sent = resp
+
+    gw = GatewayServer.__new__(GatewayServer)
+    gw.backend = _QuarantineBackend()
+    h = EngineHarness()
+    gw.fanout = h.engine
+    h.subscribe('watcher', {})
+    fan = {'updates': {}, 'quarantined': {}, 'enq': {},
+           'origins': {}}
+    conn = _FakeConn()
+    op = PendingOp(conn, 1, 'apply_changes',
+                   {'id': 1, 'cmd': 'apply_changes', 'doc': DOC,
+                    'changes': [ch('a', 1, 'k', 1)]},
+                   (DOC,), 1, batchable=True)
+
+    class _NoQueue(object):
+        def note_complete(self, op):
+            pass
+
+    gw.queue = _NoQueue()
+    gw._run_exec(op, count=False, fan=fan)
+    assert conn.sent['errorType'] == 'AutomergeError'
+    assert DOC in fan['quarantined'], \
+        'exec-path quarantine not recorded for fan-out'
+    h.engine.on_flush(fan['updates'], fan['quarantined'], fan['enq'])
+    frame = json.loads(h.frames['watcher'][-1])
+    assert frame['event'] == 'quarantined'
+
+
+def test_matrix_growth_rows_and_columns():
+    """Amortized-doubling growth of both matrix axes: many actors in
+    one subscribe clock (column growth mid-call), many subscriptions
+    (row growth), and growth-while-classifying flushes."""
+    h = EngineHarness()
+    big_clock = {'x%02d' % i: 1 for i in range(20)}
+    h.subscribe('cold', big_clock)          # 20 actors into cap 8
+    for i in range(40):                     # 41 rows into cap 8
+        h.subscribe('p%02d' % i, {})
+    for s in range(1, 4):                   # new actor per flush
+        h.apply_and_flush([ch('y%02d' % s, 1, 'k', s)])
+    stats = h.engine.healthz_section()
+    assert stats['actors'] == 23
+    assert stats['live_subscriptions'] == 41
+    # every empty-clock subscriber saw every flush
+    for i in range(40):
+        got = [c['actor']
+               for buf in h.frames['p%02d' % i]
+               for c in json.loads(buf)['changes']]
+        assert got == ['y01', 'y02', 'y03']
+
+
+# ---------------------------------------------------------------------------
+# quarantine + presence
+# ---------------------------------------------------------------------------
+
+def test_quarantined_doc_fans_envelope_not_silence():
+    h = EngineHarness()
+    h.subscribe('p1', {})
+    h.subscribe('p2', {})
+    env = {'error': 'poisoned device batch',
+           'errorType': 'AutomergeError'}
+    h.engine.on_flush({}, quarantined={DOC: env})
+    for p in ('p1', 'p2'):
+        frame = json.loads(h.frames[p][-1])
+        assert frame['event'] == 'quarantined'
+        assert frame['error'] == env['error']
+        assert frame['errorType'] == env['errorType']
+
+
+def test_presence_piggybacks_and_presence_only_frames():
+    h = EngineHarness()
+    h.subscribe('p1', {})
+    h.subscribe('p2', {})
+    h.engine.presence((1, 'p1'), DOC, {'cursor': 11})
+    h.apply_and_flush([ch('a', 1, 'k', 1)])
+    frame = json.loads(h.frames['p2'][-1])
+    assert frame['event'] == 'change'
+    assert frame['presence'] == {'1/p1': {'cursor': 11}}
+    # presence-only flush: no mutation, ephemeral state still ships
+    h.engine.presence((1, 'p2'), DOC, {'cursor': 3})
+    h.engine.on_flush({})
+    frame = json.loads(h.frames['p1'][-1])
+    assert frame['event'] == 'presence'
+    assert frame['presence'] == {'1/p2': {'cursor': 3}}
+    # AMTPU_FANOUT_PRESENCE=0 sheds server-side
+    os.environ['AMTPU_FANOUT_PRESENCE'] = '0'
+    try:
+        assert h.engine.presence((1, 'p1'), DOC, {'x': 1}).get('shed')
+    finally:
+        del os.environ['AMTPU_FANOUT_PRESENCE']
+
+
+def test_unsubscribe_and_drop_conn_stop_frames():
+    h = EngineHarness()
+    h.subscribe('p1', {})
+    other = h.engine.subscribe((2, 'p2'), DOC, {}, h.send_for('p2'))
+    assert other['clock'] == {}
+    h.engine.unsubscribe((1, 'p1'), DOC)
+    h.engine.drop_conn(2)
+    h.apply_and_flush([ch('a', 1, 'k', 1)])
+    assert not h.frames
+    snap = telemetry.metrics_snapshot()
+    assert snap.get('sync.fanout.drops', 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# gateway wiring (live socket server)
+# ---------------------------------------------------------------------------
+
+def _next_change(client, timeout=30):
+    while True:
+        e = client.next_event(timeout=timeout)
+        if e is None or e['event'] == 'change':
+            return e
+
+
+def test_gateway_fanout_end_to_end(tmp_path):
+    from automerge_tpu.scheduler import GatewayServer
+    from automerge_tpu.sidecar.client import SidecarClient
+    from automerge_tpu.sidecar.server import SidecarBackend
+    path = str(tmp_path / 'gw-fan.sock')
+    os.environ['AMTPU_FLUSH_DEADLINE_MS'] = '5'
+    gw = GatewayServer(path, backend=SidecarBackend()).start()
+    try:
+        sub = SidecarClient(sock_path=path)
+        w = SidecarClient(sock_path=path)
+        w.apply_changes('gdoc', [ch('w', 1, 'k', 1)])
+        r = sub.subscribe('gdoc', peer='alice')
+        assert r['clock'] == {'w': 1} and len(r['changes']) == 1
+        w.subscribe('gdoc', peer='writer')
+        w.apply_changes('gdoc', [ch('w', 2, 'k', 2)])
+        e = _next_change(sub)
+        assert e['doc'] == 'gdoc' and e['clock'] == {'w': 2}
+        assert [(c['actor'], c['seq']) for c in e['changes']] == \
+            [('w', 2)]
+        # the writer's own connection is echo-suppressed
+        assert _next_change(w, timeout=1.0) is None
+        # presence roundtrip
+        sub.presence('gdoc', {'cursor': 4}, peer='alice')
+        pe = w.next_event(timeout=30)
+        assert pe['event'] == 'presence' \
+            and pe['presence']['1/alice'] == {'cursor': 4}
+        # fanout healthz section is live
+        h = w.healthz()
+        assert h['fanout']['live_subscriptions'] == 2
+        assert h['fanout'].get('frames', 0) >= 1
+        assert h['fanout']['latency_ms'].get('count', 0) >= 1
+        sub.close()
+        w.close()
+    finally:
+        gw.stop()
+        del os.environ['AMTPU_FLUSH_DEADLINE_MS']
+
+
+def test_gateway_fanout_disabled_answers_typed_error(tmp_path):
+    from automerge_tpu.errors import RangeError
+    from automerge_tpu.scheduler import GatewayServer
+    from automerge_tpu.sidecar.client import SidecarClient
+    from automerge_tpu.sidecar.server import SidecarBackend
+    path = str(tmp_path / 'gw-nofan.sock')
+    os.environ['AMTPU_FANOUT'] = '0'
+    try:
+        gw = GatewayServer(path, backend=SidecarBackend()).start()
+    finally:
+        del os.environ['AMTPU_FANOUT']
+    try:
+        with SidecarClient(sock_path=path) as c:
+            with pytest.raises(RangeError):
+                c.subscribe('d', peer='x')
+            # the mutation path is unaffected
+            p = c.apply_changes('d', [ch('a', 1, 'k', 1)])
+            assert p['clock'] == {'a': 1}
+    finally:
+        gw.stop()
+
+
+# ---------------------------------------------------------------------------
+# satellites: Connection clock-map + DocSet dirty set
+# ---------------------------------------------------------------------------
+
+def test_clock_union_updates_in_place_with_per_doc_isolation():
+    cm = {}
+    out = clock_union(cm, 'd1', {'a': 1})
+    assert out is cm and cm == {'d1': {'a': 1}}
+    before = cm['d1']
+    clock_union(cm, 'd2', {'b': 2})
+    assert cm['d1'] is before          # other docs untouched
+    clock_union(cm, 'd1', {'a': 3, 'c': 1})
+    assert cm['d1'] == {'a': 3, 'c': 1}
+    assert before == {'a': 1}          # per-doc isolation: the old
+    # entry object is not mutated (messages may still reference it)
+
+
+def test_docset_dirty_drain_per_flush():
+    ds = DocSet()
+    assert ds.drain_dirty() == set()
+    ds.apply_changes('d1', [ch('a', 1, 'k', 1)])
+    ds.apply_changes('d2', [ch('b', 1, 'k', 1)])
+    ds.apply_changes('d1', [ch('a', 2, 'k', 2)])
+    assert ds.dirty_docs == {'d1', 'd2'}
+    assert ds.drain_dirty() == {'d1', 'd2'}
+    assert ds.drain_dirty() == set()   # drained
+    ds.apply_changes('d2', [ch('b', 2, 'k', 2)])
+    assert ds.drain_dirty() == {'d2'}
+
+
+def test_connection_open_advertises_all_docs_single_state_fetch():
+    ds = DocSet()
+    ds.apply_changes('d1', [ch('a', 1, 'k', 1)])
+    ds.apply_changes('d2', [ch('b', 1, 'k', 1)])
+    fetches = []
+    real = Frontend.get_backend_state
+
+    def counting(doc):
+        fetches.append(1)
+        return real(doc)
+
+    msgs = []
+    conn = Connection(ds, msgs.append)
+    orig = Frontend.get_backend_state
+    Frontend.get_backend_state = counting
+    try:
+        conn.open()
+    finally:
+        Frontend.get_backend_state = orig
+    assert len(msgs) == 2              # one advertisement per doc
+    assert {m['docId'] for m in msgs} == {'d1', 'd2'}
+    assert len(fetches) == 2, \
+        'open() fetched backend state more than once per doc'

@@ -1,0 +1,230 @@
+"""Batched-sync-fan-out gate (ISSUE 9, docs/SERVING.md fan-out
+section): the encode-once coalesced path must actually reuse its
+encoding, deliver byte-identical change streams to a serial
+per-`Connection` replay, meet the change->fanout p99 SLO on the smoke
+shape, and never push the pool off the kernel path.
+
+One REAL gateway server subprocess on a unix socket:
+
+  1. **encode-once + parity** -- 1 popular doc x 200 subscribers (8
+     connections x 25 multiplexed peers, all empty clocks) + a
+     subscribed writer.  Each of ``ROUNDS`` writer mutations must fan
+     out to every subscriber; gates:
+       * ``sync.fanout.encode_reuse >= 199`` (N subscribers -> >= N-1
+         reuses of one encoding);
+       * every subscriber's concatenated received-change stream
+         byte-identical (canonical JSON) to the serial per-Connection
+         replay of the same traffic, including a STRAGGLER that joins
+         mid-run at a stale clock with no backfill;
+       * the writer's own connection receives no echo frame.
+  2. **SLO** -- ``amtpu_fanout_latency_ms`` p50 under 150 ms and p99
+     under the gate (``AMTPU_SMOKE_FANOUT_P99_MS``, default 750 ms --
+     deliberately padded: this check runs 10 processes on a 2-core CI
+     stand-in, so the tail is scheduler jitter, not fan-out cost; the
+     BENCH_FANOUT artifact records the real distribution).
+  3. **kernel-path hygiene** -- ``fallback.oracle == 0`` after the run.
+
+Run: JAX_PLATFORMS=cpu python tools/fanout_check.py   (make fanout-check)
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N_CONNS = 8
+PEERS_PER_CONN = 25
+N_SUBS = N_CONNS * PEERS_PER_CONN
+ROUNDS = 6
+STRAGGLER_JOIN_ROUND = 3      # joins after this round, at round-1 clock
+ROOT_ID = '00000000-0000-0000-0000-000000000000'
+DOC = 'hot-doc'
+
+
+def change(seq):
+    return {'actor': 'writer', 'seq': seq, 'deps': {},
+            'ops': [{'action': 'set', 'obj': ROOT_ID,
+                     'key': 'k%d' % (seq % 3), 'value': seq}]}
+
+
+def spawn_server(path, extra_env=None):
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS='cpu')
+    env.update(extra_env or {})
+    proc = subprocess.Popen(
+        [sys.executable, '-m', 'automerge_tpu.sidecar.server',
+         '--socket', path], env=env, cwd=REPO)
+    deadline = time.time() + 60
+    while not os.path.exists(path):
+        if time.time() > deadline or proc.poll() is not None:
+            raise RuntimeError('gateway server did not come up')
+        time.sleep(0.05)
+    return proc
+
+
+def stop_server(proc):
+    proc.terminate()
+    try:
+        proc.wait(timeout=30)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait(timeout=30)
+
+
+def canon(changes):
+    return json.dumps(changes, sort_keys=True)
+
+
+def serial_replay():
+    """The same traffic through per-peer Connections over a DocSet --
+    the reference's scalar shape, computed in-process."""
+    from automerge_tpu.sync.connection import Connection
+    from automerge_tpu.sync.doc_set import DocSet
+    ds = DocSet()
+    msgs = []
+    conn = Connection(ds, msgs.append)
+    conn.open()
+    conn.receive_msg({'docId': DOC, 'clock': {}})
+    straggler_msgs = []
+    for r in range(1, ROUNDS + 1):
+        ds.apply_changes(DOC, [change(r)])
+        if r == STRAGGLER_JOIN_ROUND:
+            sconn = Connection(ds, straggler_msgs.append)
+            sconn.open()
+            sconn.receive_msg({'docId': DOC, 'clock': {'writer': 1}})
+    sub_stream = [c for m in msgs if m.get('changes')
+                  for c in m['changes']]
+    straggler_stream = [c for m in straggler_msgs if m.get('changes')
+                        for c in m['changes']]
+    return sub_stream, straggler_stream
+
+
+def drain_changes(client, want, timeout=120):
+    got = []
+    deadline = time.time() + timeout
+    while len(got) < want:
+        e = client.next_event(timeout=max(0.1, deadline - time.time()))
+        if e is None:
+            break
+        if e.get('event') == 'change':
+            got.append(e)
+    return got
+
+
+def main():
+    from automerge_tpu.sidecar.client import SidecarClient
+    from automerge_tpu.utils.common import env_float
+    p99_gate = env_float('AMTPU_SMOKE_FANOUT_P99_MS', 750.0)
+    p50_gate = env_float('AMTPU_SMOKE_FANOUT_P50_MS', 150.0)
+    path = os.path.join(tempfile.mkdtemp(), 'gw-fanout.sock')
+    proc = spawn_server(path, {'AMTPU_FLUSH_DEADLINE_MS': '5'})
+    subs, errors = [], []
+    try:
+        # 200 subscribers across 8 connections, in parallel
+        def connect(i):
+            try:
+                c = SidecarClient(sock_path=path)
+                for p in range(PEERS_PER_CONN):
+                    r = c.subscribe(DOC, peer='c%d-p%02d' % (i, p))
+                    assert r['clock'] == {} and r['changes'] == [], r
+                subs.append(c)
+            except Exception as e:
+                errors.append('conn %d: %s: %s'
+                              % (i, type(e).__name__, e))
+        threads = [threading.Thread(target=connect, args=(i,))
+                   for i in range(N_CONNS)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, errors
+        assert len(subs) == N_CONNS
+
+        writer = SidecarClient(sock_path=path)
+        writer.subscribe(DOC, peer='writer')
+        straggler = None
+        for r in range(1, ROUNDS + 1):
+            writer.apply_changes(DOC, [change(r)])
+            if r == STRAGGLER_JOIN_ROUND:
+                # a peer joins mid-run at a stale clock WITHOUT a
+                # backfill: the next flush must serve its gap through
+                # the per-peer straggler filter
+                straggler = SidecarClient(sock_path=path)
+                sr = straggler.subscribe(DOC, clock={'writer': 1},
+                                         peer='straggler',
+                                         backfill=False)
+                assert sr['changes'] == [], sr
+
+        exp_stream, exp_straggler = serial_replay()
+
+        # every subscriber connection: 25 identical frames per flush;
+        # collapse consecutive duplicates into flush units and compare
+        # the concatenated per-peer change stream
+        for i, c in enumerate(subs):
+            frames = drain_changes(c, PEERS_PER_CONN * ROUNDS)
+            assert len(frames) == PEERS_PER_CONN * ROUNDS, \
+                'conn %d got %d/%d change frames' \
+                % (i, len(frames), PEERS_PER_CONN * ROUNDS)
+            per_peer = {}
+            for f in frames:
+                per_peer.setdefault(canon(f['clock']), f)
+            stream = [ch for key in sorted(
+                per_peer, key=lambda k: json.loads(k).get('writer', 0))
+                for ch in per_peer[key]['changes']]
+            assert canon(stream) == canon(exp_stream), \
+                'conn %d change stream diverged from serial replay' % i
+        print('fanout-check: parity OK (%d subscribers x %d rounds '
+              'byte-identical to serial per-Connection replay)'
+              % (N_SUBS, ROUNDS))
+
+        s_frames = drain_changes(straggler,
+                                 ROUNDS - STRAGGLER_JOIN_ROUND)
+        s_stream = [ch for f in s_frames for ch in f['changes']]
+        assert canon(s_stream) == canon(exp_straggler), \
+            'straggler stream diverged from serial replay'
+        print('fanout-check: straggler OK (filtered delta == serial '
+              'replay backfill+deltas, %d changes)' % len(s_stream))
+
+        # the writer connection must never see its own change echoed
+        echo = writer.next_event(timeout=1.0)
+        while echo is not None and echo.get('event') != 'change':
+            echo = writer.next_event(timeout=1.0)
+        assert echo is None, 'writer received echo frame: %r' % echo
+
+        h = writer.healthz()
+        fan = h['fanout']
+        reuse = fan.get('encode_reuse', 0)
+        assert reuse >= (N_SUBS - 1), \
+            'encode_reuse %.0f < %d: the coalesced path is not ' \
+            'reusing its encoding' % (reuse, N_SUBS - 1)
+        lat = fan['latency_ms']
+        assert lat.get('count', 0) >= N_SUBS, lat
+        assert lat['p50'] < p50_gate, \
+            'change->fanout p50 %.1fms over the %.0fms gate (%r)' \
+            % (lat['p50'], p50_gate, lat)
+        assert lat['p99'] < p99_gate, \
+            'change->fanout p99 %.1fms over the %.0fms gate (%r)' \
+            % (lat['p99'], p99_gate, lat)
+        assert h['scheduler']['fallback_oracle'] == 0, h['scheduler']
+        amp = fan.get('bytes_on_wire', 0) / max(
+            1.0, fan.get('bytes_encoded', 0))
+        print('fanout-check: encode-once OK (reuse=%d >= %d; '
+              'amplification %.1fx)' % (reuse, N_SUBS - 1, amp))
+        print('fanout-check: SLO OK (change->fanout p50 %.1fms / p99 '
+              '%.1fms < %.0fms; oracle=0)'
+              % (lat['p50'], lat['p99'], p99_gate))
+        for c in subs + [writer, straggler]:
+            c.close()
+    finally:
+        stop_server(proc)
+    print('FANOUT-CHECK GREEN')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
