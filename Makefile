@@ -3,7 +3,7 @@
 .PHONY: all native test bench bench-all bench-tpu bench-multichip check \
 	clean wheel telemetry-check fallback-check perf-smoke chaos-check \
 	serve-check mesh-check static-check asan-check fanout-check \
-	bench-fanout
+	bench-fanout storage-check
 
 all: native
 
@@ -57,6 +57,7 @@ check: native
 	$(MAKE) chaos-check
 	$(MAKE) serve-check
 	$(MAKE) fanout-check
+	$(MAKE) storage-check
 	$(MAKE) mesh-check
 	$(MAKE) asan-check
 	@echo "CHECK GREEN"
@@ -107,6 +108,16 @@ fanout-check: native
 # vectorized-vs-scalar missing-changes A/B in the same session.
 bench-fanout: native
 	JAX_PLATFORMS=cpu python bench.py --fanout --out BENCH_FANOUT.json
+
+# Cold-state gate (ISSUE 10, docs/STORAGE.md): the config-4 change
+# corpus must columnar-encode >= 5x smaller than its JSON bytes, a
+# rolling churn workload with settled-history GC must end with a
+# strictly smaller retained arena than the no-GC arm (byte-identical
+# patches), save -> evict -> reload -> mutate must equal a never-
+# evicted twin, and fallback.oracle must stay 0 throughout.  Writes
+# the BENCH_STORAGE artifact.
+storage-check: native
+	JAX_PLATFORMS=cpu python tools/storage_check.py
 
 # Observability gate (docs/OBSERVABILITY.md): idle telemetry must be
 # free.  Interleaved A/B of the disabled path vs a no-op-patched "raw"

@@ -45,6 +45,7 @@ PRESEED_BLOCKS = {
     'resilience': 'KNOWN_RESILIENCE_KEYS',
     'scheduler': 'KNOWN_SCHEDULER_KEYS',
     'sync.fanout': 'KNOWN_FANOUT_KEYS',
+    'storage': 'KNOWN_STORAGE_KEYS',
 }
 
 

@@ -90,8 +90,21 @@ ENV_FLAGS = (
     EnvFlag('AMTPU_DEGRADE', 'bool', False, False, 'resilience.py'),
     EnvFlag('AMTPU_FAULT', 'str', '', False, 'faults.py'),
     EnvFlag('AMTPU_FAULT_SEED', 'raw', None, False, 'faults.py'),
+    # -- columnar storage / cold-state tier (ISSUE 10) ----------------------
+    EnvFlag('AMTPU_STORAGE_FORMAT', 'str', 'columnar', False,
+            'storage/__init__.py (json = v1 parity-oracle arm)'),
+    EnvFlag('AMTPU_STORAGE_DIR', 'str', '', False,
+            'storage/coldstore.py (empty -> fresh tempdir)'),
+    EnvFlag('AMTPU_STORAGE_GC_MIN', 'int', 256, False,
+            'storage/coldstore.py (mutations per doc between settled '
+            '-history folds; 0 disables GC)'),
+    EnvFlag('AMTPU_RESIDENT_DOCS_MAX', 'int', 0, False,
+            'storage/coldstore.py (0 = no cold-doc eviction)'),
     # -- sidecar client -----------------------------------------------------
     EnvFlag('AMTPU_WAL_COMPACT', 'int', 32, False, 'sidecar/client.py'),
+    EnvFlag('AMTPU_WAL_MAX_BYTES', 'int', 67108864, False,
+            'sidecar/client.py (log-byte compaction trigger; <=0 '
+            'disables the byte bound)'),
     EnvFlag('AMTPU_SIDECAR_DEADLINE_S', 'float', 0, False,
             'sidecar/client.py (0 -> no deadline)'),
     EnvFlag('AMTPU_SIDECAR_HEARTBEAT_S', 'float', 0, False,

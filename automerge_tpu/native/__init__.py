@@ -165,6 +165,18 @@ def _load():
     lib.amtpu_save.restype = ctypes.POINTER(ctypes.c_uint8)
     lib.amtpu_save.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64)]
+    lib.amtpu_truncate_history.restype = ctypes.c_int64
+    lib.amtpu_truncate_history.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_int64]
+    lib.amtpu_get_missing_clock.restype = ctypes.POINTER(ctypes.c_uint8)
+    lib.amtpu_get_missing_clock.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64)]
+    lib.amtpu_history_bytes.restype = ctypes.c_int64
+    lib.amtpu_history_bytes.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.amtpu_drop_doc.restype = ctypes.c_int64
+    lib.amtpu_drop_doc.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.amtpu_get_clock.restype = ctypes.POINTER(ctypes.c_uint8)
     lib.amtpu_get_clock.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64)]
@@ -414,26 +426,77 @@ def apply_payloads_pipelined(pools_payloads):
 
 
 #: fixed byte prefix of a v1 checkpoint; the remainder is the raw
-#: changes array
+#: changes array (the v2 columnar container lives in
+#: automerge_tpu.storage -- this alias keeps the byte-splice loader
+#: self-contained)
 _CKPT_PREFIX = (b'\x82' + msgpack.packb('format') +
                 msgpack.packb('amtpu-doc-v1') + msgpack.packb('changes'))
+
+
+def _base_pool_of(pool, doc_id):
+    """The NativeDocPool that actually owns `doc_id`'s state: sharded /
+    mesh pools route per doc; a plain pool is its own base."""
+    if hasattr(pool, '_shard_of'):
+        return pool.pools[pool._shard_of(doc_id)]
+    return pool
 
 
 def _load_batch(pool, blobs):
     """Splices many save() checkpoints into ONE {doc: [changes]} payload
     and applies it as a single batch -- per-doc loads each pay a full
-    device round trip; a whole DocSet restore should pay one."""
+    device round trip; a whole DocSet restore should pay one.  v2
+    columnar containers (docs/STORAGE.md) decode their snapshot chunks
+    here and, post-apply, re-adopt them so a reloaded doc keeps its
+    compacted cold-state economics."""
+    from .. import storage
     from ..errors import RangeError
     if faults.ARMED:
         faults.fire('checkpoint.load', [doc_key(d) for d in blobs])
     parts = [_map_header(len(blobs))]
+    adopts = []          # (doc_id, key, frontier, chunks) post-apply
     for doc_id, data in blobs.items():
-        if not data.startswith(_CKPT_PREFIX):
-            raise RangeError('not an amtpu-doc-v1 checkpoint: %r'
+        key = doc_key(doc_id)
+        if data.startswith(_CKPT_PREFIX):
+            parts.append(msgpack.packb(key, use_bin_type=True))
+            parts.append(memoryview(data)[len(_CKPT_PREFIX):])
+            continue
+        if not data.startswith(storage.CKPT_V2_PREFIX):
+            raise RangeError('not an amtpu-doc checkpoint: %r'
                              % (doc_id,))
-        parts.append(msgpack.packb(doc_key(doc_id), use_bin_type=True))
-        parts.append(memoryview(data)[len(_CKPT_PREFIX):])
+        try:
+            frontier, chunks, tail = \
+                storage.unpack_checkpoint(bytes(data))
+            raws = []
+            for chunk in chunks:
+                raws.extend(storage.decode_columnar(chunk))
+        except ValueError as e:
+            # the RangeError contract covers corrupt containers too --
+            # whatever the columnar decoder tripped on internally
+            raise RangeError('corrupt checkpoint for %r: %s'
+                             % (doc_id, e))
+        raws.extend(tail)
+        parts.append(msgpack.packb(key, use_bin_type=True))
+        parts.append(storage.join_changes_array(raws))
+        if frontier and chunks and storage.storage_format() != 'json':
+            # adopt ONLY into docs that are empty pre-load: loading an
+            # (older) checkpoint into a LIVE doc replays as seq-deduped
+            # no-ops, and overwriting that doc's storage state with the
+            # checkpoint's would discard newer compacted chunks (changes
+            # then live in neither arena nor snapshot) -- and the
+            # checkpoint's application-order prefix need not be a
+            # prefix of the live doc's.  A live target just stays on
+            # its own (possibly uncompacted) state.
+            pre = {}
+            try:
+                pre = pool.get_clock(doc_id).get('clock') or {}
+            except Exception:
+                pass
+            if not pre:
+                adopts.append((doc_id, key, frontier, chunks))
     pool.apply_batch_bytes(b''.join(parts))
+    for doc_id, key, frontier, chunks in adopts:
+        _base_pool_of(pool, doc_id)._adopt_snapshot(key, frontier,
+                                                    chunks)
 
 
 def _apply_batch_dicts(pool, changes_by_doc):
@@ -700,6 +763,13 @@ class NativeDocPool:
         from .resident import ResidentCache
         self._resident = ResidentCache()
         self._resclk = PoolClockCache()
+        # per-doc settled-history snapshots (ISSUE 10, docs/STORAGE.md):
+        # doc key -> {'frontier': {actor: seq}, 'chunks': [columnar
+        # blob, ...]}.  The chunks hold exactly the changes <= frontier
+        # in application order; the C++ arena holds only the tail.
+        # Driven single-threaded under the callers' pool serialization
+        # (the gateway's pool lock), like every other pool mutation.
+        self._storage = {}
 
     @staticmethod
     def _backend_is_cpu():
@@ -1915,26 +1985,62 @@ class NativeDocPool:
             _raise_last()
         return msgpack.unpackb(_take_buf(ptr, out_len.value), raw=False)
 
-    def save(self, doc_id):
-        """Checkpoint one doc as msgpack bytes: the full change history in
-        application order (the reference's save serializes opSet.history,
-        src/automerge.js:45-52).  Load with `load()` on any pool."""
+    def _tail_raws(self, key):
+        """Raw msgpack bytes of the changes the C++ arena still holds
+        for `key` (the post-truncation tail), application order."""
+        from .. import storage
         out_len = ctypes.c_int64()
-        ptr = lib().amtpu_save(
-            self._pool, self._doc_key(doc_id).encode(),
-            ctypes.byref(out_len))
+        ptr = lib().amtpu_save(self._pool, key.encode(),
+                               ctypes.byref(out_len))
         if not ptr:
             _raise_last()
-        return _take_buf(ptr, out_len.value)
+        raw_v1 = _take_buf(ptr, out_len.value)
+        return storage.split_changes_array(
+            memoryview(raw_v1)[len(_CKPT_PREFIX):])
+
+    def _snapshot_raws(self, st):
+        from .. import storage
+        out = []
+        for chunk in st['chunks']:
+            out.extend(storage.decode_columnar(chunk))
+        return out
+
+    def save(self, doc_id):
+        """Checkpoint one doc as msgpack bytes: by default the v2
+        COLUMNAR container (settled snapshot chunks + delta/RLE-encoded
+        tail, docs/STORAGE.md) -- compacted docs reuse their cached
+        snapshot bytes, so save cost is O(tail), not O(history).
+        ``AMTPU_STORAGE_FORMAT=json`` emits the PR-4 v1 container (raw
+        change history, the parity oracle).  Load with `load()` on any
+        pool; both formats restore byte-identically (the reference's
+        save serializes opSet.history, src/automerge.js:45-52)."""
+        from .. import storage
+        key = self._doc_key(doc_id)
+        st = self._storage.get(key)
+        tail = self._tail_raws(key)
+        if storage.storage_format() == 'json':
+            if not st or not st['chunks']:
+                return storage.pack_checkpoint_v1(tail)
+            # parity-oracle arm of a doc compacted earlier (format
+            # flipped mid-process / v2 blob loaded): reconstruct the
+            # full v1 history
+            return storage.pack_checkpoint_v1(
+                self._snapshot_raws(st) + tail)
+        frontier = dict(st['frontier']) if st else {}
+        chunks = list(st['chunks']) if st else []
+        return storage.pack_checkpoint(frontier, chunks, tail)
 
     def load(self, doc_id, data):
-        """Restores a `save()` checkpoint as ONE batched replay (the
-        reference replays scalar, O(history) through a fresh backend --
-        here the whole history resolves in a single kernel pass).
-        Returns the doc's whole-state patch."""
-        if not data.startswith(_CKPT_PREFIX):
+        """Restores a `save()` checkpoint (either container format) as
+        ONE batched replay (the reference replays scalar, O(history)
+        through a fresh backend -- here the whole history resolves in a
+        single kernel pass).  A v2 container's settled snapshot is re-
+        adopted, so a reloaded doc stays compacted.  Returns the doc's
+        whole-state patch."""
+        from .. import storage
+        if not storage.is_checkpoint(data):
             from ..errors import RangeError
-            raise RangeError('not an amtpu-doc-v1 checkpoint')
+            raise RangeError('not an amtpu-doc checkpoint')
         _load_batch(self, {doc_id: data})
         return self.get_patch(doc_id)
 
@@ -1953,15 +2059,73 @@ class NativeDocPool:
             _raise_last()
         return msgpack.unpackb(_take_buf(ptr, out_len.value), raw=False)
 
-    def get_missing_changes(self, doc_id, have_deps):
+    def _missing_clock(self, key, have_deps):
+        """The transitively-closed {actor: from_seq} clock the C++
+        missing-changes walk serves from (the same closure, exposed)."""
         have = msgpack.packb(dict(have_deps), use_bin_type=True)
         out_len = ctypes.c_int64()
-        ptr = lib().amtpu_get_missing_changes(
-            self._pool, self._doc_key(doc_id).encode(), have, len(have),
+        ptr = lib().amtpu_get_missing_clock(
+            self._pool, key.encode(), have, len(have),
             ctypes.byref(out_len))
         if not ptr:
             _raise_last()
         return msgpack.unpackb(_take_buf(ptr, out_len.value), raw=False)
+
+    def _missing_changes_raw(self, key, have_deps):
+        have = msgpack.packb(dict(have_deps), use_bin_type=True)
+        out_len = ctypes.c_int64()
+        ptr = lib().amtpu_get_missing_changes(
+            self._pool, key.encode(), have, len(have),
+            ctypes.byref(out_len))
+        if not ptr:
+            _raise_last()
+        return _take_buf(ptr, out_len.value)
+
+    def get_missing_changes(self, doc_id, have_deps):
+        """Changes the requester is missing given its `have_deps`
+        clock.  A doc compacted behind the settled frontier serves a
+        straggler (whose closure reaches into the snapshot) by merging
+        snapshot-decoded changes with the C++ tail, in exactly the
+        order the untruncated walk would have produced -- byte parity
+        is the GC-frontier test lane's contract (docs/STORAGE.md)."""
+        from .. import storage
+        key = self._doc_key(doc_id)
+        st = self._storage.get(key)
+        if st and st['chunks']:
+            from_clock = self._missing_clock(key, have_deps)
+            if any(from_clock.get(a, 0) < s
+                   for a, s in st['frontier'].items()):
+                telemetry.metric('storage.snapshot_backfills')
+                raws = self._merged_missing_raws(key, st, from_clock)
+                return [msgpack.unpackb(r, raw=False,
+                                        strict_map_key=False)
+                        for r in raws]
+        return msgpack.unpackb(self._missing_changes_raw(key, have_deps),
+                               raw=False)
+
+    def _merged_missing_raws(self, key, st, from_clock):
+        """Snapshot + tail merge: per actor in first-seen application
+        order, changes with seq > from_clock[actor], seq ascending --
+        the exact emission order of the C++ walk over full history."""
+        from .. import storage
+        full = []
+        for chunk in st['chunks']:
+            full.extend(storage.decode_columnar_meta(chunk))
+        for raw in self._tail_raws(key):
+            c = msgpack.unpackb(raw, raw=False, strict_map_key=False)
+            full.append((raw, c.get('actor'), c.get('seq')))
+        actor_order, per_actor = [], {}
+        for raw, actor, seq in full:
+            if actor not in per_actor:
+                actor_order.append(actor)
+                per_actor[actor] = []
+            per_actor[actor].append((seq, raw))
+        out = []
+        for actor in actor_order:
+            frm = from_clock.get(actor, 0)
+            out.extend(raw for seq, raw in per_actor[actor]
+                       if seq is not None and seq > frm)
+        return out
 
     def get_register(self, doc_id, obj, key):
         """Current field ops of one (obj, key), winner first -- the
@@ -1983,14 +2147,123 @@ class NativeDocPool:
     def get_changes_for_actor_bytes(self, doc_id, actor, after_seq=0):
         """Raw msgpack array of changes -- the zero-decode shipping path
         replica catch-up uses (change bytes pass sender -> receiver
-        without ever becoming Python objects)."""
+        without ever becoming Python objects).  Compacted docs splice
+        snapshot-decoded raws ahead of the C++ tail (decode_columnar is
+        byte-lossless, so the shipped bytes are identical either way)."""
+        from .. import storage
+        key = self._doc_key(doc_id)
         out_len = ctypes.c_int64()
         ptr = lib().amtpu_get_changes_for_actor(
-            self._pool, self._doc_key(doc_id).encode(), actor.encode(),
+            self._pool, key.encode(), actor.encode(),
             after_seq, ctypes.byref(out_len))
         if not ptr:
             _raise_last()
-        return _take_buf(ptr, out_len.value)
+        buf = _take_buf(ptr, out_len.value)
+        st = self._storage.get(key)
+        if not st or not st['chunks'] \
+                or after_seq >= st['frontier'].get(actor, 0):
+            return buf
+        telemetry.metric('storage.snapshot_backfills')
+        head = []
+        for chunk in st['chunks']:
+            for raw, a, seq in storage.decode_columnar_meta(chunk):
+                if a == actor and seq is not None and seq > after_seq:
+                    head.append(raw)
+        return storage.join_changes_array(
+            head + storage.split_changes_array(buf))
+
+    # -- settled-history GC + cold-doc eviction (ISSUE 10) ---------------
+
+    def _adopt_snapshot(self, key, frontier, chunks):
+        """Installs a checkpoint's settled snapshot for `key` and
+        truncates the C++ arena behind its frontier (reload keeps the
+        compacted economics; docs/STORAGE.md)."""
+        self._storage[key] = {'frontier': dict(frontier),
+                              'chunks': list(chunks)}
+        self._truncate(key, frontier)
+
+    def _truncate(self, key, frontier):
+        fb = msgpack.packb(dict(frontier), use_bin_type=True)
+        freed = lib().amtpu_truncate_history(self._pool, key.encode(),
+                                             fb, len(fb))
+        if freed < 0:
+            _raise_last()
+        telemetry.metric('storage.gc.bytes_freed', freed)
+        return freed
+
+    def compact(self, doc_id, frontier=None, min_changes=0):
+        """Folds the causally-settled PREFIX of the doc's history into
+        its columnar snapshot and truncates the arena behind it.
+
+        `frontier` is the settled {actor: seq} clock (every peer's
+        acked coverage -- the gateway passes the fan-out engine's
+        pointwise-min believed clock); None means no external
+        constraint (no live subscribers), i.e. everything applied is
+        settled.  Only the longest history PREFIX at or behind the
+        frontier folds: application order is part of the materialize
+        contract (concurrent changes resolve key order by arrival), so
+        the snapshot must stay an exact order-preserving prefix.
+        Returns the number of changes folded (0 = nothing to do;
+        ``AMTPU_STORAGE_FORMAT=json`` makes this a no-op, the parity
+        -oracle arm)."""
+        from .. import storage
+        key = self._doc_key(doc_id)
+        if storage.storage_format() == 'json':
+            telemetry.metric('storage.gc.skipped_json')
+            return 0
+        clock = self.get_clock(doc_id).get('clock') or {}
+        if not clock:
+            return 0
+        if frontier is None:
+            limit = dict(clock)
+        else:
+            limit = {}
+            for a, s in frontier.items():
+                s = min(int(s), int(clock.get(a, 0)))
+                if s > 0:
+                    limit[a] = s
+            if not limit:
+                return 0
+        tail = self._tail_raws(key)
+        fold, prefix_clock = [], {}
+        for raw in tail:
+            c = msgpack.unpackb(raw, raw=False, strict_map_key=False)
+            actor, seq = c.get('actor'), c.get('seq', 0)
+            if seq > limit.get(actor, 0):
+                break            # first unsettled change ends the prefix
+            fold.append(raw)
+            prefix_clock[actor] = max(prefix_clock.get(actor, 0), seq)
+        if not fold or len(fold) < min_changes:
+            return 0
+        st = self._storage.setdefault(key, {'frontier': {},
+                                            'chunks': []})
+        st['chunks'].append(storage.encode_columnar(fold))
+        for a, s in prefix_clock.items():
+            st['frontier'][a] = max(st['frontier'].get(a, 0), s)
+        self._truncate(key, st['frontier'])
+        telemetry.metric('storage.gc.compactions')
+        telemetry.metric('storage.gc.changes_folded', len(fold))
+        return len(fold)
+
+    def drop_doc(self, doc_id):
+        """Cold-doc eviction: removes the doc's entire state from the
+        pool (checkpoint it FIRST -- `save()` -> disk; reload is
+        `load()`).  Returns True if the doc existed."""
+        key = self._doc_key(doc_id)
+        found = lib().amtpu_drop_doc(self._pool, key.encode())
+        if found < 0:
+            _raise_last()
+        self._storage.pop(key, None)
+        return bool(found)
+
+    def history_bytes(self, doc_id=None):
+        """Retained raw-change bytes in the C++ arena (one doc, or the
+        whole pool) -- the measure the storage gate bounds."""
+        key = '' if doc_id is None else self._doc_key(doc_id)
+        n = lib().amtpu_history_bytes(self._pool, key.encode())
+        if n < 0:
+            _raise_last()
+        return int(n)
 
 
 class ShardedNativePool:
@@ -2280,6 +2553,19 @@ class ShardedNativePool:
     def get_changes_for_actor_bytes(self, doc_id, actor, after_seq=0):
         return self.pools[self._shard_of(doc_id)] \
             .get_changes_for_actor_bytes(doc_id, actor, after_seq)
+
+    def compact(self, doc_id, frontier=None, min_changes=0):
+        return self.pools[self._shard_of(doc_id)].compact(
+            doc_id, frontier, min_changes)
+
+    def drop_doc(self, doc_id):
+        return self.pools[self._shard_of(doc_id)].drop_doc(doc_id)
+
+    def history_bytes(self, doc_id=None):
+        if doc_id is not None:
+            return self.pools[self._shard_of(doc_id)] \
+                .history_bytes(doc_id)
+        return sum(p.history_bytes() for p in self.pools)
 
 
 def make_pool():

@@ -284,27 +284,39 @@ class TPUDocPool:
 
     def save(self, doc_id):
         """Checkpoint one doc (wire-compatible with NativeDocPool.save:
-        msgpack {'format': 'amtpu-doc-v1', 'changes': [...]} in
-        application order)."""
+        the v2 columnar container by default, the v1 raw-history
+        container under ``AMTPU_STORAGE_FORMAT=json`` --
+        docs/STORAGE.md).  Application order either way."""
         import msgpack
+
+        from .. import storage
         state = self.peek(doc_id)
         changes = [state.states[a][s - 1]['change']
                    for a, s in state.history]
-        return msgpack.packb({'format': 'amtpu-doc-v1',
-                              'changes': changes}, use_bin_type=True)
+        if storage.storage_format() == 'json':
+            return msgpack.packb({'format': 'amtpu-doc-v1',
+                                  'changes': changes}, use_bin_type=True)
+        return storage.pack_checkpoint(
+            {}, [], [msgpack.packb(c, use_bin_type=True)
+                     for c in changes])
 
     def load(self, doc_id, data):
-        """Restores a save() checkpoint as one batched replay; returns
-        the doc's whole-state patch."""
+        """Restores a save() checkpoint (either container format) as
+        one batched replay; returns the doc's whole-state patch."""
         import msgpack
+
+        from .. import storage
+        changes = None
         try:
-            header = msgpack.unpackb(data, raw=False)
-        except Exception:
-            header = None
-        if not isinstance(header, dict) or \
-                header.get('format') != 'amtpu-doc-v1':
-            raise RangeError('not an amtpu-doc-v1 checkpoint')
-        self.apply_batch({doc_id: header['changes']})
+            if storage.is_checkpoint(data):
+                changes = [msgpack.unpackb(r, raw=False,
+                                           strict_map_key=False)
+                           for r in storage.checkpoint_raw_changes(data)]
+        except (ValueError, TypeError, KeyError):
+            changes = None
+        if changes is None:
+            raise RangeError('not an amtpu-doc checkpoint')
+        self.apply_batch({doc_id: changes})
         return self.get_patch(doc_id)
 
     def get_missing_deps(self, doc_id):

@@ -283,6 +283,10 @@ class GatewayServer(object):
         # client are driven single-threaded, as they always were)
         self.pool_lock = threading.RLock()
         self.fanout = None
+        # cold-state tier (ISSUE 10, docs/STORAGE.md): LRU eviction past
+        # AMTPU_RESIDENT_DOCS_MAX + the settled-history GC cadence;
+        # every call into it happens under pool_lock
+        self.storage_tier = None
         self._srv = None
         self._conns = {}
         self._conns_lock = threading.Lock()
@@ -301,6 +305,10 @@ class GatewayServer(object):
         self._srv.listen(self.backlog)
         telemetry.register_healthz_section('scheduler',
                                            self._healthz_section)
+        from ..storage.coldstore import DocEvictor
+        self.storage_tier = DocEvictor.from_env(self.backend.pool)
+        telemetry.register_healthz_section(
+            'storage', self.storage_tier.healthz_section)
         if env_bool('AMTPU_FANOUT', True):
             from ..sync.fanout import FanoutEngine
             self.fanout = FanoutEngine(self.backend.pool,
@@ -345,6 +353,7 @@ class GatewayServer(object):
             self._dispatch_thread.join(timeout=30)
         telemetry.register_healthz_section('scheduler', None)
         telemetry.register_healthz_section('fanout', None)
+        telemetry.register_healthz_section('storage', None)
 
     def _healthz_section(self):
         from ..native import live_batch_handles
@@ -431,6 +440,20 @@ class GatewayServer(object):
                 # against, so answer straight off the reader thread
                 telemetry.metric('scheduler.bypass_reads')
                 with self.pool_lock:
+                    if docs is not None and self.storage_tier \
+                            is not None:
+                        # a read of a cold doc reloads it on touch --
+                        # transparently, under the same pool lock the
+                        # flush path uses.  A FAILED reload answers a
+                        # typed error (reading the missing doc would
+                        # silently serve empty state)
+                        failed = self.storage_tier.ensure_resident(
+                            docs)
+                        if failed:
+                            d, e = next(iter(failed.items()))
+                            conn.send(self._cold_error(rid, d, e))
+                            return
+                        self.storage_tier.note_touch(docs)
                     conn.send(self.backend.handle(req))
                 return
             op = PendingOp(conn, rid, cmd, req, docs, 1, batchable=False)
@@ -494,6 +517,19 @@ class GatewayServer(object):
         with telemetry.span('scheduler.flush', batched=len(batch),
                             exec_ops=len(execs)) as fsp:
             with self.pool_lock:
+                touched = {d for op in batch + execs for d in op.docs}
+                if self.storage_tier is not None and touched:
+                    # reload-on-touch BEFORE the ops run: a cold doc's
+                    # followers are already parked by the per-doc FIFO,
+                    # so the reload is indistinguishable from an in-
+                    # flight op taking a little longer.  Docs whose
+                    # reload FAILED are shed per op (typed error, blob
+                    # stays cold) so one corrupt blob cannot fail the
+                    # whole flush's unrelated traffic
+                    failed = self.storage_tier.ensure_resident(touched)
+                    if failed:
+                        batch, execs = self._shed_cold_failures(
+                            batch, execs, failed)
                 # per-flush fan-out inputs: doc -> post clock /
                 # quarantine envelope / earliest admission time /
                 # originator (conn, submitted-clock) for echo
@@ -507,6 +543,60 @@ class GatewayServer(object):
                     self._run_exec(op, fan=fan)
                 if fan is not None:
                     self._fanout_flush(fan, fsp)
+                if self.storage_tier is not None and touched:
+                    self._storage_upkeep(batch, execs, touched)
+
+    @staticmethod
+    def _cold_error(rid, doc, exc):
+        return {'id': rid,
+                'error': 'cold doc %r failed to reload: %s: %s'
+                         % (doc, type(exc).__name__, exc),
+                'errorType': 'InternalError'}
+
+    def _shed_cold_failures(self, batch, execs, failed):
+        """Answers every op touching a reload-failed doc with the typed
+        error (running it would CREATE a fresh empty doc and silently
+        diverge) and returns the surviving ops.  The cold blob stays in
+        the store for a later attempt."""
+        keep_batch, keep_execs = [], []
+        for ops, keep in ((batch, keep_batch), (execs, keep_execs)):
+            for op in ops:
+                bad = next((d for d in op.docs if d in failed), None)
+                if bad is None:
+                    keep.append(op)
+                    continue
+                self._finish(op, self._cold_error(op.rid, bad,
+                                                  failed[bad]))
+        return keep_batch, keep_execs
+
+    def _storage_upkeep(self, batch, execs, touched):
+        """Post-flush cold-state maintenance (still under the pool
+        lock): GC cadence per mutated doc, LRU touch, eviction past the
+        residency cap."""
+        muts = {}
+        for op in batch + execs:
+            if op.cmd in BATCH_CMDS + EXEC_CMDS:
+                per_doc = max(1, op.n_ops // max(1, len(op.docs)))
+                for d in op.docs:
+                    muts[d] = muts.get(d, 0) + per_doc
+        for d, n in muts.items():
+            # the acked clock resolves LAZILY: note_mutations only
+            # reads it on the rare flush whose debt actually folds, so
+            # the hot path never pays the fanout matrix min
+            acked_fn = None
+            if self.fanout is not None:
+                acked_fn = (lambda doc=d:
+                            self.fanout.acked_clock(doc))
+            try:
+                self.storage_tier.note_mutations(d, n, acked_fn)
+            except Exception as e:
+                # GC is an optimization: a doc that will not compact
+                # must never fail its flush
+                telemetry.metric('storage.gc.failed')
+                print('gateway: compaction failed for %r: %s: %s'
+                      % (d, type(e).__name__, e), file=sys.stderr)
+        self.storage_tier.note_touch(touched)
+        self.storage_tier.maybe_evict(protect=touched)
 
     def _observe_wait(self, ops):
         now = time.perf_counter()

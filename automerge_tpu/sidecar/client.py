@@ -47,12 +47,19 @@ class SidecarTimeout(ConnectionError):
 class CheckpointWAL:
     """Rolling client-side write-ahead log for sidecar state replay.
 
-    Two tiers: per-doc ``save()`` checkpoint snapshots, plus the ordered
-    log of mutating requests acknowledged since the last compaction.
-    When the log exceeds ``compact_every`` entries (AMTPU_WAL_COMPACT,
-    default 32) every known doc is snapshotted and the log is cleared,
-    bounding both replay time and WAL memory.  Replay = load every
-    snapshot, then re-send the residual log in order.
+    Two tiers: per-doc ``save()`` checkpoint snapshots (the v2 COLUMNAR
+    containers since ISSUE 10 -- the server's save() compresses settled
+    history, so snapshot memory and respawn-replay time shrink with
+    it), plus the ordered log of mutating requests acknowledged since
+    the last compaction.  Compaction triggers on EITHER bound: the log
+    exceeds ``compact_every`` entries (AMTPU_WAL_COMPACT, default 32)
+    or ``max_bytes`` of retained log bytes (AMTPU_WAL_MAX_BYTES,
+    default 64 MiB) -- the byte trigger keeps a burst of huge batches
+    (or a server that keeps failing compaction, the
+    ``wal_compact_failed`` path) from growing the log without limit
+    between entry-count trips.  ``sidecar.client.wal_bytes`` gauges the
+    current snapshot+log footprint.  Replay = load every snapshot, then
+    re-send the residual log in order.
 
     Caveat: checkpoints serialize change history only, so a server-side
     undo stack survives a respawn only as far as the residual log's
@@ -60,13 +67,19 @@ class CheckpointWAL:
     change was already compacted away replays as an error.
     """
 
-    def __init__(self, compact_every=None):
+    def __init__(self, compact_every=None, max_bytes=None):
         if compact_every is None:
             compact_every = env_int('AMTPU_WAL_COMPACT', 32)
+        if max_bytes is None:
+            max_bytes = env_int('AMTPU_WAL_MAX_BYTES', 67108864)
         self.compact_every = max(1, compact_every)
+        self.max_bytes = max_bytes
         self.snapshots = {}      # doc -> checkpoint_b64
-        self.log = []            # (cmd, kwargs) in ack order
+        self.log = []            # (cmd, kwargs, n_bytes) in ack order
         self.docs = set()
+        self.log_bytes = 0
+        self.snap_bytes = 0
+        self._gauged = 0
 
     @staticmethod
     def _docs_of(cmd, kwargs):
@@ -75,17 +88,42 @@ class CheckpointWAL:
         doc = kwargs.get('doc')
         return [doc] if doc is not None else []
 
+    @staticmethod
+    def _entry_bytes(kwargs):
+        try:
+            import msgpack
+            return len(msgpack.packb(kwargs, use_bin_type=True,
+                                     default=str))
+        except Exception:
+            return len(repr(kwargs))
+
+    def _gauge(self):
+        """`sidecar.client.wal_bytes` tracks the CURRENT footprint:
+        the flat map accumulates, so the gauge emits deltas."""
+        now = self.log_bytes + self.snap_bytes
+        if now != self._gauged:
+            telemetry.metric('sidecar.client.wal_bytes',
+                             now - self._gauged)
+            self._gauged = now
+
     def record(self, cmd, kwargs):
         """One mutating request was ACKNOWLEDGED by the server."""
-        self.log.append((cmd, kwargs))
+        n = self._entry_bytes(kwargs)
+        self.log.append((cmd, kwargs, n))
+        self.log_bytes += n
         self.docs.update(self._docs_of(cmd, kwargs))
+        self._gauge()
 
     def maybe_compact(self, call_raw):
-        """Snapshot + truncate when the log is due.  ``call_raw`` is the
-        client's no-WAL no-heal request function.  A compaction failure
-        (server died under us) is swallowed -- the uncompacted log still
-        replays, and the NEXT request heals the server."""
-        if len(self.log) < self.compact_every:
+        """Snapshot + truncate when the log is due (entry count OR byte
+        bound).  ``call_raw`` is the client's no-WAL no-heal request
+        function.  A compaction failure (server died under us) is
+        swallowed -- the uncompacted log still replays, the NEXT
+        request heals the server, and the byte bound re-trips on every
+        subsequent record until a compaction lands."""
+        if len(self.log) < self.compact_every \
+                and not (self.max_bytes > 0
+                         and self.log_bytes >= self.max_bytes):
             return
         try:
             snaps = {}
@@ -96,7 +134,10 @@ class CheckpointWAL:
             telemetry.metric('sidecar.client.wal_compact_failed')
             return
         self.snapshots = snaps
+        self.snap_bytes = sum(len(s) for s in snaps.values())
         del self.log[:]
+        self.log_bytes = 0
+        self._gauge()
         telemetry.metric('sidecar.client.wal_compactions')
 
     def replay(self, call_raw):
@@ -104,7 +145,7 @@ class CheckpointWAL:
         residual log, in order."""
         for doc in sorted(self.snapshots):
             call_raw('load', {'doc': doc, 'data': self.snapshots[doc]})
-        for cmd, kwargs in self.log:
+        for cmd, kwargs, _n in self.log:
             call_raw(cmd, dict(kwargs))
         telemetry.metric('sidecar.client.wal_replays')
 

@@ -1,0 +1,216 @@
+"""Cold-doc disk tier + LRU eviction (ISSUE 10 tentpole c,
+docs/STORAGE.md).
+
+A host serving millions of docs cannot keep every doc's arena resident:
+past ``AMTPU_RESIDENT_DOCS_MAX`` live docs, the least-recently-touched
+doc checkpoints to disk (`pool.save()` -- the v2 columnar container,
+so cold bytes are already compressed) and drops out of the pool
+entirely (`pool.drop_doc()`).  A later request touching a cold doc
+takes a transparent reload-on-touch: the gateway re-loads it inside the
+flush that wants it, under the pool lock, so the scheduler's per-doc
+FIFO parks followers exactly as it would behind an in-flight op.
+
+Thread model: every method is called under the gateway's pool lock
+(the single serialization point for all pool state); the store itself
+is therefore single-threaded by construction and keeps its index as a
+plain dict.  The disk directory (``AMTPU_STORAGE_DIR``, default a
+fresh tempdir) is an extension of pool memory, not durable storage --
+a process that dies with evicted docs loses them exactly as it loses
+resident ones (durability remains the checkpoint-WAL's job).
+"""
+
+import collections
+import hashlib
+import os
+import tempfile
+
+from .. import telemetry
+from ..utils.common import env_int, env_str
+
+
+class ColdStore(object):
+    """File-per-doc blob store: checkpoint containers keyed by doc id."""
+
+    def __init__(self, root=None):
+        if root is None:
+            root = env_str('AMTPU_STORAGE_DIR', '')
+        self.root = root or tempfile.mkdtemp(prefix='amtpu-cold-')
+        os.makedirs(self.root, exist_ok=True)
+        self._index = {}         # doc id -> (path, n_bytes)
+
+    def _path(self, doc_id):
+        h = hashlib.sha1(str(doc_id).encode('utf-8')).hexdigest()
+        return os.path.join(self.root, h + '.amtc')
+
+    def __contains__(self, doc_id):
+        return doc_id in self._index
+
+    def __len__(self):
+        return len(self._index)
+
+    @property
+    def bytes(self):
+        return sum(n for _p, n in self._index.values())
+
+    def put(self, doc_id, blob):
+        path = self._path(doc_id)
+        tmp = path + '.tmp'
+        with open(tmp, 'wb') as f:
+            f.write(blob)
+        os.replace(tmp, path)
+        telemetry.metric('storage.cold_bytes_written', len(blob))
+        self._index[doc_id] = (path, len(blob))
+
+    def get(self, doc_id):
+        """Reads a cold blob WITHOUT removing it -- reload reads first
+        and discards only after the replay committed, so a failed
+        reload cannot destroy the only copy of a doc."""
+        path, _n = self._index[doc_id]
+        with open(path, 'rb') as f:
+            return f.read()
+
+    def discard(self, doc_id):
+        entry = self._index.pop(doc_id, None)
+        if entry is None:
+            return
+        try:
+            os.unlink(entry[0])
+        except OSError:
+            pass
+
+    def pop(self, doc_id):
+        blob = self.get(doc_id)
+        self.discard(doc_id)
+        return blob
+
+
+class DocEvictor(object):
+    """LRU residency manager one gateway owns (all calls under the
+    gateway's pool lock).  Also hosts the per-doc GC cadence: every
+    ``AMTPU_STORAGE_GC_MIN`` mutations a doc's settled history folds
+    into its columnar snapshot (`pool.compact`)."""
+
+    def __init__(self, pool, max_resident=None, store=None,
+                 gc_every=None):
+        self.pool = pool
+        self.max = env_int('AMTPU_RESIDENT_DOCS_MAX', 0) \
+            if max_resident is None else max_resident
+        self.gc_every = env_int('AMTPU_STORAGE_GC_MIN', 256) \
+            if gc_every is None else gc_every
+        self.store = store if store is not None else ColdStore()
+        self._lru = collections.OrderedDict()   # doc id -> True
+        self._gc_debt = {}       # doc id -> mutations since last fold
+
+    @classmethod
+    def from_env(cls, pool):
+        """The gateway's constructor: None when eviction is disabled
+        (``AMTPU_RESIDENT_DOCS_MAX`` unset/0) AND GC is off -- an
+        evictor with max=0 still drives the GC cadence."""
+        return cls(pool)
+
+    # -- residency ------------------------------------------------------
+
+    def ensure_resident(self, docs):
+        """Reloads every cold doc in `docs` (ONE batched replay) before
+        the caller touches the pool -- the reload-on-touch half of the
+        eviction contract.  Returns {doc: exception} for docs whose
+        reload FAILED: their blobs stay cold (the only copy must
+        survive a transient replay failure), the failure is isolated
+        per doc (one corrupt blob must not pin the batch's other cold
+        docs), and the caller must NOT run ops against them -- an
+        apply on the missing doc would create a fresh empty doc and
+        silently diverge."""
+        cold = [d for d in docs if d in self.store]
+        if not cold:
+            return {}
+        # read WITHOUT removing: if the replay raises (armed
+        # checkpoint.load fault, poisoned history), the cold blobs must
+        # survive -- they are the only copy of those docs
+        blobs = {d: self.store.get(d) for d in cold}
+        failed = {}
+        try:
+            self.pool.load_batch(blobs)
+            ok = cold
+        except Exception:
+            ok = []
+            for d in cold:           # isolate the poison blob(s)
+                try:
+                    self.pool.load_batch({d: blobs[d]})
+                    ok.append(d)
+                except Exception as e:
+                    failed[d] = e
+        for d in ok:
+            self.store.discard(d)
+            self._lru[d] = True
+            self._lru.move_to_end(d)
+        if ok:
+            telemetry.metric('storage.reloads', len(ok))
+        if failed:
+            telemetry.metric('storage.reload_failed', len(failed))
+        return failed
+
+    def note_touch(self, docs):
+        for d in docs:
+            self._lru[d] = True
+            self._lru.move_to_end(d)
+
+    def maybe_evict(self, protect=()):
+        """Evicts least-recently-touched docs past the residency cap
+        (never one in `protect` -- the flush's own docs)."""
+        if self.max <= 0:
+            return 0
+        protect = set(protect)
+        evicted = 0
+        # bounded walk: each pass either evicts the oldest unprotected
+        # doc or skips a protected one (requeued at the end)
+        attempts = len(self._lru)
+        while len(self._lru) > self.max and attempts > 0:
+            attempts -= 1
+            doc, _ = next(iter(self._lru.items()))
+            if doc in protect:
+                self._lru.move_to_end(doc)
+                continue
+            try:
+                blob = self.pool.save(doc)
+                self.store.put(doc, blob)
+                self.pool.drop_doc(doc)
+            except Exception:
+                # a doc that will not checkpoint must NOT be dropped;
+                # requeue it hot so the walk cannot spin on it
+                telemetry.metric('storage.evict_failed')
+                self._lru.move_to_end(doc)
+                continue
+            self._lru.pop(doc, None)
+            self._gc_debt.pop(doc, None)
+            evicted += 1
+        if evicted:
+            telemetry.metric('storage.evictions', evicted)
+        return evicted
+
+    # -- settled-history GC cadence -------------------------------------
+
+    def note_mutations(self, doc, n, acked_fn=None):
+        """`n` changes committed for `doc` this flush; past the
+        ``AMTPU_STORAGE_GC_MIN`` debt the settled prefix folds into the
+        doc's columnar snapshot.  `acked_fn` resolves the frontier
+        LAZILY (the fan-out engine's pointwise-min believed clock,
+        None = no subscribers) -- it is only called on the rare flush
+        that actually folds, so the per-flush cost is one dict add."""
+        if self.gc_every <= 0:
+            return 0
+        debt = self._gc_debt.get(doc, 0) + max(1, n)
+        if debt < self.gc_every:
+            self._gc_debt[doc] = debt
+            return 0
+        self._gc_debt[doc] = 0
+        frontier = acked_fn() if acked_fn is not None else None
+        return self.pool.compact(doc, frontier=frontier)
+
+    # -- observability --------------------------------------------------
+
+    def healthz_section(self):
+        return {'resident_docs': len(self._lru),
+                'max_resident': self.max,
+                'cold_docs': len(self.store),
+                'cold_bytes': self.store.bytes,
+                'gc_every': self.gc_every}

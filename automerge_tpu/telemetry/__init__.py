@@ -229,10 +229,45 @@ KNOWN_SCHEDULER_KEYS = ('flushes', 'coalesced_ops', 'batched_docs',
 KNOWN_FANOUT_KEYS = ('flushes', 'docs', 'frames', 'encode_reuse',
                      'coalesced_peers', 'straggler_peers',
                      'uptodate_peers', 'bytes_encoded',
-                     'bytes_on_wire', 'subscribes', 'unsubscribes',
-                     'drops', 'backfills', 'presence_frames',
-                     'quarantine_frames', 'vector_passes',
-                     'scalar_passes', 'errors')
+                     'bytes_on_wire', 'writes_coalesced', 'subscribes',
+                     'unsubscribes', 'drops', 'backfills',
+                     'presence_frames', 'quarantine_frames',
+                     'vector_passes', 'scalar_passes', 'errors')
+
+# columnar storage tier counters (`telemetry.metric('storage.<name>')`
+# call sites in automerge_tpu/storage/ + native/__init__.py +
+# scheduler/gateway.py; glossary: docs/OBSERVABILITY.md, architecture:
+# docs/STORAGE.md), pre-seeded into every bench_block's `storage` sub
+# -object so the storage-check gate reads explicit zeros:
+# columnar.encodes/decodes   codec passes
+# columnar.changes           changes columnar-encoded
+# columnar.residual_changes  changes carried verbatim (non-canonical
+#                              bytes / exotic shapes; byte round-trip
+#                              holds either way)
+# columnar.bytes_in/_out     raw change bytes in vs blob bytes out (the
+#                              compression ratio the gate bounds)
+# save_v2                    v2 columnar containers emitted by save()
+# snapshot_backfills         straggler queries served by merging the
+#                              columnar snapshot with the C++ tail
+# gc.compactions             settled-prefix folds into the snapshot
+# gc.changes_folded          changes those folds moved out of the arena
+# gc.bytes_freed             raw-change bytes released by truncation
+# gc.skipped_json            compactions no-op'd by the
+#                              AMTPU_STORAGE_FORMAT=json oracle arm
+# gc.failed                  compactions that raised (flush survived)
+# evictions / reloads        cold-doc LRU evictions and reload-on-touch
+#                              restores
+# evict_failed               docs that refused to checkpoint (kept
+#                              resident)
+# cold_bytes_written         checkpoint bytes written to the cold store
+KNOWN_STORAGE_KEYS = ('columnar.encodes', 'columnar.decodes',
+                      'columnar.changes', 'columnar.residual_changes',
+                      'columnar.bytes_in', 'columnar.bytes_out',
+                      'save_v2', 'snapshot_backfills',
+                      'gc.compactions', 'gc.changes_folded',
+                      'gc.bytes_freed', 'gc.skipped_json', 'gc.failed',
+                      'evictions', 'reloads', 'reload_failed',
+                      'evict_failed', 'cold_bytes_written')
 
 # docs per gateway flush are effectively powers of two: exact log2 bounds
 BATCH_OCCUPANCY_BUCKETS = tuple(float(2 ** i) for i in range(13))
@@ -518,6 +553,10 @@ def bench_block():
                    for k, v in flat.items()
                    if k.startswith('sync.fanout.')})
     fanout['latency_ms'] = FANOUT_LATENCY.summary() or {}
+    storage = {r: 0.0 for r in KNOWN_STORAGE_KEYS}
+    storage.update({k.split('.', 1)[1]: round(v, 6)
+                    for k, v in flat.items()
+                    if k.startswith('storage.')})
     block = {
         'fallbacks': fallbacks,
         'collect': collect,
@@ -527,6 +566,7 @@ def bench_block():
         'pipeline': pipeline,
         'mesh': mesh,
         'fanout': fanout,
+        'storage': storage,
         'device_s': round(flat.get('device.dispatch_sync_s', 0.0), 4),
         'device_dispatches': int(flat.get('device.dispatches', 0)),
         'batch_latency': BATCH_LATENCY.snapshot() or {},

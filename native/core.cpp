@@ -4468,6 +4468,154 @@ uint8_t* amtpu_save(void* pool_ptr, const char* doc_id, int64_t* len) {
   }
 }
 
+// ---------------------------------------------------------------------------
+// settled-history GC + cold-doc eviction (ISSUE 10, docs/STORAGE.md)
+// ---------------------------------------------------------------------------
+
+// Frees the raw change bytes of every applied change at or behind the
+// causally-settled `frontier` ({actor: seq} msgpack map, clamped to the
+// doc's clock) and drops those changes from the application-order
+// history log -- amtpu_save then emits only the tail.  The op state
+// (StateEntry.all_deps, registers, arenas) is untouched: settled ops
+// still resolve conflicts and anchor list insertions; only their
+// REPLAY bytes move out (into the caller's columnar snapshot, which is
+// byte-lossless, so straggler backfill merges them back in Python).
+// Returns bytes freed (0 if the doc is unknown), -1 on error.
+// Raw refs share per-payload slabs, so the HEAP gives bytes back once
+// every change of a slab settles -- per-batch payloads settle together
+// in practice, and this return value tracks the retained-span sum that
+// amtpu_history_bytes reports either way.
+int64_t amtpu_truncate_history(void* pool_ptr, const char* doc_id,
+                               const uint8_t* frontier, int64_t flen) {
+  Pool& pool = *static_cast<Pool*>(pool_ptr);
+  try {
+    auto it = pool.docs.find(doc_id);
+    if (it == pool.docs.end()) return 0;
+    DocState& st = it->second;
+    Reader r(frontier, static_cast<size_t>(flen));
+    Clock f;
+    size_t n = r.read_map();
+    for (size_t i = 0; i < n; ++i) {
+      u32 a = pool.intern.id_of(r.read_str());
+      i64 s = r.read_int();
+      i64 applied = clock_get(st.clock, a);
+      if (s > applied) s = applied;   // clamp: never truncate past what
+      if (s > 0)                      // the doc has actually applied
+        clock_set_max(f, a, static_cast<u32>(s));
+    }
+    int64_t freed = 0;
+    for (auto& [a, s] : f) {
+      auto sit = st.states.find(a);
+      if (sit == st.states.end()) continue;
+      auto& entries = sit->second;
+      size_t upto = std::min<size_t>(s, entries.size());
+      for (size_t i = 0; i < upto; ++i) {
+        RawRef& raw = entries[i].change.raw;
+        freed += static_cast<int64_t>(raw.size());
+        raw.slab.reset();
+        raw.off = raw.len = 0;
+      }
+    }
+    std::vector<std::pair<u32, u32>> keep;
+    keep.reserve(st.history.size());
+    for (auto& [a, s] : st.history)
+      if (s > clock_get(f, a)) keep.emplace_back(a, s);
+    st.history.swap(keep);
+    return freed;
+  } catch (const std::exception& e) {
+    g_error = e.what(); g_error_kind = 0;
+    return -1;
+  }
+}
+
+// The transitively-closed {actor: from_seq} clock amtpu_get_missing_
+// changes serves FROM for `have_deps` -- exposed so the Python merge
+// path (snapshot + tail, docs/STORAGE.md) applies the SAME closure the
+// C++ walk would, instead of re-deriving it from decoded history.
+uint8_t* amtpu_get_missing_clock(void* pool_ptr, const char* doc_id,
+                                 const uint8_t* have, int64_t have_len,
+                                 int64_t* len) {
+  Pool& pool = *static_cast<Pool*>(pool_ptr);
+  try {
+    DocState& st = find_doc(pool, doc_id);
+    Reader r(have, static_cast<size_t>(have_len));
+    Clock have_deps;
+    size_t n = r.read_map();
+    for (size_t i = 0; i < n; ++i) {
+      u32 a = pool.intern.id_of(r.read_str());
+      u32 s = static_cast<u32>(r.read_int());
+      have_deps.emplace_back(a, s);
+    }
+    Clock all_deps;
+    for (auto& [da, ds] : have_deps) {
+      if (ds == 0) continue;
+      for (auto& [ta, ts] : all_deps_of(st, da, ds))
+        clock_set_max(all_deps, ta, ts);
+      clock_set_max(all_deps, da, ds);
+    }
+    Writer out;
+    write_clock(out, pool, all_deps);
+    *len = static_cast<int64_t>(out.buf.size());
+    uint8_t* res = static_cast<uint8_t*>(std::malloc(out.buf.size()));
+    std::memcpy(res, out.buf.data(), out.buf.size());
+    return res;
+  } catch (const std::exception& e) {
+    g_error = e.what(); g_error_kind = 0;
+    *len = -1;
+    return nullptr;
+  }
+}
+
+// Retained raw-change bytes (applied history + causal queue) of one doc
+// (or, with doc_id = "", the whole pool) -- the arena measure the
+// storage gate compares across the GC / no-GC arms.
+int64_t amtpu_history_bytes(void* pool_ptr, const char* doc_id) {
+  Pool& pool = *static_cast<Pool*>(pool_ptr);
+  try {
+    auto sum_doc = [](const DocState& st) {
+      int64_t b = 0;
+      for (auto& [a, entries] : st.states)
+        for (auto& e : entries) b += static_cast<int64_t>(e.change.raw.size());
+      for (auto& ch : st.queue) b += static_cast<int64_t>(ch.raw.size());
+      return b;
+    };
+    if (doc_id == nullptr || doc_id[0] == '\0') {
+      int64_t total = 0;
+      for (auto& [id, st] : pool.docs) total += sum_doc(st);
+      return total;
+    }
+    auto it = pool.docs.find(doc_id);
+    return it == pool.docs.end() ? 0 : sum_doc(it->second);
+  } catch (const std::exception& e) {
+    g_error = e.what(); g_error_kind = 0;
+    return -1;
+  }
+}
+
+// Cold-doc eviction: removes one doc's entire state from the pool (the
+// caller has checkpointed it -- save() -> disk; reload-on-touch is
+// load()).  The pool-resident clock table keys rows by DocState
+// POINTER, and a future doc could reuse the freed address, so the
+// cache invalidates (one full re-upload; eviction is the cold path by
+// definition).  Interned strings stay -- the interner is append-only
+// by design.  Returns 1 if the doc existed, 0 otherwise, -1 on error.
+int64_t amtpu_drop_doc(void* pool_ptr, const char* doc_id) {
+  Pool& pool = *static_cast<Pool*>(pool_ptr);
+  try {
+    auto it = pool.docs.find(doc_id);
+    if (it == pool.docs.end()) return 0;
+    pool.docs.erase(it);
+    for (auto dit = pool.doc_order.begin();
+         dit != pool.doc_order.end(); ++dit)
+      if (*dit == doc_id) { pool.doc_order.erase(dit); break; }
+    pool.resclk.invalidate();
+    return 1;
+  } catch (const std::exception& e) {
+    g_error = e.what(); g_error_kind = 0;
+    return -1;
+  }
+}
+
 // clock + deps only (no materialization): the cheap per-round query that
 // batched replica catch-up gossips (reference advertises clocks the same
 // way, connection.js:51-56, without shipping document state)
@@ -4547,15 +4695,21 @@ uint8_t* amtpu_get_missing_changes(void* pool_ptr, const char* doc_id,
     for (u32 actor : st.state_actor_order) {
       auto& entries = st.states[actor];
       u32 from = clock_get(all_deps, actor);
-      for (size_t i = from; i < entries.size(); ++i) count++;
+      for (size_t i = from; i < entries.size(); ++i)
+        if (entries[i].change.raw.size()) count++;
     }
     out.array(count);
     for (u32 actor : st.state_actor_order) {
       auto& entries = st.states[actor];
       u32 from = clock_get(all_deps, actor);
+      // GC-truncated entries (amtpu_truncate_history freed their raw
+      // bytes) are SKIPPED, consistently with the count above: the
+      // Python wrapper merges them back from the doc's columnar
+      // snapshot when the requester is behind the settled frontier
       for (size_t i = from; i < entries.size(); ++i)
-        out.raw(entries[i].change.raw.data(),
-                entries[i].change.raw.size());
+        if (entries[i].change.raw.size())
+          out.raw(entries[i].change.raw.data(),
+                  entries[i].change.raw.size());
     }
     *len = static_cast<int64_t>(out.buf.size());
     uint8_t* res = static_cast<uint8_t*>(std::malloc(out.buf.size()));
@@ -4585,10 +4739,15 @@ uint8_t* amtpu_get_changes_for_actor(void* pool_ptr, const char* doc_id,
     if (it == st.states.end() || from >= it->second.size()) {
       out.array(0);
     } else {
-      out.array(it->second.size() - from);
+      // GC-truncated entries are skipped (see amtpu_get_missing_changes)
+      size_t count = 0;
       for (size_t i = from; i < it->second.size(); ++i)
-        out.raw(it->second[i].change.raw.data(),
-                it->second[i].change.raw.size());
+        if (it->second[i].change.raw.size()) count++;
+      out.array(count);
+      for (size_t i = from; i < it->second.size(); ++i)
+        if (it->second[i].change.raw.size())
+          out.raw(it->second[i].change.raw.data(),
+                  it->second[i].change.raw.size());
     }
     *len = static_cast<int64_t>(out.buf.size());
     uint8_t* res = static_cast<uint8_t*>(std::malloc(out.buf.size()));

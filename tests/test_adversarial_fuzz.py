@@ -510,9 +510,13 @@ class TestSaveLoadMidStream:
         assert blobs[0] == blobs[1]
 
         # restored-side oracle: replay the saved history itself
+        # (container-format agnostic: the v2 columnar container decodes
+        # through the storage helpers, docs/STORAGE.md)
+        from automerge_tpu import storage
         oracle = Backend.init()
         oracle, _ = Backend.apply_changes(
-            oracle, msgpack.unpackb(blobs[0], raw=False)['changes'])
+            oracle, [msgpack.unpackb(r, raw=False)
+                     for r in storage.checkpoint_raw_changes(blobs[0])])
         for pool in restored:
             assert pool.get_patch(0) == Backend.get_patch(oracle), \
                 type(pool).__name__

@@ -1,0 +1,208 @@
+"""CI gate: cold-state economics (ISSUE 10, docs/STORAGE.md).
+
+Four acceptance checks, one process, kernel path forced:
+
+  1. **compression** -- columnar-encoding the config-4 bench change
+     corpus (per doc, the real save/WAL unit) must be >= 5x smaller
+     than the same corpus' JSON change bytes;
+  2. **bounded arena under churn** -- a rolling create/mutate/idle
+     workload with the settled-history GC cadence must end with a
+     strictly smaller retained raw-change arena than an identical
+     no-GC arm, with byte-identical final patches;
+  3. **evict/reload byte parity** -- save -> drop_doc -> load ->
+     mutate must equal a never-evicted twin, patch-for-patch;
+  4. **oracle-free** -- `fallback.oracle == 0` across all of it (the
+     storage tier may never push work off the kernel path).
+
+Writes the BENCH_STORAGE artifact (JSON; `--out` overrides) with the
+measured ratios and the telemetry block.
+
+Usage: [JAX_PLATFORMS=cpu] python tools/storage_check.py [--out F]
+"""
+import argparse
+import json
+import os
+import random
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+sys.path.insert(0, ROOT)
+
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+os.environ['AMTPU_HOST_FULL'] = '0'       # the kernel path is the subject
+os.environ.pop('AMTPU_STORAGE_FORMAT', None)   # columnar is the subject
+
+ROOT_ID = '00000000-0000-0000-0000-000000000000'
+
+
+def _corpus():
+    """The config-4 bench corpus at a CI-sized doc count (env
+    overridable, same knob bench.py reads)."""
+    sys.path.insert(0, ROOT)
+    os.environ.setdefault('AMTPU_BENCH_C4_DOCS', '256')
+    import bench
+    batch, _metric = bench.build_config_4(random.Random(7))
+    return batch
+
+
+def check_compression(problems, report):
+    import msgpack
+
+    from automerge_tpu.storage import encode_columnar
+    batch = _corpus()
+    t0 = time.perf_counter()
+    json_bytes = col_bytes = mp_bytes = n_changes = 0
+    for changes in batch.values():
+        raws = [msgpack.packb(c, use_bin_type=True) for c in changes]
+        blob = encode_columnar(raws)
+        json_bytes += len(json.dumps(
+            {'version': 1, 'changes': changes}, separators=(',', ':'),
+            sort_keys=True))
+        mp_bytes += sum(len(r) for r in raws)
+        col_bytes += len(blob)
+        n_changes += len(changes)
+    dt = time.perf_counter() - t0
+    ratio = json_bytes / max(1, col_bytes)
+    report['compression'] = {
+        'docs': len(batch), 'changes': n_changes,
+        'json_bytes': json_bytes, 'msgpack_bytes': mp_bytes,
+        'columnar_bytes': col_bytes,
+        'ratio_vs_json': round(ratio, 2),
+        'ratio_vs_msgpack': round(mp_bytes / max(1, col_bytes), 2),
+        'encode_s': round(dt, 3),
+    }
+    if ratio < 5.0:
+        problems.append('columnar compression %.2fx vs JSON is below '
+                        'the 5x gate' % ratio)
+
+
+def _churn(pool, gc, docs=48, rounds=10, muts=6):
+    """Rolling churn: every round mutates a rotating doc window; the
+    GC arm folds settled history on the gateway cadence."""
+    rng = random.Random(13)
+    patches = {}
+    seqs = {}
+    for r in range(rounds):
+        for d in range(docs):
+            if (d + r) % 3:          # rotating idle window
+                continue
+            doc = 'churn%d' % d
+            actor = 'w%d' % (d % 4)
+            seq0 = seqs.get(doc, 0)
+            changes = []
+            for i in range(muts):
+                changes.append({
+                    'actor': actor, 'seq': seq0 + i + 1,
+                    'deps': {actor: seq0 + i} if seq0 + i else {},
+                    'ops': [{'action': 'set', 'obj': ROOT_ID,
+                             'key': 'k%d' % rng.randrange(16),
+                             'value': r * 1000 + i}]})
+            seqs[doc] = seq0 + muts
+            pool.apply_changes(doc, changes)
+            if gc:
+                pool.compact(doc)
+    for d in range(docs):
+        patches['churn%d' % d] = pool.get_patch('churn%d' % d)
+    return patches
+
+
+def check_churn(problems, report):
+    from automerge_tpu.native import NativeDocPool
+    gc_pool, raw_pool = NativeDocPool(), NativeDocPool()
+    t0 = time.perf_counter()
+    gc_patches = _churn(gc_pool, gc=True)
+    raw_patches = _churn(raw_pool, gc=False)
+    dt = time.perf_counter() - t0
+    gc_arena = gc_pool.history_bytes()
+    raw_arena = raw_pool.history_bytes()
+    report['churn'] = {
+        'gc_arena_bytes': gc_arena, 'nogc_arena_bytes': raw_arena,
+        'arena_ratio': round(raw_arena / max(1, gc_arena), 2),
+        'wall_s': round(dt, 3),
+    }
+    if gc_patches != raw_patches:
+        problems.append('churn workload: GC arm patches diverge from '
+                        'the no-GC arm')
+    if not gc_arena < raw_arena:
+        problems.append('post-GC arena (%d B) is not smaller than the '
+                        'no-GC arm (%d B)' % (gc_arena, raw_arena))
+
+
+def check_evict_reload(problems, report):
+    from automerge_tpu.native import NativeDocPool
+    pool, twin = NativeDocPool(), NativeDocPool()
+    batch = _corpus()
+    sample = dict(list(batch.items())[:8])
+    for p in (pool, twin):
+        for d, changes in sample.items():
+            p.apply_changes('t%d' % d, changes)
+    cycled = 0
+    for d in sample:
+        doc = 't%d' % d
+        pool.compact(doc)
+        blob = pool.save(doc)
+        if not pool.drop_doc(doc):
+            problems.append('drop_doc(%r) found nothing' % doc)
+            continue
+        pool.load(doc, blob)
+        cycled += 1
+    mut = [{'actor': 'z', 'seq': 1, 'deps': {},
+            'ops': [{'action': 'set', 'obj': ROOT_ID,
+                     'key': 'post-evict', 'value': 1}]}]
+    mismatches = 0
+    for d in sample:
+        doc = 't%d' % d
+        if pool.apply_changes(doc, mut) != twin.apply_changes(doc, mut):
+            mismatches += 1
+        elif pool.get_patch(doc) != twin.get_patch(doc):
+            mismatches += 1
+    report['evict_reload'] = {'docs_cycled': cycled,
+                              'mismatches': mismatches}
+    if mismatches:
+        problems.append('%d docs diverged through the save -> evict '
+                        '-> reload -> mutate cycle' % mismatches)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--out', default=os.path.join(ROOT,
+                                                  'BENCH_STORAGE.json'))
+    args = ap.parse_args()
+    from automerge_tpu import telemetry
+    telemetry.metrics_reset()
+    problems = []
+    report = {'metric': 'storage_check', 'ts': time.time()}
+    check_compression(problems, report)
+    check_churn(problems, report)
+    check_evict_reload(problems, report)
+    snap = telemetry.metrics_snapshot()
+    oracle = snap.get('fallback.oracle', 0)
+    if oracle:
+        problems.append('fallback.oracle == %s on the storage gate '
+                        'workloads (must be 0)' % oracle)
+    report['fallback_oracle'] = oracle
+    report['telemetry'] = telemetry.bench_block()
+    with open(args.out, 'w') as f:
+        json.dump(report, f, indent=1, sort_keys=True)
+        f.write('\n')
+    if problems:
+        print('storage-check FAILED:', file=sys.stderr)
+        for p in problems:
+            print('  * ' + p, file=sys.stderr)
+        return 1
+    c = report['compression']
+    print('storage-check: %.1fx vs JSON (%.1fx vs msgpack) on %d '
+          'changes; churn arena %d -> %d B (%.1fx); %d evict/reload '
+          'cycles byte-identical; oracle=0'
+          % (c['ratio_vs_json'], c['ratio_vs_msgpack'], c['changes'],
+             report['churn']['nogc_arena_bytes'],
+             report['churn']['gc_arena_bytes'],
+             report['churn']['arena_ratio'],
+             report['evict_reload']['docs_cycled']))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
