@@ -3,7 +3,7 @@
 .PHONY: all native test bench bench-all bench-tpu bench-multichip check \
 	clean wheel telemetry-check fallback-check perf-smoke chaos-check \
 	serve-check mesh-check static-check asan-check fanout-check \
-	bench-fanout storage-check
+	bench-fanout storage-check obs-check
 
 all: native
 
@@ -51,6 +51,12 @@ check: native
 	        % (r['mode'], r['value'], k['value']))"
 	JAX_PLATFORMS=cpu python -c "import __graft_entry__ as g; \
 	  g.dryrun_multichip(8); print('dryrun ok')"
+	@# soft bench trajectory: diff this smoke against the previous
+	@# GREEN check's (report-only -- the hard perf gates stay below);
+	@# the baseline rolls forward only after every gate passes
+	-@[ -f .bench_smoke.prev.json ] && \
+	  python tools/bench_compare.py --soft .bench_smoke.prev.json \
+	    .bench_smoke.json || true
 	$(MAKE) static-check
 	$(MAKE) fallback-check
 	$(MAKE) perf-smoke
@@ -58,8 +64,10 @@ check: native
 	$(MAKE) serve-check
 	$(MAKE) fanout-check
 	$(MAKE) storage-check
+	$(MAKE) obs-check
 	$(MAKE) mesh-check
 	$(MAKE) asan-check
+	@cp .bench_smoke.json .bench_smoke.prev.json
 	@echo "CHECK GREEN"
 
 # Escalation-ladder gate (ISSUE 2): a config-4-shaped smoke on the
@@ -119,8 +127,18 @@ bench-fanout: native
 storage-check: native
 	JAX_PLATFORMS=cpu python tools/storage_check.py
 
-# Observability gate (docs/OBSERVABILITY.md): idle telemetry must be
-# free.  Interleaved A/B of the disabled path vs a no-op-patched "raw"
+# Observability gate (ISSUE 12, docs/OBSERVABILITY.md): flight
+# recorder + critical-path attribution + SLO surface against a LIVE
+# gateway -- per-stage attribution must sum to the request wall, a
+# slow request must land an exemplar span tree in the trace file, a
+# fault-triggered quarantine must dump a recorder file containing the
+# injected event, the on-demand `dump` request must round-trip a file,
+# and amtpu_top must render from the live /metrics + /healthz.
+obs-check: native
+	JAX_PLATFORMS=cpu python tools/obs_check.py
+
+# Telemetry idle-cost gate (docs/OBSERVABILITY.md): idle telemetry must
+# be free.  Interleaved A/B of the disabled path vs a no-op-patched "raw"
 # pipeline on the quickbench workload (target ~2% overhead; the assert
 # tolerance is padded for this single-core host's +-15% jitter), plus
 # an enabled-path sanity pass.  CPU-pinned: host-phase cost is

@@ -7,6 +7,8 @@ Collects every statically reachable telemetry emit in the package:
     wildcard patterns, so `'fallback.escalated.w%d' % W` still counts);
   * phase counters and spans -- `trace.count` / `phase_count` /
     `trace.span` names (they satisfy doc rows but are not pre-seeded);
+    flight-recorder event stamps (`recorder.record`) count the same
+    way, so the docs' event catalog stays in lockstep with the sites;
   * registry families -- `registry.counter/gauge/histogram('amtpu_*')`.
 
 Then enforces three invariants:
@@ -46,6 +48,8 @@ PRESEED_BLOCKS = {
     'scheduler': 'KNOWN_SCHEDULER_KEYS',
     'sync.fanout': 'KNOWN_FANOUT_KEYS',
     'storage': 'KNOWN_STORAGE_KEYS',
+    'recorder': 'KNOWN_RECORDER_KEYS',
+    'slo': 'KNOWN_SLO_KEYS',
 }
 
 
@@ -150,7 +154,7 @@ def _collect_emits(sources):
                 elif pat is not None:
                     patterns.append((pat, src.path, node.lineno))
             elif name in ('count', 'phase_count', 'span', 'phase_add',
-                          'span_with_context', 'fire', 'arm'):
+                          'span_with_context', 'fire', 'arm', 'record'):
                 lit, pat = _pattern_of(node.args[0])
                 if lit is not None:
                     phases.add(lit)

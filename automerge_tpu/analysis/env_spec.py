@@ -29,6 +29,23 @@ ENV_FLAGS = (
     # -- observability ------------------------------------------------------
     EnvFlag('AMTPU_TRACE', 'bool', False, False, 'telemetry/spans.py'),
     EnvFlag('AMTPU_TRACE_FILE', 'str', '', False, 'telemetry/spans.py'),
+    EnvFlag('AMTPU_TRACE_FILE_MAX_MB', 'int', 256, False,
+            'telemetry/spans.py (keep-1 rotation cap; <=0 disables)'),
+    EnvFlag('AMTPU_RECORDER_EVENTS', 'int', 4096, False,
+            'telemetry/recorder.py (ring size; read once at import)'),
+    EnvFlag('AMTPU_RECORDER_DIR', 'str', '', False,
+            'telemetry/recorder.py (dump dir; empty -> per-process '
+            'tempdir)'),
+    EnvFlag('AMTPU_RECORDER_MIN_DUMP_S', 'float', 5.0, False,
+            'telemetry/recorder.py (per-reason dump rate limit)'),
+    EnvFlag('AMTPU_SLOW_MS', 'float', 250.0, False,
+            'telemetry/attribution.py (exemplar-trace threshold)'),
+    EnvFlag('AMTPU_SLO_P99_MS', 'float', 100.0, False,
+            'telemetry/attribution.py (p99 target the burn rates '
+            'measure against)'),
+    EnvFlag('AMTPU_EXEMPLAR_MIN_S', 'float', 0.05, False,
+            'telemetry/attribution.py (min interval between exemplar '
+            'emissions; bounds the tail sampler under error storms)'),
     EnvFlag('AMTPU_DEVTIME', 'bool', False, False, 'telemetry/__init__.py'),
     EnvFlag('AMTPU_DEGRADED_WINDOW_S', 'float', 300.0, False,
             'telemetry/__init__.py'),

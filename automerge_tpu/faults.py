@@ -198,6 +198,9 @@ def fire(site, docs=None):
             return
     telemetry.metric('resilience.fault_injected')
     telemetry.metric('resilience.fault_injected.' + site)
+    telemetry.recorder.record('fault.injected', n=1,
+                              doc=spec.match, detail='%s:%s'
+                              % (site, kind))
     cls = TransientFault if kind == 'transient' else PermanentFault
     detail = spec.match if spec.match is not None else ''
     raise cls(site, detail)

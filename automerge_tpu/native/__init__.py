@@ -22,6 +22,7 @@ import msgpack
 import numpy as np
 
 from .. import faults, telemetry, trace
+from ..telemetry import attribution, recorder
 from ..utils.common import (doc_key, env_bool, env_int, env_raw, env_str,
                             parse_mesh_env)
 from ..utils.wire import map_header as _map_header
@@ -283,8 +284,12 @@ def _rollback_batch(bh, exc=None):
         if exc is not None:
             exc.amtpu_state_suspect = True
         telemetry.metric('resilience.rollback_unavailable')
+        recorder.record('batch.rollback', detail='state_suspect')
         return False
     telemetry.metric('resilience.rollback')
+    recorder.record('batch.rollback',
+                    detail=type(exc).__name__ if exc is not None
+                    else None)
     return True
 
 
@@ -815,6 +820,7 @@ class NativeDocPool:
             # overlaps across shards itself) and the top level already
             # counted docs for telemetry -- no header parse needed
             docs = 0
+        recorder.record('batch.begin', n=docs)
         if self._should_pipeline(payload, docs):
             try:
                 out = self._apply_waves(payload, docs)
@@ -836,14 +842,22 @@ class NativeDocPool:
         return out
 
     def _apply_unpipelined(self, payload):
-        """One whole-payload phase a + b: the non-wave batch body."""
+        """One whole-payload phase a + b: the non-wave batch body.
+        The always-on attribution seams split the wall at the phase
+        boundary: `dispatch` = host begin + async device dispatch,
+        `collect` = blocking on device outputs + host mid/emit."""
+        t0 = time.perf_counter()
         ctx = self._phase_a(payload)
+        t1 = time.perf_counter()
+        attribution.note_flush_phase('dispatch', t1 - t0)
         try:
             return self._phase_b(ctx)
         except Exception as e:
             _rollback_batch(ctx['bh'], e)
             raise
         finally:
+            attribution.note_flush_phase('collect',
+                                         time.perf_counter() - t1)
             _free_batch(ctx['bh'])
 
     def _should_pipeline(self, payload, docs):
@@ -907,7 +921,8 @@ class NativeDocPool:
                     subs.append((ctypes.cast(ptr, ctypes.c_char_p),
                                  sub_len.value))
             ctxs = []
-            t_a0 = time.perf_counter()
+            t_loop0 = time.perf_counter()
+            t_a0 = t_loop0
             try:
                 for i, sub in enumerate(subs):
                     ctx = self._phase_a(sub, overlapped=True)
@@ -935,6 +950,9 @@ class NativeDocPool:
                              time.perf_counter() - t_a0)
             trace.metric('pipeline.batches')
             trace.metric('pipeline.waves', len(ctxs))
+            t_disp = time.perf_counter()
+            attribution.note_flush_phase('dispatch', t_disp - t_loop0)
+            recorder.record('wave.dispatch', n=len(ctxs))
             results = [None] * len(ctxs)
             errors = []
 
@@ -944,6 +962,9 @@ class NativeDocPool:
             _collect_ready_order(
                 ctxs, on_result=keep,
                 on_error=lambda i, e: errors.append((i, e)))
+            attribution.note_flush_phase('collect',
+                                         time.perf_counter() - t_disp)
+            recorder.record('wave.collect', n=len(ctxs))
             if errors:
                 _i, err = errors[0]
                 # suspect if any wave committed OR any other wave's

@@ -47,6 +47,7 @@ import msgpack
 
 from . import faults, telemetry
 from .errors import AutomergeError
+from .telemetry import recorder
 from .utils.common import env_bool, env_float, env_int
 from .utils.wire import map_header as _map_header
 from .utils.wire import read_map_header as _read_map_header
@@ -146,6 +147,10 @@ def apply_payload(pool, payload, first_exc=None):
             return pool.apply_batch_bytes(payload)
         except Exception as e:
             if not should_isolate(e):
+                if getattr(e, 'amtpu_state_suspect', False):
+                    recorder.record('resilience.state_suspect',
+                                    detail=type(e).__name__)
+                    recorder.dump('state_suspect')
                 raise
             first_exc = e
     if isinstance(payload, tuple):   # zero-copy shard view: materialize
@@ -195,12 +200,18 @@ def _apply_group(pool, keyed, doc_list, parts, pending_exc=None):
                 # state-suspect failure still re-raises -- re-applying
                 # those docs is unsafe in any form.
                 if getattr(e, 'amtpu_state_suspect', False):
+                    recorder.record('resilience.state_suspect',
+                                    n=len(doc_list),
+                                    detail=type(e).__name__)
+                    recorder.dump('state_suspect')
                     raise
                 exc = e
         if faults.is_transient(exc) and attempts_left > 0:
             attempts_left -= 1
             retried = True
             telemetry.metric('resilience.retry.attempts')
+            recorder.record('resilience.retry', n=len(doc_list),
+                            detail=type(exc).__name__)
             time.sleep(delay)
             delay = min(delay * 2, _BACKOFF_CAP_S)
             exc = None
@@ -210,6 +221,7 @@ def _apply_group(pool, keyed, doc_list, parts, pending_exc=None):
         telemetry.metric('resilience.retry.exhausted')
     if len(doc_list) > 1:
         telemetry.metric('resilience.bisect.rounds')
+        recorder.record('resilience.bisect', n=len(doc_list))
         mid = len(doc_list) // 2
         _apply_group(pool, keyed, doc_list[:mid], parts)
         _apply_group(pool, keyed, doc_list[mid:], parts)
@@ -227,6 +239,12 @@ def _apply_group(pool, keyed, doc_list, parts, pending_exc=None):
             exc = e
     telemetry.metric('resilience.quarantined')
     telemetry.note_degraded()
+    # the quarantine IS the post-mortem moment: stamp the event and
+    # dump the surrounding ring (docs/RESILIENCE.md; rate-limited so a
+    # poison-storm cannot become a disk-write storm)
+    recorder.record('resilience.quarantine', doc=key,
+                    detail=type(exc).__name__)
+    recorder.dump('quarantine')
     parts.append((1, msgpack.packb(key, use_bin_type=True) +
                   msgpack.packb(error_envelope(exc), use_bin_type=True)))
 

@@ -66,13 +66,14 @@ import time
 
 from .. import faults, telemetry
 from ..resilience import is_quarantine_error, is_quarantined
+from ..telemetry import attribution
 from ..utils.common import env_bool
 from .queue import (READ_CMDS, AdmissionQueue,  # noqa: F401 (re-export)
                     Overloaded, PendingOp, flush_deadline_s,
                     max_batch_docs, max_batch_ops)
 
 #: commands answered without touching the pool (never queued, no lock)
-PURE_CMDS = ('ping', 'metrics', 'healthz')
+PURE_CMDS = ('ping', 'metrics', 'healthz', 'dump')
 
 # READ_CMDS (read-only pool commands: inline bypass when their doc has
 # no pending mutation, queued/ordered otherwise) is owned by .queue --
@@ -204,6 +205,9 @@ class _Conn(object):
 
     def _run_jsonl(self):
         for line in self.rfile:
+            # frame receipt: attribution's t0, so the `admit` stage
+            # covers decode + routing, not just admission
+            t0 = time.perf_counter()
             line = line.strip()
             if not line:
                 continue
@@ -214,7 +218,7 @@ class _Conn(object):
                            'errorType': 'RangeError'})
                 continue
             self._frame_fault()
-            self.gateway.submit(self, req)
+            self.gateway.submit(self, req, t0=t0)
 
     def _run_msgpack(self):
         import msgpack
@@ -226,6 +230,7 @@ class _Conn(object):
             body = self.rfile.read(n)
             if len(body) < n:
                 break
+            t0 = time.perf_counter()    # frame receipt (see _run_jsonl)
             try:
                 req = msgpack.unpackb(body, raw=False,
                                       strict_map_key=False)
@@ -236,7 +241,7 @@ class _Conn(object):
                            'errorType': 'RangeError'})
                 continue
             self._frame_fault()
-            self.gateway.submit(self, req)
+            self.gateway.submit(self, req, t0=t0)
 
     def close(self):
         self.closed = True
@@ -400,10 +405,12 @@ class GatewayServer(object):
 
     # -- request routing ------------------------------------------------
 
-    def submit(self, conn, req):
+    def submit(self, conn, req, t0=None):
         """Routes one decoded request.  Runs on the connection's reader
         thread; anything that can block on the pool or the queue must
-        not stall OTHER connections (it only stalls this reader)."""
+        not stall OTHER connections (it only stalls this reader).
+        `t0` is the frame-receipt timestamp the reader stamped before
+        decoding -- attribution backdates each Clock to it."""
         cmd = req.get('cmd')
         rid = req.get('id')
         if cmd in PURE_CMDS:
@@ -423,6 +430,10 @@ class GatewayServer(object):
                            'errorType': 'RangeError'})
                 return
             op = PendingOp(conn, rid, cmd, req, docs, 1, batchable=False)
+            # marked BEFORE offer: the dispatcher may claim (and stamp)
+            # the op the instant offer releases the queue lock
+            op.clock = attribution.Clock(attribution.class_of(cmd), t0=t0)
+            op.clock.mark('admit')
             try:
                 # presence is ephemeral -- shedding it under overload is
                 # the correct behaviour; the subscription lifecycle is
@@ -437,8 +448,13 @@ class GatewayServer(object):
             docs = _op_docs(cmd, req)
             if docs is None or not self.queue.doc_pending(docs[0]):
                 # inline bypass: no queued mutation can be reordered
-                # against, so answer straight off the reader thread
+                # against, so answer straight off the reader thread.
+                # Attribution: admit covers decode/route, dispatch the
+                # pool-lock wait + backend handle, emit the send.
                 telemetry.metric('scheduler.bypass_reads')
+                clock = attribution.Clock(attribution.class_of(cmd),
+                                          t0=t0)
+                clock.mark('admit')
                 with self.pool_lock:
                     if docs is not None and self.storage_tier \
                             is not None:
@@ -451,12 +467,25 @@ class GatewayServer(object):
                             docs)
                         if failed:
                             d, e = next(iter(failed.items()))
-                            conn.send(self._cold_error(rid, d, e))
-                            return
-                        self.storage_tier.note_touch(docs)
-                    conn.send(self.backend.handle(req))
+                            resp = self._cold_error(rid, d, e)
+                        else:
+                            self.storage_tier.note_touch(docs)
+                            resp = self.backend.handle(req)
+                    else:
+                        resp = self.backend.handle(req)
+                # send + finish OUTSIDE the pool lock: a failed read's
+                # finish() may snapshot the recorder ring and write an
+                # exemplar -- never on the lock every flush needs
+                clock.mark('dispatch')
+                conn.send(resp)
+                clock.mark('emit')
+                attribution.finish(clock, ok='error' not in resp,
+                                   cmd=cmd, rid=rid,
+                                   doc=docs[0] if docs else None)
                 return
             op = PendingOp(conn, rid, cmd, req, docs, 1, batchable=False)
+            op.clock = attribution.Clock(attribution.class_of(cmd), t0=t0)
+            op.clock.mark('admit')
             try:
                 self.queue.offer(op, admit_always=True)
             except Overloaded as e:     # only on gateway shutdown
@@ -476,6 +505,8 @@ class GatewayServer(object):
             op = PendingOp(conn, rid, cmd, req, docs,
                            _op_weight(cmd, req),
                            batchable=(cmd in BATCH_CMDS))
+            op.clock = attribution.Clock(attribution.class_of(cmd), t0=t0)
+            op.clock.mark('admit')
             try:
                 self.queue.offer(op)
             except Overloaded as e:
@@ -505,13 +536,27 @@ class GatewayServer(object):
                 print('gateway: flush failed: %s: %s'
                       % (type(e).__name__, e), file=sys.stderr)
                 for op in batch + execs:
-                    self._finish(op, {
-                        'id': op.rid,
-                        'error': '%s: %s' % (type(e).__name__, e),
-                        'errorType': 'InternalError'})
+                    # only UNANSWERED ops: a partial flush's completed
+                    # ops already sent their real response -- a second
+                    # _finish would double-count their emit/pending
+                    # state and mislabel a success as failed
+                    if not op.answered:
+                        self._finish(op, {
+                            'id': op.rid,
+                            'error': '%s: %s' % (type(e).__name__, e),
+                            'errorType': 'InternalError'})
+                for op in batch + execs:
+                    self._finalize_attribution(op)
 
     def _flush(self, batch, execs):
         telemetry.metric('scheduler.flushes')
+        # attribution: the claim closed every op's queue stage
+        claimed = batch + execs
+        for op in claimed:
+            if op.clock is not None:
+                op.clock.mark('queue')
+        fanout_s = 0.0
+        fanned = ()
         # the flush span parents the pool's batch spans (contextvars
         # nesting), completing the request -> flush -> batch trace link
         with telemetry.span('scheduler.flush', batched=len(batch),
@@ -542,9 +587,29 @@ class GatewayServer(object):
                 for op in execs:
                     self._run_exec(op, fan=fan)
                 if fan is not None:
-                    self._fanout_flush(fan, fsp)
+                    fanout_s = self._fanout_flush(fan, fsp)
+                    fanned = set(fan['updates']) | set(fan['quarantined'])
                 if self.storage_tier is not None and touched:
                     self._storage_upkeep(batch, execs, touched)
+        # attribution epilogue (responses are already on the wire;
+        # histograms + tail sampling only): the fan-out wall lands on
+        # every request whose doc actually fanned, then each request's
+        # stage vector finalizes exactly once
+        for op in claimed:
+            self._finalize_attribution(op, fanout_s, fanned)
+
+    def _finalize_attribution(self, op, fanout_s=0.0, fanned=()):
+        """Final per-request accounting (idempotent: the clock detaches
+        on first call, so the dispatcher's error path can sweep ops a
+        partial flush already finalized)."""
+        clock, op.clock = op.clock, None
+        if clock is None:
+            return
+        if fanout_s and any(d in fanned for d in op.docs):
+            clock.add('fanout', fanout_s)
+        attribution.finish(clock, ok=not op.failed, cmd=op.cmd,
+                           rid=op.rid,
+                           doc=op.docs[0] if op.docs else None)
 
     @staticmethod
     def _cold_error(rid, doc, exc):
@@ -608,6 +673,12 @@ class GatewayServer(object):
         -request responses routed back by (conn, id)."""
         self._observe_wait(ops)
         telemetry.metric('scheduler.coalesced_ops', len(ops))
+        for op in ops:
+            if op.clock is not None:
+                op.clock.mark('claim')
+        # bracket the pool call so the native driver's always-on phase
+        # seams can split the shared apply wall into dispatch/collect
+        attribution.flush_phases_begin()
         t0 = time.perf_counter()
         try:
             # merge building sits INSIDE the try: a request malformed in
@@ -624,6 +695,7 @@ class GatewayServer(object):
             telemetry.metric('scheduler.batched_docs', len(merged))
             out = self.backend.pool.apply_batch(merged)
         except Exception as e:
+            attribution.flush_phases_end()
             # whole-batch protocol error (validation; nothing committed,
             # post-rollback): replay serially so each request gets
             # exactly the result/error serial application produces
@@ -635,6 +707,17 @@ class GatewayServer(object):
                 self._run_exec(op, count=False, fan=fan)
             return
         dt = time.perf_counter() - t0
+        # the collect share of the shared apply wall (zero when the
+        # pool drove shard/mesh threads: their seams land in other
+        # threads' brackets, and `dispatch` absorbs the whole wall)
+        collect_s = attribution.flush_phases_end().get('collect', 0.0)
+        # close every op's dispatch/collect segment BEFORE the response
+        # loop: op k's dispatch must not absorb ops 1..k-1's response
+        # builds and socket writes -- that serialized-emission wait is
+        # real latency, but it belongs to each op's own emit delta
+        for op in ops:
+            if op.clock is not None:
+                op.clock.mark_split('dispatch', 'collect', collect_s)
         flush_id = getattr(fsp, 'span_id', None)
         for op in ops:
             if op.cmd == 'apply_changes':
@@ -680,10 +763,19 @@ class GatewayServer(object):
         if count:
             telemetry.metric('scheduler.exec_ops')
             self._observe_wait([op])
+            if op.clock is not None:
+                # serial-fallback replays (count=False) marked claim in
+                # _run_batch already; marking again would double-count
+                op.clock.mark('claim')
         if op.cmd in FANOUT_CMDS:
-            self._finish(op, self._fanout_cmd(op))
+            resp = self._fanout_cmd(op)
+            if op.clock is not None:
+                op.clock.mark('dispatch')
+            self._finish(op, resp)
             return
         resp = self.backend.handle(op.req)
+        if op.clock is not None:
+            op.clock.mark('dispatch')
         if fan is not None and op.cmd in BATCH_CMDS + EXEC_CMDS:
             if 'error' not in resp:
                 result = resp.get('result')
@@ -795,7 +887,9 @@ class GatewayServer(object):
     def _fanout_flush(self, fan, fsp):
         """Hands the flush's committed docs to the fan-out engine; the
         span nests under scheduler.flush (contextvars) and carries the
-        flush span id, exactly like the pool's batch spans."""
+        flush span id, exactly like the pool's batch spans.  Returns
+        the pass's wall seconds (the `fanout` attribution stage)."""
+        t0 = time.perf_counter()
         try:
             with telemetry.span('sync.fanout', docs=len(fan['updates']),
                                 flush=getattr(fsp, 'span_id', None)):
@@ -808,7 +902,12 @@ class GatewayServer(object):
             telemetry.metric('sync.fanout.errors')
             print('gateway: fan-out failed: %s: %s'
                   % (type(e).__name__, e), file=sys.stderr)
+        return time.perf_counter() - t0
 
     def _finish(self, op, resp):
+        op.answered = True
         op.conn.send(resp)
+        if op.clock is not None:
+            op.failed = 'error' in resp
+            op.clock.mark('emit')
         self.queue.note_complete(op)

@@ -77,7 +77,7 @@ class PendingOp(object):
     coalesce into one pool batch."""
 
     __slots__ = ('conn', 'rid', 'cmd', 'req', 'docs', 'n_ops',
-                 'batchable', 'enq_t')
+                 'batchable', 'enq_t', 'clock', 'failed', 'answered')
 
     def __init__(self, conn, rid, cmd, req, docs, n_ops, batchable):
         self.conn = conn
@@ -88,6 +88,14 @@ class PendingOp(object):
         self.n_ops = max(1, int(n_ops))
         self.batchable = bool(batchable)
         self.enq_t = time.perf_counter()
+        # critical-path attribution (telemetry/attribution.py): the
+        # gateway attaches a stage Clock before offer() and clears it
+        # at finalization; `failed` records the response outcome;
+        # `answered` guards the dispatcher's crash sweep from double
+        # -finishing ops a partial flush already answered
+        self.clock = None
+        self.failed = False
+        self.answered = False
 
 
 class AdmissionQueue(object):
@@ -128,6 +136,8 @@ class AdmissionQueue(object):
             if not admit_always:
                 if self.shedding and self.depth_ops <= self.low_ops:
                     self.shedding = False
+                    telemetry.recorder.record('shed.off',
+                                              n=self.depth_ops)
                 # a single request LARGER than the whole queue is
                 # admitted when the queue is empty (the --serial loop
                 # accepts it, and claim() serves an oversized op as its
@@ -137,6 +147,11 @@ class AdmissionQueue(object):
                 over = self.depth_ops + op.n_ops > self.max_ops \
                     and self.depth_ops > 0
                 if self.shedding or over:
+                    if not self.shedding:
+                        # flight-recorder transition event (the per
+                        # -request counter below stays per shed)
+                        telemetry.recorder.record('shed.on',
+                                                  n=self.depth_ops)
                     self.shedding = True
                     telemetry.metric('scheduler.shed')
                     raise Overloaded(

@@ -555,6 +555,10 @@ class SidecarClient:
         into the fresh process."""
         self._respawns += 1
         telemetry.metric('sidecar.client.respawns')
+        # the dead server can no longer dump ITS ring; record + dump
+        # the client-side view so the respawn leaves a post-mortem
+        telemetry.recorder.record('sidecar.respawn', n=self._respawns)
+        telemetry.recorder.dump('respawn')
         deadline = time.monotonic() + env_float(
             'AMTPU_SIDECAR_RESPAWN_DEADLINE_S', 30.0)
         delay = 0.05
@@ -684,6 +688,11 @@ class SidecarClient:
 
     def healthz(self):
         return self.call('healthz')
+
+    def dump(self):
+        """Triggers a SERVER-side flight-recorder dump; returns
+        {'path', 'events', 'reason'} (docs/OBSERVABILITY.md)."""
+        return self.call('dump')
 
     @property
     def restarts(self):

@@ -36,6 +36,11 @@ Requests (fields beyond `cmd`/`id` per command):
   {"id": 12, "cmd": "subscribe",   "doc": d, "clock": {...}, "peer": p?}
   {"id": 13, "cmd": "unsubscribe", "doc": d, "peer": p?}
   {"id": 14, "cmd": "presence",    "doc": d, "state": ..., "peer": p?}
+  {"id": 15, "cmd": "dump"}
+
+`dump` writes the always-on flight recorder's event ring as JSONL
+(docs/OBSERVABILITY.md) and answers {"path": ..., "events": n}; the
+same ring is served in place at the HTTP listener's /debug/recorder.
 
 The last three are the batched fan-out control plane (ISSUE 9,
 docs/SERVING.md fan-out section) and are served only by the gateway
@@ -151,7 +156,7 @@ class SidecarBackend:
     COMMANDS = ('ping', 'apply_changes', 'apply_batch',
                 'apply_local_change', 'get_patch', 'save', 'load',
                 'get_missing_deps', 'get_missing_changes',
-                'get_changes_for_actor', 'metrics', 'healthz',
+                'get_changes_for_actor', 'metrics', 'healthz', 'dump',
                 'subscribe', 'unsubscribe', 'presence')
 
     def handle(self, req):
@@ -184,6 +189,13 @@ class SidecarBackend:
                           'body': telemetry.render_prometheus()}
             elif cmd == 'healthz':
                 result = telemetry.healthz()
+            elif cmd == 'dump':
+                # on-demand flight-recorder dump (docs/OBSERVABILITY.md):
+                # writes the ring as JSONL and answers the path, so an
+                # operator can snapshot "what just happened" without
+                # waiting for a fault to trigger it
+                result = telemetry.recorder.dump('request', force=True) \
+                    or {'path': None, 'events': 0, 'reason': 'request'}
             elif cmd == 'apply_changes':
                 result = self.apply_changes(req['doc'], req['changes'])
             elif cmd == 'apply_batch':
@@ -345,6 +357,13 @@ def main(argv=None):
     cleanup = []      # filled by the socket branch below
 
     def _graceful_exit(signum, _frame):
+        if signum == signal.SIGTERM:
+            # a supervised shutdown is a post-mortem opportunity: dump
+            # the flight recorder before the ring dies with the process
+            try:
+                telemetry.recorder.dump('sigterm', force=True)
+            except Exception:
+                pass
         for fn in cleanup:
             try:
                 fn()

@@ -145,8 +145,12 @@ class DocEvictor(object):
             self._lru.move_to_end(d)
         if ok:
             telemetry.metric('storage.reloads', len(ok))
+            telemetry.recorder.record('storage.reload', n=len(ok))
         if failed:
             telemetry.metric('storage.reload_failed', len(failed))
+            telemetry.recorder.record(
+                'storage.reload', n=len(failed),
+                doc=next(iter(failed)), detail='failed')
         return failed
 
     def note_touch(self, docs):
@@ -185,6 +189,7 @@ class DocEvictor(object):
             evicted += 1
         if evicted:
             telemetry.metric('storage.evictions', evicted)
+            telemetry.recorder.record('storage.evict', n=evicted)
         return evicted
 
     # -- settled-history GC cadence -------------------------------------

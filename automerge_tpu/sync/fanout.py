@@ -429,6 +429,7 @@ class FanoutEngine(object):
         if not dirty and not presence:
             return 0
         telemetry.metric('sync.fanout.flushes')
+        telemetry.recorder.record('fanout.flush', n=len(dirty))
 
         # 2. classify EVERY subscriber of EVERY dirty doc in one pass
         rows_per_doc = []

@@ -269,6 +269,25 @@ KNOWN_STORAGE_KEYS = ('columnar.encodes', 'columnar.decodes',
                       'evictions', 'reloads', 'reload_failed',
                       'evict_failed', 'cold_bytes_written')
 
+# flight-recorder counters (`telemetry.metric('recorder.<name>')` call
+# sites in telemetry/recorder.py; event catalog: docs/OBSERVABILITY.md),
+# pre-seeded into every bench_block so gates read explicit zeros:
+# dumps         JSONL ring dumps written (quarantine, state-suspect,
+#                 respawn, SIGTERM, the `dump` request)
+# dump_failed   dumps that could not be written (full disk, bad dir);
+#                 the triggering failure is never re-raised
+KNOWN_RECORDER_KEYS = ('dumps', 'dump_failed')
+
+# SLO / attribution counters (`telemetry.metric('slo.<name>')` call
+# sites in telemetry/attribution.py; request-stage glossary:
+# docs/OBSERVABILITY.md), pre-seeded into every bench_block:
+# requests    gateway requests the critical-path attribution finished
+# breaches    attributed requests whose through-emit wall exceeded
+#               AMTPU_SLO_P99_MS
+# exemplars   tail-sampled exemplar span trees emitted (slow or
+#               failed/quarantined requests)
+KNOWN_SLO_KEYS = ('requests', 'breaches', 'exemplars')
+
 # docs per gateway flush are effectively powers of two: exact log2 bounds
 BATCH_OCCUPANCY_BUCKETS = tuple(float(2 ** i) for i in range(13))
 
@@ -381,6 +400,9 @@ def observe_batch(pool, seconds, docs=0, ops=0):
         DOCS.inc(docs)
     if ops:
         OPS.inc(ops)
+    # flight-recorder commit event (begin/rollback stamp in native/):
+    # one ring append per completed batch, any entry point
+    recorder.record('batch.commit', n=docs, detail=pool)
 
 
 def devtime_on():
@@ -513,7 +535,13 @@ def healthz():
                          and degraded_age < _degraded_window_s()),
             'last_degraded_age_s': (None if degraded_age is None
                                     else round(degraded_age, 3)),
-            'resilience': res})
+            'resilience': res,
+            # the SLO surface (docs/OBSERVABILITY.md): rolling
+            # per-class p50/p99 + multi-window burn rates, and the
+            # flight recorder's ring state -- process-wide, so both
+            # healthz transports carry them without registration
+            'slo': attribution.slo_section(),
+            'recorder': recorder.RECORDER.healthz_section()})
 
 
 def bench_block():
@@ -557,6 +585,14 @@ def bench_block():
     storage.update({k.split('.', 1)[1]: round(v, 6)
                     for k, v in flat.items()
                     if k.startswith('storage.')})
+    rec = {r: 0.0 for r in KNOWN_RECORDER_KEYS}
+    rec.update({k.split('.', 1)[1]: round(v, 6)
+                for k, v in flat.items()
+                if k.startswith('recorder.')})
+    slo = {r: 0.0 for r in KNOWN_SLO_KEYS}
+    slo.update({k.split('.', 1)[1]: round(v, 6)
+                for k, v in flat.items()
+                if k.startswith('slo.')})
     block = {
         'fallbacks': fallbacks,
         'collect': collect,
@@ -567,6 +603,8 @@ def bench_block():
         'mesh': mesh,
         'fanout': fanout,
         'storage': storage,
+        'recorder': rec,
+        'slo': slo,
         'device_s': round(flat.get('device.dispatch_sync_s', 0.0), 4),
         'device_dispatches': int(flat.get('device.dispatches', 0)),
         'batch_latency': BATCH_LATENCY.snapshot() or {},
@@ -600,3 +638,9 @@ def reset_all():
     registry.reset()
     metrics_reset()
     phase_reset()
+
+
+# imported LAST: both modules resolve names from this module (registry,
+# buckets, metric) lazily, so they must load after those exist
+from . import attribution, recorder  # noqa: E402,F401
+

@@ -1,10 +1,12 @@
 """Sidecar metrics listener: a tiny stdlib HTTP server exposing
-`/metrics` (Prometheus text exposition) and `/healthz` (JSON liveness)
-so a fleet of sidecars is scrapeable without touching the stream
-protocol.  Runs as a daemon thread next to the stream loop; the same
-payloads are also answerable in-band via the `metrics` / `healthz`
-request types (sidecar/server.py) for transports that already hold a
-stream open.
+`/metrics` (Prometheus text exposition), `/healthz` (JSON liveness),
+and `/debug/recorder` (the flight recorder's ring as JSON, newest
+last, plus the recent exemplar roots) so a fleet of sidecars is
+scrapeable and post-mortem-able without touching the stream protocol.
+Runs as a daemon thread next to the stream loop; the same payloads are
+also answerable in-band via the `metrics` / `healthz` / `dump` request
+types (sidecar/server.py) for transports that already hold a stream
+open.
 """
 
 import json
@@ -24,6 +26,13 @@ class _Handler(BaseHTTPRequestHandler):
             ctype = CONTENT_TYPE
         elif path == '/healthz':
             body = (json.dumps(healthz()) + '\n').encode()
+            ctype = 'application/json'
+        elif path == '/debug/recorder':
+            from . import attribution, recorder
+            body = (json.dumps(
+                {'events': recorder.events_json(),
+                 'exemplars': attribution.recent_exemplars()},
+                default=str) + '\n').encode()
             ctype = 'application/json'
         else:
             self.send_response(404)

@@ -51,6 +51,43 @@ def format_value(v):
     return str(v)
 
 
+def bucket_index(bounds, v):
+    """Index of the bucket holding `v` against fixed sorted `bounds`
+    (len(bounds) = the +Inf bucket).  Bisection: the binary search
+    beats log() calls and stays exact at the boundaries.  The ONE
+    bucket search shared by HistogramChild and the SLO windows
+    (telemetry/attribution.py)."""
+    lo, hi = 0, len(bounds)
+    while lo < hi:
+        mid = (lo + hi) // 2
+        if v <= bounds[mid]:
+            hi = mid
+        else:
+            lo = mid + 1
+    return lo
+
+
+def quantile_from_counts(bounds, counts, total, q):
+    """Linear-interpolated quantile from bucket counts (the same
+    estimate Prometheus' histogram_quantile computes server-side; +Inf
+    observations clamp to the top finite bound).  Returns 0.0 on an
+    empty histogram.  Shared by HistogramChild and the SLO windows so
+    healthz p99s cannot drift from the exposition's."""
+    if total == 0:
+        return 0.0
+    target = q * total
+    cum = 0
+    for i, c in enumerate(counts):
+        cum += c
+        if cum >= target and c > 0:
+            lo = bounds[i - 1] if i > 0 else 0.0
+            if i >= len(bounds):          # +Inf bucket: clamp
+                return bounds[-1]
+            hi = bounds[i]
+            return lo + (hi - lo) * (target - (cum - c)) / c
+    return bounds[-1]
+
+
 def _labels_text(labelnames, labelvalues):
     if not labelnames:
         return ''
@@ -114,16 +151,7 @@ class HistogramChild(_Child):
         self.count = 0
 
     def _bucket_index(self, v):
-        # bisect over the fixed bounds (27 entries: the binary search
-        # beats log() calls and stays exact at the boundaries)
-        lo, hi = 0, len(self.bounds)
-        while lo < hi:
-            mid = (lo + hi) // 2
-            if v <= self.bounds[mid]:
-                hi = mid
-            else:
-                lo = mid + 1
-        return lo
+        return bucket_index(self.bounds, v)
 
     def observe(self, v):
         i = self._bucket_index(v)
@@ -147,19 +175,7 @@ class HistogramChild(_Child):
         return self._quantile_from(counts, total, q)
 
     def _quantile_from(self, counts, total, q):
-        if total == 0:
-            return 0.0
-        target = q * total
-        cum = 0
-        for i, c in enumerate(counts):
-            cum += c
-            if cum >= target and c > 0:
-                lo = self.bounds[i - 1] if i > 0 else 0.0
-                if i >= len(self.bounds):      # +Inf bucket: clamp
-                    return self.bounds[-1]
-                hi = self.bounds[i]
-                return lo + (hi - lo) * (target - (cum - c)) / c
-        return self.bounds[-1]
+        return quantile_from_counts(self.bounds, counts, total, q)
 
     def summary(self):
         """{count, sum, p50, p95, p99} -- the bench-line embed shape;

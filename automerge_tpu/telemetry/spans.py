@@ -29,7 +29,7 @@ import os
 import sys
 import threading
 import time
-from ..utils.common import env_bool, env_str
+from ..utils.common import env_bool, env_int, env_str
 
 _current = contextvars.ContextVar('amtpu_current_span', default=None)
 
@@ -173,14 +173,46 @@ def trace_file():
     return _export_path
 
 
+def _max_export_bytes():
+    """Size cap on the JSONL export (``AMTPU_TRACE_FILE_MAX_MB``,
+    default 256; <=0 disables the cap).  Long-lived traced servers must
+    not grow the span file without bound."""
+    return env_int('AMTPU_TRACE_FILE_MAX_MB', 256) * 1024 * 1024
+
+
+def _rotate_locked():
+    """Keep-1 rotation (caller holds _export_lock): the live file moves
+    to ``<path>.1`` (replacing any previous rotation) and a fresh file
+    opens, so the export footprint is bounded at ~2x the cap while the
+    most recent cap's worth of spans always survives."""
+    global _export_file
+    _export_file.close()
+    _export_file = None
+    os.replace(_export_path, _export_path + '.1')
+
+
 def _export(sp, dur):
-    global _export_file, _export_path
     rec = {'name': sp.name, 'trace': sp.trace_id, 'span': sp.span_id,
            'parent': sp.parent_id, 'start': round(sp.start, 6),
            'dur_s': round(dur, 9)}
     if sp.attrs:
         rec['attrs'] = sp.attrs
-    line = json.dumps(rec, default=str) + '\n'
+    _write_line(json.dumps(rec, default=str) + '\n')
+
+
+def export_record(rec):
+    """Appends one arbitrary JSON-safe record to the trace file when
+    one is configured -- the tail-sampled exemplar path
+    (telemetry/attribution.py), which must export even while span
+    tracing is disabled (exemplars ARE the sample).  No-op without a
+    configured file."""
+    if _export_path is None:
+        return
+    _write_line(json.dumps(rec, default=str) + '\n')
+
+
+def _write_line(line):
+    global _export_file, _export_path
     with _export_lock:
         if _export_path is None:      # raced with set_trace_file(None)
             return
@@ -189,6 +221,9 @@ def _export(sp, dur):
                 _export_file = open(_export_path, 'a')
             _export_file.write(line)
             _export_file.flush()
+            cap = _max_export_bytes()
+            if cap > 0 and _export_file.tell() > cap:
+                _rotate_locked()
         except OSError as e:
             # a broken export path (bad dir, full disk) must degrade
             # TRACING, never the instrumented operation: disable the

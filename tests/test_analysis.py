@@ -207,7 +207,13 @@ def _aliasing(donate):
 br._jit_row_scatter = _aliasing
 
 caught = False
-for attempt in range(3):
+# the alias is only observable while the async dispatch still holds
+# the raw buffers; on a saturated single-core host XLA sometimes
+# completes inside the dispatch call itself and an attempt misses.
+# Six independent attempts keep the detection power while pushing the
+# all-miss flake rate into the noise (p_miss^6; measured ~10-20%
+# all-miss at 3 attempts on a 1-core box)
+for attempt in range(6):
     if run_rounds() != ref:
         caught = True
         break

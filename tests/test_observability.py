@@ -1,0 +1,269 @@
+"""Unit coverage for the ISSUE-12 observability layer: the flight
+recorder ring (telemetry/recorder.py), per-request critical-path
+attribution + SLO windows (telemetry/attribution.py), and the
+size-capped trace-file rotation (telemetry/spans.py)."""
+
+import json
+import os
+import time
+
+import pytest
+
+from automerge_tpu import telemetry
+from automerge_tpu.telemetry import attribution, recorder, spans
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    telemetry.reset_all()
+    attribution._exemplar_last = 0.0   # re-open the tail sampler
+    yield
+    telemetry.reset_all()
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+def test_ring_keeps_newest_on_wrap():
+    r = recorder.Recorder(16)
+    for i in range(50):
+        r.record('batch.begin', n=i)
+    snap = r.snapshot()
+    assert len(snap) == 16
+    seqs = [s[0] for s in snap]
+    assert seqs == sorted(seqs)
+    assert seqs[-1] == 49
+    assert seqs[0] == 50 - 16
+
+
+def test_record_fields_and_tail():
+    r = recorder.Recorder(32)
+    t0 = time.time()
+    r.record('resilience.quarantine', doc='doc-7', n=2, detail='Boom')
+    ev = r.events_json()[-1]
+    assert ev['event'] == 'resilience.quarantine'
+    assert ev['doc'] == 'doc-7' and ev['n'] == 2
+    assert ev['detail'] == 'Boom'
+    assert r.tail(t0 - 1)[-1]['event'] == 'resilience.quarantine'
+    assert r.tail(time.time() + 60) == []
+
+
+def test_dump_writes_jsonl_and_rate_limits(tmp_path, monkeypatch):
+    monkeypatch.setenv('AMTPU_RECORDER_DIR', str(tmp_path))
+    r = recorder.Recorder(16)
+    r.record('fault.injected', doc='p', detail='native.begin:permanent')
+    out = r.dump('quarantine')
+    assert out is not None and os.path.exists(out['path'])
+    lines = [json.loads(ln) for ln in open(out['path'])]
+    assert lines[0]['recorder_dump'] == 'quarantine'
+    assert any(e.get('event') == 'fault.injected' for e in lines[1:])
+    # second dump for the same reason inside the rate window is refused
+    assert r.dump('quarantine') is None
+    # ...but force (the on-demand `dump` request) always writes
+    assert r.dump('quarantine', force=True) is not None
+    assert telemetry.metrics_snapshot().get('recorder.dumps') == 2
+    # healthz reports dumps WRITTEN, not trigger reasons attempted
+    assert r.healthz_section()['dumps'] == 2
+
+
+def test_dump_degrades_on_unwritable_dir(tmp_path, monkeypatch):
+    # an uncreatable dump dir must degrade the DUMP (None +
+    # recorder.dump_failed), never raise into the quarantine path
+    blocker = tmp_path / 'blocker'
+    blocker.write_text('x')
+    monkeypatch.setenv('AMTPU_RECORDER_DIR',
+                       str(blocker / 'sub'))   # parent is a file
+    r = recorder.Recorder(16)
+    r.record('batch.begin')
+    assert r.dump('quarantine') is None
+    assert telemetry.metrics_snapshot().get('recorder.dump_failed') == 1
+    assert r.healthz_section()['dumps'] == 0
+
+
+def test_module_ring_is_always_on():
+    before = len(recorder.snapshot())
+    recorder.record('shed.on', n=123)
+    assert len(recorder.snapshot()) >= min(before + 1,
+                                           recorder.RECORDER.size)
+    assert recorder.events_json()[-1]['event'] == 'shed.on'
+
+
+# ---------------------------------------------------------------------------
+# attribution
+# ---------------------------------------------------------------------------
+
+def _stage_sums():
+    fam = attribution._family()
+    return {k: v['sum'] for k, v in (fam.snapshot() or {}).items()}
+
+
+def test_stage_partition_sums_to_total():
+    c = attribution.Clock('mutate')
+    time.sleep(0.002)
+    c.mark('admit')
+    c.mark('queue')
+    c.mark('claim')
+    time.sleep(0.002)
+    c.mark_split('dispatch', 'collect', 0.0005)
+    c.mark('emit')
+    c.add('fanout', 0.003)
+    attribution.finish(c, ok=True, cmd='apply_changes', rid=1, doc='d')
+    sums = _stage_sums()
+    partition = sum(sums.get(s, 0.0) for s in
+                    ('admit', 'queue', 'claim', 'dispatch', 'collect',
+                     'emit'))
+    assert sums['total'] == pytest.approx(partition, rel=1e-6)
+    # the fan-out tail is attributed on top, never inside the total
+    assert sums['fanout'] == pytest.approx(3.0, rel=0.05)
+    assert telemetry.metrics_snapshot().get('slo.requests') == 1
+
+
+def test_mark_split_clamps_to_segment():
+    c = attribution.Clock('read')
+    c.mark('admit')
+    c.mark_split('dispatch', 'collect', 10.0)   # larger than the wall
+    d = dict(c.stages)
+    assert d['dispatch'] == 0.0
+    assert d['collect'] < 1.0
+
+
+def test_slow_request_emits_exemplar(tmp_path, monkeypatch):
+    monkeypatch.setenv('AMTPU_SLOW_MS', '1')
+    trace_file = tmp_path / 'spans.jsonl'
+    spans.set_trace_file(str(trace_file))
+    try:
+        c = attribution.Clock('mutate')
+        time.sleep(0.005)
+        c.mark('admit')
+        c.mark('emit')
+        attribution.finish(c, ok=True, cmd='apply_changes', rid=9,
+                           doc='slow-doc')
+        recs = [json.loads(ln) for ln in open(trace_file)]
+    finally:
+        spans.set_trace_file(None)
+    roots = [r for r in recs if r['name'] == 'request.exemplar']
+    assert roots and roots[-1]['attrs']['doc'] == 'slow-doc'
+    assert roots[-1]['events'] is not None
+    kids = [r for r in recs if r.get('parent') == roots[-1]['span']]
+    assert {k['name'] for k in kids} >= {'request.stage.admit',
+                                         'request.stage.emit'}
+    assert attribution.recent_exemplars()[-1]['attrs']['rid'] == 9
+    assert telemetry.metrics_snapshot().get('slo.exemplars', 0) >= 1
+
+
+def test_failed_request_always_sampled(monkeypatch):
+    monkeypatch.setenv('AMTPU_SLOW_MS', '60000')
+    before = telemetry.metrics_snapshot().get('slo.exemplars', 0)
+    c = attribution.Clock('mutate')
+    c.mark('admit')
+    c.mark('emit')
+    attribution.finish(c, ok=False, cmd='apply_changes', rid=2, doc='q')
+    assert telemetry.metrics_snapshot().get('slo.exemplars') == \
+        before + 1
+
+
+def test_exemplar_rate_limit(monkeypatch):
+    # an error storm must not emit one exemplar per failing request
+    monkeypatch.setenv('AMTPU_SLOW_MS', '60000')
+    monkeypatch.setenv('AMTPU_EXEMPLAR_MIN_S', '30')
+    before = telemetry.metrics_snapshot().get('slo.exemplars', 0)
+    for i in range(10):
+        c = attribution.Clock('mutate')
+        c.mark('admit')
+        c.mark('emit')
+        attribution.finish(c, ok=False, cmd='apply_changes', rid=i)
+    assert telemetry.metrics_snapshot().get('slo.exemplars') == \
+        before + 1
+
+
+def test_flush_phase_bracket_is_thread_scoped():
+    assert attribution.flush_phases_end() == {}
+    attribution.note_flush_phase('collect', 1.0)   # outside a bracket
+    attribution.flush_phases_begin()
+    attribution.note_flush_phase('collect', 0.25)
+    attribution.note_flush_phase('collect', 0.25)
+    attribution.note_flush_phase('dispatch', 0.1)
+    got = attribution.flush_phases_end()
+    assert got == {'collect': 0.5, 'dispatch': 0.1}
+    assert attribution.flush_phases_end() == {}
+
+
+def test_slo_windows_and_burn(monkeypatch):
+    monkeypatch.setenv('AMTPU_SLO_P99_MS', '10')
+    slo = attribution._SloWindows()
+    for _ in range(99):
+        slo.observe('mutate', 1.0, False)
+    slo.observe('mutate', 500.0, True)
+    monkeypatch.setattr(attribution, '_SLO', slo)
+    sec = attribution.slo_section()
+    w = sec['classes']['mutate']['60s']
+    assert w['count'] == 100
+    assert w['p50_ms'] <= 10
+    assert w['p99_ms'] >= 1.0
+    assert w['breach_frac'] == pytest.approx(0.01)
+    # 1% breaches == exactly the 1% budget -> burn 1.0
+    assert sec['burn']['300s'] == pytest.approx(1.0)
+    assert sec['target_p99_ms'] == 10
+
+
+def test_class_of_covers_protocol():
+    assert attribution.class_of('apply_changes') == 'mutate'
+    assert attribution.class_of('load') == 'mutate'
+    assert attribution.class_of('subscribe') == 'control'
+    assert attribution.class_of('get_patch') == 'read'
+
+
+# ---------------------------------------------------------------------------
+# trace-file rotation (satellite: bounded span export)
+# ---------------------------------------------------------------------------
+
+def test_trace_file_rotates_at_cap(tmp_path, monkeypatch):
+    # the env helper reads MB; 1 MB cap keeps the test fast
+    monkeypatch.setenv('AMTPU_TRACE_FILE_MAX_MB', '1')
+    path = str(tmp_path / 'trace.jsonl')
+    spans.set_trace_file(path)
+    telemetry.enable()
+    try:
+        big = 'x' * 8192
+        for i in range(200):            # ~1.6 MB of spans
+            with telemetry.span('rotate.test', blob=big):
+                pass
+    finally:
+        telemetry.disable()
+        spans.set_trace_file(None)
+    assert os.path.exists(path + '.1'), 'rotation never triggered'
+    assert os.path.getsize(path + '.1') <= 1.2 * 1024 * 1024
+    assert os.path.getsize(path) <= 1.2 * 1024 * 1024
+    # both generations stay valid JSONL (rotation never tears a line)
+    for p in (path, path + '.1'):
+        with open(p) as f:
+            for ln in f:
+                json.loads(ln)
+
+
+def test_trace_file_cap_disabled(tmp_path, monkeypatch):
+    monkeypatch.setenv('AMTPU_TRACE_FILE_MAX_MB', '0')
+    path = str(tmp_path / 'trace.jsonl')
+    spans.set_trace_file(path)
+    telemetry.enable()
+    try:
+        for _i in range(5):
+            with telemetry.span('norotate.test', blob='y' * 64):
+                pass
+    finally:
+        telemetry.disable()
+        spans.set_trace_file(None)
+    assert not os.path.exists(path + '.1')
+
+
+def test_export_record_without_tracing(tmp_path):
+    path = str(tmp_path / 'trace.jsonl')
+    spans.set_trace_file(path)
+    try:
+        assert not telemetry.enabled()
+        spans.export_record({'name': 'exemplar.probe', 'x': 1})
+        rec = json.loads(open(path).readline())
+    finally:
+        spans.set_trace_file(None)
+    assert rec == {'name': 'exemplar.probe', 'x': 1}

@@ -1,0 +1,159 @@
+"""Diff two BENCH_*.json artifacts: ops/s, collect share, phase
+seconds -- the bench trajectory as a regression table instead of
+hand-diffed JSON.
+
+Accepts every artifact shape the repo emits:
+
+  * JSON lines (`bench.py --all`, `--multichip`, `--fanout`): one
+    result object per line;
+  * a single result object (`bench.py --config N` > file, the
+    `.bench_smoke.json` the pre-commit gate writes);
+  * the round-capture wrapper (``{"cmd", "rc", "tail", "parsed"}``):
+    the embedded ``parsed`` object is the line.
+
+Lines pair by ``(config, mode)`` (falling back to ``metric``); for each
+pair the table reports ops/s delta, collect-share delta (from the
+embedded telemetry block when present), and the biggest per-phase
+second movers.  Exit code: 1 when any pair regresses past the
+thresholds (``--tol-ops`` fractional ops/s drop, default 0.10;
+``--tol-share`` absolute collect-share increase, default 0.10) --
+unless ``--soft``, the report-only mode `make check` wires in (this
+host's windows jitter far past any honest hard gate; the table is for
+eyes and artifacts, the hard perf gates stay in perf-smoke/mesh-check).
+
+Run: python tools/bench_compare.py [--soft] OLD.json NEW.json
+"""
+
+import argparse
+import json
+import sys
+
+
+def load_lines(path):
+    """[(key, line_dict)] for one artifact, any supported shape."""
+    with open(path) as f:
+        text = f.read()
+    objs = []
+    try:
+        one = json.loads(text)
+        if isinstance(one, dict) and 'parsed' in one \
+                and isinstance(one['parsed'], dict):
+            one = one['parsed']
+        objs = one if isinstance(one, list) else [one]
+    except ValueError:
+        for ln in text.splitlines():
+            ln = ln.strip()
+            if not ln:
+                continue
+            try:
+                objs.append(json.loads(ln))
+            except ValueError:
+                pass
+    out = []
+    for o in objs:
+        if not isinstance(o, dict) or 'value' not in o:
+            continue
+        key = (str(o.get('config', o.get('metric', '?'))),
+               str(o.get('mode', '?')))
+        out.append((key, o))
+    return out
+
+
+def collect_share_of(line):
+    tele = line.get('telemetry') or {}
+    share = line.get('collect_share', tele.get('collect_share'))
+    return float(share) if share is not None else None
+
+
+def phases_of(line):
+    tele = line.get('telemetry') or {}
+    return {k: v.get('s', 0.0)
+            for k, v in (tele.get('phases') or {}).items()}
+
+
+def _fmt_ops(v):
+    return '%.0f' % v if v is not None else '-'
+
+
+def _fmt_pct(frac):
+    return '%+.1f%%' % (100 * frac) if frac is not None else '-'
+
+
+def _fmt_share(s):
+    return '%.3f' % s if s is not None else '-'
+
+
+def compare(old_path, new_path, tol_ops, tol_share, top_phases=4):
+    old = dict(load_lines(old_path))
+    new = dict(load_lines(new_path))
+    keys = [k for k in new if k in old]
+    if not keys:
+        print('bench-compare: no comparable (config, mode) lines '
+              'between %s and %s' % (old_path, new_path))
+        return []
+    print('bench-compare: %s -> %s' % (old_path, new_path))
+    header = ('config/mode', 'old ops/s', 'new ops/s', 'delta',
+              'share old', 'share new')
+    rows = []
+    regressions = []
+    for key in sorted(keys):
+        ol, nl = old[key], new[key]
+        ov, nv = float(ol['value']), float(nl['value'])
+        delta = (nv - ov) / ov if ov else None
+        oshare, nshare = collect_share_of(ol), collect_share_of(nl)
+        rows.append(('%s/%s' % key, _fmt_ops(ov), _fmt_ops(nv),
+                     _fmt_pct(delta), _fmt_share(oshare),
+                     _fmt_share(nshare)))
+        if delta is not None and delta < -tol_ops:
+            regressions.append('%s/%s: ops/s %s' % (key[0], key[1],
+                                                    _fmt_pct(delta)))
+        if oshare is not None and nshare is not None \
+                and nshare - oshare > tol_share:
+            regressions.append('%s/%s: collect share %.3f -> %.3f'
+                               % (key[0], key[1], oshare, nshare))
+    widths = [max(len(r[i]) for r in [header] + rows)
+              for i in range(len(header))]
+    for r in [header] + rows:
+        print('  ' + '  '.join(c.rjust(w) for c, w in zip(r, widths)))
+    # phase movers: the per-phase seconds that moved most, per pair
+    for key in sorted(keys):
+        op, np_ = phases_of(old[key]), phases_of(new[key])
+        moves = sorted(((np_.get(p, 0.0) - op.get(p, 0.0), p)
+                        for p in set(op) | set(np_)),
+                       key=lambda m: -abs(m[0]))[:top_phases]
+        moves = [(d, p) for d, p in moves if abs(d) >= 1e-4]
+        if moves:
+            print('  phases %s/%s: %s' % (key[0], key[1], ', '.join(
+                '%s %+.3fs' % (p, d) for d, p in moves)))
+    return regressions
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument('old')
+    ap.add_argument('new')
+    ap.add_argument('--soft', action='store_true',
+                    help='report only; always exit 0 (the make-check '
+                         'wiring)')
+    ap.add_argument('--tol-ops', type=float, default=0.10,
+                    help='fractional ops/s drop that counts as a '
+                         'regression (default 0.10)')
+    ap.add_argument('--tol-share', type=float, default=0.10,
+                    help='absolute collect-share increase that counts '
+                         'as a regression (default 0.10)')
+    args = ap.parse_args(argv)
+    regressions = compare(args.old, args.new, args.tol_ops,
+                          args.tol_share)
+    if regressions:
+        for r in regressions:
+            print('bench-compare: REGRESSION %s' % r)
+        if not args.soft:
+            return 1
+        print('bench-compare: soft mode, reporting only')
+    else:
+        print('bench-compare: no regressions past tolerance')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -20,7 +20,11 @@ AMTPU_MESH topology latch at first backend init):
      records the physical-core ceiling: on this CPU-core-bound
      stand-in the dp axis parallelizes the HOST work (C++ decode/
      begin/emit in one GIL-released thread per chip), so the ideal
-     ratio is min(dp, cores), not dp.
+     ratio is min(dp, cores), not dp.  On a SINGLE-core host that
+     ceiling is 1x -- there is nothing for dp to scale onto and the
+     threading overhead makes the ratio < 1 by construction -- so the
+     scaling assertion is skipped (loudly; the measured ratio still
+     lands in the JSON) and parity/oracle/engagement remain the gate.
 
 Run: JAX_PLATFORMS=cpu python tools/mesh_check.py     (make mesh-check)
 """
@@ -165,17 +169,30 @@ def main():
     if not parity.get('ok'):
         problems.extend(parity.get('problems', ['parity child failed']))
 
+    cores = os.cpu_count() or 1
     trials = []
     # bounded retries: the box is shared and the A/B still sees
     # minute-scale drift (same deflake posture as telemetry-check's
-    # median-of-trials)
-    for _ in range(int(os.environ.get('AMTPU_MESHCHECK_TRIALS', '3'))):
+    # median-of-trials).  One trial suffices when the assertion below
+    # is vacuous anyway (single core) -- the ratio is still recorded.
+    n_trials = int(os.environ.get('AMTPU_MESHCHECK_TRIALS', '3')) \
+        if cores >= 2 else 1
+    for _ in range(n_trials):
         ratio_med, ratio_min, rows = _scaling_trial()
         trials.append((ratio_med, ratio_min))
         if max(ratio_med, ratio_min) >= GATE:
             break
     speedup = max(ratio_med, ratio_min)
-    if speedup < GATE:
+    if cores < 2:
+        # nothing for the dp axis to scale onto: min(dp, cores) = 1,
+        # and per-chip threading overhead makes the ratio < 1 by
+        # construction.  Asserting 1.5x here would gate host
+        # provisioning, not the code -- parity/oracle/engagement above
+        # still gate.
+        print('mesh-check: scaling gate SKIPPED (1 physical core; '
+              'ceiling 1x; measured %.2fx recorded in the JSON)'
+              % speedup, file=sys.stderr)
+    elif speedup < GATE:
         problems.append('dp=4 speedup %.2fx (med %.2fx / min %.2fx) '
                         '< %.1fx gate' % (speedup, ratio_med, ratio_min,
                                           GATE))
@@ -184,10 +201,10 @@ def main():
         if bad:
             problems.append('fallback.oracle != 0 in dp=%d measure' % dp)
 
-    cores = os.cpu_count() or 1
     out = {
         'ok': not problems,
         'gate_speedup': GATE,
+        'scaling_gate_skipped': cores < 2,
         'speedup_med': round(ratio_med, 3),
         'speedup_min': round(ratio_min, 3),
         'trials': [[round(a, 3), round(b, 3)] for a, b in trials],
@@ -205,9 +222,10 @@ def main():
         for p in problems:
             print('  * ' + p, file=sys.stderr)
         return 1
-    print('mesh-check: parity ok, dp=4 %.2fx over dp=1 (gate %.1fx, '
+    print('mesh-check: parity ok, dp=4 %.2fx over dp=1 (gate %s, '
           'ceiling %dx on %d cores), oracle==0'
-          % (speedup, GATE, min(4, cores), cores), file=sys.stderr)
+          % (speedup, 'skipped' if cores < 2 else '%.1fx' % GATE,
+             min(4, cores), cores), file=sys.stderr)
     return 0
 
 

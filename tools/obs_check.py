@@ -1,0 +1,305 @@
+"""Observability gate (ISSUE 12, docs/OBSERVABILITY.md): the flight
+recorder, the per-request critical-path attribution, and the SLO
+surface must actually work against a LIVE gateway, not just in unit
+tests.
+
+Two phases, each against a real server subprocess on a unix socket:
+
+  1. **attribution + SLO + exemplars** -- 8 concurrent connections of
+     mixed traffic (mutations + bypass reads) with ``AMTPU_SLOW_MS``
+     pinned low so the tail sampler must fire.  Gates:
+       * the per-stage ``amtpu_request_stage_ms`` sums partition the
+         ``total`` series (sum of admit/queue/claim/dispatch/collect/
+         emit ~= sum of total, within 2% -- the stages are deltas of
+         one timestamp vector, so real drift means broken marks);
+       * at least one ``request.exemplar`` span tree landed in the
+         ``AMTPU_TRACE_FILE`` JSONL with its stage children and
+         attached recorder events;
+       * healthz carries the ``slo`` section (per-class windows +
+         burn) and the ``recorder`` ring state;
+       * ``tools/amtpu_top.py --once`` renders a frame from the live
+         /metrics + /healthz listener;
+       * SIGTERM leaves a recorder dump file behind.
+  2. **fault -> quarantine -> dump** -- one armed permanent
+     ``native.begin`` fault: the poisoned request answers the per-doc
+     error envelope AND the quarantine triggers a recorder dump whose
+     JSONL contains the injected ``fault.injected`` event (the
+     post-mortem exists without anyone asking for it), while an
+     on-demand ``dump`` request round-trips a fresh file.
+
+Run: JAX_PLATFORMS=cpu python tools/obs_check.py      (make obs-check)
+"""
+
+import glob
+import json
+import os
+import re
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N_CONNS = 8
+ROUNDS = 6
+ROOT_ID = '00000000-0000-0000-0000-000000000000'
+
+
+def spawn_server(path, extra_env=None, stderr_path=None):
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS='cpu')
+    env.update(extra_env or {})
+    stderr = open(stderr_path, 'wb') if stderr_path else None
+    proc = subprocess.Popen(
+        [sys.executable, '-m', 'automerge_tpu.sidecar.server',
+         '--socket', path]
+        + (['--metrics-port', '0'] if stderr_path else []),
+        env=env, cwd=REPO, stderr=stderr)
+    deadline = time.time() + 60
+    while not os.path.exists(path):
+        if time.time() > deadline or proc.poll() is not None:
+            raise RuntimeError('gateway server did not come up')
+        time.sleep(0.05)
+    return proc
+
+
+def stop_server(proc):
+    proc.terminate()
+    try:
+        proc.wait(timeout=30)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait(timeout=30)
+
+
+def metrics_port(stderr_path):
+    """The ephemeral port the server printed to stderr."""
+    deadline = time.time() + 30
+    pat = re.compile(r'metrics on http://[^:]+:(\d+)/metrics')
+    while time.time() < deadline:
+        with open(stderr_path, 'rb') as f:
+            m = pat.search(f.read().decode(errors='replace'))
+        if m:
+            return int(m.group(1))
+        time.sleep(0.1)
+    raise RuntimeError('metrics port never appeared on stderr')
+
+
+def drive_traffic(path):
+    from automerge_tpu.sidecar.client import SidecarClient
+    errors = []
+
+    def client(i):
+        try:
+            doc = 'obs-%02d' % i
+            with SidecarClient(sock_path=path) as c:
+                for s in range(1, ROUNDS + 1):
+                    c.apply_changes(doc, [{
+                        'actor': 'w%02d' % i, 'seq': s, 'deps': {},
+                        'ops': [{'action': 'set', 'obj': ROOT_ID,
+                                 'key': 'k%d' % (s % 3),
+                                 'value': '%d-%d' % (i, s)}]}])
+                    if s % 2 == 0:
+                        c.get_patch(doc)
+        except Exception as e:
+            errors.append((i, '%s: %s' % (type(e).__name__, e)))
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(N_CONNS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    if errors:
+        raise AssertionError('traffic failed: %s' % errors)
+
+
+def stage_sums(metrics_text):
+    pat = re.compile(
+        r'^amtpu_request_stage_ms_(sum|count)\{stage="([a-z]+)"\}'
+        r'\s+(\S+)$', re.M)
+    out = {}
+    for kind, stage, val in pat.findall(metrics_text):
+        out.setdefault(stage, {})[kind] = float(val)
+    return out
+
+
+def check_phase1(problems):
+    from automerge_tpu.sidecar.client import SidecarClient
+    tmp = tempfile.mkdtemp(prefix='amtpu-obs-')
+    sock = os.path.join(tmp, 'gw.sock')
+    trace_file = os.path.join(tmp, 'spans.jsonl')
+    rec_dir = os.path.join(tmp, 'recorder')
+    stderr_path = os.path.join(tmp, 'server.stderr')
+    proc = spawn_server(sock, {
+        'AMTPU_FLUSH_DEADLINE_MS': '5',
+        'AMTPU_SLOW_MS': '0.01',         # everything is "slow": the
+        'AMTPU_TRACE_FILE': trace_file,  # tail sampler must fire
+        'AMTPU_RECORDER_DIR': rec_dir,
+    }, stderr_path=stderr_path)
+    try:
+        drive_traffic(sock)
+        with SidecarClient(sock_path=sock) as c:
+            health = c.healthz()
+            metrics = c.metrics()['body']
+        port = metrics_port(stderr_path)
+        top = subprocess.run(
+            [sys.executable, os.path.join(REPO, 'tools', 'amtpu_top.py'),
+             '--url', 'http://127.0.0.1:%d' % port, '--once'],
+            capture_output=True, text=True, timeout=60)
+    finally:
+        stop_server(proc)
+
+    # 1a. stage sums partition the total
+    stages = stage_sums(metrics)
+    total = stages.get('total', {}).get('sum', 0.0)
+    parts = sum(stages.get(s, {}).get('sum', 0.0)
+                for s in ('admit', 'queue', 'claim', 'dispatch',
+                          'collect', 'emit'))
+    if total <= 0:
+        problems.append('phase1: no attributed requests '
+                        '(total sum = %r)' % total)
+    elif abs(parts - total) > 0.02 * total:
+        problems.append('phase1: stage sums %.3f ms != total %.3f ms '
+                        '(>2%% drift)' % (parts, total))
+    n_mut = stages.get('total', {}).get('count', 0)
+    if n_mut < N_CONNS * ROUNDS:
+        problems.append('phase1: only %s attributed requests '
+                        '(want >= %d)' % (n_mut, N_CONNS * ROUNDS))
+
+    # 1b. exemplars in the trace file, with children + recorder events
+    roots, children = [], []
+    if os.path.exists(trace_file):
+        for ln in open(trace_file):
+            try:
+                rec = json.loads(ln)
+            except ValueError:
+                continue
+            if rec.get('name') == 'request.exemplar':
+                roots.append(rec)
+            elif str(rec.get('name', '')).startswith('request.stage.'):
+                children.append(rec)
+    if not roots:
+        problems.append('phase1: no request.exemplar records in %s'
+                        % trace_file)
+    else:
+        root = roots[-1]
+        kids = [c for c in children if c['parent'] == root['span']]
+        if not kids:
+            problems.append('phase1: exemplar has no stage children')
+        if not isinstance(root.get('events'), list):
+            problems.append('phase1: exemplar carries no recorder '
+                            'events')
+
+    # 1c. the SLO surface on healthz
+    slo = health.get('slo') or {}
+    if 'burn' not in slo or 'classes' not in slo:
+        problems.append('phase1: healthz slo section missing/short: %r'
+                        % sorted(slo))
+    else:
+        mut = slo['classes'].get('mutate', {}).get('300s', {})
+        if not mut.get('count'):
+            problems.append('phase1: slo mutate window empty: %r' % mut)
+    if not (health.get('recorder') or {}).get('events'):
+        problems.append('phase1: healthz recorder section empty')
+
+    # 1d. amtpu_top renders from the live listener
+    if top.returncode != 0 or 'stage waterfall' not in top.stdout:
+        problems.append('phase1: amtpu_top --once failed (rc %s): %s %s'
+                        % (top.returncode, top.stdout[-200:],
+                           top.stderr[-200:]))
+
+    # 1e. SIGTERM left a recorder dump behind
+    if not glob.glob(os.path.join(rec_dir, '*sigterm*.jsonl')):
+        problems.append('phase1: no sigterm recorder dump in %s'
+                        % rec_dir)
+    if not problems:
+        print('obs-check: phase 1 OK (%d reqs attributed; stage sums '
+              '%.1f ms ~= total %.1f ms; %d exemplars; amtpu_top ok; '
+              'sigterm dump present)'
+              % (n_mut, parts, total, len(roots)))
+
+
+def check_phase2(problems):
+    from automerge_tpu.sidecar.client import SidecarClient
+    tmp = tempfile.mkdtemp(prefix='amtpu-obs2-')
+    sock = os.path.join(tmp, 'gw.sock')
+    rec_dir = os.path.join(tmp, 'recorder')
+    proc = spawn_server(sock, {
+        'AMTPU_FLUSH_DEADLINE_MS': '5',
+        'AMTPU_RECORDER_DIR': rec_dir,
+        # one permanent begin fault: the first apply quarantines
+        'AMTPU_FAULT': 'native.begin:permanent:1.0:1',
+    })
+    try:
+        with SidecarClient(sock_path=sock) as c:
+            from automerge_tpu.errors import AutomergeError
+            try:
+                resp = c.apply_changes('poison', [{
+                    'actor': 'px', 'seq': 1, 'deps': {},
+                    'ops': [{'action': 'set', 'obj': ROOT_ID,
+                             'key': 'k', 'value': 1}]}])
+                problems.append('phase2: poisoned apply answered a '
+                                'normal patch: %r' % (resp,))
+            except AutomergeError:
+                pass                     # the quarantine envelope
+            # a healthy doc still serves afterwards
+            ok = c.apply_changes('healthy', [{
+                'actor': 'h', 'seq': 1, 'deps': {},
+                'ops': [{'action': 'set', 'obj': ROOT_ID,
+                         'key': 'k', 'value': 2}]}])
+            if 'clock' not in ok:
+                problems.append('phase2: healthy doc result odd: %r'
+                                % (ok,))
+            on_demand = c.dump()
+            health = c.healthz()
+    finally:
+        stop_server(proc)
+
+    dumps = glob.glob(os.path.join(rec_dir, '*quarantine*.jsonl'))
+    if not dumps:
+        problems.append('phase2: quarantine produced no recorder dump '
+                        'in %s' % rec_dir)
+    else:
+        events = [json.loads(ln) for ln in open(dumps[0])][1:]
+        fault = [e for e in events if e.get('event') == 'fault.injected']
+        if not fault:
+            problems.append('phase2: quarantine dump lacks the '
+                            'injected fault event: %r'
+                            % [e.get('event') for e in events][-10:])
+        elif 'native.begin' not in str(fault[-1].get('detail')):
+            problems.append('phase2: fault event detail odd: %r'
+                            % fault[-1])
+        quar = [e for e in events
+                if e.get('event') == 'resilience.quarantine']
+        if not quar or quar[-1].get('doc') != 'poison':
+            problems.append('phase2: dump lacks the quarantine event '
+                            'for the poisoned doc: %r' % quar)
+    if not on_demand.get('path') or not os.path.exists(on_demand['path']):
+        problems.append('phase2: on-demand dump did not round-trip a '
+                        'file: %r' % on_demand)
+    if health.get('resilience', {}).get('quarantined', 0) < 1:
+        problems.append('phase2: healthz quarantined counter is zero')
+    if not problems:
+        print('obs-check: phase 2 OK (quarantine dumped %d events incl.'
+              ' the injected fault; on-demand dump %s; healthz sees the'
+              ' quarantine)' % (len(events), on_demand['path']))
+
+
+def main():
+    problems = []
+    check_phase1(problems)
+    if not problems:
+        check_phase2(problems)
+    if problems:
+        for p in problems:
+            print('obs-check: FAIL %s' % p)
+        return 1
+    print('obs-check: PASS')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -44,6 +44,7 @@ import msgpack  # noqa: E402
 
 from automerge_tpu import telemetry, trace  # noqa: E402
 from automerge_tpu.native import NativeDocPool, ShardedNativePool  # noqa: E402
+from automerge_tpu.telemetry import attribution, recorder  # noqa: E402
 from automerge_tpu.telemetry.spans import NULL_SPAN  # noqa: E402
 
 PAIRS = int(os.environ.get('AMTPU_TCHECK_PAIRS', 5))
@@ -66,6 +67,11 @@ _PATCHES = [
     (telemetry, 'observe_batch', _noop),
     (telemetry, 'observe_device_dispatch', _noop),
     (telemetry, 'metric', _noop),
+    # the always-on recorder/attribution seams (ISSUE 12): the raw arm
+    # must approximate deleting them too, so the gate prices their
+    # disabled-path cost honestly
+    (recorder, 'record', _noop),
+    (attribution, 'note_flush_phase', _noop),
 ]
 
 
