@@ -3,7 +3,7 @@
 .PHONY: all native test bench bench-all bench-tpu bench-multichip check \
 	clean wheel telemetry-check fallback-check perf-smoke chaos-check \
 	serve-check mesh-check static-check asan-check fanout-check \
-	bench-fanout storage-check obs-check
+	bench-fanout storage-check obs-check backpressure-check
 
 all: native
 
@@ -63,6 +63,7 @@ check: native
 	$(MAKE) chaos-check
 	$(MAKE) serve-check
 	$(MAKE) fanout-check
+	$(MAKE) backpressure-check
 	$(MAKE) storage-check
 	$(MAKE) obs-check
 	$(MAKE) mesh-check
@@ -110,6 +111,16 @@ serve-check: native
 # smoke gate, and fallback.oracle == 0.
 fanout-check: native
 	JAX_PLATFORMS=cpu python tools/fanout_check.py
+
+# Backpressure gate (ISSUE 13, docs/SERVING.md backpressure section):
+# one deliberately wedged consumer while 32 healthy connections stream
+# -- every healthy peer still receives every change, healthy p99 stays
+# within 2x the no-wedge baseline (floored for CI jitter), the wedged
+# peer is resynced with a typed envelope or evicted, its
+# post-reconnect backfill is byte-identical to a serial replay, and
+# fallback.oracle == 0.
+backpressure-check: native
+	JAX_PLATFORMS=cpu python tools/backpressure_check.py
 
 # The BENCH_FANOUT artifact (ISSUE 9): RGA-heavy text edits under
 # zipfian doc popularity fanned to 1k+ subscribed peers, with the

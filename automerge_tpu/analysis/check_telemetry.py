@@ -47,6 +47,7 @@ PRESEED_BLOCKS = {
     'resilience': 'KNOWN_RESILIENCE_KEYS',
     'scheduler': 'KNOWN_SCHEDULER_KEYS',
     'sync.fanout': 'KNOWN_FANOUT_KEYS',
+    'egress': 'KNOWN_EGRESS_KEYS',
     'storage': 'KNOWN_STORAGE_KEYS',
     'recorder': 'KNOWN_RECORDER_KEYS',
     'slo': 'KNOWN_SLO_KEYS',

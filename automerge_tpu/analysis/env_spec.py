@@ -142,6 +142,16 @@ ENV_FLAGS = (
             'scheduler/queue.py'),
     EnvFlag('AMTPU_QUEUE_LOW_FRAC', 'float', 0.5, False,
             'scheduler/queue.py'),
+    # -- bounded egress / backpressure (ISSUE 13) ---------------------------
+    EnvFlag('AMTPU_EGRESS_MAX_BYTES', 'int', 1048576, False,
+            'scheduler/egress.py (per-conn queued-byte bound before '
+            'tier-1 event shedding)'),
+    EnvFlag('AMTPU_EGRESS_WEDGE_S', 'float', 10.0, False,
+            'scheduler/egress.py (zero-progress seconds before tier-3 '
+            'wedge eviction)'),
+    EnvFlag('AMTPU_EGRESS_RESYNC_SHEDS', 'int', 3, False,
+            'scheduler/egress.py (consecutive sheds before tier-2 '
+            'drop-to-resubscribe)'),
     # -- batched sync fan-out -----------------------------------------------
     EnvFlag('AMTPU_FANOUT', 'bool', True, False, 'scheduler/gateway.py'),
     EnvFlag('AMTPU_FANOUT_VECTOR', 'bool', True, False,

@@ -21,6 +21,13 @@ Sites (see docs/RESILIENCE.md for what each models):
   sidecar.frame     sidecar server request framing (uncaught by design:
                     the serve loop dies, simulating a process crash)
   checkpoint.load   save()-checkpoint restore (WAL replay path)
+  fanout.write      per-connection egress write failure (the writer
+                    thread treats it as a dead transport and tears the
+                    connection down off the flush critical path)
+  fanout.stall      armed wedge: the egress writer makes no progress
+                    while it fires, so a permanent stall drives the
+                    AMTPU_EGRESS_WEDGE_S tier-3 eviction
+                    deterministically
 
 Arming:
 
@@ -49,7 +56,7 @@ from .utils.common import env_raw, env_str
 #: fails loudly instead of never firing
 SITES = ('native.begin', 'native.mid', 'device.dispatch',
          'device.collect', 'escalation.tier', 'sidecar.frame',
-         'checkpoint.load')
+         'checkpoint.load', 'fanout.write', 'fanout.stall')
 
 KINDS = ('transient', 'permanent')
 

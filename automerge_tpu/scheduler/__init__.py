@@ -6,6 +6,7 @@ device batches under a latency deadline, with admission control and
 SLO telemetry.  Architecture + tunables: docs/SERVING.md.
 """
 
+from .egress import EgressQueue  # noqa: F401
 from .gateway import (BATCH_CMDS, EXEC_CMDS, PURE_CMDS,  # noqa: F401
                       READ_CMDS, GatewayServer)
 from .queue import (AdmissionQueue, Overloaded,  # noqa: F401

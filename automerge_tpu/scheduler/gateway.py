@@ -14,11 +14,14 @@ Three layers:
 
   * **connections** (:class:`_Conn`) -- one reader thread per accepted
     unix-socket connection, speaking the sidecar's existing framings
-    (JSON lines or length-prefixed msgpack).  Responses are written
-    whole under a per-connection lock, so dispatcher and reader never
-    interleave frames.  Per connection, responses may complete out of
-    request order (reads bypass the queue); clients match by id
-    (``SidecarClient`` demultiplexes).
+    (JSON lines or length-prefixed msgpack).  Every outbound frame is
+    STAGED on the connection's bounded egress queue
+    (:mod:`automerge_tpu.scheduler.egress`, ISSUE 13) and drained by a
+    dedicated writer thread, so no producer ever blocks on a slow or
+    dead client socket; frames never interleave (one writer).  Per
+    connection, responses may complete out of request order (reads
+    bypass the queue); clients match by id (``SidecarClient``
+    demultiplexes).
   * **scheduling** (:class:`GatewayServer` + ``scheduler.queue``) --
     mutating commands queue; the dispatcher drains them when the flush
     deadline (``AMTPU_FLUSH_DEADLINE_MS``), the doc cap, or the op cap
@@ -58,6 +61,7 @@ disables the engine (subscribe answers a typed error).
 
 import json
 import os
+import random
 import socket
 import struct
 import sys
@@ -68,6 +72,7 @@ from .. import faults, telemetry
 from ..resilience import is_quarantine_error, is_quarantined
 from ..telemetry import attribution
 from ..utils.common import env_bool
+from .egress import EgressQueue
 from .queue import (READ_CMDS, AdmissionQueue,  # noqa: F401 (re-export)
                     Overloaded, PendingOp, flush_deadline_s,
                     max_batch_docs, max_batch_ops)
@@ -120,6 +125,22 @@ def _op_docs(cmd, req):
         if any(not isinstance(chs, list) for chs in docs.values()):
             return None
         return tuple(docs)
+    if cmd in ('subscribe', 'unsubscribe'):
+        # doc-set / wildcard variants (ISSUE 13 satellite): a `docs`
+        # list keys the per-doc FIFO on every member; a `prefix` keys
+        # it on a pseudo-doc so two prefix ops on one prefix still
+        # order (a real doc sharing the pseudo-key only over-parks)
+        docs = req.get('docs')
+        if docs is not None:
+            if not isinstance(docs, list) or not docs or any(
+                    isinstance(d, (dict, list, set)) for d in docs):
+                return None
+            return tuple(docs)
+        prefix = req.get('prefix')
+        if prefix is not None:
+            if not isinstance(prefix, str) or not prefix:
+                return None
+            return ('prefix\x00%s' % prefix,)
     doc = req.get('doc')
     if doc is None:
         return None
@@ -133,28 +154,34 @@ def _op_docs(cmd, req):
 
 class _Conn(object):
     """One accepted connection: a reader thread decoding frames into
-    the gateway, plus a locked framed writer any thread may answer
-    through."""
+    the gateway, plus a bounded egress queue (ISSUE 13,
+    docs/SERVING.md backpressure section) through which EVERY outbound
+    frame -- responses and fan-out events alike -- is staged.  No
+    producer thread (dispatcher, reader, healthz) ever blocks on this
+    socket: a dedicated writer drains the queue, and an unhealthy
+    consumer degrades through the shed -> resync -> evict tiers
+    instead of stalling the flush."""
 
     def __init__(self, sock, gateway, cid):
         self.sock = sock
         self.gateway = gateway
         self.cid = cid
         self.rfile = sock.makefile('rb')
-        self.wfile = sock.makefile('wb')
-        self._wlock = threading.Lock()
         self.closed = False
-        # ONE stable bound reference (attribute access would mint a new
-        # bound-method object per call): the fan-out engine groups
-        # subscription rows sharing a transport by callable identity,
-        # so peers multiplexed on this connection receive their k
-        # copies of a coalesced frame as a single write
-        self.raw_send = self.send_raw
+        # ONE stable transport object: the fan-out engine groups
+        # subscription rows sharing a transport by identity, so peers
+        # multiplexed on this connection receive their k copies of a
+        # coalesced frame as a single staged write
+        self.egress = EgressQueue(
+            sock, label='conn-%d' % cid,
+            on_overflow=self._egress_overflow,
+            on_dead=self._egress_dead)
 
     def send(self, resp):
-        """Writes one response frame atomically; a dead peer marks the
-        connection closed (later sends drop silently -- the requester is
-        gone, there is nobody to answer)."""
+        """Stages one response frame (egress kind 'response': never
+        shed by tier-1, delivered in staging order with event frames).
+        Returns immediately; a dead peer's frames are dropped by the
+        writer, which tears the connection down itself."""
         if self.closed:
             return
         try:
@@ -164,24 +191,25 @@ class _Conn(object):
                 frame = struct.pack('>I', len(body)) + body
             else:
                 frame = (json.dumps(resp) + '\n').encode()
-            with self._wlock:
-                self.wfile.write(frame)
-                self.wfile.flush()
-        except (BrokenPipeError, ConnectionError, OSError, ValueError):
-            self.close()
-
-    def send_raw(self, frame):
-        """Writes an ALREADY-encoded frame atomically -- the fan-out
-        engine's encode-once path: one doc's delta is serialized once
-        and these bytes fan out to every subscriber."""
-        if self.closed:
+        except (TypeError, ValueError):
             return
-        try:
-            with self._wlock:
-                self.wfile.write(frame)
-                self.wfile.flush()
-        except (BrokenPipeError, ConnectionError, OSError, ValueError):
-            self.close()
+        self.egress.stage(frame, kind='response')
+
+    def _egress_overflow(self, _queue):
+        """Tier 2 (drop-to-resubscribe): this connection kept
+        overflowing its egress bound without draining."""
+        self.gateway._conn_slow(self)
+
+    def _egress_dead(self, reason):
+        """The writer declared the transport dead (write error or
+        tier-3 wedge eviction): close without ever blocking on the
+        socket -- close() only shutdown()s it."""
+        if reason == 'wedge':
+            print('gateway: evicting wedged consumer conn-%d '
+                  '(no egress progress for AMTPU_EGRESS_WEDGE_S)'
+                  % self.cid, file=sys.stderr)
+        self.close()
+        self.gateway._conn_gone(self)
 
     def run(self):
         """Reader loop: decode frames, route into the gateway.  The
@@ -245,19 +273,22 @@ class _Conn(object):
 
     def close(self):
         self.closed = True
-        # shutdown FIRST: a foreign thread closing the makefile objects
+        # the egress queue drops its backlog first (on_drop callbacks
+        # regress fan-out clocks; the writer thread exits) -- nothing
+        # below blocks on the peer
+        self.egress.close()
+        # shutdown NEXT: a foreign thread closing the makefile object
         # would block on the BufferedReader lock the reader thread holds
         # inside its blocking recv -- shutdown EOFs that recv, releasing
-        # the lock, and only then are the file objects closed
+        # the lock, and only then is the file object closed
         try:
             self.sock.shutdown(socket.SHUT_RDWR)
         except OSError:
             pass
-        for f in (self.wfile, self.rfile):
-            try:
-                f.close()
-            except Exception:
-                pass
+        try:
+            self.rfile.close()
+        except Exception:
+            pass
         try:
             self.sock.close()
         except Exception:
@@ -310,6 +341,8 @@ class GatewayServer(object):
         self._srv.listen(self.backlog)
         telemetry.register_healthz_section('scheduler',
                                            self._healthz_section)
+        telemetry.register_healthz_section('egress',
+                                           self._egress_healthz_section)
         from ..storage.coldstore import DocEvictor
         self.storage_tier = DocEvictor.from_env(self.backend.pool)
         telemetry.register_healthz_section(
@@ -357,6 +390,7 @@ class GatewayServer(object):
         if self._dispatch_thread is not None:
             self._dispatch_thread.join(timeout=30)
         telemetry.register_healthz_section('scheduler', None)
+        telemetry.register_healthz_section('egress', None)
         telemetry.register_healthz_section('fanout', None)
         telemetry.register_healthz_section('storage', None)
 
@@ -394,6 +428,47 @@ class GatewayServer(object):
         if self.fanout is not None:
             self.fanout.drop_conn(conn.cid)
 
+    def _conn_slow(self, conn):
+        """Tier-2 degradation (drop-to-resubscribe, ISSUE 13): a
+        connection that keeps overflowing its egress bound without ever
+        draining has its subscription rows freed and is told to resync
+        with a typed envelope (a RESPONSE-lane frame, so tier-1
+        shedding cannot drop it).  The peer resubscribes at its
+        last-seen clock and the subscribe backfill -- the same
+        transitive-deps machinery as any straggler -- makes it whole."""
+        docs = []
+        if self.fanout is not None:
+            docs = self.fanout.resync_conn(conn.cid)
+        telemetry.metric('egress.resyncs')
+        telemetry.recorder.record('egress.resync', n=len(docs),
+                                  detail='conn-%d' % conn.cid)
+        conn.send({'event': 'resync', 'docs': docs,
+                   'reason': 'slow-consumer',
+                   'retryAfterMs': self.queue.retry_after_ms()})
+
+    def _egress_healthz_section(self):
+        """Aggregate egress state across live connections: the
+        queue-depth gauges the backpressure tiers key off, plus the
+        flat egress.* counters."""
+        with self._conns_lock:
+            conns = list(self._conns.values())
+        stats = [c.egress.stats() for c in conns
+                 if getattr(c, 'egress', None) is not None]
+        out = {
+            'connections': len(stats),
+            'queued_bytes': sum(s['queued_bytes'] for s in stats),
+            'queued_frames': sum(s['queued_frames'] for s in stats),
+            'max_conn_queued_bytes': max(
+                (s['queued_bytes'] for s in stats), default=0),
+            'backlogged_conns': sum(1 for s in stats
+                                    if s['queued_frames']),
+        }
+        flat = telemetry.metrics_snapshot()
+        out.update({k.split('egress.', 1)[1]: v
+                    for k, v in flat.items()
+                    if k.startswith('egress.')})
+        return out
+
     def _encode_frame(self, obj):
         """One wire frame in this server's framing -- the fan-out
         engine encodes each doc's delta through this exactly once."""
@@ -426,7 +501,9 @@ class GatewayServer(object):
             docs = _op_docs(cmd, req)
             if docs is None:
                 conn.send({'id': rid,
-                           'error': "missing required field: 'doc'",
+                           'error': "missing or invalid routing field: "
+                                    "'doc' (subscribe/unsubscribe also "
+                                    "accept 'docs': [...] or 'prefix')",
                            'errorType': 'RangeError'})
                 return
             op = PendingOp(conn, rid, cmd, req, docs, 1, batchable=False)
@@ -435,14 +512,24 @@ class GatewayServer(object):
             op.clock = attribution.Clock(attribution.class_of(cmd), t0=t0)
             op.clock.mark('admit')
             try:
-                # presence is ephemeral -- shedding it under overload is
-                # the correct behaviour; the subscription lifecycle is
-                # control plane and always admits
-                self.queue.offer(op, admit_always=(cmd != 'presence'))
+                # presence is ephemeral -- shedding it under overload
+                # is the correct behaviour -- and subscribe is
+                # stampede-controlled (ISSUE 13): a post-partition
+                # resubscribe burst sheds through the same watermarks
+                # as mutations, with a JITTERED retryAfterMs so the
+                # herd decorrelates.  Only unsubscribe always admits
+                # (it frees resources; refusing it helps nobody).
+                self.queue.offer(op,
+                                 admit_always=(cmd == 'unsubscribe'))
             except Overloaded as e:
+                retry_ms = e.retry_after_ms
+                if cmd == 'subscribe':
+                    telemetry.metric('sync.fanout.subscribe_shed')
+                    retry_ms = max(1, int(retry_ms *
+                                          (1.0 + 3.0 * random.random())))
                 conn.send({'id': rid, 'error': str(e),
                            'errorType': 'Overloaded',
-                           'retryAfterMs': e.retry_after_ms})
+                           'retryAfterMs': retry_ms})
             return
         if cmd in READ_CMDS:
             docs = _op_docs(cmd, req)
@@ -855,25 +942,49 @@ class GatewayServer(object):
 
     def _fanout_cmd(self, op):
         """subscribe/unsubscribe/presence dispatch into the fan-out
-        engine, answered with the protocol's result/error envelope."""
+        engine, answered with the protocol's result/error envelope.
+        The transport handed to the engine is the connection's bounded
+        egress queue (plain fakes fall back to their send callable)."""
         from ..errors import AutomergeError, RangeError
         req, rid = op.req, op.rid
         peer = (op.conn.cid, str(req.get('peer') or ''))
-        doc = op.docs[0]
+        transport = getattr(op.conn, 'egress', None)
+        if transport is None:
+            transport = getattr(op.conn, 'raw_send', op.conn.send)
+        prefix = req.get('prefix')
+        doc_set = req.get('docs') if isinstance(req.get('docs'), list) \
+            else None
         try:
             if op.cmd == 'subscribe':
                 clock = req.get('clock') or {}
                 if not isinstance(clock, dict):
                     raise RangeError('subscribe clock must be a '
                                      '{actor: seq} map')
-                res = self.fanout.subscribe(
-                    peer, doc, clock, op.conn.raw_send,
-                    backfill=bool(req.get('backfill', True)))
+                backfill = bool(req.get('backfill', True))
+                if prefix is not None and doc_set is None:
+                    res = self.fanout.subscribe_prefix(peer, prefix,
+                                                       transport)
+                elif doc_set is not None:
+                    res = self.fanout.subscribe_many(
+                        peer, doc_set, clock, transport,
+                        backfill=backfill)
+                else:
+                    res = self.fanout.subscribe(
+                        peer, op.docs[0], clock, transport,
+                        backfill=backfill)
             elif op.cmd == 'unsubscribe':
-                res = {'ok': True,
-                       'removed': self.fanout.unsubscribe(peer, doc)}
+                if prefix is not None and doc_set is None:
+                    removed = self.fanout.unsubscribe_prefix(peer,
+                                                             prefix)
+                elif doc_set is not None:
+                    removed = sum(self.fanout.unsubscribe(peer, d)
+                                  for d in doc_set)
+                else:
+                    removed = self.fanout.unsubscribe(peer, op.docs[0])
+                res = {'ok': True, 'removed': removed}
             else:
-                res = self.fanout.presence(peer, doc, req.get('state'))
+                res = self.fanout.presence(peer, op.docs[0],
+                                           req.get('state'))
             return {'id': rid, 'result': res}
         except (AutomergeError, RangeError, TypeError) as e:
             return {'id': rid, 'error': str(e),

@@ -184,6 +184,15 @@ class SidecarClient:
     _events = None
     _pump = None
     _inflight = None
+    _subs = None
+    _sub_clocks = None
+    #: auto-resubscribe on a server {"event": "resync"} envelope
+    #: (ISSUE 13 drop-to-resubscribe: the gateway freed this client's
+    #: subscription rows under egress overload).  The pump re-issues
+    #: each recorded subscribe at the last-seen clock on a side thread;
+    #: the backfill's changes surface as a synthetic change event so
+    #: the application stream stays gapless.
+    auto_resubscribe = True
 
     def __init__(self, proc=None, sock_path=None, use_msgpack=False,
                  deadline_s=None, heal=None, max_respawns=None,
@@ -327,6 +336,10 @@ class SidecarClient:
         # parse-error frame to the OLDEST of these (ids are monotonic;
         # a serial server answers in order)
         self._inflight = set()    # guarded-by: self._resp_cond
+        # live subscription registry + last-seen per-doc clocks (from
+        # change events), the auto-resubscribe inputs
+        self._subs = {}           # guarded-by: self._resp_cond
+        self._sub_clocks = {}     # guarded-by: self._resp_cond
 
     def _await_response(self):
         """Blocks until the first byte of the response is available (or
@@ -482,8 +495,19 @@ class SidecarClient:
                     self._pump = None
                     self._resp_cond.notify_all()
                 return
+            resync = None
             with self._resp_cond:
                 if isinstance(resp, dict) and 'event' in resp:
+                    if resp['event'] == 'change' \
+                            and isinstance(resp.get('clock'), dict):
+                        # track where each subscription stands so a
+                        # resync can resubscribe at the last-seen
+                        # clock instead of refetching full history
+                        self._sub_clocks[resp.get('doc')] = \
+                            dict(resp['clock'])
+                    elif resp['event'] == 'resync' \
+                            and self.auto_resubscribe and self._subs:
+                        resync = resp
                     self._events.append(resp)
                 else:
                     r = resp.get('id') if isinstance(resp, dict) \
@@ -500,6 +524,88 @@ class SidecarClient:
                             self._resp_cond.notify_all()
                             continue
                     self._resp[r] = resp
+                self._resp_cond.notify_all()
+            if resync is not None:
+                # resubscribing is an RPC; the pump must keep reading
+                # (it parks the very response that RPC waits on), so
+                # the re-subscribe runs on a side thread
+                telemetry.metric('sidecar.client.resyncs')
+                threading.Thread(target=self._auto_resub_worker,
+                                 args=(resync,), daemon=True).start()
+
+    def _auto_resub_worker(self, resync):
+        """Drop-to-resubscribe recovery: re-issue every recorded
+        subscription the resync envelope covers, at the last-seen
+        clock; backfill changes surface as a synthetic change event
+        (marked ``"resync": true``) so `next_event` consumers see a
+        gapless stream.  An Overloaded answer honours the (jittered)
+        ``retryAfterMs`` -- the stampede-control contract."""
+        docs = resync.get('docs')
+        with self._resp_cond:
+            subs = list(self._subs.items())
+            clocks = dict(self._sub_clocks)
+        from ..errors import OverloadedError
+        for key, kwargs in subs:
+            if isinstance(docs, list) and docs \
+                    and kwargs.get('doc') is not None \
+                    and kwargs['doc'] not in docs:
+                continue
+            kw = dict(kwargs)
+            if kw.get('doc') is not None:
+                kw['clock'] = clocks.get(kw['doc'], kw.get('clock')) \
+                    or {}
+            done = False
+            for _attempt in range(5):
+                try:
+                    r = self.call('subscribe', **kw)
+                except OverloadedError as e:
+                    time.sleep(max(1, e.retry_after_ms or 1) / 1000.0)
+                    continue
+                except ConnectionError:
+                    # transport died; healing/close owns the outcome,
+                    # but the loss must not be silent
+                    telemetry.metric(
+                        'sidecar.client.resubscribe_failed')
+                    return
+                except Exception:
+                    break         # per-subscription failure: next one
+                telemetry.metric('sidecar.client.resubscribes')
+                self._surface_resub_backfill(kw, r)
+                done = True
+                break
+            if not done:
+                # overloaded past the retry budget or a protocol error:
+                # the server already freed the rows, so the stream for
+                # this subscription is dead -- surface it instead of
+                # going quiet
+                telemetry.metric('sidecar.client.resubscribe_failed')
+                with self._resp_cond:
+                    self._events.append(
+                        {'event': 'resync_failed',
+                         'doc': kw.get('doc'), 'docs': kw.get('docs'),
+                         'prefix': kw.get('prefix')})
+                    self._resp_cond.notify_all()
+
+    def _surface_resub_backfill(self, kw, res):
+        """Backfill changes from an auto-resubscribe surface as
+        synthetic change events (marked ``"resync": true``) so
+        `next_event` consumers see a gapless stream -- including the
+        per-doc backfills of doc-set and prefix subscriptions."""
+        if not isinstance(res, dict):
+            return
+        per_doc = res.get('docs') if isinstance(res.get('docs'), dict) \
+            else None
+        if per_doc is None:
+            per_doc = {kw.get('doc'): res}
+        evs = []
+        for d, r in per_doc.items():
+            if isinstance(r, dict) and r.get('changes'):
+                evs.append({'event': 'change', 'doc': d,
+                            'clock': r.get('clock'),
+                            'changes': r['changes'], 'resync': True})
+        if evs:
+            with self._resp_cond:
+                self._events.extend(evs)
                 self._resp_cond.notify_all()
 
     def next_event(self, timeout=None):
@@ -649,26 +755,58 @@ class SidecarClient:
 
     # -- fan-out subscription surface (gateway socket mode) --------------
 
-    def subscribe(self, doc, clock=None, peer=None, backfill=True):
+    def subscribe(self, doc=None, clock=None, peer=None, backfill=True,
+                  docs=None, prefix=None):
         """Subscribes this connection (optionally as named `peer`) to
-        `doc`'s flush fan-out; returns the backfill
-        ``{"doc", "clock", "changes"}``.  Event frames then arrive via
-        `next_event()`.  ``backfill=False`` registers at the advertised
-        clock without shipping history (the next flush serves the gap
-        through the straggler filter)."""
+        flush fan-out; returns the backfill ``{"doc", "clock",
+        "changes"}``.  Event frames then arrive via `next_event()`.
+        ``backfill=False`` registers at the advertised clock without
+        shipping history (the next flush serves the gap through the
+        straggler filter).  Doc-set and wildcard shapes (ISSUE 13):
+        ``docs=[...]`` subscribes every listed doc in one request
+        (result: ``{"docs": {doc: backfill}}``), ``prefix="ws/"``
+        follows every current AND future doc under the prefix.  The
+        subscription is recorded for resync auto-resubscribe."""
         self._ensure_pump()
-        kwargs = {'doc': doc, 'clock': clock or {}}
+        kwargs = {'clock': clock or {}}
+        if doc is not None:
+            kwargs['doc'] = doc
+        if docs is not None:
+            kwargs['docs'] = list(docs)
+        if prefix is not None:
+            kwargs['prefix'] = prefix
         if peer is not None:
             kwargs['peer'] = peer
         if not backfill:
             kwargs['backfill'] = False
-        return self.call('subscribe', **kwargs)
+        res = self.call('subscribe', **kwargs)
+        with self._resp_cond:
+            self._subs[(doc, tuple(docs) if docs else None, prefix,
+                        peer)] = dict(kwargs)
+            got = res.get('docs') if isinstance(res, dict) else None
+            if isinstance(got, dict):
+                for d, r in got.items():
+                    if isinstance(r, dict) and 'clock' in r:
+                        self._sub_clocks.setdefault(d, r['clock'])
+            elif isinstance(res, dict) and doc is not None:
+                self._sub_clocks.setdefault(doc, res.get('clock') or {})
+        return res
 
-    def unsubscribe(self, doc, peer=None):
-        kwargs = {'doc': doc}
+    def unsubscribe(self, doc=None, peer=None, docs=None, prefix=None):
+        kwargs = {}
+        if doc is not None:
+            kwargs['doc'] = doc
+        if docs is not None:
+            kwargs['docs'] = list(docs)
+        if prefix is not None:
+            kwargs['prefix'] = prefix
         if peer is not None:
             kwargs['peer'] = peer
-        return self.call('unsubscribe', **kwargs)
+        res = self.call('unsubscribe', **kwargs)
+        with self._resp_cond:
+            self._subs.pop((doc, tuple(docs) if docs else None, prefix,
+                            peer), None)
+        return res
 
     def presence(self, doc, state, peer=None):
         """Ships ephemeral per-peer state (cursor position, selection)

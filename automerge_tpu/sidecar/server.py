@@ -34,6 +34,7 @@ Requests (fields beyond `cmd`/`id` per command):
   {"id": 10, "cmd": "metrics"}
   {"id": 11, "cmd": "healthz"}
   {"id": 12, "cmd": "subscribe",   "doc": d, "clock": {...}, "peer": p?}
+      (doc-set/wildcard shapes: "docs": [d, ...] or "prefix": "ws/")
   {"id": 13, "cmd": "unsubscribe", "doc": d, "peer": p?}
   {"id": 14, "cmd": "presence",    "doc": d, "state": ..., "peer": p?}
   {"id": 15, "cmd": "dump"}

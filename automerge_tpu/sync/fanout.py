@@ -45,7 +45,11 @@ Wire surface (gateway socket mode; docs/SERVING.md):
 
   {"cmd": "subscribe",   "doc": d, "clock": {...}, "peer": label?}
       -> {"result": {"doc": d, "clock": {...}, "changes": [...]}}
-  {"cmd": "unsubscribe", "doc": d, "peer": label?}
+  {"cmd": "subscribe",   "docs": [d, ...], "clock": {...}}      (doc set)
+      -> {"result": {"docs": {d: {...backfill...}}}}
+  {"cmd": "subscribe",   "prefix": "ws/"}                      (wildcard)
+      -> {"result": {"prefix": "ws/", "docs": {d: {...}}}}
+  {"cmd": "unsubscribe", "doc": d, "peer": label?}   (also docs/prefix)
   {"cmd": "presence",    "doc": d, "state": ..., "peer": label?}
 
 Event frames (no ``id``; clients demux by the ``event`` key):
@@ -54,10 +58,28 @@ Event frames (no ``id``; clients demux by the ``event`` key):
    "presence": {peer: state}?}
   {"event": "presence", "doc": d, "presence": {peer: state}}
   {"event": "quarantined", "doc": d, "error": ..., "errorType": ...}
+  {"event": "resync", "docs": [...], "reason": "slow-consumer",
+   "retryAfterMs": n}          (egress tier 2; docs/RESILIENCE.md)
 
 `AMTPU_FANOUT_VECTOR=0` flips classification to the per-peer scalar
 dict loop (the reference shape) -- the parity oracle for tests and the
 A/B baseline `bench.py --fanout` measures the vectorized pass against.
+
+Backpressure (ISSUE 13, docs/SERVING.md backpressure section): when
+the transport is a bounded egress queue (`scheduler/egress.py` --
+anything exposing ``stage``), the flush STAGES frames and never blocks
+on a subscriber socket.  The engine then keeps TWO clocks per
+subscription row: ``believed`` (advanced at stage time -- what the
+peer will hold once its queue drains; classification uses it, so a
+queued-but-unwritten delta is never re-sent) and ``acked`` (advanced
+at write completion, on the egress writer thread -- what the peer
+provably received).  A shed frame's ``on_drop`` REGRESSES believed
+back to acked, so the next flush classifies the peer as a straggler
+and the transitive-deps filtered delta heals it: no duplicate, no gap.
+``amtpu_fanout_latency_ms`` is observed at write completion.  Legacy
+plain-callable transports (tests, in-process consumers) keep the
+synchronous contract: effects apply immediately after the send
+returns.
 """
 
 import sys
@@ -112,7 +134,10 @@ class FanoutEngine(object):
     def __init__(self, pool, encode):
         self._pool = pool
         self._encode = encode        # frame dict -> wire bytes (framing
-        self._lock = threading.Lock()  # owned by the gateway)
+        # RLock: egress shed callbacks (`on_drop`) may fire
+        # synchronously while the staging thread already holds the
+        # engine lock (the writer-thread invocations acquire normally)
+        self._lock = threading.RLock()  # owned by the gateway
         # -- actor interning (shared columns) --
         self._actor_col = {}      # guarded-by: self._lock
         self._actor_names = []    # guarded-by: self._lock
@@ -120,9 +145,13 @@ class FanoutEngine(object):
         self._doc_row = {}        # guarded-by: self._lock
         self._auth = np.zeros((_MIN_CAP, _MIN_CAP),
                               np.int64)          # guarded-by: self._lock
-        # -- subscription rows (believed clocks) --
+        # -- subscription rows (believed = staged clocks) --
         self._believed = np.zeros((_MIN_CAP, _MIN_CAP),
                                   np.int64)      # guarded-by: self._lock
+        # write-acked clocks: what each peer provably received; the
+        # regression target when a queued frame is shed (ISSUE 13)
+        self._acked = np.zeros((_MIN_CAP, _MIN_CAP),
+                               np.int64)         # guarded-by: self._lock
         self._sub_doc = np.zeros(_MIN_CAP,
                                  np.int64)       # guarded-by: self._lock
         self._free_rows = []      # guarded-by: self._lock
@@ -134,6 +163,13 @@ class FanoutEngine(object):
         self._peer_send = {}      # guarded-by: self._lock
         self._conn_peers = {}     # guarded-by: self._lock
         self._presence = {}       # guarded-by: self._lock
+        # -- wildcard/prefix subscriptions (ISSUE 13 satellite) --
+        self._prefix_subs = {}    # guarded-by: self._lock
+        # -- subscribe-backfill memo: (doc, clock) -> (auth, changes),
+        # so a reconnect stampede of peers sharing a clock fetches the
+        # missing-changes walk ONCE (validated against the live auth
+        # clock, so a stale entry can never serve) --
+        self._backfill_memo = {}  # guarded-by: self._lock
 
     # -- interning ------------------------------------------------------
 
@@ -147,6 +183,7 @@ class FanoutEngine(object):
                 cap = max(_MIN_CAP, 2 * self._auth.shape[1])
                 self._auth = self._grow(self._auth, cols=cap)
                 self._believed = self._grow(self._believed, cols=cap)
+                self._acked = self._grow(self._acked, cols=cap)
             self._actor_col[actor] = col
             self._actor_names.append(actor)
         return col
@@ -202,9 +239,7 @@ class FanoutEngine(object):
         auth = self._pool.get_clock(doc_id).get('clock') or {}
         changes = []
         if backfill and auth:
-            changes = self._pool.get_missing_changes(doc_id,
-                                                     dict(clock or {}))
-            telemetry.metric('sync.fanout.backfills')
+            changes = self._memoized_backfill(doc_id, clock, auth)
         with self._lock:
             row = self._peer_row.get((peer, doc_id))
             if row is None:
@@ -217,15 +252,101 @@ class FanoutEngine(object):
                                           self._clock_vec(auth))
             if backfill:
                 # after the backfill the peer holds everything we do
+                # (the backfill rides the response lane, which the
+                # egress tiers never shed: only eviction loses it, and
+                # eviction frees the row with the connection)
                 self._believed[row] = np.maximum(self._clock_vec(clock),
                                                  self._clock_vec(auth))
             else:
                 auth = dict(clock or {})
                 self._believed[row] = self._clock_vec(clock)
+            self._acked[row] = self._believed[row]
             self._peer_send[peer] = send
             self._conn_peers.setdefault(peer[0], set()).add(peer)
             telemetry.metric('sync.fanout.subscribes')
         return {'doc': doc_id, 'clock': auth, 'changes': changes}
+
+    def _memoized_backfill(self, doc_id, clock, auth):
+        """One missing-changes walk per distinct (doc, advertised
+        clock) per authoritative state: a post-partition resubscribe
+        stampede of peers sharing a clock (common: empty, or the clock
+        of the last pre-partition flush) pays the pool query and its
+        serialization ONCE (`sync.fanout.backfill_reuse`).  The memo
+        entry pins the auth clock it was computed under, so any
+        intervening mutation invalidates it by value."""
+        ckey = tuple(sorted((clock or {}).items()))
+        akey = tuple(sorted(auth.items()))
+        with self._lock:
+            hit = self._backfill_memo.get((doc_id, ckey))
+        if hit is not None and hit[0] == akey:
+            telemetry.metric('sync.fanout.backfill_reuse')
+            return hit[1]
+        changes = self._pool.get_missing_changes(doc_id,
+                                                 dict(clock or {}))
+        telemetry.metric('sync.fanout.backfills')
+        with self._lock:
+            if len(self._backfill_memo) >= 512:
+                self._backfill_memo.clear()
+            self._backfill_memo[(doc_id, ckey)] = (akey, changes)
+        return changes
+
+    def subscribe_many(self, peer, doc_ids, clock, send, backfill=True):
+        """Doc-set subscription (`{"cmd": "subscribe", "docs": [...]}`):
+        one subscription row per doc, one response carrying every
+        backfill -- the shape ROADMAP #1's routing tier proxies."""
+        out = {}
+        for doc_id in doc_ids:
+            out[doc_id] = self.subscribe(peer, doc_id, clock, send,
+                                         backfill=backfill)
+        return {'docs': out}
+
+    def subscribe_prefix(self, peer, prefix, send):
+        """Wildcard subscription: `peer` follows every doc whose id
+        starts with `prefix` -- docs the engine already serves attach
+        now (full backfill in the response); docs first seen by a LATER
+        flush auto-attach at a zero clock, so the straggler filter
+        ships their complete history in that flush's pass."""
+        with self._lock:
+            self._prefix_subs.setdefault(peer, set()).add(prefix)
+            self._peer_send[peer] = send
+            self._conn_peers.setdefault(peer[0], set()).add(peer)
+            known = [d for d in set(self._doc_row) | set(self._doc_subs)
+                     if d.startswith(prefix)]
+            telemetry.metric('sync.fanout.prefix_subscribes')
+        out = {}
+        for doc_id in sorted(known):
+            out[doc_id] = self.subscribe(peer, doc_id, {}, send)
+        return {'prefix': prefix, 'docs': out}
+
+    def unsubscribe_prefix(self, peer, prefix):
+        """Removes one prefix registration and every row it attached."""
+        with self._lock:
+            prefixes = self._prefix_subs.get(peer)
+            if prefixes is not None:
+                prefixes.discard(prefix)
+                if not prefixes:
+                    self._prefix_subs.pop(peer, None)
+            docs = [k[1] for k in self._peer_row
+                    if k[0] == peer and k[1].startswith(prefix)]
+        removed = 0
+        for doc_id in docs:
+            removed += self.unsubscribe(peer, doc_id)
+        return removed
+
+    def resync_conn(self, cid):
+        """Tier-2 drop-to-resubscribe (docs/RESILIENCE.md): frees every
+        subscription row the connection's peers hold and returns the
+        doc ids they covered -- the gateway then stages the typed
+        ``{"event": "resync"}`` envelope and the client re-subscribes
+        at its last-seen clock (the subscribe backfill closes the
+        gap)."""
+        with self._lock:
+            peers = list(self._conn_peers.get(cid, ()))
+            docs = sorted({k[1] for k in self._peer_row
+                           if k[0] in peers})
+        for peer in peers:
+            self.unsubscribe(peer)
+        return docs
 
     def _alloc_row(self, peer, doc_id):  # holds-lock: self._lock
         if self._free_rows:
@@ -235,11 +356,13 @@ class FanoutEngine(object):
             if row >= self._believed.shape[0]:
                 cap = 2 * self._believed.shape[0]
                 self._believed = self._grow(self._believed, rows=cap)
+                self._acked = self._grow(self._acked, rows=cap)
                 grown = np.zeros(cap, np.int64)
                 grown[:len(self._sub_doc)] = self._sub_doc
                 self._sub_doc = grown
             self._n_rows += 1
         self._believed[row] = 0
+        self._acked[row] = 0
         self._sub_doc[row] = self._drow(doc_id)
         self._row_peer[row] = peer
         self._peer_row[(peer, doc_id)] = row
@@ -267,7 +390,13 @@ class FanoutEngine(object):
                 self._free_rows.append(row)
             if removed:
                 telemetry.metric('sync.fanout.unsubscribes', removed)
-            if not any(k[0] == peer for k in self._peer_row):
+            if doc_id is None:
+                # a full unsubscribe also retires the peer's wildcard
+                # registrations (a doc-scoped one leaves them: the peer
+                # still wants future matches)
+                self._prefix_subs.pop(peer, None)
+            if not any(k[0] == peer for k in self._peer_row) \
+                    and peer not in self._prefix_subs:
                 self._peer_send.pop(peer, None)
                 conn = self._conn_peers.get(peer[0])
                 if conn is not None:
@@ -353,8 +482,13 @@ class FanoutEngine(object):
                     if peer is not None and peer[0] == cid:
                         np.maximum(self._believed[row], vec,
                                    out=self._believed[row])
+                        # echo suppression has no frame to lose: the
+                        # writer already holds its own change, so the
+                        # acked row advances with nothing in flight
+                        np.maximum(self._acked[row], vec,
+                                   out=self._acked[row])
 
-    def _stage(self, pending, row, buf, enq_t, post_vec):  # holds-lock: self._lock
+    def _stage(self, pending, row, buf, enq_t, post_vec, doc_id):  # holds-lock: self._lock
         """Queues one frame for `row`'s transport; the flush writes
         each transport ONCE (`_flush_writes`), so a connection
         multiplexing many peers across many docs pays one syscall per
@@ -364,42 +498,117 @@ class FanoutEngine(object):
         if send is None:
             return False
         pending.setdefault(id(send), (send, []))[1].append(
-            (buf, row, post_vec, enq_t))
+            (buf, peer, doc_id, row, post_vec, enq_t))
         return True
+
+    def _entry_row(self, peer, doc_id, row):  # holds-lock: self._lock
+        """Completion callbacks run on the egress writer thread, after
+        arbitrary time: the row index is only still this entry's
+        subscription if the (peer, doc) registration hasn't been freed
+        (and possibly reallocated to someone else) in between."""
+        return row if self._peer_row.get((peer, doc_id)) == row else None
+
+    def _write_complete(self, entries, n_bytes):
+        """A transport's staged flush buffer reached the socket: acked
+        clocks advance and change->fanout latency is observed (the
+        egress writer thread's half of the stage/complete split)."""
+        now = time.perf_counter()
+        with self._lock:
+            telemetry.metric('sync.fanout.bytes_on_wire', n_bytes)
+            if len(entries) > 1:
+                telemetry.metric('sync.fanout.writes_coalesced',
+                                 len(entries) - 1)
+            for _buf, peer, doc_id, row, post_vec, enq_t in entries:
+                if enq_t is not None:
+                    telemetry.FANOUT_LATENCY.observe(
+                        (now - enq_t) * 1000.0)
+                row = self._entry_row(peer, doc_id, row)
+                if row is not None and post_vec is not None:
+                    np.maximum(self._acked[row], post_vec,
+                               out=self._acked[row])
+
+    def _write_dropped(self, entries):
+        """A staged flush buffer was shed (egress tier 1) or died with
+        its connection: every surviving row's believed clock REGRESSES
+        to its acked row -- exactly what the peer provably has -- so
+        the next flush classifies it as a straggler and the filtered
+        delta re-ships only the lost changes (no dup, no gap)."""
+        regressed = 0
+        with self._lock:
+            for _buf, peer, doc_id, row, post_vec, _enq_t in entries:
+                row = self._entry_row(peer, doc_id, row)
+                if row is None or post_vec is None:
+                    continue
+                if not np.array_equal(self._believed[row],
+                                      self._acked[row]):
+                    self._believed[row] = self._acked[row]
+                    regressed += 1
+            if regressed:
+                telemetry.metric('sync.fanout.regressed_peers',
+                                 regressed)
 
     def _flush_writes(self, pending):  # holds-lock: self._lock
         """One write per live transport: every staged frame of a conn
         concatenates into a single buffer (ISSUE 10 satellite; ROADMAP
-        #4 'remaining depth').  Per-row effects -- believed-clock
-        advancement, latency observation -- apply only when the write
-        did not raise, exactly like the per-frame sends they replace."""
+        #4 'remaining depth').  Believed clocks advance at STAGE time
+        (classification must account for queued frames); acked clocks,
+        latency, and wire-byte accounting land at write completion --
+        immediately for plain-callable transports, on the egress
+        writer thread for bounded queues (ISSUE 13), whose sheds
+        regress believed back to acked instead."""
         n_frames = 0
         for send, entries in pending.values():
             payload = b''.join(e[0] for e in entries)
+            n_frames += len(entries)
+            stage = getattr(send, 'stage', None)
+            if stage is not None:
+                self._advance_staged(entries)
+                stage(payload, kind='event',
+                      on_write=(lambda e=entries, n=len(payload):
+                                self._write_complete(e, n)),
+                      on_drop=(lambda e=entries:
+                               self._write_dropped(e)))
+                continue
             try:
                 send(payload)
             except Exception as e:
                 print('fanout: send failed: %s' % e, file=sys.stderr)
+                n_frames -= len(entries)
                 continue
-            now = time.perf_counter()
-            telemetry.metric('sync.fanout.bytes_on_wire', len(payload))
-            if len(entries) > 1:
-                telemetry.metric('sync.fanout.writes_coalesced',
-                                 len(entries) - 1)
-            for _buf, row, post_vec, enq_t in entries:
-                n_frames += 1
-                if enq_t is not None:
-                    telemetry.FANOUT_LATENCY.observe(
-                        (now - enq_t) * 1000.0)
-                if row is not None and post_vec is not None:
-                    np.maximum(self._believed[row], post_vec,
-                               out=self._believed[row])
+            self._advance_staged(entries)
+            self._write_complete(entries, len(payload))
         return n_frames
+
+    def _advance_staged(self, entries):  # holds-lock: self._lock
+        for _buf, _peer, _doc, row, post_vec, _enq_t in entries:
+            if post_vec is not None:
+                np.maximum(self._believed[row], post_vec,
+                           out=self._believed[row])
+
+    def _attach_prefix_subs(self, updates):  # holds-lock: self._lock
+        """Wildcard auto-attach: a dirty doc matching a registered
+        prefix gains a zero-clock row for that peer, so THIS flush's
+        straggler filter ships its complete history (the router-proxy
+        first-sight contract)."""
+        if not self._prefix_subs:
+            return
+        attached = 0
+        for doc_id in updates:
+            for peer, prefixes in self._prefix_subs.items():
+                if (peer, doc_id) in self._peer_row:
+                    continue
+                if any(doc_id.startswith(p) for p in prefixes):
+                    self._alloc_row(peer, doc_id)
+                    attached += 1
+        if attached:
+            telemetry.metric('sync.fanout.prefix_attaches', attached)
 
     def _flush_locked(self, updates, quarantined, enq, origins):  # holds-lock: self._lock
         presence, self._presence = self._presence, {}
-        # 0. echo suppression (may intern new actors -- must precede
-        #    the pre-flush row snapshots, which growth would reallocate)
+        # 0. wildcard auto-attach, then echo suppression (either may
+        #    intern new actors -- both must precede the pre-flush row
+        #    snapshots, which growth would reallocate)
+        self._attach_prefix_subs(updates)
         self._note_origins(origins)
         # 1. intern + advance authoritative clocks, snapshotting the
         #    pre-flush rows (intern FIRST: growth reallocates matrices)
@@ -480,7 +689,7 @@ class FanoutEngine(object):
                                 'presence': states})
             telemetry.metric('sync.fanout.bytes_encoded', len(buf))
             for row in sorted(rows):
-                self._stage(pending, row, buf, None, None)
+                self._stage(pending, row, buf, None, None, doc_id)
             telemetry.metric('sync.fanout.presence_frames', len(rows))
 
         # 5. ONE write per transport carries all of its frames
@@ -503,7 +712,7 @@ class FanoutEngine(object):
             telemetry.metric('sync.fanout.bytes_encoded', len(buf))
             staged = 0
             for row in rows:
-                if self._stage(pending, row, buf, enq_t, None):
+                if self._stage(pending, row, buf, enq_t, None, doc_id):
                     staged += 1
             telemetry.metric('sync.fanout.quarantine_frames', staged)
             return
@@ -534,21 +743,31 @@ class FanoutEngine(object):
             telemetry.metric('sync.fanout.bytes_encoded', len(buf))
             staged = 0
             for row in coalesced:
-                if self._stage(pending, row, buf, enq_t, post_vec):
+                if self._stage(pending, row, buf, enq_t, post_vec,
+                               doc_id):
                     staged += 1
             telemetry.metric('sync.fanout.coalesced_peers', staged)
             if staged > 1:
                 telemetry.metric('sync.fanout.encode_reuse', staged - 1)
+        # stragglers group by believed clock: a reconnect stampede (or
+        # a shed cohort regressed to the same acked row) pays ONE
+        # filtered-delta fetch and ONE encoding per distinct clock --
+        # the encode-once machinery extended to the straggler path
+        straggler_groups = {}
         for row in stragglers:
-            # divergent clock: per-peer filter through the transitive
-            # -deps closure (a reconnecting peer gets its FULL backfill)
+            straggler_groups.setdefault(
+                self._believed[row].tobytes(), []).append(row)
+        for rows_g in straggler_groups.values():
             delta = self._pool.get_missing_changes(
-                doc_id, self._vec_clock(self._believed[row]))
+                doc_id, self._vec_clock(self._believed[rows_g[0]]))
             if not delta:
-                uptodate += 1
                 # transitively complete already: advance without a frame
-                np.maximum(self._believed[row], post_vec,
-                           out=self._believed[row])
+                for row in rows_g:
+                    uptodate += 1
+                    np.maximum(self._believed[row], post_vec,
+                               out=self._believed[row])
+                    np.maximum(self._acked[row], post_vec,
+                               out=self._acked[row])
                 continue
             frame = {'event': 'change', 'doc': doc_id, 'clock': post,
                      'changes': delta}
@@ -556,7 +775,11 @@ class FanoutEngine(object):
                 frame['presence'] = presence
             buf = self._encode(frame)
             telemetry.metric('sync.fanout.bytes_encoded', len(buf))
-            self._stage(pending, row, buf, enq_t, post_vec)
+            for row in rows_g:
+                self._stage(pending, row, buf, enq_t, post_vec, doc_id)
+            if len(rows_g) > 1:
+                telemetry.metric('sync.fanout.straggler_reuse',
+                                 len(rows_g) - 1)
         if stragglers:
             telemetry.metric('sync.fanout.straggler_peers',
                              len(stragglers))

@@ -232,7 +232,30 @@ KNOWN_FANOUT_KEYS = ('flushes', 'docs', 'frames', 'encode_reuse',
                      'bytes_on_wire', 'writes_coalesced', 'subscribes',
                      'unsubscribes', 'drops', 'backfills',
                      'presence_frames', 'quarantine_frames',
-                     'vector_passes', 'scalar_passes', 'errors')
+                     'vector_passes', 'scalar_passes', 'errors',
+                     'straggler_reuse', 'backfill_reuse',
+                     'regressed_peers', 'prefix_subscribes',
+                     'prefix_attaches', 'subscribe_shed')
+
+# bounded-egress counters (`telemetry.metric('egress.<name>')` call
+# sites in scheduler/egress.py + scheduler/gateway.py; glossary:
+# docs/OBSERVABILITY.md, degradation tiers: docs/RESILIENCE.md),
+# pre-seeded into every bench_block's `egress` sub-object and surfaced
+# by the healthz `egress` section:
+# staged_frames/staged_bytes  frames/bytes staged on per-conn egress
+#                               queues (responses AND events)
+# writes / write_errors       frames fully written / transports that
+#                               died on a write error
+# sheds / shed_frames /       tier-1 overflow events, the event frames
+#   shed_bytes                  they dropped, and the bytes freed
+# resyncs                     tier-2 drop-to-resubscribe envelopes
+#                               (subscription rows freed)
+# wedge_evictions             tier-3 consumers disconnected after
+#                               AMTPU_EGRESS_WEDGE_S of zero progress
+KNOWN_EGRESS_KEYS = ('staged_frames', 'staged_bytes', 'writes',
+                     'write_errors', 'sheds', 'shed_frames',
+                     'shed_bytes', 'resyncs', 'wedge_evictions',
+                     'overflow_evictions')
 
 # columnar storage tier counters (`telemetry.metric('storage.<name>')`
 # call sites in automerge_tpu/storage/ + native/__init__.py +
@@ -581,6 +604,10 @@ def bench_block():
                    for k, v in flat.items()
                    if k.startswith('sync.fanout.')})
     fanout['latency_ms'] = FANOUT_LATENCY.summary() or {}
+    egress = {r: 0.0 for r in KNOWN_EGRESS_KEYS}
+    egress.update({k.split('.', 1)[1]: round(v, 6)
+                   for k, v in flat.items()
+                   if k.startswith('egress.')})
     storage = {r: 0.0 for r in KNOWN_STORAGE_KEYS}
     storage.update({k.split('.', 1)[1]: round(v, 6)
                     for k, v in flat.items()
@@ -602,6 +629,7 @@ def bench_block():
         'pipeline': pipeline,
         'mesh': mesh,
         'fanout': fanout,
+        'egress': egress,
         'storage': storage,
         'recorder': rec,
         'slo': slo,

@@ -52,6 +52,7 @@ EVENTS = (
     'fault.injected',
     'storage.evict', 'storage.reload',
     'fanout.flush',
+    'egress.shed', 'egress.resync', 'egress.evict',
     'shed.on', 'shed.off',
     'sidecar.respawn',
     'request.slow',

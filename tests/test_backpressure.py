@@ -1,0 +1,681 @@
+"""Subscriber-scale backpressure (ISSUE 13): bounded egress queues,
+drop-to-resubscribe degradation, and stampede-proof reconnect.
+
+Lanes:
+  * EgressQueue unit behaviour: tier-1 event shedding (responses
+    survive), tier-2 overflow escalation, tier-3 wedge eviction, and
+    the `fanout.write` / `fanout.stall` fault sites.
+  * Clock-regression shed parity: a peer whose staged frames are shed
+    converges byte-identically to a never-shed twin (no dup, no gap).
+  * Reconnect-mid-backfill: a peer dropped to resubscribe while its
+    straggler delta was still queued converges byte-identically after
+    re-subscribing at its received clock.
+  * Encode batching across the straggler/backfill paths, wildcard and
+    doc-set subscriptions, live-gateway resync + client
+    auto-resubscribe, wedged-consumer isolation, and stampede
+    admission control with jittered retryAfterMs.
+"""
+
+import json
+import os
+import socket
+import time
+
+import pytest
+
+from automerge_tpu import faults, telemetry
+from automerge_tpu.native import NativeDocPool
+from automerge_tpu.scheduler.egress import EgressQueue
+from automerge_tpu.sync.fanout import FanoutEngine
+
+ROOT = '00000000-0000-0000-0000-000000000000'
+DOC = 'bp-doc'
+
+
+@pytest.fixture(autouse=True)
+def _hygiene():
+    yield
+    faults.reset('')
+    telemetry.reset_all()
+
+
+def ch(actor, seq, key, value, deps=None):
+    return {'actor': actor, 'seq': seq, 'deps': dict(deps or {}),
+            'ops': [{'action': 'set', 'obj': ROOT, 'key': key,
+                     'value': value}]}
+
+
+def canon(changes):
+    return json.dumps(changes, sort_keys=True, default=str)
+
+
+def _pair(sndbuf=None):
+    a, b = socket.socketpair()
+    if sndbuf is not None:
+        try:
+            a.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, sndbuf)
+        except OSError:
+            pass
+    return a, b
+
+
+def _drain(sock, timeout=5.0):
+    """Reads whatever arrives on `sock` until quiet; returns bytes."""
+    sock.settimeout(0.2)
+    out = b''
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            chunk = sock.recv(65536)
+        except socket.timeout:
+            break
+        if not chunk:
+            break
+        out += chunk
+    return out
+
+
+# ---------------------------------------------------------------------------
+# EgressQueue unit lanes
+# ---------------------------------------------------------------------------
+
+def test_egress_shed_drops_events_keeps_responses():
+    """Tier 1: overflow drops queued EVENT frames (their on_drop runs)
+    while response frames survive and are eventually delivered."""
+    a, b = _pair(sndbuf=4096)
+    dead = []
+    q = EgressQueue(a, max_bytes=4096, wedge_s=30.0, resync_sheds=99,
+                    on_dead=dead.append)
+    # a large response wedges the writer mid-frame (nobody reads yet),
+    # so everything staged after it queues
+    big = b'R' * 262144
+    assert q.stage(big, kind='response')
+    time.sleep(0.1)                      # writer is now blocked in send
+    dropped = []
+    q.stage(b'EVENT-1\n', kind='event',
+            on_drop=lambda: dropped.append(1))
+    q.stage(b'E' * 8192, kind='event',
+            on_drop=lambda: dropped.append(2))  # crosses max_bytes
+    q.stage(b'RESP-2\n', kind='response')
+    deadline = time.time() + 5
+    while len(dropped) < 2 and time.time() < deadline:
+        time.sleep(0.01)
+    assert sorted(dropped) == [1, 2], \
+        'tier-1 shed did not drop the queued event frames'
+    got = _drain(b)
+    assert got.startswith(b'R' * 1024)
+    assert b'RESP-2' in got, 'response frame was shed'
+    assert b'EVENT-1' not in got, 'shed event frame still delivered'
+    assert not dead
+    snap = telemetry.metrics_snapshot()
+    assert snap.get('egress.sheds', 0) >= 1
+    assert snap.get('egress.shed_frames', 0) >= 2
+    q.close()
+    a.close()
+    b.close()
+
+
+def test_egress_tier2_overflow_escalation_fires_once():
+    """Repeated sheds without a drain escalate to on_overflow exactly
+    once; a full drain re-arms the escalation."""
+    a, b = _pair(sndbuf=4096)
+    slow = []
+    q = EgressQueue(a, max_bytes=2048, wedge_s=30.0, resync_sheds=2,
+                    on_overflow=lambda _q: slow.append(1))
+    q.stage(b'R' * 262144, kind='response')     # wedge the writer
+    time.sleep(0.1)
+    for _ in range(4):                          # 4 sheds, 1 escalation
+        q.stage(b'E' * 4096, kind='event')
+        time.sleep(0.01)
+    deadline = time.time() + 5
+    while not slow and time.time() < deadline:
+        time.sleep(0.01)
+    assert slow == [1], 'tier-2 escalation must fire exactly once'
+    assert q.stats()['sheds'] >= 2
+    _drain(b)                                   # let the writer drain
+    deadline = time.time() + 5
+    while q.stats()['queued_frames'] and time.time() < deadline:
+        _drain(b, timeout=0.5)
+    assert q.stats()['sheds'] == 0, 'a full drain resets escalation'
+    q.close()
+    a.close()
+    b.close()
+
+
+def test_egress_wedge_eviction():
+    """Tier 3: a consumer that accepts no bytes for the wedge deadline
+    is declared dead -- without any thread ever blocking on it."""
+    a, b = _pair(sndbuf=4096)
+    dead = []
+    q = EgressQueue(a, max_bytes=1 << 20, wedge_s=0.4, resync_sheds=99,
+                    on_dead=dead.append)
+    q.stage(b'X' * 524288, kind='response')     # nobody ever reads b
+    deadline = time.time() + 10
+    while not dead and time.time() < deadline:
+        time.sleep(0.02)
+    assert dead == ['wedge']
+    snap = telemetry.metrics_snapshot()
+    assert snap.get('egress.wedge_evictions', 0) == 1
+    q.close()
+    a.close()
+    b.close()
+
+
+def test_fault_site_fanout_write_kills_transport():
+    a, b = _pair()
+    dead = []
+    q = EgressQueue(a, wedge_s=30.0, on_dead=dead.append)
+    faults.arm('fanout.write', 'permanent', 1.0)
+    try:
+        q.stage(b'hello\n', kind='response')
+        deadline = time.time() + 5
+        while not dead and time.time() < deadline:
+            time.sleep(0.01)
+    finally:
+        faults.disarm()
+    assert dead == ['error']
+    snap = telemetry.metrics_snapshot()
+    assert snap.get('egress.write_errors', 0) >= 1
+    assert snap.get('resilience.fault_injected.fanout.write', 0) >= 1
+    q.close()
+    a.close()
+    b.close()
+
+
+def test_fault_site_fanout_stall_drives_wedge_eviction():
+    """An armed permanent stall makes the writer progress-free, so the
+    tier-3 eviction fires deterministically even though the peer's
+    socket is perfectly healthy."""
+    a, b = _pair()
+    dead = []
+    q = EgressQueue(a, wedge_s=0.3, on_dead=dead.append)
+    faults.arm('fanout.stall', 'permanent', 1.0)
+    try:
+        q.stage(b'hello\n', kind='response')
+        deadline = time.time() + 10
+        while not dead and time.time() < deadline:
+            time.sleep(0.02)
+    finally:
+        faults.disarm()
+    assert dead == ['wedge']
+    # a transient stall (bounded count) clears and the frame delivers
+    a2, b2 = _pair()
+    q2 = EgressQueue(a2, wedge_s=5.0)
+    faults.arm('fanout.stall', 'transient', 1.0, count=2)
+    try:
+        q2.stage(b'after-stall\n', kind='response')
+        got, deadline = b'', time.time() + 10
+        while b'after-stall' not in got and time.time() < deadline:
+            got += _drain(b2, timeout=1.0)
+        assert b'after-stall' in got
+    finally:
+        faults.disarm()
+    for s in (q, q2):
+        s.close()
+    for s in (a, b, a2, b2):
+        s.close()
+
+
+def test_oversized_event_frame_is_exempt_not_self_shed():
+    """A single event frame larger than the whole bound staged into an
+    otherwise-empty queue must DELIVER (the bound limits backlog, not
+    frame size) -- shedding it would regress, re-stage the same
+    oversized straggler delta, and starve a healthy peer forever."""
+    a, b = _pair()
+    dropped = []
+    q = EgressQueue(a, max_bytes=1024, wedge_s=10.0, resync_sheds=99)
+    q.stage(b'J' * 8192, kind='event',
+            on_drop=lambda: dropped.append(1))
+    got, deadline = b'', time.time() + 10
+    while len(got) < 8192 and time.time() < deadline:
+        got += _drain(b, timeout=1.0)
+    assert len(got) == 8192 and not dropped, \
+        'oversized lone event frame was shed instead of delivered'
+    q.close()
+    a.close()
+    b.close()
+
+
+def test_unsheddable_backlog_hard_cap_evicts():
+    """Response frames are never shed, but a consumer accumulating an
+    unsheddable backlog past 4x the bound is evicted -- a trickling
+    reader defeats the wedge clock, so growth must not be unbounded.
+    A SINGLE oversized response (a big backfill) stays exempt."""
+    a, b = _pair(sndbuf=4096)
+    dead = []
+    q = EgressQueue(a, max_bytes=2048, wedge_s=30.0, resync_sheds=99,
+                    on_dead=dead.append)
+    # one big response alone: over the hard cap but a single frame --
+    # exempt, the writer starts delivering it
+    assert q.stage(b'R' * 262144, kind='response')
+    assert not dead
+    time.sleep(0.1)                     # writer wedges mid-frame
+    # more unsheddable frames pile up past 4x the bound -> eviction
+    ok = True
+    for _ in range(8):
+        ok = q.stage(b'S' * 2048, kind='response')
+        if not ok:
+            break
+    deadline = time.time() + 5
+    while not dead and time.time() < deadline:
+        time.sleep(0.01)
+    assert dead == ['overflow']
+    assert not ok, 'stage() must refuse after the overflow eviction'
+    snap = telemetry.metrics_snapshot()
+    assert snap.get('egress.overflow_evictions', 0) == 1
+    q.close()
+    a.close()
+    b.close()
+
+
+def test_disarmed_cost_is_one_attr_read():
+    """The standard disarmed-cost contract: with nothing armed the
+    writer's fault hook is a single `faults.ARMED` check -- fire() is
+    never entered (monkeypatching it would otherwise be visible)."""
+    assert not faults.ARMED
+    called = []
+    orig = faults.fire
+    faults.fire = lambda *a, **k: called.append(a)
+    try:
+        a, b = _pair()
+        q = EgressQueue(a, wedge_s=5.0)
+        q.stage(b'ping\n', kind='response')
+        assert b'ping' in _drain(b)
+        q.close()
+        a.close()
+        b.close()
+    finally:
+        faults.fire = orig
+    assert not called
+
+
+# ---------------------------------------------------------------------------
+# engine-level: clock regression, resync, encode batching, wildcards
+# ---------------------------------------------------------------------------
+
+class FakeEgress(object):
+    """Egress-shaped transport the engine stages into: frames deliver
+    (on_write) or shed (on_drop) under test control, synchronously."""
+
+    def __init__(self):
+        self.delivered = []
+        self.drop_next = 0
+
+    def stage(self, buf, kind='event', on_write=None, on_drop=None):
+        if kind == 'event' and self.drop_next > 0:
+            self.drop_next -= 1
+            if on_drop is not None:
+                on_drop()
+            return True
+        self.delivered.append(buf)
+        if on_write is not None:
+            on_write()
+        return True
+
+    def changes(self):
+        out = []
+        for buf in self.delivered:
+            for line in buf.decode().splitlines():
+                frame = json.loads(line)
+                if frame.get('event') == 'change':
+                    out.extend(frame['changes'])
+        return out
+
+
+class Harness(object):
+    def __init__(self):
+        self.pool = NativeDocPool()
+        self.engine = FanoutEngine(
+            self.pool, lambda obj: (json.dumps(obj) + '\n').encode())
+
+    def flush(self, batch, doc=DOC):
+        res = self.pool.apply_changes(doc, batch)
+        self.engine.on_flush({doc: res['clock']},
+                             enq={doc: time.perf_counter()})
+        return res
+
+
+def test_clock_regression_shed_parity_vs_never_shed_twin():
+    """A peer whose flush frame is shed regresses to its acked clock,
+    is healed as a straggler next flush, and its total received change
+    stream is byte-identical to a twin that never shed."""
+    shed, clean = Harness(), Harness()
+    t_shed, t_clean = FakeEgress(), FakeEgress()
+    shed.engine.subscribe((1, 'p'), DOC, {}, t_shed)
+    clean.engine.subscribe((1, 'p'), DOC, {}, t_clean)
+    batches = [[ch('a', 1, 'k', 1)], [ch('a', 2, 'k', 2)],
+               [ch('b', 1, 'j', 3)]]
+    for i, batch in enumerate(batches):
+        if i == 1:
+            t_shed.drop_next = 1          # tier-1 sheds this flush
+        shed.flush(batch)
+        clean.flush(batch)
+    assert canon(t_shed.changes()) == canon(t_clean.changes()), \
+        'shed peer diverged from never-shed twin (dup or gap)'
+    assert len(t_shed.delivered) == len(t_clean.delivered) - 1, \
+        'the healing flush must carry the lost delta in ONE frame'
+    snap = telemetry.metrics_snapshot()
+    assert snap.get('sync.fanout.regressed_peers', 0) >= 1
+    assert snap.get('sync.fanout.straggler_peers', 0) >= 1
+
+
+def test_reconnect_mid_backfill_resync_converges_no_dup_no_gap():
+    """Drop-to-resubscribe while the peer's straggler delta is still
+    queued: the shed drops the queued delta (regression), resync frees
+    the rows, and a re-subscribe at the peer's RECEIVED clock closes
+    the gap byte-identically."""
+    h = Harness()
+    t = FakeEgress()
+    h.engine.subscribe((7, 'p'), DOC, {}, t)
+    h.flush([ch('a', 1, 'k', 1)])             # delivered
+    t.drop_next = 2
+    h.flush([ch('a', 2, 'k', 2)])             # shed (coalesced frame)
+    h.flush([ch('a', 3, 'k', 3)])             # shed (straggler delta)
+    docs = h.engine.resync_conn(7)            # tier 2
+    assert docs == [DOC]
+    assert h.engine.healthz_section()['live_subscriptions'] == 0
+    # the client reconnects at the clock of what it actually received
+    received = t.changes()
+    assert [(c['actor'], c['seq']) for c in received] == [('a', 1)]
+    back = h.engine.subscribe((8, 'p'), DOC, {'a': 1}, t)
+    h.flush([ch('a', 4, 'k', 4)])             # and life goes on
+    total = received + back['changes'] + t.changes()[len(received):]
+    seen = [(c['actor'], c['seq']) for c in total]
+    assert seen == [('a', 1), ('a', 2), ('a', 3), ('a', 4)], \
+        'resync + backfill left a dup or a gap: %r' % (seen,)
+
+
+def test_straggler_encodes_batch_across_shared_clock():
+    h = Harness()
+    h.pool.apply_changes(DOC, [ch('a', 1, 'k', 1), ch('a', 2, 'k', 2)])
+    transports = [FakeEgress() for _ in range(3)]
+    for i, t in enumerate(transports):
+        h.engine.subscribe((i, 'p'), DOC, {'a': 1}, t, backfill=False)
+    telemetry.metrics_reset()
+    h.flush([ch('b', 1, 'j', 9)])
+    bufs = {t.delivered[-1] for t in transports}
+    assert len(bufs) == 1, \
+        'stragglers at one clock must share ONE encoding'
+    snap = telemetry.metrics_snapshot()
+    assert snap.get('sync.fanout.straggler_reuse', 0) == 2
+    assert snap.get('sync.fanout.straggler_peers', 0) == 3
+
+
+def test_backfill_memo_reuses_and_invalidates():
+    h = Harness()
+    h.pool.apply_changes(DOC, [ch('a', 1, 'k', 1)])
+    t = FakeEgress()
+    telemetry.metrics_reset()
+    r1 = h.engine.subscribe((1, 'x'), DOC, {}, t)
+    r2 = h.engine.subscribe((2, 'y'), DOC, {}, t)
+    assert canon(r1['changes']) == canon(r2['changes'])
+    snap = telemetry.metrics_snapshot()
+    assert snap.get('sync.fanout.backfills', 0) == 1
+    assert snap.get('sync.fanout.backfill_reuse', 0) == 1
+    # a mutation invalidates the memo by value: the next subscriber at
+    # the same advertised clock gets the FULL fresh backfill
+    h.flush([ch('a', 2, 'k', 2)])
+    r3 = h.engine.subscribe((3, 'z'), DOC, {}, t)
+    assert [(c['actor'], c['seq']) for c in r3['changes']] == \
+        [('a', 1), ('a', 2)]
+
+
+def test_docset_and_prefix_subscriptions():
+    h = Harness()
+    h.pool.apply_changes('ws/a', [ch('a', 1, 'k', 1)])
+    t = FakeEgress()
+    res = h.engine.subscribe_many((1, 'r'), ['ws/a', 'plain'], {}, t)
+    assert set(res['docs']) == {'ws/a', 'plain'}
+    assert [(c['actor'], c['seq'])
+            for c in res['docs']['ws/a']['changes']] == [('a', 1)]
+    pre = h.engine.subscribe_prefix((2, 'w'), 'ws/', FakeEgress())
+    assert pre['prefix'] == 'ws/'
+    assert set(pre['docs']) == {'ws/a'}       # known doc attached now
+    # a NEW doc under the prefix auto-attaches on its first flush and
+    # ships its complete history through the straggler filter
+    wt = h.engine._peer_send[(2, 'w')]
+    res = h.pool.apply_changes('ws/new', [ch('n', 1, 'k', 7)])
+    h.engine.on_flush({'ws/new': res['clock']})
+    assert [(c['actor'], c['seq']) for c in wt.changes()] == [('n', 1)]
+    # ...and a non-matching doc does not
+    res = h.pool.apply_changes('other', [ch('o', 1, 'k', 8)])
+    h.engine.on_flush({'other': res['clock']})
+    assert len(wt.changes()) == 1
+    snap = telemetry.metrics_snapshot()
+    assert snap.get('sync.fanout.prefix_attaches', 0) == 1
+    # prefix unsubscribe retires the registration and its rows
+    h.engine.unsubscribe_prefix((2, 'w'), 'ws/')
+    res = h.pool.apply_changes('ws/more', [ch('m', 1, 'k', 9)])
+    h.engine.on_flush({'ws/more': res['clock']})
+    assert len(wt.changes()) == 1
+
+
+def test_row_reuse_guard_on_stale_completion():
+    """A write/drop completion that lands after its subscription row
+    was freed (and possibly reallocated) must not touch the new
+    tenant's clocks."""
+    h = Harness()
+
+    class HoldingEgress(FakeEgress):
+        def __init__(self):
+            super().__init__()
+            self.held = []
+
+        def stage(self, buf, kind='event', on_write=None, on_drop=None):
+            self.held.append((buf, on_write, on_drop))
+            return True
+
+    t = HoldingEgress()
+    h.engine.subscribe((1, 'old'), DOC, {}, t)
+    h.flush([ch('a', 1, 'k', 1)])
+    assert t.held
+    h.engine.unsubscribe((1, 'old'))          # frees the row...
+    t2 = FakeEgress()
+    h.engine.subscribe((2, 'new'), DOC, {}, t2, backfill=False)
+    # ...which the new subscriber now occupies at a zero clock
+    for _buf, on_write, _on_drop in t.held:
+        if on_write is not None:
+            on_write()                        # stale completion
+    h.flush([ch('a', 2, 'k', 2)])
+    # the new tenant's clock was NOT advanced by the stale completion:
+    # it still receives the full history as a straggler
+    assert [(c['actor'], c['seq']) for c in t2.changes()] == \
+        [('a', 1), ('a', 2)]
+
+
+# ---------------------------------------------------------------------------
+# live gateway lanes
+# ---------------------------------------------------------------------------
+
+def _gateway(tmp_path, env=None):
+    from automerge_tpu.scheduler import GatewayServer
+    from automerge_tpu.sidecar.server import SidecarBackend
+    for k, v in (env or {}).items():
+        os.environ[k] = v
+    path = str(tmp_path / 'gw-bp.sock')
+    gw = GatewayServer(path, backend=SidecarBackend()).start()
+    return gw, path
+
+
+def _cleanup(gw, env):
+    gw.stop()
+    for k in env:
+        os.environ.pop(k, None)
+
+
+def test_gateway_resync_and_client_auto_resubscribe(tmp_path):
+    """Tier-2 end to end: the gateway drops a connection to
+    resubscribe; SidecarClient sees the typed envelope, re-subscribes
+    at its last-seen clock on its own, and keeps receiving deltas."""
+    from automerge_tpu.sidecar.client import SidecarClient
+    env = {'AMTPU_FLUSH_DEADLINE_MS': '5'}
+    gw, path = _gateway(tmp_path, env)
+    try:
+        sub = SidecarClient(sock_path=path)
+        w = SidecarClient(sock_path=path)
+        w.apply_changes('rdoc', [ch('w', 1, 'k', 1)])
+        r = sub.subscribe('rdoc', peer='alice')
+        assert r['clock'] == {'w': 1}
+        w.apply_changes('rdoc', [ch('w', 2, 'k', 2)])
+        e = sub.next_event(timeout=30)
+        assert e['event'] == 'change' and e['clock'] == {'w': 2}
+        # force tier 2 on the subscriber's connection
+        with gw._conns_lock:
+            victim = [c for c in gw._conns.values()
+                      if c.cid == 1][0]
+        gw._conn_slow(victim)
+        e = sub.next_event(timeout=30)
+        assert e['event'] == 'resync' and e['docs'] == ['rdoc']
+        assert isinstance(e.get('retryAfterMs'), int)
+        # the client re-subscribes by itself (at {'w': 2}, so the
+        # backfill is empty -- no synthetic event) and the next flush
+        # reaches it again
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if gw.fanout.healthz_section()['live_subscriptions'] >= 2:
+                break
+            time.sleep(0.05)
+        w.apply_changes('rdoc', [ch('w', 3, 'k', 3)])
+        e = sub.next_event(timeout=30)
+        assert e['event'] == 'change' and e['clock'] == {'w': 3}, e
+        snap = telemetry.metrics_snapshot()
+        assert snap.get('egress.resyncs', 0) >= 1
+        assert snap.get('sidecar.client.resyncs', 0) >= 1
+        assert snap.get('sidecar.client.resubscribes', 0) >= 1
+        sub.close()
+        w.close()
+    finally:
+        _cleanup(gw, env)
+
+
+def test_wedged_consumer_does_not_stall_healthy_peers(tmp_path):
+    """One subscriber stops reading entirely; 4 healthy subscribers
+    must still receive every flush's delta (the dispatcher and the
+    fan-out pass never block on the wedged socket), and the wedged
+    consumer ends up shed + resynced or evicted."""
+    from automerge_tpu.sidecar.client import SidecarClient
+    env = {'AMTPU_FLUSH_DEADLINE_MS': '5',
+           'AMTPU_EGRESS_MAX_BYTES': '32768',
+           'AMTPU_EGRESS_WEDGE_S': '1.0',
+           'AMTPU_EGRESS_RESYNC_SHEDS': '2'}
+    gw, path = _gateway(tmp_path, env)
+    try:
+        wedge = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        wedge.connect(path)
+        wedge.sendall((json.dumps(
+            {'id': 1, 'cmd': 'subscribe', 'doc': 'wdoc',
+             'peer': 'wedge'}) + '\n').encode())
+        wedge.settimeout(10)
+        assert b'"id": 1' in wedge.recv(65536)  # backfill answered
+        # ...and never reads again
+        healthy = []
+        for i in range(4):
+            c = SidecarClient(sock_path=path)
+            c.subscribe('wdoc', peer='h%d' % i)
+            healthy.append(c)
+        w = SidecarClient(sock_path=path)
+        rounds, blob = 24, 'x' * 8192
+        for s in range(1, rounds + 1):
+            w.apply_changes('wdoc', [ch('w', s, 'k', blob)])
+        for i, c in enumerate(healthy):
+            got = 0
+            deadline = time.time() + 60
+            while got < rounds and time.time() < deadline:
+                e = c.next_event(timeout=max(
+                    0.1, deadline - time.time()))
+                if e is not None and e.get('event') == 'change':
+                    got += len(e['changes'])
+            assert got == rounds, \
+                'healthy peer %d got %d/%d changes' % (i, got, rounds)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            snap = telemetry.metrics_snapshot()
+            if snap.get('egress.resyncs', 0) \
+                    or snap.get('egress.wedge_evictions', 0):
+                break
+            time.sleep(0.1)
+        snap = telemetry.metrics_snapshot()
+        assert snap.get('egress.sheds', 0) >= 1, snap
+        assert snap.get('egress.resyncs', 0) >= 1 \
+            or snap.get('egress.wedge_evictions', 0) >= 1, snap
+        for c in healthy:
+            c.close()
+        w.close()
+        wedge.close()
+    finally:
+        _cleanup(gw, env)
+
+
+def test_subscribe_stampede_sheds_with_jittered_retry(tmp_path):
+    """Reconnect-stampede admission: past the queue watermark a
+    subscribe answers the typed Overloaded envelope with a JITTERED
+    retryAfterMs (>= the deterministic hint the queue computes)."""
+    from automerge_tpu.errors import OverloadedError
+    from automerge_tpu.sidecar.client import SidecarClient
+    env = {'AMTPU_FLUSH_DEADLINE_MS': '400',
+           'AMTPU_QUEUE_MAX_OPS': '1'}
+    gw, path = _gateway(tmp_path, env)
+    try:
+        pump = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        pump.connect(path)
+        # two queued mutations: the first admits, the second trips the
+        # watermark so the queue is shedding when the subscribe lands
+        for i in range(2):
+            pump.sendall((json.dumps(
+                {'id': i, 'cmd': 'apply_changes', 'doc': 'sdoc',
+                 'changes': [ch('a', i + 1, 'k', i)]}) + '\n').encode())
+        sub = SidecarClient(sock_path=path)
+        base = gw.queue.retry_after_ms()
+        hit = None
+        for _ in range(50):
+            try:
+                sub.subscribe('sdoc', peer='late')
+            except OverloadedError as e:
+                hit = e
+                break
+            time.sleep(0.005)
+        assert hit is not None, 'subscribe was never shed'
+        assert hit.retry_after_ms >= base, \
+            'jittered retryAfterMs below the deterministic hint'
+        snap = telemetry.metrics_snapshot()
+        assert snap.get('sync.fanout.subscribe_shed', 0) >= 1
+        # after the backlog drains, the same subscribe is admitted
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            try:
+                r = sub.subscribe('sdoc', peer='late')
+                break
+            except OverloadedError as e:
+                time.sleep(max(1, e.retry_after_ms) / 1000.0)
+        assert r['clock'], r
+        sub.close()
+        pump.close()
+    finally:
+        _cleanup(gw, env)
+
+
+def test_gateway_docset_and_prefix_over_the_wire(tmp_path):
+    from automerge_tpu.sidecar.client import SidecarClient
+    env = {'AMTPU_FLUSH_DEADLINE_MS': '5'}
+    gw, path = _gateway(tmp_path, env)
+    try:
+        w = SidecarClient(sock_path=path)
+        w.apply_changes('ws/a', [ch('a', 1, 'k', 1)])
+        sub = SidecarClient(sock_path=path)
+        r = sub.subscribe(docs=['ws/a', 'ws/b'], peer='router')
+        assert set(r['docs']) == {'ws/a', 'ws/b'}
+        assert [(c['actor'], c['seq'])
+                for c in r['docs']['ws/a']['changes']] == [('a', 1)]
+        pre = sub.subscribe(prefix='ws/', peer='router')
+        assert pre['prefix'] == 'ws/'
+        w.apply_changes('ws/new', [ch('n', 1, 'k', 2)])
+        e = sub.next_event(timeout=30)
+        assert e['event'] == 'change' and e['doc'] == 'ws/new'
+        assert [(c['actor'], c['seq']) for c in e['changes']] == \
+            [('n', 1)]
+        r = sub.unsubscribe(prefix='ws/', peer='router')
+        assert r['removed'] >= 1
+        sub.close()
+        w.close()
+    finally:
+        _cleanup(gw, env)

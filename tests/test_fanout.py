@@ -287,10 +287,12 @@ def test_shared_transport_ships_copies_in_one_write():
     assert snap['sync.fanout.encode_reuse'] == 3
 
 
-def test_gateway_conn_raw_send_is_stable():
-    """The _Conn sender the gateway hands the engine must be ONE stable
-    object per connection, or the write-grouping above can never
-    engage (bound-method attribute access mints a new object)."""
+def test_gateway_conn_transport_is_stable():
+    """The _Conn transport the gateway hands the engine must be ONE
+    stable object per connection, or the write-grouping above can
+    never engage -- since ISSUE 13 that transport IS the bounded
+    egress queue (identity-stable by construction; a bound-method
+    access would mint a new object per call)."""
     from automerge_tpu.scheduler.gateway import _Conn
 
     class _Sock(object):
@@ -299,9 +301,11 @@ def test_gateway_conn_raw_send_is_stable():
             return io.BytesIO()
 
     conn = _Conn(_Sock(), gateway=None, cid=1)
-    assert conn.raw_send is conn.raw_send
-    assert conn.send_raw is not conn.send_raw     # the trap raw_send
-    # exists to avoid
+    assert conn.egress is conn.egress
+    assert callable(conn.egress.stage)
+    # and no writer thread was spawned for a connection that never
+    # staged a frame (lazy start)
+    assert conn.egress._thread is None
 
 
 def test_exec_path_quarantine_still_fans_envelope():

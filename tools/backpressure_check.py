@@ -1,0 +1,256 @@
+"""Backpressure gate (ISSUE 13, docs/SERVING.md backpressure section;
+degradation tiers: docs/RESILIENCE.md): one deliberately wedged
+consumer must not harm anyone else.
+
+Two arms against REAL gateway server subprocesses on unix sockets,
+with a small egress bound and a short wedge deadline so the tiers
+engage on the smoke shape:
+
+  1. **baseline** -- 32 healthy subscriber connections + a writer
+     streaming ROUNDS large change frames; every subscriber must
+     receive every change, and the healthy change->fanout p99 is
+     recorded.
+  2. **wedged** -- identical traffic plus one consumer that subscribes
+     and then never reads its socket again.  Gates:
+       * every healthy subscriber still receives every change (the
+         dispatcher/flush path never blocks on the wedged socket);
+       * healthy p99 within 2x the baseline arm's p99 (floored at
+         ``AMTPU_SMOKE_BP_P99_FLOOR_MS``, default 300 ms -- this check
+         runs ~35 processes' worth of traffic on a 1-2 core CI
+         stand-in, so sub-floor baselines are scheduler noise);
+       * the wedged peer was degraded through the tiers: egress sheds
+         observed, and it was resynced (typed ``{"event": "resync"}``
+         envelope) or wedge-evicted;
+       * after reconnecting, the dropped peer's backfill is
+         byte-identical to a serial per-Connection replay of the full
+         history (no dup, no gap);
+       * ``fallback.oracle == 0``.
+
+Run: JAX_PLATFORMS=cpu python tools/backpressure_check.py
+     (make backpressure-check)
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N_CONNS = 32
+ROUNDS = 24
+BLOB = 'x' * 8192
+ROOT_ID = '00000000-0000-0000-0000-000000000000'
+DOC = 'bp-doc'
+
+SERVER_ENV = {
+    'AMTPU_FLUSH_DEADLINE_MS': '5',
+    'AMTPU_EGRESS_MAX_BYTES': '32768',
+    'AMTPU_EGRESS_WEDGE_S': '1.5',
+    'AMTPU_EGRESS_RESYNC_SHEDS': '2',
+}
+
+
+def change(seq):
+    return {'actor': 'writer', 'seq': seq, 'deps': {},
+            'ops': [{'action': 'set', 'obj': ROOT_ID,
+                     'key': 'k%d' % (seq % 3), 'value': BLOB}]}
+
+
+def spawn_server(path):
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS='cpu')
+    env.update(SERVER_ENV)
+    proc = subprocess.Popen(
+        [sys.executable, '-m', 'automerge_tpu.sidecar.server',
+         '--socket', path], env=env, cwd=REPO)
+    deadline = time.time() + 60
+    while not os.path.exists(path):
+        if time.time() > deadline or proc.poll() is not None:
+            raise RuntimeError('gateway server did not come up')
+        time.sleep(0.05)
+    return proc
+
+
+def stop_server(proc):
+    proc.terminate()
+    try:
+        proc.wait(timeout=30)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait(timeout=30)
+
+
+def canon(changes):
+    return json.dumps(changes, sort_keys=True)
+
+
+def serial_oracle():
+    """Full-history backfill through the reference's per-Connection
+    shape: what a fresh empty-clock peer must receive."""
+    from automerge_tpu.sync.connection import Connection
+    from automerge_tpu.sync.doc_set import DocSet
+    ds = DocSet()
+    for r in range(1, ROUNDS + 1):
+        ds.apply_changes(DOC, [change(r)])
+    msgs = []
+    conn = Connection(ds, msgs.append)
+    conn.open()
+    conn.receive_msg({'docId': DOC, 'clock': {}})
+    return [c for m in msgs if m.get('changes') for c in m['changes']]
+
+
+def drain_all(client, want, timeout=120):
+    got = []
+    deadline = time.time() + timeout
+    while len(got) < want and time.time() < deadline:
+        e = client.next_event(timeout=max(0.1, deadline - time.time()))
+        if e is None:
+            break
+        if e.get('event') == 'change':
+            got.extend(e['changes'])
+    return got
+
+
+def run_arm(wedged):
+    from automerge_tpu.sidecar.client import SidecarClient
+    path = os.path.join(tempfile.mkdtemp(), 'gw-bp.sock')
+    proc = spawn_server(path)
+    out = {'arm': 'wedged' if wedged else 'baseline'}
+    try:
+        wedge_sock = None
+        if wedged:
+            wedge_sock = socket.socket(socket.AF_UNIX,
+                                       socket.SOCK_STREAM)
+            wedge_sock.connect(path)
+            wedge_sock.sendall((json.dumps(
+                {'id': 1, 'cmd': 'subscribe', 'doc': DOC,
+                 'peer': 'wedge'}) + '\n').encode())
+            wedge_sock.settimeout(30)
+            assert wedge_sock.recv(65536), 'wedge subscribe unanswered'
+            # ...and from here on it never reads again
+
+        subs = [SidecarClient(sock_path=path) for _ in range(N_CONNS)]
+        for i, c in enumerate(subs):
+            r = c.subscribe(DOC, peer='h%02d' % i)
+            assert r['clock'] == {} and r['changes'] == [], r
+        writer = SidecarClient(sock_path=path)
+        t0 = time.time()
+        for s in range(1, ROUNDS + 1):
+            writer.apply_changes(DOC, [change(s)])
+        for i, c in enumerate(subs):
+            got = drain_all(c, ROUNDS)
+            assert len(got) == ROUNDS, \
+                '%s arm: healthy peer %d got %d/%d changes' \
+                % (out['arm'], i, len(got), ROUNDS)
+        out['wall_s'] = round(time.time() - t0, 3)
+
+        h = writer.healthz()
+        lat = h['fanout']['latency_ms']
+        out['p50_ms'] = lat.get('p50', 0.0)
+        out['p99_ms'] = lat.get('p99', 0.0)
+        out['egress'] = {k: h['egress'].get(k, 0) for k in
+                        ('sheds', 'shed_frames', 'resyncs',
+                         'wedge_evictions', 'writes', 'write_errors')}
+        out['fallback_oracle'] = h['scheduler']['fallback_oracle']
+
+        if wedged:
+            # the wedged peer must have been degraded: sheds observed,
+            # then resynced with the typed envelope or evicted
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                h = writer.healthz()
+                eg = h['egress']
+                if eg.get('resyncs', 0) or eg.get('wedge_evictions', 0):
+                    break
+                time.sleep(0.2)
+            eg = writer.healthz()['egress']
+            out['egress'] = {k: eg.get(k, 0) for k in out['egress']}
+            assert eg.get('sheds', 0) >= 1, \
+                'wedged arm never tier-1 shed: %r' % (eg,)
+            assert eg.get('resyncs', 0) >= 1 \
+                or eg.get('wedge_evictions', 0) >= 1, \
+                'wedged peer neither resynced nor evicted: %r' % (eg,)
+            # drain whatever reached the wedged socket: either a typed
+            # resync envelope is in there, or the server evicted it
+            # (EOF after the kernel buffer drains)
+            buf, resynced, eof = b'', False, False
+            wedge_sock.settimeout(0.5)
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                try:
+                    chunk = wedge_sock.recv(65536)
+                except socket.timeout:
+                    if resynced or eof:
+                        break
+                    continue
+                except OSError:
+                    eof = True
+                    break
+                if not chunk:
+                    eof = True
+                    break
+                buf += chunk
+                resynced = b'"event": "resync"' in buf \
+                    or b'"resync"' in buf
+            evicted = eg.get('wedge_evictions', 0) >= 1
+            assert resynced or evicted, \
+                'no typed resync envelope and no eviction for the ' \
+                'wedged peer'
+            out['wedged_outcome'] = 'resync' if resynced else 'evicted'
+            wedge_sock.close()
+
+            # reconnect: the dropped peer comes back at an empty clock
+            # and its backfill must be byte-identical to the serial
+            # per-Connection replay of the whole history
+            back = SidecarClient(sock_path=path)
+            r = back.subscribe(DOC, peer='wedge-back')
+            assert canon(r['changes']) == canon(serial_oracle()), \
+                'post-reconnect backfill diverged from serial replay'
+            out['reconnect_parity'] = True
+            back.close()
+
+        assert out['fallback_oracle'] == 0, out
+        for c in subs:
+            c.close()
+        writer.close()
+    finally:
+        stop_server(proc)
+    return out
+
+
+def main():
+    from automerge_tpu.utils.common import env_float
+    floor_ms = env_float('AMTPU_SMOKE_BP_P99_FLOOR_MS', 300.0)
+    base = run_arm(wedged=False)
+    print('backpressure-check: baseline OK (%d conns x %d rounds, '
+          'p50 %.1fms / p99 %.1fms, wall %.1fs)'
+          % (N_CONNS, ROUNDS, base['p50_ms'], base['p99_ms'],
+             base['wall_s']))
+    wedge = run_arm(wedged=True)
+    print('backpressure-check: wedged arm OK (healthy peers all '
+          'served; p50 %.1fms / p99 %.1fms; outcome=%s; egress %r)'
+          % (wedge['p50_ms'], wedge['p99_ms'],
+             wedge.get('wedged_outcome'), wedge['egress']))
+    gate = max(2.0 * base['p99_ms'], floor_ms)
+    assert wedge['p99_ms'] <= gate, \
+        'healthy p99 %.1fms with a wedged consumer exceeds the gate ' \
+        '%.1fms (2x baseline %.1fms, floor %.0fms)' \
+        % (wedge['p99_ms'], gate, base['p99_ms'], floor_ms)
+    print('backpressure-check: isolation OK (wedged-arm healthy p99 '
+          '%.1fms <= max(2 x %.1fms, %.0fms))'
+          % (wedge['p99_ms'], base['p99_ms'], floor_ms))
+    print('backpressure-check: reconnect parity OK (dropped peer '
+          'byte-identical to serial replay); oracle=0')
+    with open(os.path.join(REPO, '.backpressure_check.json'), 'w') as f:
+        json.dump({'baseline': base, 'wedged': wedge,
+                   'p99_gate_ms': gate}, f, indent=2)
+    print('BACKPRESSURE-CHECK GREEN')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
