@@ -3,7 +3,8 @@
 .PHONY: all native test bench bench-all bench-tpu bench-multichip check \
 	clean wheel telemetry-check fallback-check perf-smoke chaos-check \
 	serve-check mesh-check static-check asan-check fanout-check \
-	bench-fanout storage-check obs-check backpressure-check
+	bench-fanout storage-check obs-check backpressure-check \
+	coldstart-check bench-coldstart
 
 all: native
 
@@ -65,6 +66,7 @@ check: native
 	$(MAKE) fanout-check
 	$(MAKE) backpressure-check
 	$(MAKE) storage-check
+	$(MAKE) coldstart-check
 	$(MAKE) obs-check
 	$(MAKE) mesh-check
 	$(MAKE) asan-check
@@ -137,6 +139,22 @@ bench-fanout: native
 # the BENCH_STORAGE artifact.
 storage-check: native
 	JAX_PLATFORMS=cpu python tools/storage_check.py
+
+# Cold-start gate (ISSUE 14, docs/STORAGE.md): the native columnar
+# codec must decode >= 10x the Python codec's changes/s (scaled text
+# corpus AND the config-4 acceptance corpus), the end-to-end 2k-doc
+# restore through the arena-direct load must beat the dict-replay arm
+# >= 4x with per-doc byte parity vs the never-evicted twin, a durable-
+# mode kill-mid-save must recover via the manifest, and
+# fallback.oracle == 0 throughout.
+coldstart-check: native
+	JAX_PLATFORMS=cpu python tools/coldstart_check.py
+
+# The BENCH_COLDSTART artifact (ISSUE 14): timed 100k-doc cold restart
+# + peak-RSS soak through the native arena-direct decode, with the
+# Python-codec arm measured on a subset for the A/B ratio.
+bench-coldstart: native
+	JAX_PLATFORMS=cpu python bench.py --coldstart --out BENCH_COLDSTART.json
 
 # Observability gate (ISSUE 12, docs/OBSERVABILITY.md): flight
 # recorder + critical-path attribution + SLO surface against a LIVE

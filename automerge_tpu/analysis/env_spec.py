@@ -107,9 +107,21 @@ ENV_FLAGS = (
     EnvFlag('AMTPU_DEGRADE', 'bool', False, False, 'resilience.py'),
     EnvFlag('AMTPU_FAULT', 'str', '', False, 'faults.py'),
     EnvFlag('AMTPU_FAULT_SEED', 'raw', None, False, 'faults.py'),
-    # -- columnar storage / cold-state tier (ISSUE 10) ----------------------
+    # -- columnar storage / cold-state tier (ISSUE 10, 14) ------------------
     EnvFlag('AMTPU_STORAGE_FORMAT', 'str', 'columnar', False,
             'storage/__init__.py (json = v1 parity-oracle arm)'),
+    EnvFlag('AMTPU_STORAGE_NATIVE', 'bool', True, False,
+            'storage/columnar.py (0 = Python codec + dict-replay load, '
+            'the parity-oracle arm; checked per call)'),
+    EnvFlag('AMTPU_STORAGE_FOLD', 'bool', True, False,
+            'native/__init__.py (0 = no op-state folding, the A/B arm '
+            'of the folding lane)'),
+    EnvFlag('AMTPU_STORAGE_CHUNK_MAX', 'int', 8, False,
+            'native/__init__.py (snapshot chunks per doc before '
+            're-compaction merges them; 0 disables)'),
+    EnvFlag('AMTPU_STORAGE_DURABLE', 'bool', False, False,
+            'storage/coldstore.py (fsync + per-dir manifest: the '
+            'crash-safe replica-handoff transport)'),
     EnvFlag('AMTPU_STORAGE_DIR', 'str', '', False,
             'storage/coldstore.py (empty -> fresh tempdir)'),
     EnvFlag('AMTPU_STORAGE_GC_MIN', 'int', 256, False,

@@ -28,6 +28,10 @@ Sites (see docs/RESILIENCE.md for what each models):
                     while it fires, so a permanent stall drives the
                     AMTPU_EGRESS_WEDGE_S tier-3 eviction
                     deterministically
+  storage.save      cold-store blob write, mid-stream (a partial
+                    tempfile exists, the atomic rename has not run --
+                    models a kill mid-save; the prior committed copy
+                    and the durable manifest must survive)
 
 Arming:
 
@@ -56,7 +60,8 @@ from .utils.common import env_raw, env_str
 #: fails loudly instead of never firing
 SITES = ('native.begin', 'native.mid', 'device.dispatch',
          'device.collect', 'escalation.tier', 'sidecar.frame',
-         'checkpoint.load', 'fanout.write', 'fanout.stall')
+         'checkpoint.load', 'fanout.write', 'fanout.stall',
+         'storage.save')
 
 KINDS = ('transient', 'permanent')
 

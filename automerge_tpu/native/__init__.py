@@ -197,6 +197,22 @@ def _load():
     lib.amtpu_get_changes_for_actor.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64,
         ctypes.POINTER(ctypes.c_int64)]
+    lib.amtpu_columnar_encode.restype = ctypes.POINTER(ctypes.c_uint8)
+    lib.amtpu_columnar_encode.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64)]
+    lib.amtpu_columnar_decode.restype = ctypes.POINTER(ctypes.c_uint8)
+    lib.amtpu_columnar_decode.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.POINTER(ctypes.c_int64)]
+    lib.amtpu_begin_columnar.restype = ctypes.c_void_p
+    lib.amtpu_begin_columnar.argtypes = [ctypes.c_void_p,
+                                         ctypes.c_char_p, ctypes.c_int64]
+    lib.amtpu_fold_settled.restype = ctypes.c_int64
+    lib.amtpu_fold_settled.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_int64]
+    lib.amtpu_op_count.restype = ctypes.c_int64
+    lib.amtpu_op_count.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.amtpu_doc_shard.restype = ctypes.c_uint32
     lib.amtpu_doc_shard.argtypes = [ctypes.c_char_p, ctypes.c_int64,
                                     ctypes.c_int]
@@ -234,6 +250,45 @@ def _take_buf(ptr, length):
         return ctypes.string_at(ptr, length)
     finally:
         lib().amtpu_buf_free(ptr)
+
+
+# ---------------------------------------------------------------------------
+# native columnar codec bindings (ISSUE 14; storage/columnar.py
+# dispatches here under AMTPU_STORAGE_NATIVE)
+# ---------------------------------------------------------------------------
+
+
+def columnar_encode_native(raws):
+    """C++ columnar encode: list of raw change bytes ->
+    (blob, n_changes, n_residual).  Blob bytes are identical to the
+    Python encoder's (the fuzz parity lane pins it).  Raws cross the
+    boundary BIN-wrapped -- element boundaries must be explicit, a
+    residual raw with trailing bytes is not re-delimitable by msgpack
+    skip.  Raises on any native error; the columnar.py dispatch falls
+    back to the Python codec then."""
+    payload = msgpack.packb([bytes(r) for r in raws],
+                            use_bin_type=True)
+    out_len = ctypes.c_int64()
+    stats = (ctypes.c_int64 * 2)()
+    ptr = lib().amtpu_columnar_encode(payload, len(payload),
+                                      ctypes.byref(out_len), stats)
+    if not ptr:
+        _raise_last()
+    return _take_buf(ptr, out_len.value), int(stats[0]), int(stats[1])
+
+
+def columnar_decode_native(blob):
+    """C++ columnar decode: blob -> list of raw change bytes, byte-
+    identical to the encode input (BIN-wrapped across the boundary, as
+    in `columnar_encode_native`).  Corruption raises ValueError
+    (decode_columnar's contract; the C++ side reports it as kind 1)."""
+    out_len = ctypes.c_int64()
+    ptr = lib().amtpu_columnar_decode(blob, len(blob),
+                                      ctypes.byref(out_len))
+    if not ptr:
+        raise ValueError('corrupt columnar blob: %s'
+                         % lib().amtpu_last_error().decode())
+    return msgpack.unpackb(_take_buf(ptr, out_len.value), raw=False)
 
 
 # ---------------------------------------------------------------------------
@@ -446,17 +501,116 @@ def _base_pool_of(pool, doc_id):
     return pool
 
 
+def _v2_adopt_info(pool, doc_id, key, adopts, frontier, chunks,
+                   empty_pools):
+    """Queues the post-apply snapshot re-adopt for a v2 container --
+    ONLY into docs that are empty pre-load (see _load_batch's inline
+    rationale: adopting over a live doc would discard newer compacted
+    chunks).  `empty_pools` caches base-pool emptiness: a cold restart
+    into a fresh pool (the 1M-doc case) skips the per-doc clock query
+    entirely."""
+    from .. import storage
+    if frontier and chunks and storage.storage_format() != 'json':
+        bp = _base_pool_of(pool, doc_id)
+        empty = empty_pools.get(id(bp))
+        if empty is None:
+            empty = empty_pools[id(bp)] = bp.doc_count() == 0
+        if not empty:
+            pre = {}
+            try:
+                pre = pool.get_clock(doc_id).get('clock') or {}
+            except Exception:
+                pass
+            if pre:
+                return
+        adopts.append((doc_id, key, frontier, chunks))
+
+
+def _load_batch_native(pool, blobs):
+    """Arena-direct restore (ISSUE 14 tentpole): v2 snapshot chunks +
+    tail ship to C++ AS COLUMNAR BLOBS (`amtpu_begin_columnar`) -- the
+    columns materialize straight into ChangeRec arena state with no
+    Python change dicts and no per-change msgpack round trip; v1
+    containers splice their raw changes array through the same entry.
+    Docs group per base pool, so sharded/mesh drivers route exactly
+    like the dict path.  Byte parity with the dict-replay path is the
+    decode-parity test lane's contract (both exec modes)."""
+    from .. import storage
+    from ..errors import RangeError
+    groups = {}          # id(base pool) -> (base pool, {key: [parts]})
+    adopts = []          # (doc_id, key, frontier, chunks) post-apply
+    empty_pools = {}     # id(base pool) -> was empty pre-load
+    for doc_id, data in blobs.items():
+        key = doc_key(doc_id)
+        data = bytes(data)
+        if data.startswith(_CKPT_PREFIX):
+            doc_parts = [data[len(_CKPT_PREFIX):]]
+        elif data.startswith(storage.CKPT_V2_PREFIX):
+            try:
+                frontier, chunks, tail_blob = \
+                    storage.unpack_checkpoint_parts(data)
+            except ValueError as e:
+                raise RangeError('corrupt checkpoint for %r: %s'
+                                 % (doc_id, e))
+            doc_parts = list(chunks) + [tail_blob]
+            _v2_adopt_info(pool, doc_id, key, adopts, frontier,
+                           chunks, empty_pools)
+        else:
+            raise RangeError('not an amtpu-doc checkpoint: %r'
+                             % (doc_id,))
+        bp = _base_pool_of(pool, doc_id)
+        groups.setdefault(id(bp), (bp, {}))[1][key] = doc_parts
+    def apply_group(bp, keyed):
+        try:
+            bp._apply_columnar(msgpack.packb(keyed, use_bin_type=True))
+        except RangeError as e:
+            # corrupt-blob surface parity with the dict-replay arm: a
+            # bad chunk/tail reports as a corrupt CHECKPOINT
+            raise RangeError('corrupt checkpoint (docs %s): %s'
+                             % (sorted(keyed), e))
+
+    if len(groups) > 1:
+        # sharded/mesh pools: drive the per-shard restores CONCURRENTLY
+        # (ctypes releases the GIL around the C++ begin/emit), matching
+        # the dict-replay arm's threaded shard runner.  Shards commit
+        # independently -- the first error re-raises after every group
+        # ran, the documented sharded-pool error contract.
+        import concurrent.futures
+        with concurrent.futures.ThreadPoolExecutor(
+                max_workers=min(len(groups), os.cpu_count() or 1)) \
+                as pool_exec:
+            futs = [pool_exec.submit(apply_group, bp, keyed)
+                    for bp, keyed in groups.values()]
+            errors = [f.exception() for f in futs
+                      if f.exception() is not None]
+        if errors:
+            raise errors[0]
+    else:
+        for bp, keyed in groups.values():
+            apply_group(bp, keyed)
+    for doc_id, key, frontier, chunks in adopts:
+        _base_pool_of(pool, doc_id)._adopt_snapshot(key, frontier,
+                                                    chunks)
+
+
 def _load_batch(pool, blobs):
     """Splices many save() checkpoints into ONE {doc: [changes]} payload
     and applies it as a single batch -- per-doc loads each pay a full
     device round trip; a whole DocSet restore should pay one.  v2
     columnar containers (docs/STORAGE.md) decode their snapshot chunks
     here and, post-apply, re-adopt them so a reloaded doc keeps its
-    compacted cold-state economics."""
+    compacted cold-state economics.
+
+    Under ``AMTPU_STORAGE_NATIVE`` (default on) the restore goes
+    ARENA-DIRECT through `amtpu_begin_columnar` instead
+    (`_load_batch_native`); this dict-replay body is the =0 parity
+    oracle."""
     from .. import storage
     from ..errors import RangeError
     if faults.ARMED:
         faults.fire('checkpoint.load', [doc_key(d) for d in blobs])
+    if storage.storage_native_on():
+        return _load_batch_native(pool, blobs)
     parts = [_map_header(len(blobs))]
     adopts = []          # (doc_id, key, frontier, chunks) post-apply
     for doc_id, data in blobs.items():
@@ -2193,15 +2347,49 @@ class NativeDocPool:
         return storage.join_changes_array(
             head + storage.split_changes_array(buf))
 
+    def _apply_columnar(self, payload):
+        """One arena-direct columnar batch (`amtpu_begin_columnar`):
+        payload is msgpack {doc_key: [part, ...]} where each part is a
+        columnar blob or a raw msgpack changes array.  The batch is
+        pinned host-full in C++, so phase b is the hostreg driver
+        regardless of exec mode (host/kernel byte parity is pinned by
+        the differential suites)."""
+        L = lib()
+        _check_resident_latch()
+        self._ensure_mode_flags()
+        t0 = time.perf_counter()
+        with trace.span('host.begin'):
+            bh = L.amtpu_begin_columnar(self._pool, payload,
+                                        len(payload))
+        if not bh:
+            _raise_last()
+        _track_begin()
+        telemetry.metric('storage.native_loads')
+        ctx = self._phase_a_rest(bh)
+        t1 = time.perf_counter()
+        attribution.note_flush_phase('dispatch', t1 - t0)
+        try:
+            return self._phase_b(ctx)
+        except Exception as e:
+            _rollback_batch(ctx['bh'], e)
+            raise
+        finally:
+            attribution.note_flush_phase('collect',
+                                         time.perf_counter() - t1)
+            _free_batch(ctx['bh'])
+
     # -- settled-history GC + cold-doc eviction (ISSUE 10) ---------------
 
     def _adopt_snapshot(self, key, frontier, chunks):
         """Installs a checkpoint's settled snapshot for `key` and
         truncates the C++ arena behind its frontier (reload keeps the
-        compacted economics; docs/STORAGE.md)."""
+        compacted economics; docs/STORAGE.md).  Op-state folding rides
+        along, so a reloaded doc's op arena stays as lean as the one it
+        checkpointed from."""
         self._storage[key] = {'frontier': dict(frontier),
                               'chunks': list(chunks)}
         self._truncate(key, frontier)
+        self._fold_settled(key, frontier)
 
     def _truncate(self, key, frontier):
         fb = msgpack.packb(dict(frontier), use_bin_type=True)
@@ -2262,9 +2450,59 @@ class NativeDocPool:
         for a, s in prefix_clock.items():
             st['frontier'][a] = max(st['frontier'].get(a, 0), s)
         self._truncate(key, st['frontier'])
+        self._fold_settled(key, st['frontier'])
+        self._maybe_rechunk(key, st)
         telemetry.metric('storage.gc.compactions')
         telemetry.metric('storage.gc.changes_folded', len(fold))
         return len(fold)
+
+    def _fold_settled(self, key, frontier):
+        """Op-state folding (ISSUE 14 tentpole): settled changes at or
+        behind `frontier` free their op records / deps / message in the
+        C++ arena -- registers and list arenas already hold their final
+        values, and the columnar snapshot holds their replay bytes, so
+        the arena stops growing with history under settled-overwrite
+        churn.  ``AMTPU_STORAGE_FOLD=0`` is the no-fold A/B arm the
+        folding lane compares against (byte-identical patches and
+        straggler backfills either way)."""
+        if not frontier or not env_bool('AMTPU_STORAGE_FOLD', True):
+            return 0
+        fb = msgpack.packb(dict(frontier), use_bin_type=True)
+        n = lib().amtpu_fold_settled(self._pool, key.encode(), fb,
+                                     len(fb))
+        if n < 0:
+            _raise_last()
+        if n:
+            telemetry.metric('storage.gc.ops_folded', n)
+        return int(n)
+
+    def _maybe_rechunk(self, key, st):
+        """Chunk re-compaction (ISSUE 14): a long-lived doc accumulates
+        one snapshot chunk per GC fold; past ``AMTPU_STORAGE_CHUNK_MAX``
+        chunks (default 8; 0 disables) they merge into one columnar
+        blob on the same `_storage_upkeep` cadence that triggered the
+        fold.  Decode is byte-lossless, so the merged chunk replays and
+        backfills byte-identically."""
+        from .. import storage
+        cap = env_int('AMTPU_STORAGE_CHUNK_MAX', 8)
+        if cap <= 0 or len(st['chunks']) < cap:
+            return 0
+        raws = []
+        for chunk in st['chunks']:
+            raws.extend(storage.decode_columnar(chunk))
+        st['chunks'] = [storage.encode_columnar(raws)]
+        telemetry.metric('storage.gc.rechunks')
+        return len(raws)
+
+    def op_count(self, doc_id=None):
+        """Retained op records in the C++ arena (applied states + the
+        causal queue; one doc or the whole pool) -- the growth measure
+        the op-state folding lane gates flat."""
+        key = '' if doc_id is None else self._doc_key(doc_id)
+        n = lib().amtpu_op_count(self._pool, key.encode())
+        if n < 0:
+            _raise_last()
+        return int(n)
 
     def drop_doc(self, doc_id):
         """Cold-doc eviction: removes the doc's entire state from the
@@ -2587,6 +2825,11 @@ class ShardedNativePool:
             return self.pools[self._shard_of(doc_id)] \
                 .history_bytes(doc_id)
         return sum(p.history_bytes() for p in self.pools)
+
+    def op_count(self, doc_id=None):
+        if doc_id is not None:
+            return self.pools[self._shard_of(doc_id)].op_count(doc_id)
+        return sum(p.op_count() for p in self.pools)
 
 
 def make_pool():

@@ -28,7 +28,7 @@ from ..utils.common import env_str
 from .columnar import (corrupt_raises_value_error,  # noqa: F401
                        decode_columnar, decode_columnar_dicts,
                        decode_columnar_meta, encode_columnar,
-                       encode_columnar_dicts)
+                       encode_columnar_dicts, storage_native_on)
 
 FORMAT_V1 = 'amtpu-doc-v1'
 FORMAT_V2 = 'amtpu-doc-v2c'
@@ -130,6 +130,26 @@ def unpack_checkpoint(data):
         return (obj.get('frontier') or {},
                 list(obj.get('chunks') or ()),
                 decode_columnar(obj['tail']))
+
+
+def unpack_checkpoint_parts(data):
+    """v2-only LAZY parse: (frontier, chunks, tail_blob) without
+    decoding anything columnar -- the native arena-direct loader
+    (`amtpu_begin_columnar`) takes the blobs as-is, so a cold restart
+    never builds Python change objects.  Corruption raises ValueError
+    like `unpack_checkpoint`."""
+    if not data.startswith(CKPT_V2_PREFIX):
+        raise ValueError('not an amtpu v2 checkpoint container')
+    with corrupt_raises_value_error('checkpoint container'):
+        obj = msgpack.unpackb(data, raw=False, strict_map_key=False)
+        tail = obj.get('tail')
+        if not isinstance(tail, (bytes, bytearray)):
+            raise ValueError('checkpoint tail missing')
+        chunks = list(obj.get('chunks') or ())
+        if not all(isinstance(c, (bytes, bytearray)) for c in chunks):
+            raise ValueError('checkpoint chunks not bytes')
+        return (obj.get('frontier') or {}, [bytes(c) for c in chunks],
+                bytes(tail))
 
 
 def checkpoint_raw_changes(data):

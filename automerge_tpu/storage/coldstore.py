@@ -14,9 +14,25 @@ Thread model: every method is called under the gateway's pool lock
 (the single serialization point for all pool state); the store itself
 is therefore single-threaded by construction and keeps its index as a
 plain dict.  The disk directory (``AMTPU_STORAGE_DIR``, default a
-fresh tempdir) is an extension of pool memory, not durable storage --
-a process that dies with evicted docs loses them exactly as it loses
-resident ones (durability remains the checkpoint-WAL's job).
+fresh tempdir) is by default an extension of pool memory, not durable
+storage -- a process that dies with evicted docs loses them exactly as
+it loses resident ones (durability remains the checkpoint-WAL's job).
+
+**Durable mode** (``AMTPU_STORAGE_DURABLE=1``, ISSUE 14): the store
+becomes a crash-safe handoff transport -- every blob write fsyncs
+(file + directory) and lands in a per-dir **manifest**
+(``manifest.amtm``: doc id -> file name, byte count, sha1 checksum;
+itself written tempfile + rename + fsync), so a FRESH process pointed
+at the same directory recovers the exact committed doc set
+(`doc_ids()`), a kill at ANY byte of a save leaves the prior blob and
+manifest intact, and a torn/bit-rotted blob fails its checksum at
+`get` instead of replaying garbage.  This is the replica-handoff
+transport ROADMAP #1 needs (ColdStore.save on the source + load_batch
+on the target).
+
+Writes are crash-safe in BOTH modes: blobs land via tempfile + atomic
+``os.replace``, so a partial write can never corrupt the previous
+committed copy (the ``storage.save`` fault lane pins it).
 """
 
 import collections
@@ -24,19 +40,29 @@ import hashlib
 import os
 import tempfile
 
-from .. import telemetry
-from ..utils.common import env_int, env_str
+import msgpack
+
+from .. import faults, telemetry
+from ..utils.common import env_bool, env_int, env_str
+
+#: per-dir manifest file name (durable mode)
+MANIFEST = 'manifest.amtm'
 
 
 class ColdStore(object):
     """File-per-doc blob store: checkpoint containers keyed by doc id."""
 
-    def __init__(self, root=None):
+    def __init__(self, root=None, durable=None):
         if root is None:
             root = env_str('AMTPU_STORAGE_DIR', '')
         self.root = root or tempfile.mkdtemp(prefix='amtpu-cold-')
         os.makedirs(self.root, exist_ok=True)
-        self._index = {}         # doc id -> (path, n_bytes)
+        if durable is None:
+            durable = env_bool('AMTPU_STORAGE_DURABLE', False)
+        self.durable = durable
+        self._index = {}         # doc id -> (path, n_bytes, sha1|None)
+        if self.durable:
+            self._recover()
 
     def _path(self, doc_id):
         h = hashlib.sha1(str(doc_id).encode('utf-8')).hexdigest()
@@ -48,26 +74,160 @@ class ColdStore(object):
     def __len__(self):
         return len(self._index)
 
+    def doc_ids(self):
+        """Committed doc ids (durable mode: exactly what a fresh
+        process recovers from the manifest -- the handoff inventory)."""
+        return list(self._index)
+
     @property
     def bytes(self):
-        return sum(n for _p, n in self._index.values())
+        return sum(e[1] for e in self._index.values())
 
-    def put(self, doc_id, blob):
-        path = self._path(doc_id)
+    # -- durable-mode manifest ------------------------------------------
+
+    def _recover(self):
+        """Rebuilds the index from the manifest: only entries whose
+        file exists at the recorded size are adopted (a killed save
+        leaves at most a stray ``.tmp``, which is ignored -- the
+        manifest names the last COMMITTED copy)."""
+        mpath = os.path.join(self.root, MANIFEST)
+        if not os.path.exists(mpath):
+            return
+        try:
+            with open(mpath, 'rb') as f:
+                m = msgpack.unpackb(f.read(), raw=False)
+            docs = m.get('docs') or {}
+        except Exception:
+            telemetry.metric('storage.manifest_corrupt')
+            return
+        n = 0
+        for doc_id, ent in docs.items():
+            path = os.path.join(self.root, ent['file'])
+            try:
+                if os.path.getsize(path) != ent['bytes']:
+                    continue
+            except OSError:
+                continue
+            self._index[doc_id] = (path, ent['bytes'], ent.get('sha1'))
+            n += 1
+        if n:
+            telemetry.metric('storage.manifest_recovered', n)
+
+    def _fsync_dir(self):
+        try:
+            fd = os.open(self.root, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        except OSError:
+            pass
+
+    def _write_manifest(self):
+        docs = {}
+        for doc_id, (path, n, digest) in self._index.items():
+            docs[str(doc_id)] = {'file': os.path.basename(path),
+                                 'bytes': n, 'sha1': digest}
+        mpath = os.path.join(self.root, MANIFEST)
+        tmp = mpath + '.tmp'
+        with open(tmp, 'wb') as f:
+            f.write(msgpack.packb({'format': 'amtpu-manifest-v1',
+                                   'docs': docs}, use_bin_type=True))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, mpath)
+        self._fsync_dir()
+        telemetry.metric('storage.manifest_writes')
+
+    # -- blob I/O -------------------------------------------------------
+
+    def _put_blob(self, doc_id, blob):
+        """Writes one blob crash-safely and updates the in-memory
+        index; returns the obsolete prior path (durable mode) for the
+        caller to unlink AFTER the manifest commits.
+
+        Crash-safety: tempfile + atomic rename, so a kill at any byte
+        of the write leaves the PRIOR committed copy intact (the
+        ``storage.save`` fault lane fires mid-write -- partial
+        tempfile on disk, rename not yet run -- modeling exactly that
+        kill).  Durable mode additionally VERSIONS the file name by
+        content hash: a re-save never overwrites the committed copy in
+        place, so a kill between the rename and the manifest write
+        still leaves the manifest naming the intact prior file; the
+        new file is simply a stray the next recovery ignores."""
+        digest = hashlib.sha1(blob).hexdigest() if self.durable else None
+        base = self._path(doc_id)
+        path = '%s-%s.amtc' % (base[:-5], digest[:12]) if self.durable \
+            else base
         tmp = path + '.tmp'
         with open(tmp, 'wb') as f:
-            f.write(blob)
+            if faults.ARMED:
+                # a real kill interrupts the write stream itself: leave
+                # a genuinely partial tempfile behind the fault
+                half = len(blob) // 2
+                f.write(blob[:half])
+                faults.fire('storage.save', [str(doc_id)])
+                f.write(blob[half:])
+            else:
+                f.write(blob)
+            if self.durable:
+                f.flush()
+                os.fsync(f.fileno())
         os.replace(tmp, path)
+        prior = None
+        if self.durable:
+            self._fsync_dir()
+            telemetry.metric('storage.durable_writes')
+            old = self._index.get(doc_id)
+            if old is not None and old[0] != path:
+                prior = old[0]
         telemetry.metric('storage.cold_bytes_written', len(blob))
-        self._index[doc_id] = (path, len(blob))
+        self._index[doc_id] = (path, len(blob), digest)
+        return prior
+
+    def _retire(self, paths):
+        """Unlinks obsolete blob versions AFTER the manifest named
+        their replacements (a kill in between leaves strays the next
+        recovery ignores, never a lost committed copy)."""
+        for path in paths:
+            if path is None:
+                continue
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+    def put(self, doc_id, blob):
+        prior = self._put_blob(doc_id, blob)
+        if self.durable:
+            self._write_manifest()
+            self._retire([prior])
+
+    def put_many(self, blobs):
+        """Batched handoff writes ({doc_id: blob}): one manifest
+        rewrite + fsync for the whole batch instead of one per doc --
+        the replica-handoff path saves thousands of docs in a burst,
+        and per-put manifests would make that O(n^2)."""
+        priors = [self._put_blob(d, b) for d, b in blobs.items()]
+        if self.durable:
+            self._write_manifest()
+            self._retire(priors)
 
     def get(self, doc_id):
         """Reads a cold blob WITHOUT removing it -- reload reads first
         and discards only after the replay committed, so a failed
-        reload cannot destroy the only copy of a doc."""
-        path, _n = self._index[doc_id]
+        reload cannot destroy the only copy of a doc.  Durable mode
+        verifies the manifest checksum, so a torn or bit-rotted blob
+        raises here instead of replaying garbage."""
+        path, _n, digest = self._index[doc_id]
         with open(path, 'rb') as f:
-            return f.read()
+            data = f.read()
+        if digest is not None \
+                and hashlib.sha1(data).hexdigest() != digest:
+            telemetry.metric('storage.checksum_failed')
+            raise ValueError('cold blob checksum mismatch for %r'
+                             % (doc_id,))
+        return data
 
     def discard(self, doc_id):
         entry = self._index.pop(doc_id, None)
@@ -77,6 +237,8 @@ class ColdStore(object):
             os.unlink(entry[0])
         except OSError:
             pass
+        if self.durable:
+            self._write_manifest()
 
     def pop(self, doc_id):
         blob = self.get(doc_id)
@@ -218,4 +380,5 @@ class DocEvictor(object):
                 'max_resident': self.max,
                 'cold_docs': len(self.store),
                 'cold_bytes': self.store.bytes,
+                'durable': self.store.durable,
                 'gc_every': self.gc_every}

@@ -40,6 +40,30 @@ import zlib
 import msgpack
 
 from .. import telemetry
+from ..utils.common import env_bool
+
+
+def storage_native_on():
+    """Native-codec dispatch gate (ISSUE 14): AMTPU_STORAGE_NATIVE
+    (default on) routes encode/decode through the C++ codec in
+    native/core.cpp; 0 keeps this module's pure-Python codec as the
+    parity oracle (same A/B pattern as AMTPU_FANOUT_VECTOR).  Checked
+    per call, not latched, so interleaved A/B runs flip it
+    in-process."""
+    return env_bool('AMTPU_STORAGE_NATIVE', True)
+
+
+def _native_codec():
+    """The native bindings module when the dispatch gate is on and the
+    library loads; None keeps everything on the Python codec."""
+    if not storage_native_on():
+        return None
+    try:
+        from .. import native
+        native.lib()
+        return native
+    except Exception:
+        return None
 
 
 @contextlib.contextmanager
@@ -427,19 +451,36 @@ class _Encoder(object):
 def encode_columnar(raw_changes):
     """Encodes an iterable of raw msgpack change bytes into one
     columnar blob.  `decode_columnar` reproduces the exact input
-    byte-for-byte (foreign encodings ride the residual column)."""
-    enc = _Encoder()
-    n_in = 0
-    for raw in raw_changes:
-        raw = bytes(raw)
-        n_in += len(raw)
-        enc.add(raw)
-    blob = enc.dump()
+    byte-for-byte (foreign encodings ride the residual column).
+
+    Dispatches to the native C++ codec when `AMTPU_STORAGE_NATIVE`
+    (default on) -- blob bytes are identical either way (the fuzz
+    parity lane pins it); `storage.native_encodes` vs
+    `storage.python_encodes` makes the split observable.  A native
+    failure (e.g. msgpack ext framing the C++ reader cannot skip)
+    falls back to the Python codec, never to a failed save."""
+    raws = [bytes(raw) for raw in raw_changes]
+    n_in = sum(len(raw) for raw in raws)
+    blob = n_changes = n_residual = None
+    nat = _native_codec()
+    if nat is not None:
+        try:
+            blob, n_changes, n_residual = nat.columnar_encode_native(raws)
+            telemetry.metric('storage.native_encodes')
+        except Exception:
+            blob = None
+    if blob is None:
+        enc = _Encoder()
+        for raw in raws:
+            enc.add(raw)
+        blob = enc.dump()
+        n_changes, n_residual = enc.n_changes, enc.n_residual
+        telemetry.metric('storage.python_encodes')
     telemetry.metric('storage.columnar.encodes')
-    telemetry.metric('storage.columnar.changes', enc.n_changes)
-    if enc.n_residual:
+    telemetry.metric('storage.columnar.changes', n_changes)
+    if n_residual:
         telemetry.metric('storage.columnar.residual_changes',
-                         enc.n_residual)
+                         n_residual)
     telemetry.metric('storage.columnar.bytes_in', n_in)
     telemetry.metric('storage.columnar.bytes_out', len(blob))
     return blob
@@ -592,8 +633,15 @@ def decode_columnar(blob):
     """-> list of raw msgpack change bytes, byte-identical to the
     `encode_columnar` input.  A corrupt blob raises ValueError
     whatever the decoder tripped on internally (zlib, struct, an
-    out-of-range table index)."""
+    out-of-range table index).  Dispatches to the native codec under
+    `AMTPU_STORAGE_NATIVE` (corruption surfaces as the same
+    ValueError)."""
     telemetry.metric('storage.columnar.decodes')
+    nat = _native_codec()
+    if nat is not None:
+        telemetry.metric('storage.native_decodes')
+        return nat.columnar_decode_native(bytes(blob))
+    telemetry.metric('storage.python_decodes')
     with corrupt_raises_value_error():
         return [raw for raw, _a, _s in _Decoder(blob).changes()]
 
@@ -602,8 +650,11 @@ def decode_columnar_meta(blob):
     """-> list of (raw_bytes, actor, seq); residual changes pay one
     unpack for their meta (the merge paths in native/__init__.py key
     on actor/seq).  Corruption raises ValueError, like
-    `decode_columnar`."""
+    `decode_columnar`.  Always the Python decoder (the meta tuple is a
+    Python-object product anyway; the hot arena-direct path is
+    `amtpu_begin_columnar`)."""
     telemetry.metric('storage.columnar.decodes')
+    telemetry.metric('storage.python_decodes')
     with corrupt_raises_value_error():
         entries = list(_Decoder(blob).changes())
     out = []

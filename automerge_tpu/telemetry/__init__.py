@@ -289,8 +289,14 @@ KNOWN_STORAGE_KEYS = ('columnar.encodes', 'columnar.decodes',
                       'save_v2', 'snapshot_backfills',
                       'gc.compactions', 'gc.changes_folded',
                       'gc.bytes_freed', 'gc.skipped_json', 'gc.failed',
+                      'gc.ops_folded', 'gc.rechunks',
                       'evictions', 'reloads', 'reload_failed',
-                      'evict_failed', 'cold_bytes_written')
+                      'evict_failed', 'cold_bytes_written',
+                      'native_encodes', 'python_encodes',
+                      'native_decodes', 'python_decodes',
+                      'native_loads', 'durable_writes',
+                      'manifest_writes', 'manifest_recovered',
+                      'manifest_corrupt', 'checksum_failed')
 
 # flight-recorder counters (`telemetry.metric('recorder.<name>')` call
 # sites in telemetry/recorder.py; event catalog: docs/OBSERVABILITY.md),

@@ -944,6 +944,95 @@ BUILDERS = {1: build_config_1, 2: build_config_2, 3: build_config_3,
             4: build_config_4}
 
 
+def run_coldstart(args):
+    """--coldstart (ISSUE 14): the scale bench behind the CI miniature
+    -- a timed cold restart of ``AMTPU_BENCH_COLDSTART_DOCS`` (default
+    100k) saved docs through the native arena-direct decode
+    (`amtpu_begin_columnar`), recording wall time, changes/s, and the
+    process peak RSS (the "working-set >> RAM" soak), plus the Python-
+    codec dict-replay arm on a subset for the A/B ratio and a sampled
+    per-doc byte-parity check between the arms.  Emits one
+    BENCH_COLDSTART JSON line (--out writes it)."""
+    import resource
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), 'tools'))
+    import coldstart_check as cc
+    from automerge_tpu import telemetry
+    from automerge_tpu.native import NativeDocPool
+    n_docs = env_int('AMTPU_BENCH_COLDSTART_DOCS', 100000)
+    py_docs = min(n_docs, env_int('AMTPU_BENCH_COLDSTART_PYDOCS', 4096))
+    step = env_int('AMTPU_BENCH_COLDSTART_BATCH', 8192)
+    rng = random.Random(SEED)
+    t0 = time.perf_counter()
+    blobs, builder = cc._build_blobs(n_docs, rng)
+    build_s = time.perf_counter() - t0
+    n_changes = 17 * n_docs          # 1 init + 16 rounds per doc
+    blob_bytes = sum(len(b) for b in blobs.values())
+    # parity sample captured BEFORE the builder pool frees: the restore
+    # must reproduce these bytes exactly
+    sample_docs = sorted(blobs)[::max(1, n_docs // 64)]
+    sample_saves = {d: builder.save(d) for d in sample_docs}
+    del builder
+    print('coldstart: built %d docs (%d changes, %.1f MB cold bytes) '
+          'in %.1fs' % (n_docs, n_changes, blob_bytes / 1e6, build_s),
+          file=sys.stderr)
+
+    # Python-codec arm on a subset (the full corpus would take minutes
+    # at the Python codec's changes/s -- which is the point)
+    os.environ['AMTPU_STORAGE_NATIVE'] = '0'
+    sub = {d: blobs[d] for d in list(blobs)[:py_docs]}
+    p = NativeDocPool()
+    t0 = time.perf_counter()
+    p.load_batch(sub)
+    py_s = time.perf_counter() - t0
+    py_rate = (17 * py_docs) / py_s
+    del p, sub
+    print('coldstart: python arm %d docs in %.1fs (%.0f changes/s)'
+          % (py_docs, py_s, py_rate), file=sys.stderr)
+
+    # the timed native cold restart (chunked payloads bound memory)
+    os.environ['AMTPU_STORAGE_NATIVE'] = '1'
+    pool = NativeDocPool()
+    docs = list(blobs)
+    t0 = time.perf_counter()
+    for i in range(0, len(docs), step):
+        pool.load_batch({d: blobs[d] for d in docs[i:i + step]})
+    native_s = time.perf_counter() - t0
+    native_rate = n_changes / native_s
+    peak_rss_mb = resource.getrusage(
+        resource.RUSAGE_SELF).ru_maxrss / 1024.0
+    parity = all(pool.save(d) == sample_saves[d] for d in sample_docs)
+    os.environ.pop('AMTPU_STORAGE_NATIVE', None)
+    speedup = native_rate / py_rate
+    print('coldstart: native restart %d docs in %.1fs (%.0f changes/s, '
+          '%.1fx the python arm), peak RSS %.0f MB, parity %s'
+          % (n_docs, native_s, native_rate, speedup, peak_rss_mb,
+             parity), file=sys.stderr)
+    result = {
+        'metric': 'coldstart_restore',
+        'value': round(native_rate, 1),
+        'unit': 'changes/sec',
+        'docs': n_docs,
+        'changes': n_changes,
+        'cold_bytes': blob_bytes,
+        'build_s': round(build_s, 2),
+        'native_restore_s': round(native_s, 3),
+        'python_arm': {'docs': py_docs, 'restore_s': round(py_s, 3),
+                       'changes_per_s': round(py_rate, 1)},
+        'vs_baseline': round(speedup, 2),
+        'baseline': 'python-codec-dict-replay',
+        'peak_rss_mb': round(peak_rss_mb, 1),
+        'parity': parity,
+        'telemetry': telemetry.bench_block(),
+    }
+    print(json.dumps(result))
+    if args.out:
+        with open(args.out, 'w') as f:
+            f.write(json.dumps(result) + '\n')
+        print('wrote %s' % args.out, file=sys.stderr)
+    return 0 if parity and speedup >= 4.0 else 1
+
+
 def run_fanout(args):
     """--fanout (ISSUE 9): the real collaboration workload -- RGA-heavy
     text edits under zipfian doc popularity fanned out to 1k+
@@ -1189,6 +1278,12 @@ def main(argv=None):
                          'mesh pool mode: one subprocess per dp '
                          '(AMTPU_MULTICHIP_DP, default 1,2,4,8) + the '
                          'sp-crossover probe; write with --out')
+    ap.add_argument('--coldstart', action='store_true',
+                    help='BENCH_COLDSTART artifact (ISSUE 14): timed '
+                         '100k-doc cold restart + peak-RSS soak '
+                         'through the native arena-direct decode, '
+                         'with the Python-codec arm on a subset; '
+                         'write with --out')
     ap.add_argument('--fanout', action='store_true',
                     help='BENCH_FANOUT artifact (ISSUE 9): RGA-heavy '
                          'text edits under zipfian doc popularity '
@@ -1208,6 +1303,8 @@ def main(argv=None):
         return run_all(args)
     if args.multichip:
         return run_multichip(args)
+    if args.coldstart:
+        return run_coldstart(args)
     if args.fanout:
         return run_fanout(args)
     if args.mode == 'host':

@@ -32,6 +32,19 @@
 #include <unordered_map>
 #include <vector>
 
+#include <zlib.h>
+// zconf.h drags in <unistd.h>, whose legacy lseek L_* macros collide
+// with this file's materializer literal ids
+#ifdef L_SET
+#undef L_SET
+#endif
+#ifdef L_INCR
+#undef L_INCR
+#endif
+#ifdef L_XTND
+#undef L_XTND
+#endif
+
 #include "msgpack.h"
 
 namespace amtpu {
@@ -431,6 +444,14 @@ static bool changes_equal(const ChangeRec& a, const ChangeRec& b) {
 struct StateEntry {
   ChangeRec change;
   Clock all_deps;
+  // op-state folding (amtpu_fold_settled): the change's op records /
+  // deps / message were freed -- everything behind the settled frontier
+  // is re-derivable from the doc's columnar snapshot, and the live
+  // register/arena state already holds the fold's final values.
+  // all_deps stays (straggler closure walks read it); duplicate
+  // consistency checks skip folded entries (the original bytes were
+  // validated when the change first applied).
+  bool folded = false;
 };
 
 struct InboundRef {
@@ -1292,6 +1313,11 @@ struct Batch {
   // the Python driver sizes the sliding window from it
   i64 max_group = 0;
 
+  // load-batch mode (amtpu_begin_columnar): emit performs every state
+  // mutation (mirrors, inbound, visibility, Fenwick) but writes NO
+  // patch bytes -- checkpoint restores discard them, and at 1M docs
+  // the skipped diff rendering is a measurable slice of cold start
+  bool no_patch = false;
   // local-change mode (apply_local_change / undo / redo):
   // kind 0 = not local, 1 = undoable change, 2 = undo, 3 = redo
   int local_kind = 0;
@@ -1531,8 +1557,13 @@ static void validate_duplicates(Pool& pool, Batch& b) {
     const ChangeRec* prior = nullptr;
     auto it = st.states.find(ch.actor);
     if (it != st.states.end() && ch.seq >= 1 &&
-        ch.seq - 1 < it->second.size())
+        ch.seq - 1 < it->second.size()) {
+      // folded entries freed their op records (amtpu_fold_settled);
+      // the duplicate is behind the settled frontier, so its bytes
+      // were already validated when the change first applied
+      if (it->second[ch.seq - 1].folded) continue;
       prior = &it->second[ch.seq - 1].change;
+    }
     if (!prior) {
       auto ait = applied_idx.find(K3{doc, ch.actor, ch.seq});
       if (ait != applied_idx.end()) prior = ait->second;
@@ -3058,6 +3089,29 @@ static void emit_map_diff(Writer& w, Pool& pool, DocState& st,
 
 // emits one list/text diff and maintains visibility mirrors;
 // returns false when no diff is produced
+// State-only twin of emit_list_diff's visibility transition: the
+// mutation a list assign applies to the arena, without any patch
+// bytes.  The no-patch load path (Batch::no_patch) runs this so a
+// restored doc's visibility state is byte-identical to the patched
+// path's -- the decode-parity lanes pin it.
+static void apply_list_visibility(Arena& ar, const Register& reg,
+                                  i64 op_idx, Batch& b) {
+  i32 eidx = b.eidx_of_op[op_idx];
+  if (eidx < 0 || op_idx >= static_cast<i64>(b.list_index_of_op.size()))
+    return;
+  i32 index = b.list_index_of_op[op_idx];
+  if (index == INT32_MIN) return;
+  bool visible_before = ar.visible[eidx] != 0;
+  bool alive = !reg.empty();
+  if (visible_before && !alive) {
+    ar.visible_order.erase(ar.visible_order.begin() + index);
+    ar.visible[eidx] = 0;
+  } else if (!visible_before && alive) {
+    ar.visible_order.insert(ar.visible_order.begin() + index, eidx);
+    ar.visible[eidx] = 1;
+  }
+}
+
 static bool emit_list_diff(Writer& w, Pool& pool, Arena& ar,
                            const OpRec& op, const Register& reg, i64 op_idx,
                            Batch& b, u8 obj_type,
@@ -3169,7 +3223,7 @@ static void emit(Pool& pool, Batch& b) {
   // Local changes stay buffered: their envelope reads undo/redo state
   // committed AFTER the op loop.
   std::vector<u8> doc_seen(b.bdoc_ids.size(), 0);
-  bool direct = !b.local_kind;
+  bool direct = !b.local_kind && !b.no_patch;
   {
     u32 prev = ~0u;
     for (auto& f : b.ops) {
@@ -3223,7 +3277,7 @@ static void emit(Pool& pool, Batch& b) {
     for (size_t d = 0; d < b.bdoc_ids.size(); ++d) {
       if (assigns[d])
         b.bdocs[d]->registers.reserve(b.bdocs[d]->registers.n + assigns[d]);
-      if (!direct) diff_bufs[d].buf.reserve(per[d] * 48);
+      if (!direct && !b.no_patch) diff_bufs[d].buf.reserve(per[d] * 48);
     }
   }
 
@@ -3317,6 +3371,7 @@ static void emit(Pool& pool, Batch& b) {
     Writer& w = direct ? out : diff_bufs[f.doc];
 
     if (op.action >= A_MAKE_MAP) {
+      if (b.no_patch) continue;   // creation happened in prepass
       const std::string& ob = render_obj(op.obj);
       const std::string& ty = L_TYPES[make_type(op.action)];
       if (64 + ob.size() + ty.size() <= DiffBuf::CAP) {
@@ -3407,9 +3462,14 @@ static void emit(Pool& pool, Batch& b) {
         prior);
     // path rendered AFTER the mirror update (the reference computes it
     // inside updateMapKey/updateListElement, post inbound maintenance)
-    // but BEFORE this op's visibility mutation
-    const std::vector<u8>& path_bytes = render_path(f.doc, st, op.obj);
-    const std::string& obj_bytes = render_obj(op.obj);
+    // but BEFORE this op's visibility mutation.  The no-patch load
+    // path renders nothing -- the bytes are never read.
+    static const std::vector<u8> kNoPath;
+    static const std::string kNoObj;
+    const std::vector<u8>& path_bytes =
+        b.no_patch ? kNoPath : render_path(f.doc, st, op.obj);
+    const std::string& obj_bytes =
+        b.no_patch ? kNoObj : render_obj(op.obj);
     if (is_list_type(obj_type)) {
       // host-full: the list index is the in-emit Fenwick prefix count
       // (same contract as the dominance kernels: visible lower-ranked
@@ -3442,10 +3502,13 @@ static void emit(Pool& pool, Batch& b) {
             hf->fen.prefix(b.rank_host[hf->base + heidx]);
         vis_pre = arp->visible[heidx];
       }
-      if (emit_list_diff(w, pool, *arp, op, ereg,
-                         static_cast<i64>(op_idx), b,
-                         obj_type, path_bytes, obj_bytes))
+      if (b.no_patch) {
+        apply_list_visibility(*arp, ereg, static_cast<i64>(op_idx), b);
+      } else if (emit_list_diff(w, pool, *arp, op, ereg,
+                                static_cast<i64>(op_idx), b,
+                                obj_type, path_bytes, obj_bytes)) {
         diff_counts[f.doc]++;
+      }
       if (hf != nullptr) {
         u8 vis_post = arp->visible[heidx];
         if (vis_post != vis_pre)
@@ -3453,7 +3516,7 @@ static void emit(Pool& pool, Batch& b) {
                       static_cast<i32>(vis_post) -
                           static_cast<i32>(vis_pre));
       }
-    } else {
+    } else if (!b.no_patch) {
       emit_map_diff(w, pool, st, op, ereg, obj_type, path_bytes,
                     obj_bytes);
       diff_counts[f.doc]++;
@@ -3481,6 +3544,10 @@ static void emit(Pool& pool, Batch& b) {
   }
 
   // assemble {doc_id: patch}
+  if (b.no_patch) {
+    b.result.clear();
+    return;
+  }
   if (direct) {
     if (cur_doc != ~0u) close_run(cur_doc);
     // zero-op docs (duplicate-only deliveries, queued-only changes)
@@ -3717,6 +3784,1334 @@ static bool message_is_nil(const ChangeRec& ch) {
   return !ch.has_message ||
          (ch.message.size() == 1 && ch.message[0] == 0xc0);
 }
+
+// ===========================================================================
+// Native columnar change codec (ISSUE 14 tentpole; docs/STORAGE.md).
+//
+// A C++ mirror of automerge_tpu/storage/columnar.py: the SAME wire
+// format (AMTC v1 -- string table, interned change/op shapes, RLE'd
+// shape columns, delta columns, typed value columns, residual column,
+// whole-body zlib), with the byte-round-trip guarantee enforced the
+// same way -- a change is only columnarized when this file's own
+// canonical msgpack writer reproduces its exact input bytes; anything
+// else rides the residual column verbatim.  The canonicality test here
+// is deliberately CONSERVATIVE relative to the Python encoder (ext
+// types, non-string map keys, very deep nesting all go residual):
+// residual never breaks parity, it only costs compression, and every
+// blob either codec writes decodes byte-identically on both sides.
+//
+// Decode is ARENA-DIRECT: amtpu_begin_columnar materializes the
+// columns straight into ChangeRec state (canonical raw bytes rebuilt
+// into one slab per blob, then the standard decode_change/begin_phases
+// pipeline) without any Python change dicts -- the 1M-doc cold-start
+// fast path.  AMTPU_STORAGE_NATIVE=0 keeps the Python codec as the
+// parity oracle.
+// ===========================================================================
+
+namespace colnr {
+
+using u128 = unsigned __int128;
+using i128 = __int128;
+
+static const int COL_VERSION = 1;
+static const u8 COL_FLAG_ZLIB = 1;
+// change-shape id 0 is reserved for residual (verbatim) changes
+enum {
+  V_INT = 0, V_STR = 1, V_TRUE = 2, V_FALSE = 3, V_NULL = 4,
+  V_FLOAT = 5, V_MSGPACK = 6, V_BIN = 7
+};
+enum { K_STR = 0, K_ELEM = 1 };
+
+static Error corrupt(const std::string& what) {
+  // RangeError kind: the Python wrapper maps it to decode_columnar's
+  // ValueError contract
+  return Error(1, "corrupt columnar blob: " + what);
+}
+
+static void put_uvarint(std::vector<u8>& out, u128 n) {
+  while (true) {
+    u8 b = static_cast<u8>(n & 0x7f);
+    n >>= 7;
+    if (n) {
+      out.push_back(b | 0x80);
+    } else {
+      out.push_back(b);
+      return;
+    }
+  }
+}
+
+// sign-fold zigzag over (neg, mag): mirrors columnar.py's _zz_fold on
+// unbounded ints -- wire msgpack bounds mag at 2^64, so u128 holds the
+// folded value exactly
+static u128 zz_fold(bool neg, u64 mag) {
+  return neg ? (static_cast<u128>(mag) << 1) - 1
+             : static_cast<u128>(mag) << 1;
+}
+static void put_zigzag(std::vector<u8>& out, i128 v) {
+  u128 z = v < 0 ? ((static_cast<u128>(-(v + 1)) + 1) << 1) - 1
+                 : static_cast<u128>(v) << 1;
+  put_uvarint(out, z);
+}
+
+struct ColReader {
+  const u8* p;
+  const u8* end;
+  ColReader(const u8* d, size_t n) : p(d), end(d + n) {}
+  bool ok() const { return p != nullptr; }
+  u128 uvarint() {
+    u128 n = 0;
+    int shift = 0;
+    while (true) {
+      if (p >= end) throw corrupt("truncated varint");
+      u8 b = *p++;
+      if (shift >= 121) throw corrupt("varint overflow");
+      n |= static_cast<u128>(b & 0x7f) << shift;
+      if (!(b & 0x80)) return n;
+      shift += 7;
+    }
+  }
+  u64 uvarint64() {
+    u128 n = uvarint();
+    if (n >> 64) throw corrupt("varint out of range");
+    return static_cast<u64>(n);
+  }
+  i128 zigzag() {
+    u128 n = uvarint();
+    return (n & 1) ? -static_cast<i128>(n >> 1) - 1
+                   : static_cast<i128>(n >> 1);
+  }
+  const u8* take(size_t n) {
+    if (static_cast<size_t>(end - p) < n)
+      throw corrupt("truncated section");
+    const u8* out = p;
+    p += n;
+    return out;
+  }
+  u8 byte() {
+    if (p >= end) throw corrupt("truncated section");
+    return *p++;
+  }
+};
+
+static bool utf8_valid(const u8* s, size_t n) {
+  size_t i = 0;
+  while (i < n) {
+    u8 c = s[i];
+    if (c < 0x80) { ++i; continue; }
+    int len;
+    u32 cp, min;
+    if ((c & 0xe0) == 0xc0) { len = 2; cp = c & 0x1f; min = 0x80; }
+    else if ((c & 0xf0) == 0xe0) { len = 3; cp = c & 0x0f; min = 0x800; }
+    else if ((c & 0xf8) == 0xf0) { len = 4; cp = c & 0x07; min = 0x10000; }
+    else return false;
+    if (i + len > n) return false;
+    for (int j = 1; j < len; ++j) {
+      if ((s[i + j] & 0xc0) != 0x80) return false;
+      cp = (cp << 6) | (s[i + j] & 0x3f);
+    }
+    if (cp < min || cp > 0x10ffff) return false;
+    if (cp >= 0xd800 && cp <= 0xdfff) return false;  // surrogates
+    i += len;
+  }
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// canonical re-encoder: walks one msgpack value and emits the canonical
+// form msgpack-python's packb(unpackb(raw)) would produce.  Returns
+// false (without a defined writer state) for anything outside the
+// conservative canonical subset: ext types, non-string or duplicate map
+// keys, invalid utf-8, nesting past the depth cap.  float32 values
+// re-encode as float64 (what Python unpack->pack does), so their bytes
+// differ from the input and the compare in canonical_ok sends the
+// change residual -- exactly the Python behavior.
+// ---------------------------------------------------------------------------
+
+static const int CANON_MAX_DEPTH = 192;
+
+// parsed int as (neg, mag): mag is |v| for neg, v for non-neg
+struct IntVal { bool neg; u64 mag; };
+
+static void put_canon_int(Writer& w, const IntVal& v) {
+  if (!v.neg) {
+    w.uinteger(v.mag);
+  } else {
+    // mag <= 2^63 by wire construction
+    w.integer(-static_cast<i64>(v.mag - 1) - 1);
+  }
+}
+
+static bool canon_value(const u8*& p, const u8* end, Writer& w,
+                        int depth);
+
+static bool canon_read_uint(const u8*& p, const u8* end, size_t width,
+                            u64* out) {
+  if (static_cast<size_t>(end - p) < width) return false;
+  u64 v = 0;
+  for (size_t i = 0; i < width; ++i) v = (v << 8) | *p++;
+  *out = v;
+  return true;
+}
+
+// reads one int value (any wire width) as (neg, mag); false = not an
+// int tag / truncated
+static bool canon_read_int(const u8*& p, const u8* end, IntVal* out) {
+  if (p >= end) return false;
+  u8 b = *p++;
+  u64 v;
+  if (b <= 0x7f) { *out = {false, b}; return true; }
+  if (b >= 0xe0) {
+    *out = {true, static_cast<u64>(-static_cast<i64>(static_cast<int8_t>(b)))};
+    return true;
+  }
+  switch (b) {
+    case 0xcc: if (!canon_read_uint(p, end, 1, &v)) return false;
+               *out = {false, v}; return true;
+    case 0xcd: if (!canon_read_uint(p, end, 2, &v)) return false;
+               *out = {false, v}; return true;
+    case 0xce: if (!canon_read_uint(p, end, 4, &v)) return false;
+               *out = {false, v}; return true;
+    case 0xcf: if (!canon_read_uint(p, end, 8, &v)) return false;
+               *out = {false, v}; return true;
+    case 0xd0: case 0xd1: case 0xd2: case 0xd3: {
+      size_t width = size_t(1) << (b - 0xd0);
+      if (!canon_read_uint(p, end, width, &v)) return false;
+      i64 sv;
+      if (b == 0xd0) sv = static_cast<int8_t>(v);
+      else if (b == 0xd1) sv = static_cast<int16_t>(v);
+      else if (b == 0xd2) sv = static_cast<int32_t>(v);
+      else sv = static_cast<i64>(v);
+      if (sv >= 0) *out = {false, static_cast<u64>(sv)};
+      else *out = {true, static_cast<u64>(-(sv + 1)) + 1};
+      return true;
+    }
+    default: --p; return false;
+  }
+}
+
+// str header; false when not a str tag
+static bool canon_read_strhdr(const u8*& p, const u8* end, size_t* n) {
+  if (p >= end) return false;
+  u8 b = *p++;
+  u64 v;
+  if ((b & 0xe0) == 0xa0) { *n = b & 0x1f; return true; }
+  if (b == 0xd9) { if (!canon_read_uint(p, end, 1, &v)) return false;
+                   *n = v; return true; }
+  if (b == 0xda) { if (!canon_read_uint(p, end, 2, &v)) return false;
+                   *n = v; return true; }
+  if (b == 0xdb) { if (!canon_read_uint(p, end, 4, &v)) return false;
+                   *n = v; return true; }
+  --p;
+  return false;
+}
+
+static bool canon_value(const u8*& p, const u8* end, Writer& w,
+                        int depth) {
+  if (depth > CANON_MAX_DEPTH || p >= end) return false;
+  u8 b = *p;
+  // int family
+  if (b <= 0x7f || b >= 0xe0 || (b >= 0xcc && b <= 0xd3)) {
+    IntVal v;
+    if (!canon_read_int(p, end, &v)) return false;
+    put_canon_int(w, v);
+    return true;
+  }
+  // str family
+  if ((b & 0xe0) == 0xa0 || b == 0xd9 || b == 0xda || b == 0xdb) {
+    size_t n;
+    if (!canon_read_strhdr(p, end, &n)) return false;
+    if (static_cast<size_t>(end - p) < n) return false;
+    if (!utf8_valid(p, n)) return false;
+    w.str(reinterpret_cast<const char*>(p), n);
+    p += n;
+    return true;
+  }
+  switch (b) {
+    case 0xc0: ++p; w.nil(); return true;
+    case 0xc2: ++p; w.boolean(false); return true;
+    case 0xc3: ++p; w.boolean(true); return true;
+    case 0xca: {  // float32 -> canonical float64 (bytes will differ)
+      ++p;
+      u64 v;
+      if (!canon_read_uint(p, end, 4, &v)) return false;
+      u32 bits = static_cast<u32>(v);
+      float f;
+      std::memcpy(&f, &bits, 4);
+      w.real(static_cast<double>(f));
+      return true;
+    }
+    case 0xcb: {  // float64: bit-verbatim copy (preserves NaN payloads)
+      if (static_cast<size_t>(end - p) < 9) return false;
+      w.raw(p, 9);
+      p += 9;
+      return true;
+    }
+    case 0xc4: case 0xc5: case 0xc6: {  // bin
+      ++p;
+      u64 n;
+      if (!canon_read_uint(p, end, size_t(1) << (b - 0xc4), &n))
+        return false;
+      if (static_cast<size_t>(end - p) < n) return false;
+      if (n <= 0xff) { w.buf.push_back(0xc4); w.buf.push_back(u8(n)); }
+      else if (n <= 0xffff) {
+        w.buf.push_back(0xc5);
+        w.buf.push_back(u8(n >> 8));
+        w.buf.push_back(u8(n & 0xff));
+      } else {
+        w.buf.push_back(0xc6);
+        for (int i = 3; i >= 0; --i)
+          w.buf.push_back(u8((n >> (8 * i)) & 0xff));
+      }
+      w.raw(p, n);
+      p += n;
+      return true;
+    }
+    default: break;
+  }
+  if ((b & 0xf0) == 0x90 || b == 0xdc || b == 0xdd) {  // array
+    ++p;
+    u64 n;
+    if ((b & 0xf0) == 0x90) n = b & 0x0f;
+    else if (!canon_read_uint(p, end, b == 0xdc ? 2 : 4, &n))
+      return false;
+    w.array(n);
+    for (u64 i = 0; i < n; ++i)
+      if (!canon_value(p, end, w, depth + 1)) return false;
+    return true;
+  }
+  if ((b & 0xf0) == 0x80 || b == 0xde || b == 0xdf) {  // map
+    ++p;
+    u64 n;
+    if ((b & 0xf0) == 0x80) n = b & 0x0f;
+    else if (!canon_read_uint(p, end, b == 0xde ? 2 : 4, &n))
+      return false;
+    w.map(n);
+    // conservative: keys must be unique STRINGS (a duplicate or
+    // non-string key would collapse/reorder through Python's dict and
+    // break cross-codec decode parity)
+    std::vector<std::string_view> keys;
+    keys.reserve(n < 64 ? n : 64);
+    for (u64 i = 0; i < n; ++i) {
+      size_t kn;
+      if (!canon_read_strhdr(p, end, &kn)) return false;
+      if (static_cast<size_t>(end - p) < kn) return false;
+      if (!utf8_valid(p, kn)) return false;
+      std::string_view k(reinterpret_cast<const char*>(p), kn);
+      for (auto& seen : keys)
+        if (seen == k) return false;
+      keys.push_back(k);
+      w.str(reinterpret_cast<const char*>(p), kn);
+      p += kn;
+      if (!canon_value(p, end, w, depth + 1)) return false;
+    }
+    return true;
+  }
+  return false;  // ext / reserved tags
+}
+
+// the canonical-writer byte-parity check: true iff this codec's
+// canonical re-encoding reproduces the exact input bytes (the
+// precondition for columnarizing; mirrors columnar.py _canonical)
+static bool canonical_ok(const u8* raw, size_t len, Writer& scratch) {
+  scratch.buf.clear();
+  const u8* p = raw;
+  if (!canon_value(p, raw + len, scratch, 0)) return false;
+  if (p != raw + len) return false;
+  return scratch.buf.size() == len &&
+         std::memcmp(scratch.buf.data(), raw, len) == 0;
+}
+
+// ---------------------------------------------------------------------------
+// encoder
+// ---------------------------------------------------------------------------
+
+struct ColStrings {
+  std::unordered_map<std::string_view, u32> idx;
+  std::deque<std::string> store;   // stable addresses back the views
+  u32 of(std::string_view s) {
+    auto it = idx.find(s);
+    if (it != idx.end()) return it->second;
+    store.emplace_back(s);
+    u32 id = static_cast<u32>(store.size() - 1);
+    idx.emplace(std::string_view(store.back()), id);
+    return id;
+  }
+  void dump(std::vector<u8>& body) const {
+    put_uvarint(body, store.size());
+    for (const std::string& s : store) {
+      put_uvarint(body, s.size());
+      body.insert(body.end(), s.begin(), s.end());
+    }
+  }
+};
+
+struct ColRLE {
+  std::vector<std::pair<u64, u64>> runs;
+  void push(u64 v) {
+    if (!runs.empty() && runs.back().first == v) ++runs.back().second;
+    else runs.emplace_back(v, 1);
+  }
+  void dump(std::vector<u8>& body) const {
+    put_uvarint(body, runs.size());
+    for (auto& [v, c] : runs) {
+      put_uvarint(body, v);
+      put_uvarint(body, c);
+    }
+  }
+};
+
+// one field of a parsed change/op map: key view + raw value span
+struct Field {
+  std::string_view key;
+  const u8* p;
+  size_t len;
+};
+
+struct ColEncoder {
+  ColStrings strings;
+  std::map<std::vector<std::string>, u32> cshapes;   // 1-based ids
+  std::vector<const std::vector<std::string>*> cshape_list;
+  std::map<std::pair<std::vector<std::string>, std::string>, u32> oshapes;
+  std::vector<const std::pair<std::vector<std::string>, std::string>*>
+      oshape_list;
+  ColRLE cshape_col, oshape_col;
+  std::map<std::pair<int, std::string>, std::vector<u8>> cols;
+  std::vector<u8> residuals;
+  i64 n_residual = 0;
+  i64 n_changes = 0;
+  std::unordered_map<u32, i128> last_seq;    // actor idx -> seq
+  std::unordered_map<u32, i128> run_clock;   // actor idx -> max seq
+  i128 last_elem = 0;
+  i128 last_key_elem = 0;
+  Writer canon_scratch;
+  std::vector<Field> fields, op_fields;
+
+  // per-level column cache: the field vocabulary is tiny and fixed,
+  // and the map probe below pays a string construction per field of
+  // every op otherwise (the same cost the decoder's sid caches remove)
+  std::vector<std::pair<std::string, std::vector<u8>*>> col_cache[2];
+
+  std::vector<u8>& col(int level, std::string_view name) {
+    auto& cache = col_cache[level ? 1 : 0];
+    for (auto& [n, ptr] : cache)
+      if (n == name) return *ptr;
+    auto it = cols.find({level, std::string(name)});
+    if (it == cols.end())
+      it = cols.emplace(std::make_pair(level, std::string(name)),
+                        std::vector<u8>()).first;
+    // std::map nodes are stable: the cached pointer survives inserts
+    cache.emplace_back(std::string(name), &it->second);
+    return it->second;
+  }
+
+  void add_residual(const u8* raw, size_t len) {
+    cshape_col.push(0);
+    put_uvarint(residuals, len);
+    residuals.insert(residuals.end(), raw, raw + len);
+    ++n_residual;
+    ++n_changes;
+  }
+
+  // parses one map value into ordered (key, value-span) fields; false
+  // when not a map / keys not strings (callers then go residual)
+  static bool parse_fields(Reader& r, std::vector<Field>& out) {
+    out.clear();
+    if (r.peek_type() != Type::Map) return false;
+    size_t n = r.read_map();
+    for (size_t i = 0; i < n; ++i) {
+      if (r.peek_type() != Type::Str) return false;
+      std::string_view k = r.read_str_view();
+      auto span = r.raw_value();
+      out.push_back({k, span.first, span.second});
+    }
+    return true;
+  }
+
+  static bool is_wire_int(const u8* p, size_t len) {
+    if (!len) return false;
+    u8 b = p[0];
+    return b <= 0x7f || b >= 0xe0 || (b >= 0xcc && b <= 0xd3);
+  }
+  static bool is_wire_uint(const u8* p, size_t len) {
+    IntVal v;
+    const u8* q = p;
+    return canon_read_int(q, p + len, &v) && !v.neg;
+  }
+  static bool is_wire_str(const u8* p, size_t len) {
+    if (!len) return false;
+    u8 b = p[0];
+    return (b & 0xe0) == 0xa0 || b == 0xd9 || b == 0xda || b == 0xdb;
+  }
+
+  // schema checks mirroring _columnarizable/_op_columnarizable: the
+  // typed columns route obj/key/elem BY NAME, so those fields must
+  // hold their schema types
+  bool columnarizable(const std::vector<Field>& fs) {
+    bool has_actor = false, has_seq = false;
+    for (const Field& f : fs) {
+      if (f.key == "actor") {
+        if (!is_wire_str(f.p, f.len)) return false;
+        has_actor = true;
+      } else if (f.key == "seq") {
+        if (!is_wire_uint(f.p, f.len)) return false;
+        has_seq = true;
+      } else if (f.key == "deps") {
+        Reader r(f.p, f.len);
+        if (r.peek_type() != Type::Map) return false;
+        size_t n = r.read_map();
+        for (size_t i = 0; i < n; ++i) {
+          if (r.peek_type() != Type::Str) return false;
+          r.read_str_view();
+          if (r.peek_type() != Type::Int) return false;
+          r.skip();
+        }
+      } else if (f.key == "ops") {
+        Reader r(f.p, f.len);
+        if (r.peek_type() != Type::Array) return false;
+        size_t n = r.read_array();
+        for (size_t i = 0; i < n; ++i) {
+          if (!parse_fields(r, op_fields)) return false;
+          bool has_action = false;
+          for (const Field& of : op_fields) {
+            if (of.key == "action") {
+              if (!is_wire_str(of.p, of.len)) return false;
+              has_action = true;
+            } else if (of.key == "obj" || of.key == "key") {
+              if (!is_wire_str(of.p, of.len)) return false;
+            } else if (of.key == "elem") {
+              if (!is_wire_int(of.p, of.len)) return false;
+            }
+          }
+          if (!has_action) return false;
+        }
+      }
+    }
+    return has_actor && has_seq;
+  }
+
+  void value(std::vector<u8>& out, const u8* p, size_t len) {
+    u8 b = p[0];
+    if (b == 0xc3) { out.push_back(V_TRUE); return; }
+    if (b == 0xc2) { out.push_back(V_FALSE); return; }
+    if (b == 0xc0) { out.push_back(V_NULL); return; }
+    if (is_wire_int(p, len)) {
+      IntVal v;
+      const u8* q = p;
+      canon_read_int(q, p + len, &v);
+      out.push_back(V_INT);
+      put_uvarint(out, zz_fold(v.neg, v.mag));
+      return;
+    }
+    if (is_wire_str(p, len)) {
+      Reader r(p, len);
+      out.push_back(V_STR);
+      put_uvarint(out, strings.of(r.read_str_view()));
+      return;
+    }
+    if (b == 0xcb) {  // float64: 8 bytes verbatim
+      out.push_back(V_FLOAT);
+      out.insert(out.end(), p + 1, p + 9);
+      return;
+    }
+    if (b == 0xc4 || b == 0xc5 || b == 0xc6) {
+      Reader r(p, len);
+      auto bv = r.read_bin_view();
+      out.push_back(V_BIN);
+      put_uvarint(out, bv.second);
+      out.insert(out.end(), bv.first, bv.first + bv.second);
+      return;
+    }
+    out.push_back(V_MSGPACK);
+    put_uvarint(out, len);
+    out.insert(out.end(), p, p + len);
+  }
+
+  u32 cshape_of(const std::vector<Field>& fs) {
+    std::vector<std::string> keys;
+    keys.reserve(fs.size());
+    for (const Field& f : fs) keys.emplace_back(f.key);
+    auto it = cshapes.find(keys);
+    if (it != cshapes.end()) return it->second;
+    u32 id = static_cast<u32>(cshape_list.size() + 1);
+    auto ins = cshapes.emplace(std::move(keys), id).first;
+    cshape_list.push_back(&ins->first);
+    return id;
+  }
+
+  u32 oshape_of(const std::vector<Field>& fs, std::string_view action) {
+    std::vector<std::string> keys;
+    keys.reserve(fs.size());
+    for (const Field& f : fs) keys.emplace_back(f.key);
+    std::pair<std::vector<std::string>, std::string> k(
+        std::move(keys), std::string(action));
+    auto it = oshapes.find(k);
+    if (it != oshapes.end()) return it->second;
+    u32 id = static_cast<u32>(oshape_list.size());
+    auto ins = oshapes.emplace(std::move(k), id).first;
+    oshape_list.push_back(&ins->first);
+    return id;
+  }
+
+  // decimal-split rule for op 'key' values: mirrors columnar.py's
+  // rpartition(':') + isdecimal + str(int(tail)) == tail (ASCII digits,
+  // no leading zeros), conservatively bounded to i64 elems
+  static bool split_elem_key(std::string_view v, std::string_view* head,
+                             i64* elem) {
+    size_t pos = v.rfind(':');
+    if (pos == std::string_view::npos || pos == 0 ||
+        pos + 1 >= v.size())
+      return false;
+    std::string_view tail = v.substr(pos + 1);
+    if (tail.size() > 1 && tail[0] == '0') return false;
+    if (tail.size() > 18) return false;   // conservative i64 bound
+    i64 n = 0;
+    for (char c : tail) {
+      if (c < '0' || c > '9') return false;
+      n = n * 10 + (c - '0');
+    }
+    *head = v.substr(0, pos);
+    *elem = n;
+    return true;
+  }
+
+  void add_op(Reader& r) {
+    if (!parse_fields(r, op_fields))
+      throw corrupt("internal: op reparse diverged");  // pre-validated
+    std::string_view action;
+    for (const Field& f : op_fields)
+      if (f.key == "action") {
+        Reader ar(f.p, f.len);
+        action = ar.read_str_view();
+      }
+    oshape_col.push(oshape_of(op_fields, action));
+    for (const Field& f : op_fields) {
+      if (f.key == "action") continue;   // rides the shape id
+      if (f.key == "obj") {
+        Reader vr(f.p, f.len);
+        put_uvarint(col(1, "obj"), strings.of(vr.read_str_view()));
+      } else if (f.key == "elem") {
+        IntVal v;
+        const u8* q = f.p;
+        canon_read_int(q, f.p + f.len, &v);
+        i128 e = v.neg ? -static_cast<i128>(v.mag - 1) - 1
+                       : static_cast<i128>(v.mag);
+        put_zigzag(col(1, "elem"), e - last_elem);
+        last_elem = e;
+      } else if (f.key == "key") {
+        Reader vr(f.p, f.len);
+        std::string_view sv = vr.read_str_view();
+        std::vector<u8>& out = col(1, "key");
+        std::string_view head;
+        i64 elem;
+        if (split_elem_key(sv, &head, &elem)) {
+          out.push_back(K_ELEM);
+          put_uvarint(out, strings.of(head));
+          put_zigzag(out, static_cast<i128>(elem) - last_key_elem);
+          last_key_elem = elem;
+        } else {
+          out.push_back(K_STR);
+          put_uvarint(out, strings.of(sv));
+        }
+      } else {
+        value(col(1, std::string(f.key)), f.p, f.len);
+      }
+    }
+  }
+
+  void add(const u8* raw, size_t len) {
+    if (!canonical_ok(raw, len, canon_scratch)) {
+      add_residual(raw, len);
+      return;
+    }
+    Reader top(raw, len);
+    if (!parse_fields(top, fields) || !columnarizable(fields)) {
+      add_residual(raw, len);
+      return;
+    }
+    ++n_changes;
+    cshape_col.push(cshape_of(fields));
+    // actor interns FIRST (mirrors the Python encoder's table order)
+    u32 actor_i = 0;
+    i128 seq = 0;
+    for (const Field& f : fields) {
+      if (f.key == "actor") {
+        Reader vr(f.p, f.len);
+        actor_i = strings.of(vr.read_str_view());
+      } else if (f.key == "seq") {
+        IntVal v;
+        const u8* q = f.p;
+        canon_read_int(q, f.p + f.len, &v);
+        seq = static_cast<i128>(v.mag);
+      }
+    }
+    for (const Field& f : fields) {
+      if (f.key == "actor") {
+        put_uvarint(col(0, "actor"), actor_i);
+      } else if (f.key == "seq") {
+        auto it = last_seq.find(actor_i);
+        i128 prev = it == last_seq.end() ? 0 : it->second;
+        put_zigzag(col(0, "seq"), seq - prev - 1);
+      } else if (f.key == "deps") {
+        std::vector<u8>& out = col(0, "deps");
+        Reader vr(f.p, f.len);
+        size_t n = vr.read_map();
+        put_uvarint(out, n);
+        for (size_t i = 0; i < n; ++i) {
+          u32 di = strings.of(vr.read_str_view());
+          IntVal v;
+          const u8* q = vr.pos();
+          canon_read_int(q, vr.end(), &v);
+          vr.skip();
+          i128 ds = v.neg ? -static_cast<i128>(v.mag - 1) - 1
+                          : static_cast<i128>(v.mag);
+          auto rit = run_clock.find(di);
+          i128 rc = rit == run_clock.end() ? 0 : rit->second;
+          put_uvarint(out, di);
+          put_zigzag(out, ds - rc);
+        }
+      } else if (f.key == "ops") {
+        Reader vr(f.p, f.len);
+        size_t n = vr.read_array();
+        put_uvarint(col(0, "ops"), n);
+        for (size_t i = 0; i < n; ++i) add_op(vr);
+      } else {
+        value(col(0, std::string(f.key)), f.p, f.len);
+      }
+    }
+    last_seq[actor_i] = seq;
+    auto rit = run_clock.find(actor_i);
+    if (rit == run_clock.end() || seq > rit->second)
+      run_clock[actor_i] = seq;
+  }
+
+  std::vector<u8> dump() {
+    // pre-intern late strings in the Python encoder's exact order:
+    // change-shape keys, op-shape keys + actions, column names
+    for (const auto* keys : cshape_list)
+      for (const std::string& k : *keys) strings.of(k);
+    for (const auto* sh : oshape_list) {
+      for (const std::string& k : sh->first) strings.of(k);
+      strings.of(sh->second);
+    }
+    for (const auto& [lk, _] : cols) strings.of(lk.second);
+    std::vector<u8> body;
+    put_uvarint(body, n_changes);
+    strings.dump(body);
+    put_uvarint(body, cshape_list.size());
+    for (const auto* keys : cshape_list) {
+      put_uvarint(body, keys->size());
+      for (const std::string& k : *keys) put_uvarint(body, strings.of(k));
+    }
+    put_uvarint(body, oshape_list.size());
+    for (const auto* sh : oshape_list) {
+      put_uvarint(body, sh->first.size());
+      for (const std::string& k : sh->first)
+        put_uvarint(body, strings.of(k));
+      put_uvarint(body, strings.of(sh->second));
+    }
+    cshape_col.dump(body);
+    oshape_col.dump(body);
+    put_uvarint(body, cols.size());
+    for (const auto& [lk, c] : cols) {   // std::map: sorted (level, name)
+      body.push_back(static_cast<u8>(lk.first));
+      put_uvarint(body, strings.of(lk.second));
+      put_uvarint(body, c.size());
+      body.insert(body.end(), c.begin(), c.end());
+    }
+    put_uvarint(body, residuals.size());
+    body.insert(body.end(), residuals.begin(), residuals.end());
+    // whole-body zlib (level 6, same as the Python codec); store raw
+    // when incompressible
+    uLongf bound = compressBound(static_cast<uLong>(body.size()));
+    std::vector<u8> packed(bound);
+    int rc = compress2(packed.data(), &bound, body.data(),
+                       static_cast<uLong>(body.size()), 6);
+    u8 flags = COL_FLAG_ZLIB;
+    if (rc != Z_OK || bound >= body.size()) {
+      packed = std::move(body);
+      flags = 0;
+    } else {
+      packed.resize(bound);
+    }
+    std::vector<u8> out;
+    out.reserve(packed.size() + 6);
+    out.push_back('A'); out.push_back('M');
+    out.push_back('T'); out.push_back('C');
+    out.push_back(COL_VERSION);
+    out.push_back(flags);
+    out.insert(out.end(), packed.begin(), packed.end());
+    return out;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// decoder: columns -> canonical raw change bytes, appended to one slab
+// ---------------------------------------------------------------------------
+
+static std::string i128_str(i128 v) {
+  if (v >= INT64_MIN && v <= INT64_MAX)
+    return std::to_string(static_cast<i64>(v));
+  bool neg = v < 0;
+  u128 m = neg ? static_cast<u128>(-(v + 1)) + 1 : static_cast<u128>(v);
+  std::string s;
+  while (m) {
+    s.push_back('0' + static_cast<char>(m % 10));
+    m /= 10;
+  }
+  if (neg) s.push_back('-');
+  std::reverse(s.begin(), s.end());
+  return s;
+}
+
+static void put_canon_i128(Writer& w, i128 v) {
+  if (v >= 0) {
+    if (v >> 64) throw corrupt("integer out of range");
+    w.uinteger(static_cast<u64>(v));
+  } else {
+    if (v < static_cast<i128>(INT64_MIN))
+      throw corrupt("integer out of range");
+    w.integer(static_cast<i64>(v));
+  }
+}
+
+// one reusable zlib inflater per thread: cold restarts decode
+// thousands of small blobs, and a fresh inflateInit per blob is
+// alloc-heavy (the ~40 KB inflate state)
+struct Inflater {
+  z_stream zs{};
+  bool live = false;
+  ~Inflater() {
+    if (live) inflateEnd(&zs);
+  }
+};
+
+static void inflate_body(const u8* in, size_t n, std::vector<u8>& out) {
+  static thread_local Inflater inf;
+  if (!inf.live) {
+    if (inflateInit(&inf.zs) != Z_OK) throw corrupt("zlib init failed");
+    inf.live = true;
+  } else if (inflateReset(&inf.zs) != Z_OK) {
+    throw corrupt("zlib reset failed");
+  }
+  inf.zs.next_in = const_cast<u8*>(in);
+  inf.zs.avail_in = static_cast<uInt>(n);
+  out.resize(std::max<size_t>(n * 4, 1 << 12));
+  size_t have = 0;
+  int rc;
+  do {
+    if (have == out.size()) out.resize(out.size() * 2);
+    inf.zs.next_out = out.data() + have;
+    inf.zs.avail_out = static_cast<uInt>(out.size() - have);
+    rc = inflate(&inf.zs, Z_NO_FLUSH);
+    have = out.size() - inf.zs.avail_out;
+    if (rc != Z_OK && rc != Z_STREAM_END)
+      throw corrupt("zlib inflate failed");
+  } while (rc != Z_STREAM_END);
+  out.resize(have);
+}
+
+struct ColDecoder {
+  std::vector<u8> body_store;    // inflated body (columns point into it)
+  size_t n_changes = 0;
+  std::vector<std::string> strings;
+  std::vector<std::vector<u32>> cshapes;               // key string ids
+  std::vector<std::pair<std::vector<u32>, u32>> oshapes;
+  std::vector<u64> cshape_ids;
+  std::vector<u64> oshape_ids;
+  size_t oshape_cursor = 0;
+  std::map<std::pair<int, std::string>, ColReader> cols;
+  ColReader residuals{nullptr, 0};
+  std::unordered_map<u32, i128> last_seq, run_clock;
+  i128 last_elem = 0, last_key_elem = 0;
+  // hot-path caches: per-field column lookups by STRING ID instead of
+  // a map probe with a string construction per field (the cold-start
+  // profile's largest single cost); special keys compare as sids
+  static constexpr u32 NOSID = 0xffffffffu;
+  u32 sid_actor = NOSID, sid_seq = NOSID, sid_deps = NOSID,
+      sid_ops = NOSID, sid_action = NOSID, sid_obj = NOSID,
+      sid_elem = NOSID, sid_key = NOSID, sid_value = NOSID,
+      sid_datatype = NOSID, sid_message = NOSID;
+  // fused arena-direct state: blob string id -> pool intern sid, and
+  // per-oshape parsed action enums (0xfe = not parsed yet)
+  std::vector<u32> psid_cache;
+  std::vector<u8> oshape_action;
+  std::vector<ColReader*> c0_cache, c1_cache;
+  ColReader* actor_col = nullptr;
+  ColReader* seq_col = nullptr;
+  ColReader* deps_col = nullptr;
+  ColReader* ops_col = nullptr;
+  ColReader* obj_col = nullptr;
+  ColReader* elem_col = nullptr;
+  ColReader* key_col = nullptr;
+
+  const std::string& str_at(u64 i) const {
+    if (i >= strings.size()) throw corrupt("string index out of range");
+    return strings[static_cast<size_t>(i)];
+  }
+
+  ColReader* ccol(int level, u32 sid) {
+    auto& cache = level ? c1_cache : c0_cache;
+    ColReader*& slot = cache[sid];
+    if (!slot) slot = &col(level, strings[sid]);
+    return slot;
+  }
+
+  explicit ColDecoder(const u8* blob, size_t len) {
+    if (len < 6 || std::memcmp(blob, "AMTC", 4) != 0)
+      throw corrupt("not a columnar change blob (bad magic)");
+    if (blob[4] != COL_VERSION)
+      throw corrupt("unsupported columnar version " +
+                    std::to_string(blob[4]));
+    if (blob[5] & COL_FLAG_ZLIB) {
+      inflate_body(blob + 6, len - 6, body_store);
+    } else {
+      body_store.assign(blob + 6, blob + len);
+    }
+    ColReader r(body_store.data(), body_store.size());
+    n_changes = static_cast<size_t>(r.uvarint64());
+    size_t n_strs = static_cast<size_t>(r.uvarint64());
+    strings.reserve(std::min(n_strs,
+                             body_store.size() / 2 + 1));
+    for (size_t i = 0; i < n_strs; ++i) {
+      size_t n = static_cast<size_t>(r.uvarint64());
+      const u8* p = r.take(n);
+      if (!utf8_valid(p, n)) throw corrupt("invalid utf-8 in table");
+      strings.emplace_back(reinterpret_cast<const char*>(p), n);
+    }
+    size_t n_cshapes = static_cast<size_t>(r.uvarint64());
+    for (size_t i = 0; i < n_cshapes; ++i) {
+      size_t k = static_cast<size_t>(r.uvarint64());
+      std::vector<u32> keys;
+      keys.reserve(std::min<size_t>(k, 64));
+      for (size_t j = 0; j < k; ++j) {
+        u64 si = r.uvarint64();
+        str_at(si);
+        keys.push_back(static_cast<u32>(si));
+      }
+      cshapes.push_back(std::move(keys));
+    }
+    size_t n_oshapes = static_cast<size_t>(r.uvarint64());
+    for (size_t i = 0; i < n_oshapes; ++i) {
+      size_t k = static_cast<size_t>(r.uvarint64());
+      std::vector<u32> keys;
+      keys.reserve(std::min<size_t>(k, 64));
+      for (size_t j = 0; j < k; ++j) {
+        u64 si = r.uvarint64();
+        str_at(si);
+        keys.push_back(static_cast<u32>(si));
+      }
+      u64 ai = r.uvarint64();
+      str_at(ai);
+      oshapes.emplace_back(std::move(keys), static_cast<u32>(ai));
+    }
+    auto expand = [&](std::vector<u64>& out) {
+      size_t n_runs = static_cast<size_t>(r.uvarint64());
+      for (size_t i = 0; i < n_runs; ++i) {
+        u64 v = r.uvarint64();
+        u64 c = r.uvarint64();
+        if (out.size() + c > body_store.size() * 8 + n_changes + 64)
+          throw corrupt("RLE run count implausible");
+        for (u64 j = 0; j < c; ++j) out.push_back(v);
+      }
+    };
+    expand(cshape_ids);
+    expand(oshape_ids);
+    size_t n_cols = static_cast<size_t>(r.uvarint64());
+    for (size_t i = 0; i < n_cols; ++i) {
+      int level = r.byte();
+      const std::string& name = str_at(r.uvarint64());
+      size_t n = static_cast<size_t>(r.uvarint64());
+      const u8* p = r.take(n);
+      cols.emplace(std::make_pair(level, name), ColReader(p, n));
+    }
+    size_t rn = static_cast<size_t>(r.uvarint64());
+    const u8* rp = r.take(rn);
+    residuals = ColReader(rp, rn);
+    c0_cache.assign(strings.size(), nullptr);
+    c1_cache.assign(strings.size(), nullptr);
+    for (size_t i = 0; i < strings.size(); ++i) {
+      const std::string& s = strings[i];
+      if (s == "actor") sid_actor = static_cast<u32>(i);
+      else if (s == "seq") sid_seq = static_cast<u32>(i);
+      else if (s == "deps") sid_deps = static_cast<u32>(i);
+      else if (s == "ops") sid_ops = static_cast<u32>(i);
+      else if (s == "action") sid_action = static_cast<u32>(i);
+      else if (s == "obj") sid_obj = static_cast<u32>(i);
+      else if (s == "elem") sid_elem = static_cast<u32>(i);
+      else if (s == "key") sid_key = static_cast<u32>(i);
+      else if (s == "value") sid_value = static_cast<u32>(i);
+      else if (s == "datatype") sid_datatype = static_cast<u32>(i);
+      else if (s == "message") sid_message = static_cast<u32>(i);
+    }
+  }
+
+  u32 psid(Pool& pool, u64 i) {
+    u32& slot = psid_cache[static_cast<size_t>(i)];
+    if (slot == NOSID) slot = pool.intern.id_of(strings[i]);
+    return slot;
+  }
+
+  ColReader& col(int level, const std::string& name) {
+    auto it = cols.find({level, name});
+    if (it == cols.end())
+      throw corrupt("missing column " + name);
+    return it->second;
+  }
+
+  void write_value(Writer& w, ColReader& r) {
+    u8 tag = r.byte();
+    switch (tag) {
+      case V_TRUE: w.boolean(true); return;
+      case V_FALSE: w.boolean(false); return;
+      case V_NULL: w.nil(); return;
+      case V_INT: {
+        u128 n = r.uvarint();
+        i128 v = (n & 1) ? -static_cast<i128>(n >> 1) - 1
+                         : static_cast<i128>(n >> 1);
+        put_canon_i128(w, v);
+        return;
+      }
+      case V_STR: w.str(str_at(r.uvarint64())); return;
+      case V_FLOAT: {
+        const u8* p = r.take(8);
+        w.buf.push_back(0xcb);
+        w.raw(p, 8);
+        return;
+      }
+      case V_BIN: {
+        size_t n = static_cast<size_t>(r.uvarint64());
+        const u8* p = r.take(n);
+        if (n <= 0xff) {
+          w.buf.push_back(0xc4);
+          w.buf.push_back(static_cast<u8>(n));
+        } else if (n <= 0xffff) {
+          w.buf.push_back(0xc5);
+          w.buf.push_back(static_cast<u8>(n >> 8));
+          w.buf.push_back(static_cast<u8>(n & 0xff));
+        } else {
+          w.buf.push_back(0xc6);
+          for (int i = 3; i >= 0; --i)
+            w.buf.push_back(static_cast<u8>((n >> (8 * i)) & 0xff));
+        }
+        w.raw(p, n);
+        return;
+      }
+      case V_MSGPACK: {
+        size_t n = static_cast<size_t>(r.uvarint64());
+        w.raw(r.take(n), n);
+        return;
+      }
+      default: throw corrupt("bad value tag " + std::to_string(tag));
+    }
+  }
+
+  void write_op(Writer& w) {
+    if (oshape_cursor >= oshape_ids.size())
+      throw corrupt("op shape column exhausted");
+    u64 sid = oshape_ids[oshape_cursor++];
+    if (sid >= oshapes.size()) throw corrupt("op shape id out of range");
+    auto& [keys, action] = oshapes[static_cast<size_t>(sid)];
+    w.map(keys.size());
+    for (u32 k : keys) {
+      w.str(strings[k]);
+      if (k == sid_action) {
+        w.str(strings[action]);
+      } else if (k == sid_obj) {
+        if (!obj_col) obj_col = &col(1, "obj");
+        w.str(str_at(obj_col->uvarint64()));
+      } else if (k == sid_elem) {
+        if (!elem_col) elem_col = &col(1, "elem");
+        last_elem += elem_col->zigzag();
+        put_canon_i128(w, last_elem);
+      } else if (k == sid_key) {
+        if (!key_col) key_col = &col(1, "key");
+        ColReader& r = *key_col;
+        u8 tag = r.byte();
+        if (tag == K_ELEM) {
+          const std::string& head = str_at(r.uvarint64());
+          last_key_elem += r.zigzag();
+          w.str(head + ":" + i128_str(last_key_elem));
+        } else if (tag == K_STR) {
+          w.str(str_at(r.uvarint64()));
+        } else {
+          throw corrupt("bad key tag " + std::to_string(tag));
+        }
+      } else {
+        write_value(w, *ccol(1, k));
+      }
+    }
+  }
+
+  // ---- fused arena-direct decode (amtpu_begin_columnar) -------------
+  // Builds each change's canonical raw bytes AND its ChangeRec in ONE
+  // column walk -- no second msgpack parse.  Field semantics mirror
+  // decode_change/decode_op exactly (intern routing, the single-char
+  // value table, last-wins casts); the decode-parity lanes pin the
+  // output byte-identical to the dict-replay path.
+
+  OpRec fused_op(Pool& pool, Writer& w, u32 ch_actor, u32 ch_seq,
+                 std::string& ekey_buf, u32& ekey_sid) {
+    if (oshape_cursor >= oshape_ids.size())
+      throw corrupt("op shape column exhausted");
+    u64 sid = oshape_ids[oshape_cursor++];
+    if (sid >= oshapes.size()) throw corrupt("op shape id out of range");
+    auto& [keys, action] = oshapes[static_cast<size_t>(sid)];
+    u8& act = oshape_action[static_cast<size_t>(sid)];
+    if (act == 0xfe) act = parse_action_sv(strings[action]);
+    OpRec op;
+    op.action = act;
+    op.obj = NONE; op.key = NONE; op.elem = -1;
+    op.actor = ch_actor; op.seq = ch_seq;
+    op.datatype = NONE; op.value_rid = NONE; op.value_sid = NONE;
+    w.map(keys.size());
+    for (u32 k : keys) {
+      w.str(strings[k]);
+      if (k == sid_action) {
+        w.str(strings[action]);
+      } else if (k == sid_obj) {
+        if (!obj_col) obj_col = &col(1, "obj");
+        u64 oi = obj_col->uvarint64();
+        w.str(str_at(oi));
+        op.obj = psid(pool, oi);
+      } else if (k == sid_elem) {
+        if (!elem_col) elem_col = &col(1, "elem");
+        last_elem += elem_col->zigzag();
+        put_canon_i128(w, last_elem);
+        // same cast chain as decode_op's r.read_int() (i64 via u64)
+        op.elem = static_cast<i64>(static_cast<u64>(last_elem));
+      } else if (k == sid_key) {
+        if (!key_col) key_col = &col(1, "key");
+        ColReader& r = *key_col;
+        u8 tag = r.byte();
+        if (tag == K_ELEM) {
+          const std::string& head = str_at(r.uvarint64());
+          last_key_elem += r.zigzag();
+          std::string key_s = head + ":" + i128_str(last_key_elem);
+          w.str(key_s);
+          // set-then-ins interns each elemId key twice in a row
+          if (ekey_sid == NOSID || key_s != ekey_buf) {
+            ekey_sid = pool.intern.id_of(key_s);
+            ekey_buf = std::move(key_s);
+          }
+          op.key = ekey_sid;
+        } else if (tag == K_STR) {
+          u64 ki = r.uvarint64();
+          w.str(str_at(ki));
+          op.key = psid(pool, ki);
+        } else {
+          throw corrupt("bad key tag " + std::to_string(tag));
+        }
+      } else if (k == sid_value) {
+        ColReader& r = *ccol(1, k);
+        u8 tag = r.p < r.end ? *r.p : 0xff;
+        if (tag == V_STR) {
+          ++r.p;
+          u64 vi = r.uvarint64();
+          const std::string& s = str_at(vi);
+          size_t voff = w.buf.size();
+          w.str(s);
+          std::string_view raw(
+              reinterpret_cast<const char*>(w.buf.data() + voff),
+              w.buf.size() - voff);
+          if (s.size() == 1) {
+            u8 c = static_cast<u8>(s[0]);
+            if (pool.char_sid[c] == NONE) {
+              pool.char_sid[c] = pool.intern.id_of(s);
+              pool.char_rid[c] = pool.vals.id_of(raw);
+            }
+            op.value_sid = pool.char_sid[c];
+            op.value_rid = pool.char_rid[c];
+          } else {
+            op.value_sid = psid(pool, vi);
+            op.value_rid = pool.vals.id_of(raw);
+          }
+        } else {
+          size_t voff = w.buf.size();
+          write_value(w, r);
+          op.value_rid = pool.vals.id_of(std::string_view(
+              reinterpret_cast<const char*>(w.buf.data() + voff),
+              w.buf.size() - voff));
+        }
+      } else if (k == sid_datatype) {
+        ColReader& r = *ccol(1, k);
+        u8 tag = r.p < r.end ? *r.p : 0xff;
+        if (tag == V_STR) {
+          ++r.p;
+          u64 di = r.uvarint64();
+          w.str(str_at(di));
+          op.datatype = psid(pool, di);
+        } else {
+          // non-string datatype cannot come from either encoder's
+          // schema check; decode generically (decode_op would skip it)
+          write_value(w, r);
+        }
+      } else {
+        write_value(w, *ccol(1, k));
+      }
+    }
+    return op;
+  }
+
+  void decode_changes(Pool& pool,
+                      const std::shared_ptr<std::vector<u8>>& slab,
+                      std::vector<ChangeRec>& out) {
+    std::vector<u8>& sl = *slab;
+    Writer w;
+    psid_cache.assign(strings.size(), NOSID);
+    oshape_action.assign(oshapes.size(), 0xfe);
+    std::string ekey_buf;
+    u32 ekey_sid = NOSID;
+    out.reserve(out.size() + cshape_ids.size());
+    for (u64 sid : cshape_ids) {
+      if (sid == 0) {   // residual: verbatim bytes, generic decode
+        size_t n = static_cast<size_t>(residuals.uvarint64());
+        const u8* p = residuals.take(n);
+        size_t off = sl.size();
+        sl.insert(sl.end(), p, p + n);
+        // fresh DecodeCache per residual: the shared-cache views would
+        // dangle across this slab's later growth
+        Reader cr(sl.data() + off, n);
+        out.push_back(decode_change(cr, pool, slab));
+        continue;
+      }
+      if (sid > cshapes.size()) throw corrupt("shape id out of range");
+      const std::vector<u32>& keys = cshapes[static_cast<size_t>(sid - 1)];
+      w.buf.clear();
+      if (!actor_col) actor_col = &col(0, "actor");
+      if (!seq_col) seq_col = &col(0, "seq");
+      u64 actor_i = actor_col->uvarint64();
+      str_at(actor_i);
+      i128 d = seq_col->zigzag();
+      auto lit = last_seq.find(static_cast<u32>(actor_i));
+      i128 seq = (lit == last_seq.end() ? 0 : lit->second) + 1 + d;
+      ChangeRec ch;
+      ch.actor = psid(pool, actor_i);
+      ch.seq = static_cast<u32>(static_cast<u64>(seq));
+      w.map(keys.size());
+      for (u32 k : keys) {
+        w.str(strings[k]);
+        if (k == sid_actor) {
+          w.str(strings[static_cast<size_t>(actor_i)]);
+        } else if (k == sid_seq) {
+          put_canon_i128(w, seq);
+        } else if (k == sid_deps) {
+          if (!deps_col) deps_col = &col(0, "deps");
+          ColReader& r = *deps_col;
+          size_t n = static_cast<size_t>(r.uvarint64());
+          w.map(n);
+          ch.deps.reserve(n);
+          for (size_t i = 0; i < n; ++i) {
+            u64 di = r.uvarint64();
+            w.str(str_at(di));
+            auto rit = run_clock.find(static_cast<u32>(di));
+            i128 ds = (rit == run_clock.end() ? 0 : rit->second) +
+                      r.zigzag();
+            put_canon_i128(w, ds);
+            ch.deps.emplace_back(psid(pool, di),
+                                 static_cast<u32>(static_cast<u64>(ds)));
+          }
+        } else if (k == sid_ops) {
+          if (!ops_col) ops_col = &col(0, "ops");
+          size_t n = static_cast<size_t>(ops_col->uvarint64());
+          w.array(n);
+          ch.ops.reserve(n);
+          for (size_t i = 0; i < n; ++i)
+            ch.ops.push_back(fused_op(pool, w, ch.actor, ch.seq,
+                                      ekey_buf, ekey_sid));
+        } else {
+          size_t voff = w.buf.size();
+          write_value(w, *ccol(0, k));
+          if (k == sid_message) {
+            ch.has_message = true;
+            ch.message.assign(w.buf.begin() + voff, w.buf.end());
+          }
+        }
+      }
+      last_seq[static_cast<u32>(actor_i)] = seq;
+      auto rit = run_clock.find(static_cast<u32>(actor_i));
+      if (rit == run_clock.end() || seq > rit->second)
+        run_clock[static_cast<u32>(actor_i)] = seq;
+      size_t off = sl.size();
+      sl.insert(sl.end(), w.buf.begin(), w.buf.end());
+      ch.raw.slab = slab;
+      ch.raw.off = static_cast<u32>(off);
+      ch.raw.len = static_cast<u32>(w.buf.size());
+      out.push_back(std::move(ch));
+    }
+  }
+
+  // appends every change's canonical raw bytes to `slab`, recording
+  // (offset, length) spans; residual changes splice verbatim
+  void decode_all(std::vector<u8>& slab,
+                  std::vector<std::pair<size_t, size_t>>& spans) {
+    Writer w;
+    for (u64 sid : cshape_ids) {
+      if (sid == 0) {   // residual change: verbatim bytes
+        size_t n = static_cast<size_t>(residuals.uvarint64());
+        const u8* p = residuals.take(n);
+        size_t off = slab.size();
+        slab.insert(slab.end(), p, p + n);
+        spans.emplace_back(off, n);
+        continue;
+      }
+      if (sid > cshapes.size()) throw corrupt("shape id out of range");
+      const std::vector<u32>& keys = cshapes[static_cast<size_t>(sid - 1)];
+      w.buf.clear();
+      // actor resolves FIRST regardless of its key position (the seq
+      // delta is keyed on the actor; mirrors the Python decoder)
+      if (!actor_col) actor_col = &col(0, "actor");
+      if (!seq_col) seq_col = &col(0, "seq");
+      u64 actor_i = actor_col->uvarint64();
+      str_at(actor_i);
+      i128 d = seq_col->zigzag();
+      auto lit = last_seq.find(static_cast<u32>(actor_i));
+      i128 seq = (lit == last_seq.end() ? 0 : lit->second) + 1 + d;
+      w.map(keys.size());
+      for (u32 k : keys) {
+        w.str(strings[k]);
+        if (k == sid_actor) {
+          w.str(strings[static_cast<size_t>(actor_i)]);
+        } else if (k == sid_seq) {
+          put_canon_i128(w, seq);
+        } else if (k == sid_deps) {
+          if (!deps_col) deps_col = &col(0, "deps");
+          ColReader& r = *deps_col;
+          size_t n = static_cast<size_t>(r.uvarint64());
+          w.map(n);
+          for (size_t i = 0; i < n; ++i) {
+            u64 di = r.uvarint64();
+            w.str(str_at(di));
+            auto rit = run_clock.find(static_cast<u32>(di));
+            i128 ds = (rit == run_clock.end() ? 0 : rit->second) +
+                      r.zigzag();
+            put_canon_i128(w, ds);
+          }
+        } else if (k == sid_ops) {
+          if (!ops_col) ops_col = &col(0, "ops");
+          size_t n = static_cast<size_t>(ops_col->uvarint64());
+          w.array(n);
+          for (size_t i = 0; i < n; ++i) write_op(w);
+        } else {
+          write_value(w, *ccol(0, k));
+        }
+      }
+      last_seq[static_cast<u32>(actor_i)] = seq;
+      auto rit = run_clock.find(static_cast<u32>(actor_i));
+      if (rit == run_clock.end() || seq > rit->second)
+        run_clock[static_cast<u32>(actor_i)] = seq;
+      size_t off = slab.size();
+      slab.insert(slab.end(), w.buf.begin(), w.buf.end());
+      spans.emplace_back(off, w.buf.size());
+    }
+  }
+};
+
+static bool is_columnar_blob(const u8* p, size_t n) {
+  return n >= 4 && std::memcmp(p, "AMTC", 4) == 0;
+}
+
+}  // namespace colnr
 
 }  // namespace amtpu
 
@@ -4578,6 +5973,256 @@ int64_t amtpu_history_bytes(void* pool_ptr, const char* doc_id) {
         for (auto& e : entries) b += static_cast<int64_t>(e.change.raw.size());
       for (auto& ch : st.queue) b += static_cast<int64_t>(ch.raw.size());
       return b;
+    };
+    if (doc_id == nullptr || doc_id[0] == '\0') {
+      int64_t total = 0;
+      for (auto& [id, st] : pool.docs) total += sum_doc(st);
+      return total;
+    }
+    auto it = pool.docs.find(doc_id);
+    return it == pool.docs.end() ? 0 : sum_doc(it->second);
+  } catch (const std::exception& e) {
+    g_error = e.what(); g_error_kind = 0;
+    return -1;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// native columnar codec + arena-direct load + op-state folding (ISSUE 14)
+// ---------------------------------------------------------------------------
+
+// Columnar-encodes a msgpack array of BIN-wrapped raw changes into one
+// AMTC blob.  Bin framing (not a spliced join array) because element
+// boundaries must be explicit: a residual raw with trailing bytes is
+// not re-delimitable by msgpack skip.  stats (nullable) receives
+// [n_changes, n_residual] for the Python wrapper's telemetry.  Returns
+// a malloc'd buffer (amtpu_buf_free) or NULL on error -- the Python
+// dispatch falls back to the pure-Python codec then.
+uint8_t* amtpu_columnar_encode(const uint8_t* data, int64_t len,
+                               int64_t* out_len, int64_t* stats) {
+  try {
+    Reader r(data, static_cast<size_t>(len));
+    size_t n = r.read_array();
+    colnr::ColEncoder enc;
+    for (size_t i = 0; i < n; ++i) {
+      auto span = r.read_bin_view();
+      enc.add(span.first, span.second);
+    }
+    std::vector<u8> blob = enc.dump();
+    if (stats) {
+      stats[0] = enc.n_changes;
+      stats[1] = enc.n_residual;
+    }
+    *out_len = static_cast<int64_t>(blob.size());
+    uint8_t* res = static_cast<uint8_t*>(std::malloc(blob.size()));
+    std::memcpy(res, blob.data(), blob.size());
+    return res;
+  } catch (const Error& e) {
+    g_error = e.what(); g_error_kind = e.kind;
+    *out_len = -1;
+    return nullptr;
+  } catch (const std::exception& e) {
+    g_error = e.what(); g_error_kind = 0;
+    *out_len = -1;
+    return nullptr;
+  }
+}
+
+// Decodes an AMTC blob back to a msgpack array of BIN-wrapped raw
+// changes, byte-identical to the encode input (residuals verbatim;
+// columnar changes rebuilt through the canonical writer; bin framing
+// for the same boundary reason as encode).  Corruption raises kind 1
+// (RangeError) -- the Python wrapper maps it to decode_columnar's
+// ValueError contract.
+uint8_t* amtpu_columnar_decode(const uint8_t* blob, int64_t len,
+                               int64_t* out_len) {
+  try {
+    colnr::ColDecoder dec(blob, static_cast<size_t>(len));
+    std::vector<u8> slab;
+    std::vector<std::pair<size_t, size_t>> spans;
+    dec.decode_all(slab, spans);
+    Writer out;
+    out.buf.reserve(slab.size() + spans.size() * 5 + 8);
+    out.array(spans.size());
+    for (auto& [off, n] : spans) out.bin(slab.data() + off, n);
+    *out_len = static_cast<int64_t>(out.buf.size());
+    uint8_t* res = static_cast<uint8_t*>(std::malloc(out.buf.size()));
+    std::memcpy(res, out.buf.data(), out.buf.size());
+    return res;
+  } catch (const Error& e) {
+    g_error = e.what(); g_error_kind = e.kind;
+    *out_len = -1;
+    return nullptr;
+  } catch (const std::exception& e) {
+    g_error = e.what(); g_error_kind = 0;
+    *out_len = -1;
+    return nullptr;
+  }
+}
+
+// Arena-direct checkpoint load: payload is msgpack
+// {doc_key: [part(bin), ...]} where each part is either an AMTC
+// columnar blob (a v2 snapshot chunk or tail) or a raw msgpack array
+// of changes (the v1 container remainder).  Columns materialize
+// straight into ChangeRec arena state -- canonical raw bytes rebuild
+// into one slab per blob, then the standard decode_change /
+// begin_phases pipeline runs with the batch pinned HOST-FULL (no
+// kernel dispatch; host/kernel byte parity is pinned by the
+// differential suites, so the restored doc is byte-identical in every
+// exec mode).  Returns a BatchHandle for the standard phase-b driver.
+void* amtpu_begin_columnar(void* pool_ptr, const uint8_t* data,
+                           int64_t len) {
+  Pool& pool = *static_cast<Pool*>(pool_ptr);
+  auto h = std::make_unique<BatchHandle>();
+  h->pool = &pool;
+  h->batch.pool = &pool;
+  try {
+    double t0 = mono_now();
+    if (len < 0 || len >= (1LL << 32))
+      throw Error(0, "payload too large (raw spans use 32-bit offsets; "
+                     "split batches below 4 GiB)");
+    auto slab = std::make_shared<std::vector<u8>>(data, data + len);
+    Reader r(slab->data(), slab->size());
+    size_t n_docs = r.read_map();
+    Batch& b = h->batch;
+    // arena-direct decode always resolves host-side: begin skips the
+    // kernel rows, emit runs host_resolve_step + the in-emit Fenwick.
+    // Checkpoint restores discard patches, so emit mutates state only
+    b.host_full = true;
+    b.no_patch = true;
+    std::vector<std::vector<ChangeRec>> incoming;
+    incoming.reserve(n_docs);
+    DecodeCache dc;
+    for (size_t i = 0; i < n_docs; ++i) {
+      std::string doc_id = r.read_str();
+      size_t n_parts = r.read_array();
+      std::vector<ChangeRec> chs;
+      for (size_t pi = 0; pi < n_parts; ++pi) {
+        auto bv = r.read_bin_view();
+        if (colnr::is_columnar_blob(bv.first, bv.second)) {
+          auto dslab = std::make_shared<std::vector<u8>>();
+          pool.intern.reserve(
+              pool.intern.n + std::min<size_t>(bv.second / 12,
+                                               size_t(4) << 20));
+          pool.vals.reserve(
+              pool.vals.n + std::min<size_t>(bv.second / 24,
+                                             size_t(2) << 20));
+          // FUSED decode: canonical raw bytes + ChangeRec in one
+          // column walk (no second msgpack parse)
+          colnr::ColDecoder dec(bv.first, bv.second);
+          dec.decode_changes(pool, dslab, chs);
+        } else {
+          Reader pr(bv.first, bv.second);
+          size_t n_changes = pr.read_array();
+          chs.reserve(chs.size() +
+                      std::min(n_changes,
+                               static_cast<size_t>(bv.second) / 8));
+          for (size_t j = 0; j < n_changes; ++j)
+            chs.push_back(decode_change(pr, pool, slab, nullptr, &dc));
+        }
+      }
+      b.bdocs.push_back(&pool.doc(doc_id));
+      b.bdoc_ids.push_back(std::move(doc_id));
+      incoming.push_back(std::move(chs));
+    }
+    b.tr_decode = mono_now() - t0;
+    begin_phases(pool, b, incoming, h->journal);
+    h->can_rollback = true;
+    // unpin the payload slab when most of it was NOT retained (v1
+    // parts re-loaded into live docs dedup to nothing): same re-adopt
+    // as amtpu_begin.  Per-blob decode slabs are already exactly sized
+    // and die with their last ChangeRec.
+    size_t kept = 0;
+    for (auto& ac : b.applied)
+      if (ac.stored->raw.slab == slab) kept += ac.stored->raw.len;
+    for (auto* d : b.bdocs)
+      for (auto& qc : d->queue)
+        if (qc.raw.slab == slab) kept += qc.raw.len;
+    if (kept * 4 < slab->size()) {
+      auto copy_out = [&](ChangeRec& c) {
+        if (c.raw.slab != slab) return;
+        std::vector<u8> buf(c.raw.data(), c.raw.data() + c.raw.len);
+        c.raw.adopt(std::move(buf));
+      };
+      for (auto& ac : b.applied) copy_out(*ac.stored);
+      for (auto* d : b.bdocs)
+        for (auto& qc : d->queue) copy_out(qc);
+    }
+  } catch (const Error& e) {
+    g_error = e.what(); g_error_kind = e.kind;
+    return nullptr;
+  } catch (const std::exception& e) {
+    g_error = e.what(); g_error_kind = 0;
+    return nullptr;
+  }
+  return h.release();
+}
+
+// Op-state folding (ISSUE 14 tentpole): settled changes at or behind
+// `frontier` free their op records / deps / message -- the live
+// register+arena state already holds their final values, the columnar
+// snapshot holds their replay bytes, and all_deps stays for straggler
+// closure walks.  Call AFTER amtpu_truncate_history with the same
+// frontier (the Python compact path does); duplicate re-sends of
+// folded seqs skip byte validation (validate_duplicates).  Returns op
+// records freed (0 if the doc is unknown), -1 on error.
+int64_t amtpu_fold_settled(void* pool_ptr, const char* doc_id,
+                           const uint8_t* frontier, int64_t flen) {
+  Pool& pool = *static_cast<Pool*>(pool_ptr);
+  try {
+    auto it = pool.docs.find(doc_id);
+    if (it == pool.docs.end()) return 0;
+    DocState& st = it->second;
+    Reader r(frontier, static_cast<size_t>(flen));
+    Clock f;
+    size_t n = r.read_map();
+    for (size_t i = 0; i < n; ++i) {
+      u32 a = pool.intern.id_of(r.read_str());
+      i64 s = r.read_int();
+      i64 applied = clock_get(st.clock, a);
+      if (s > applied) s = applied;   // clamp, like truncate_history
+      if (s > 0)
+        clock_set_max(f, a, static_cast<u32>(s));
+    }
+    int64_t freed = 0;
+    for (auto& [a, s] : f) {
+      auto sit = st.states.find(a);
+      if (sit == st.states.end()) continue;
+      auto& entries = sit->second;
+      size_t upto = std::min<size_t>(s, entries.size());
+      for (size_t i = 0; i < upto; ++i) {
+        StateEntry& e = entries[i];
+        if (e.folded) continue;
+        freed += static_cast<int64_t>(e.change.ops.size());
+        std::vector<OpRec>().swap(e.change.ops);
+        std::vector<u8>().swap(e.change.message);
+        e.change.has_message = false;
+        Clock().swap(e.change.deps);
+        e.folded = true;
+      }
+    }
+    return freed;
+  } catch (const std::exception& e) {
+    g_error = e.what(); g_error_kind = 0;
+    return -1;
+  }
+}
+
+// Retained op records (applied history + causal queue) of one doc (or,
+// with doc_id = "", the whole pool) -- the arena-growth measure the
+// op-state folding lane gates on (flat, not merely sub-linear, under
+// settled-overwrite churn).
+int64_t amtpu_op_count(void* pool_ptr, const char* doc_id) {
+  Pool& pool = *static_cast<Pool*>(pool_ptr);
+  try {
+    auto sum_doc = [](const DocState& st) {
+      int64_t n = 0;
+      for (auto& [a, entries] : st.states)
+        for (auto& e : entries)
+          n += static_cast<int64_t>(e.change.ops.size());
+      for (auto& ch : st.queue)
+        n += static_cast<int64_t>(ch.ops.size());
+      return n;
     };
     if (doc_id == nullptr || doc_id[0] == '\0') {
       int64_t total = 0;

@@ -116,6 +116,20 @@ class Reader {
     return s;
   }
 
+  // zero-copy view of a bin value's content bytes
+  std::pair<const uint8_t*, size_t> read_bin_view() {
+    uint8_t b = next();
+    size_t n;
+    if (b == 0xc4) n = u8();
+    else if (b == 0xc5) n = u16();
+    else if (b == 0xc6) n = u32();
+    else throw MsgpackError("expected bin");
+    need(n);
+    const uint8_t* p = p_;
+    p_ += n;
+    return {p, n};
+  }
+
   size_t read_array() {
     uint8_t b = next();
     if ((b & 0xf0) == 0x90) return b & 0x0f;
@@ -213,11 +227,14 @@ class Writer {
 
   void integer(int64_t v) {
     if (v >= 0) {
+      // canonical parity with msgpack-python's packb: non-negative
+      // values use the shortest UNSIGNED family (uint64, not int64,
+      // past 32 bits)
       if (v <= 0x7f) { buf.push_back(uint8_t(v)); }
       else if (v <= 0xff) { buf.push_back(0xcc); u8(uint8_t(v)); }
       else if (v <= 0xffff) { buf.push_back(0xcd); u16(uint16_t(v)); }
       else if (v <= 0xffffffffLL) { buf.push_back(0xce); u32(uint32_t(v)); }
-      else { buf.push_back(0xd3); u64(uint64_t(v)); }
+      else { buf.push_back(0xcf); u64(uint64_t(v)); }
     } else {
       if (v >= -32) { buf.push_back(uint8_t(v)); }
       else if (v >= -128) { buf.push_back(0xd0); u8(uint8_t(v)); }
@@ -233,6 +250,13 @@ class Writer {
     u64(bits);
   }
 
+  // unsigned ints past int64 range (canonical uint64 form)
+  void uinteger(uint64_t v) {
+    if (v <= 0x7fffffffffffffffULL) { integer(int64_t(v)); return; }
+    buf.push_back(0xcf);
+    u64(v);
+  }
+
   void str(const char* s, size_t n) {
     if (n <= 31) buf.push_back(0xa0 | uint8_t(n));
     else if (n <= 0xff) { buf.push_back(0xd9); u8(uint8_t(n)); }
@@ -241,6 +265,13 @@ class Writer {
     append(reinterpret_cast<const uint8_t*>(s), n);
   }
   void str(const std::string& s) { str(s.data(), s.size()); }
+
+  void bin(const uint8_t* data, size_t n) {
+    if (n <= 0xff) { buf.push_back(0xc4); u8(uint8_t(n)); }
+    else if (n <= 0xffff) { buf.push_back(0xc5); u16(uint16_t(n)); }
+    else { buf.push_back(0xc6); u32(uint32_t(n)); }
+    append(data, n);
+  }
 
   void array(size_t n) {
     if (n <= 15) buf.push_back(0x90 | uint8_t(n));

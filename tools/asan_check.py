@@ -28,11 +28,13 @@ ASAN_LIB = os.path.join(ROOT, 'automerge_tpu', 'native',
                         'libamtpu_core_asan.so')
 
 #: the native-heavy subset: driver + overflow/escalation paths
-#: (test_native), rollback byte-atomicity (test_atomicity), and the
-#: C++-vs-oracle differential (test_backend) -- broad begin/emit
-#: coverage without the slow subprocess lanes
+#: (test_native), rollback byte-atomicity (test_atomicity), the
+#: C++-vs-oracle differential (test_backend), and the native columnar
+#: codec / arena-direct load / op-state folding ABI (test_storage_
+#: native, ISSUE 14) -- broad begin/emit coverage without the slow
+#: subprocess lanes
 SUBSET = ('tests/test_native.py', 'tests/test_atomicity.py',
-          'tests/test_backend.py')
+          'tests/test_backend.py', 'tests/test_storage_native.py')
 
 
 def _gxx_lib(name):

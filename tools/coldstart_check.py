@@ -1,0 +1,297 @@
+"""CI gate: native columnar cold-start economics (ISSUE 14,
+docs/STORAGE.md).
+
+Six acceptance checks, one process, on a scaled (~2k-doc) corpus:
+
+  1. **native decode speed** -- columnar decode through the native
+     codec must sustain >= 10x the Python codec's changes/s on BOTH
+     the corpus' own chunk+tail blobs and the config-4 table corpus
+     (the acceptance corpus; interleaved A/B, median-of-medians);
+  2. **cold-restart speed** -- the END-TO-END restore through
+     `load_batch` (decode + the shared C++ apply, which bounds the
+     ratio) with `AMTPU_STORAGE_NATIVE=1` must be >= 4x the Python-
+     codec dict-replay arm, same A/B protocol, fresh pool per trial;
+  3. **post-restart byte parity** -- every restored doc's `save()`
+     bytes must equal the never-evicted builder twin's, and a sample of
+     whole-doc patches must match, in BOTH arms;
+  4. **durable kill-mid-save recovery** -- a `storage.save` fault mid-
+     write (partial tempfile, no rename) must leave the prior blob AND
+     the manifest naming it intact; a FRESH ColdStore on the same dir
+     must recover and serve the committed bytes;
+  5. **arena-direct path engaged** -- `storage.native_loads` > 0 in the
+     native arm (the gate must fail if the fast path silently falls
+     back to dict replay);
+  6. **oracle-free** -- `fallback.oracle == 0` across all of it.
+
+Usage: [JAX_PLATFORMS=cpu] python tools/coldstart_check.py
+Corpus size: AMTPU_SMOKE_COLDSTART_DOCS (default 2048).
+"""
+import os
+import random
+import statistics
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+sys.path.insert(0, ROOT)
+
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+os.environ.pop('AMTPU_STORAGE_FORMAT', None)   # columnar is the subject
+os.environ.pop('AMTPU_STORAGE_NATIVE', None)   # the A/B flips it per arm
+
+ROOT_ID = '00000000-0000-0000-0000-000000000000'
+#: codec-stage decode throughput floor (the ISSUE acceptance metric:
+#: native decode changes/s vs the Python codec)
+MIN_DECODE_SPEEDUP = 10.0
+#: end-to-end cold-restart floor: decode + the SHARED C++ apply, which
+#: bounds the achievable ratio (the apply runs in both arms)
+MIN_RESTORE_SPEEDUP = 4.0
+
+
+def _doc_changes(d, rng, rounds=16, ops_per_round=8):
+    """One doc's history: a text-editing session (the realistic cold-
+    start shape -- elemId keys, interleaved actors, catch-up deps) plus
+    some map churn."""
+    doc_t = 'T%d' % d
+    chs = [{'actor': 'a0', 'seq': 1, 'deps': {}, 'ops': [
+        {'action': 'makeText', 'obj': doc_t},
+        {'action': 'link', 'obj': ROOT_ID, 'key': 'text',
+         'value': doc_t}]}]
+    clock = {'a0': 1}
+    prev, elem = '_head', 0
+    for r in range(rounds):
+        actor = 'a%d' % (r % 3)
+        clock[actor] = clock.get(actor, 0) + 1
+        ops = []
+        for _o in range(ops_per_round // 2):
+            elem += 1
+            ops.append({'action': 'ins', 'obj': doc_t, 'key': prev,
+                        'elem': elem})
+            key = '%s:%d' % (actor, elem)
+            ops.append({'action': 'set', 'obj': doc_t, 'key': key,
+                        'value': chr(97 + (elem * 7) % 26)})
+            prev = key
+        if r % 4 == 0:
+            ops.append({'action': 'set', 'obj': ROOT_ID,
+                        'key': 'k%d' % (r % 3),
+                        'value': rng.randrange(10000)})
+        chs.append({'actor': actor, 'seq': clock[actor],
+                    'deps': {a: s for a, s in clock.items()
+                             if a != actor},
+                    'ops': ops})
+    return chs
+
+
+def _build_blobs(n_docs, rng):
+    """One builder pool: n_docs text-session docs, half of them
+    compacted so their checkpoints carry snapshot chunks; returns
+    ({doc: save bytes}, builder pool)."""
+    from automerge_tpu.native import NativeDocPool
+    pool = NativeDocPool()
+    batch_docs = 512
+    for base in range(0, n_docs, batch_docs):
+        payload = {('doc-%05d' % d): _doc_changes(d, rng)
+                   for d in range(base, min(base + batch_docs, n_docs))}
+        pool.apply_batch(payload)
+    for d in range(0, n_docs, 2):
+        pool.compact('doc-%05d' % d)
+    return {('doc-%05d' % d): pool.save('doc-%05d' % d)
+            for d in range(n_docs)}, pool
+
+
+def _timed_restore(blobs, native):
+    from automerge_tpu.native import NativeDocPool
+    os.environ['AMTPU_STORAGE_NATIVE'] = '1' if native else '0'
+    pool = NativeDocPool()
+    t0 = time.perf_counter()
+    pool.load_batch(blobs)
+    return time.perf_counter() - t0, pool
+
+
+def check_decode_speed(problems, report, blobs):
+    """Codec-stage A/B: decode_columnar over the corpus' own chunk +
+    tail blobs, native vs Python, interleaved, median-of-medians."""
+    from automerge_tpu import storage
+    parts = []
+    for data in blobs.values():
+        _f, chunks, tail = storage.unpack_checkpoint_parts(bytes(data))
+        parts.extend(chunks)
+        parts.append(tail)
+    times = {True: [], False: []}
+    n_changes = 0
+    for t in range(3):
+        for native in (True, False) if t % 2 == 0 else (False, True):
+            os.environ['AMTPU_STORAGE_NATIVE'] = '1' if native else '0'
+            t0 = time.perf_counter()
+            n_changes = sum(len(storage.decode_columnar(p))
+                            for p in parts)
+            times[native].append(time.perf_counter() - t0)
+    os.environ.pop('AMTPU_STORAGE_NATIVE', None)
+    med_nat = statistics.median(times[True])
+    med_py = statistics.median(times[False])
+    speedup = med_py / max(med_nat, 1e-9)
+    report['decode_changes'] = n_changes
+    report['native_decode_changes_per_s'] = round(
+        n_changes / max(med_nat, 1e-9))
+    report['python_decode_changes_per_s'] = round(
+        n_changes / max(med_py, 1e-9))
+    report['decode_speedup'] = round(speedup, 2)
+    print('coldstart-check: decode %d changes native %.0fk/s python '
+          '%.0fk/s (%.1fx)'
+          % (n_changes, n_changes / med_nat / 1e3,
+             n_changes / med_py / 1e3, speedup), file=sys.stderr)
+    if speedup < MIN_DECODE_SPEEDUP:
+        problems.append('native codec decode %.1fx < %.0fx the Python '
+                        'codec' % (speedup, MIN_DECODE_SPEEDUP))
+
+
+def check_decode_speed_config4(problems, report, rng):
+    """The acceptance corpus: config-4 table changes (nested map row
+    values -- where the Python codec pays a msgpack round trip per
+    value and the native codec splices spans)."""
+    import msgpack
+
+    from automerge_tpu import storage
+    os.environ.setdefault('AMTPU_BENCH_C4_DOCS', '128')
+    import bench
+    batch, _metric = bench.build_config_4(rng)
+    os.environ['AMTPU_STORAGE_NATIVE'] = '1'
+    blobs, n_changes = [], 0
+    for changes in batch.values():
+        raws = [msgpack.packb(c, use_bin_type=True) for c in changes]
+        n_changes += len(raws)
+        blobs.append(storage.encode_columnar(raws))
+    times = {True: [], False: []}
+    for t in range(3):
+        for native in (True, False) if t % 2 == 0 else (False, True):
+            os.environ['AMTPU_STORAGE_NATIVE'] = '1' if native else '0'
+            t0 = time.perf_counter()
+            for b in blobs:
+                storage.decode_columnar(b)
+            times[native].append(time.perf_counter() - t0)
+    os.environ.pop('AMTPU_STORAGE_NATIVE', None)
+    med_nat = statistics.median(times[True])
+    med_py = statistics.median(times[False])
+    speedup = med_py / max(med_nat, 1e-9)
+    report['config4_decode_speedup'] = round(speedup, 2)
+    print('coldstart-check: config-4 decode %d changes native %.0fk/s '
+          'python %.0fk/s (%.1fx)'
+          % (n_changes, n_changes / med_nat / 1e3,
+             n_changes / med_py / 1e3, speedup), file=sys.stderr)
+    if speedup < MIN_DECODE_SPEEDUP:
+        problems.append('config-4 native codec decode %.1fx < %.0fx '
+                        'the Python codec'
+                        % (speedup, MIN_DECODE_SPEEDUP))
+
+
+def check_speed_and_parity(problems, report, blobs, builder):
+    from automerge_tpu import telemetry
+    trials = {True: [], False: []}
+    pools = {}
+    for t in range(3):
+        for native in (True, False) if t % 2 == 0 else (False, True):
+            dt, pool = _timed_restore(blobs, native)
+            trials[native].append(dt)
+            pools[native] = pool
+    os.environ.pop('AMTPU_STORAGE_NATIVE', None)
+    med_nat = statistics.median(trials[True])
+    med_py = statistics.median(trials[False])
+    speedup = med_py / max(med_nat, 1e-9)
+    report['native_restore_s'] = round(med_nat, 4)
+    report['python_restore_s'] = round(med_py, 4)
+    report['restore_speedup'] = round(speedup, 2)
+    print('coldstart-check: restore %d docs native %.3fs python %.3fs '
+          '(%.1fx)' % (len(blobs), med_nat, med_py, speedup),
+          file=sys.stderr)
+    if speedup < MIN_RESTORE_SPEEDUP:
+        problems.append('end-to-end restore %.1fx < %.0fx the Python '
+                        'arm' % (speedup, MIN_RESTORE_SPEEDUP))
+    snap = telemetry.metrics_snapshot()
+    report['native_loads'] = int(snap.get('storage.native_loads', 0))
+    if report['native_loads'] < 1:
+        problems.append('storage.native_loads == 0: the arena-direct '
+                        'path never engaged')
+    # post-restart byte parity vs the never-evicted twin, both arms
+    sample = sorted(blobs)[::max(1, len(blobs) // 100)]
+    bad = 0
+    for arm, pool in pools.items():
+        for doc in blobs:
+            if pool.save(doc) != builder.save(doc):
+                bad += 1
+                problems.append('save bytes diverged for %s (arm %s)'
+                                % (doc, 'native' if arm else 'python'))
+                break
+        for doc in sample:
+            if pool.get_patch(doc) != builder.get_patch(doc):
+                bad += 1
+                problems.append('patch diverged for %s (arm %s)'
+                                % (doc, 'native' if arm else 'python'))
+                break
+    report['parity'] = bad == 0
+
+
+def check_durable_recovery(problems, report):
+    import tempfile
+
+    from automerge_tpu import faults
+    from automerge_tpu.storage.coldstore import ColdStore
+    root = tempfile.mkdtemp(prefix='amtpu-coldstart-check-')
+    committed = b'committed-checkpoint-bytes' * 64
+    cs = ColdStore(root=root, durable=True)
+    cs.put('doc-h', committed)
+    spec = faults.arm('storage.save', 'permanent')
+    killed = False
+    try:
+        cs.put('doc-h', b'new-bytes-the-kill-interrupts' * 64)
+    except faults.InjectedFault:
+        killed = True
+    faults.disarm(spec)
+    ok = killed and cs.get('doc-h') == committed
+    fresh = ColdStore(root=root, durable=True)
+    ok = ok and fresh.doc_ids() == ['doc-h'] \
+        and fresh.get('doc-h') == committed
+    report['durable_recovery'] = ok
+    if not ok:
+        problems.append('durable kill-mid-save recovery failed '
+                        '(killed=%s)' % killed)
+    else:
+        print('coldstart-check: kill-mid-save left the committed copy '
+              '+ manifest intact; fresh store recovered it',
+              file=sys.stderr)
+
+
+def main():
+    from automerge_tpu import telemetry
+    from automerge_tpu.utils.common import env_int
+    n_docs = env_int('AMTPU_SMOKE_COLDSTART_DOCS', 2048)
+    problems, report = [], {'docs': n_docs}
+    rng = random.Random(7)
+    t0 = time.perf_counter()
+    blobs, builder = _build_blobs(n_docs, rng)
+    print('coldstart-check: built %d docs in %.1fs'
+          % (n_docs, time.perf_counter() - t0), file=sys.stderr)
+    check_decode_speed(problems, report, blobs)
+    check_decode_speed_config4(problems, report, rng)
+    check_speed_and_parity(problems, report, blobs, builder)
+    check_durable_recovery(problems, report)
+    snap = telemetry.metrics_snapshot()
+    report['fallback_oracle'] = int(snap.get('fallback.oracle', 0))
+    if report['fallback_oracle']:
+        problems.append('fallback.oracle == %d (must be 0)'
+                        % report['fallback_oracle'])
+    if problems:
+        print('coldstart-check: FAIL')
+        for p in problems:
+            print('  - %s' % p)
+        return 1
+    print('coldstart-check: PASS (%d docs, codec %.1fx / restore '
+          '%.1fx vs the Python arm, parity + durable recovery + '
+          'oracle-free)'
+          % (n_docs, report['decode_speedup'],
+             report['restore_speedup']))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
