@@ -4,7 +4,7 @@
 	clean wheel telemetry-check fallback-check perf-smoke chaos-check \
 	serve-check mesh-check static-check asan-check fanout-check \
 	bench-fanout storage-check obs-check backpressure-check \
-	coldstart-check bench-coldstart
+	coldstart-check bench-coldstart capacity-check
 
 all: native
 
@@ -67,6 +67,7 @@ check: native
 	$(MAKE) backpressure-check
 	$(MAKE) storage-check
 	$(MAKE) coldstart-check
+	$(MAKE) capacity-check
 	$(MAKE) obs-check
 	$(MAKE) mesh-check
 	$(MAKE) asan-check
@@ -155,6 +156,16 @@ coldstart-check: native
 # Python-codec arm measured on a subset for the A/B ratio.
 bench-coldstart: native
 	JAX_PLATFORMS=cpu python bench.py --coldstart --out BENCH_COLDSTART.json
+
+# Capacity gate (ISSUE 15, docs/OBSERVABILITY.md capacity section):
+# per-doc accounting must reconcile BIT-EXACTLY with the pool-wide
+# counters under churn + GC + fold + evict + reload in both exec modes
+# and on a dp=4 mesh pool, the hot-doc sketch must rank a zipfian
+# stream correctly, and memory-pressure eviction must fire BEFORE the
+# modeled AMTPU_MEM_BUDGET_MB is breached.  The always-on accounting
+# cost is priced by telemetry-check (raw arm no-ops capacity.note_*).
+capacity-check: native
+	JAX_PLATFORMS=cpu python tools/capacity_check.py
 
 # Observability gate (ISSUE 12, docs/OBSERVABILITY.md): flight
 # recorder + critical-path attribution + SLO surface against a LIVE

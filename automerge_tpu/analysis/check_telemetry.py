@@ -51,6 +51,7 @@ PRESEED_BLOCKS = {
     'storage': 'KNOWN_STORAGE_KEYS',
     'recorder': 'KNOWN_RECORDER_KEYS',
     'slo': 'KNOWN_SLO_KEYS',
+    'capacity': 'KNOWN_CAPACITY_KEYS',
 }
 
 

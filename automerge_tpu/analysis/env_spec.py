@@ -54,6 +54,27 @@ ENV_FLAGS = (
     EnvFlag('AMTPU_METRICS_PORT', 'int', -1, False, 'sidecar/server.py'),
     EnvFlag('AMTPU_METRICS_HOST', 'str', '127.0.0.1', False,
             'sidecar/server.py'),
+    # -- per-doc capacity accounting + headroom (ISSUE 15) ------------------
+    EnvFlag('AMTPU_MEM_BUDGET_MB', 'int', 0, False,
+            'telemetry/capacity.py (memory budget the headroom '
+            'estimator measures against; 0 = unbudgeted)'),
+    EnvFlag('AMTPU_MEM_PRESSURE_EVICT', 'float', 0.85, False,
+            'telemetry/capacity.py (pressure fraction past which the '
+            'gateway evicts cold docs proactively; <=0 disables)'),
+    EnvFlag('AMTPU_PRESSURE_EVICT_DOCS', 'int', 16, False,
+            'storage/coldstore.py (max LRU docs one pressure-eviction '
+            'pass checkpoints out)'),
+    EnvFlag('AMTPU_PRESSURE_EVICT_COOLDOWN_S', 'float', 30.0, False,
+            'telemetry/capacity.py (min seconds between pressure '
+            'passes: a stuck-high RSS signal must not evict per flush)'),
+    EnvFlag('AMTPU_CAPACITY_TOPK', 'int', 10, False,
+            'telemetry/capacity.py (hot-doc table depth)'),
+    EnvFlag('AMTPU_CAPACITY_REFRESH_S', 'float', 1.0, False,
+            'telemetry/capacity.py (min seconds between native per-doc '
+            'stats passes; scrapes + pressure checks share one)'),
+    EnvFlag('AMTPU_CAPACITY_SKETCH', 'int', 128, False,
+            'telemetry/capacity.py (space-saver sketch capacity for '
+            'the streaming fanned/egress tiers)'),
     # -- kernel path --------------------------------------------------------
     EnvFlag('AMTPU_PACKED_EPILOGUE', 'bool', True, False,
             'native/__init__.py'),

@@ -213,6 +213,13 @@ def _load():
         ctypes.c_int64]
     lib.amtpu_op_count.restype = ctypes.c_int64
     lib.amtpu_op_count.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.amtpu_doc_ids.restype = ctypes.POINTER(ctypes.c_uint8)
+    lib.amtpu_doc_ids.argtypes = [ctypes.c_void_p,
+                                  ctypes.POINTER(ctypes.c_int64)]
+    lib.amtpu_doc_stats.restype = ctypes.c_int64
+    lib.amtpu_doc_stats.argtypes = [ctypes.c_void_p,
+                                    ctypes.POINTER(ctypes.c_int64),
+                                    ctypes.c_int64]
     lib.amtpu_doc_shard.restype = ctypes.c_uint32
     lib.amtpu_doc_shard.argtypes = [ctypes.c_char_p, ctypes.c_int64,
                                     ctypes.c_int]
@@ -2524,6 +2531,38 @@ class NativeDocPool:
             _raise_last()
         return int(n)
 
+    #: amtpu_doc_stats columns, in ABI order (core.cpp has the
+    #: authoritative comment); telemetry/capacity.py reads these names
+    DOC_STAT_COLS = ('hist_bytes', 'ops', 'folded_ops', 'changes',
+                     'queued', 'resclk_rows')
+
+    def doc_stats(self):
+        """Per-doc resource accounting in ONE C call for the whole pool
+        (ISSUE 15): returns ``(doc_keys, stats)`` where `stats` is an
+        int64 ndarray of shape (n_docs, len(DOC_STAT_COLS)) in the same
+        first-seen doc order as `doc_keys`.  Column totals reconcile
+        bit-exactly with `history_bytes()` / `op_count()` -- the
+        capacity tests and `make capacity-check` pin it."""
+        L = lib()
+        n = int(L.amtpu_doc_count(self._pool))
+        ncols = len(self.DOC_STAT_COLS)
+        if n <= 0:
+            return [], np.zeros((0, ncols), np.int64)
+        buf = (ctypes.c_int64 * (n * ncols))()
+        rows = L.amtpu_doc_stats(self._pool, buf, n * ncols)
+        if rows < 0:
+            _raise_last()
+        ln = ctypes.c_int64()
+        ptr = L.amtpu_doc_ids(self._pool, ctypes.byref(ln))
+        if not ptr:
+            _raise_last()
+        ids = msgpack.unpackb(_take_buf(ptr, ln.value), raw=False)
+        rows = int(rows)
+        stats = np.frombuffer(buf, dtype=np.int64,
+                              count=rows * ncols).reshape(rows, ncols)
+        # a private copy: `buf` dies with this frame
+        return ids[:rows], stats.copy()
+
 
 class ShardedNativePool:
     """S independent native pools, driven pipelined or threaded.
@@ -2830,6 +2869,22 @@ class ShardedNativePool:
         if doc_id is not None:
             return self.pools[self._shard_of(doc_id)].op_count(doc_id)
         return sum(p.op_count() for p in self.pools)
+
+    DOC_STAT_COLS = NativeDocPool.DOC_STAT_COLS
+
+    def doc_stats(self):
+        """Per-doc stats across every shard (one C call per shard),
+        concatenated in shard order -- same (doc_keys, (N, cols) int64
+        ndarray) contract as `NativeDocPool.doc_stats`."""
+        ids, mats = [], []
+        for p in self.pools:
+            pids, pstats = p.doc_stats()
+            ids.extend(pids)
+            if len(pids):
+                mats.append(pstats)
+        if not mats:
+            return ids, np.zeros((0, len(self.DOC_STAT_COLS)), np.int64)
+        return ids, np.concatenate(mats, axis=0)
 
 
 def make_pool():

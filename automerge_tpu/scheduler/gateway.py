@@ -70,7 +70,7 @@ import time
 
 from .. import faults, telemetry
 from ..resilience import is_quarantine_error, is_quarantined
-from ..telemetry import attribution
+from ..telemetry import attribution, capacity
 from ..utils.common import env_bool
 from .egress import EgressQueue
 from .queue import (READ_CMDS, AdmissionQueue,  # noqa: F401 (re-export)
@@ -353,6 +353,15 @@ class GatewayServer(object):
                                        self._encode_frame)
             telemetry.register_healthz_section(
                 'fanout', self.fanout.healthz_section)
+        # per-doc capacity accounting + headroom (ISSUE 15): wire the
+        # serving tiers into the process-wide tracker and surface the
+        # healthz `capacity` section + /debug/docs off it
+        capacity.attach(pool=self.backend.pool,
+                        pool_lock=self.pool_lock,
+                        storage_tier=self.storage_tier,
+                        egress_fn=self._egress_healthz_section)
+        telemetry.register_healthz_section(
+            'capacity', capacity.capacity_section)
         self._dispatch_thread = threading.Thread(
             target=self._dispatch_loop, name='amtpu-gw-dispatch',
             daemon=True)
@@ -393,6 +402,8 @@ class GatewayServer(object):
         telemetry.register_healthz_section('egress', None)
         telemetry.register_healthz_section('fanout', None)
         telemetry.register_healthz_section('storage', None)
+        telemetry.register_healthz_section('capacity', None)
+        capacity.detach()
 
     def _healthz_section(self):
         from ..native import live_batch_handles
@@ -749,6 +760,24 @@ class GatewayServer(object):
                       % (d, type(e).__name__, e), file=sys.stderr)
         self.storage_tier.note_touch(touched)
         self.storage_tier.maybe_evict(protect=touched)
+        # proactive memory-pressure eviction (ISSUE 15): past
+        # AMTPU_MEM_PRESSURE_EVICT of AMTPU_MEM_BUDGET_MB the LRU tail
+        # checkpoints out even below the doc-count cap -- evict before
+        # the OOM killer does.  The pressure read is throttled
+        # (AMTPU_CAPACITY_REFRESH_S shares one native stats pass with
+        # healthz scrapes), so the per-flush cost is a dict read.
+        try:
+            if capacity.TRACKER.evict_due():
+                self.storage_tier.maybe_evict(protect=touched,
+                                              pressure=True)
+                # start the cooldown window: a stuck-high RSS signal
+                # gets one bounded pass per window, never per flush
+                capacity.TRACKER.note_pressure_pass()
+        except Exception as e:
+            # pressure eviction is an optimization: it must never fail
+            # the flush that triggered it
+            print('gateway: pressure eviction failed: %s: %s'
+                  % (type(e).__name__, e), file=sys.stderr)
 
     def _observe_wait(self, ops):
         now = time.perf_counter()

@@ -79,6 +79,13 @@ class ColdStore(object):
         process recovers from the manifest -- the handoff inventory)."""
         return list(self._index)
 
+    def disk_bytes(self, doc_id):
+        """On-disk bytes of one cold doc (0 when not stored) -- the
+        `disk_bytes` tier of the capacity cost vector
+        (telemetry/capacity.py)."""
+        entry = self._index.get(doc_id)
+        return entry[1] if entry is not None else 0
+
     @property
     def bytes(self):
         return sum(e[1] for e in self._index.values())
@@ -320,23 +327,44 @@ class DocEvictor(object):
             self._lru[d] = True
             self._lru.move_to_end(d)
 
-    def maybe_evict(self, protect=()):
+    def maybe_evict(self, protect=(), pressure=False, max_evict=None):
         """Evicts least-recently-touched docs past the residency cap
-        (never one in `protect` -- the flush's own docs)."""
-        if self.max <= 0:
-            return 0
+        (never one in `protect` -- the flush's own docs).
+
+        ``pressure=True`` is the headroom estimator's proactive mode
+        (telemetry/capacity.py; docs/STORAGE.md eviction-pressure
+        section): the doc-count cap is ignored and up to `max_evict`
+        (default ``AMTPU_PRESSURE_EVICT_DOCS``) LRU docs checkpoint out
+        regardless -- evict BEFORE the OOM killer does, not just past a
+        count.  Each eviction records the arena bytes it actually freed
+        (per-doc stats, captured pre-drop) under
+        ``storage.evicted_bytes`` and a per-doc ``storage.evict``
+        recorder event carrying doc + bytes."""
+        if pressure:
+            budget = max_evict if max_evict is not None \
+                else env_int('AMTPU_PRESSURE_EVICT_DOCS', 16)
+            target = 0
+        else:
+            if self.max <= 0:
+                return 0
+            budget = len(self._lru)
+            target = self.max
         protect = set(protect)
-        evicted = 0
+        evicted = freed = 0
         # bounded walk: each pass either evicts the oldest unprotected
         # doc or skips a protected one (requeued at the end)
         attempts = len(self._lru)
-        while len(self._lru) > self.max and attempts > 0:
+        while len(self._lru) > target and attempts > 0 \
+                and evicted < budget:
             attempts -= 1
             doc, _ = next(iter(self._lru.items()))
             if doc in protect:
                 self._lru.move_to_end(doc)
                 continue
             try:
+                # bytes actually freed: the doc's retained arena span
+                # sum, read BEFORE the drop erases the DocState
+                doc_bytes = self.pool.history_bytes(doc)
                 blob = self.pool.save(doc)
                 self.store.put(doc, blob)
                 self.pool.drop_doc(doc)
@@ -349,9 +377,16 @@ class DocEvictor(object):
             self._lru.pop(doc, None)
             self._gc_debt.pop(doc, None)
             evicted += 1
+            freed += doc_bytes
+            telemetry.recorder.record('storage.evict', doc=doc,
+                                      n=doc_bytes,
+                                      detail='pressure' if pressure
+                                      else None)
         if evicted:
             telemetry.metric('storage.evictions', evicted)
-            telemetry.recorder.record('storage.evict', n=evicted)
+            telemetry.metric('storage.evicted_bytes', freed)
+            if pressure:
+                telemetry.metric('storage.pressure_evictions', evicted)
         return evicted
 
     # -- settled-history GC cadence -------------------------------------
@@ -376,9 +411,15 @@ class DocEvictor(object):
     # -- observability --------------------------------------------------
 
     def healthz_section(self):
+        flat = telemetry.metrics_snapshot()
         return {'resident_docs': len(self._lru),
                 'max_resident': self.max,
                 'cold_docs': len(self.store),
                 'cold_bytes': self.store.bytes,
                 'durable': self.store.durable,
-                'gc_every': self.gc_every}
+                'gc_every': self.gc_every,
+                'evictions': int(flat.get('storage.evictions', 0)),
+                'evicted_bytes': int(flat.get('storage.evicted_bytes',
+                                              0)),
+                'pressure_evictions': int(flat.get(
+                    'storage.pressure_evictions', 0))}

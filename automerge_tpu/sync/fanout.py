@@ -89,6 +89,7 @@ import time
 import numpy as np
 
 from .. import telemetry
+from ..telemetry import capacity
 from ..utils.common import env_bool
 
 #: amortized-doubling floor for matrix capacities
@@ -557,11 +558,18 @@ class FanoutEngine(object):
         writer thread for bounded queues (ISSUE 13), whose sheds
         regress believed back to acked instead."""
         n_frames = 0
+        egress_by_doc = {}      # capacity egress tier: one note per doc
         for send, entries in pending.values():
             payload = b''.join(e[0] for e in entries)
             n_frames += len(entries)
             stage = getattr(send, 'stage', None)
             if stage is not None:
+                # per-doc share of the egress backlog at STAGE time
+                # (aggregated locally -- the tracker is noted once per
+                # doc per flush, never per frame)
+                for e in entries:
+                    egress_by_doc[e[2]] = \
+                        egress_by_doc.get(e[2], 0) + len(e[0])
                 self._advance_staged(entries)
                 stage(payload, kind='event',
                       on_write=(lambda e=entries, n=len(payload):
@@ -577,6 +585,8 @@ class FanoutEngine(object):
                 continue
             self._advance_staged(entries)
             self._write_complete(entries, len(payload))
+        for doc_id, n_bytes in egress_by_doc.items():
+            capacity.note_egress(doc_id, n_bytes)
         return n_frames
 
     def _advance_staged(self, entries):  # holds-lock: self._lock
@@ -715,8 +725,14 @@ class FanoutEngine(object):
                 if self._stage(pending, row, buf, enq_t, None, doc_id):
                     staged += 1
             telemetry.metric('sync.fanout.quarantine_frames', staged)
+            capacity.note_fanout(doc_id, len(buf), len(buf) * staged,
+                                 len(rows))
             return
         if not rows:
+            # still note the zero: a doc whose subscribers all left
+            # must read subscribers=0 on the capacity surface, not its
+            # last positive count
+            capacity.note_fanout(doc_id, 0, 0, 0)
             return
         # a PRIVATE copy: entries outlive this doc's staging pass, and
         # the believed updates in _flush_writes must see the post clock
@@ -728,6 +744,10 @@ class FanoutEngine(object):
         stragglers = [row for row, b, e in zip(rows, behind, exact)
                       if b and not e]
         uptodate = len(rows) - len(coalesced) - len(stragglers)
+        # capacity cost vector, fan-out tier (telemetry/capacity.py):
+        # encoded-once bytes vs total fanned bytes = this doc's
+        # amplification; one note per dirty doc per flush
+        encoded_b = fanned_b = 0
         if coalesced:
             # THE encode-once path: one pool delta fetch, one wire
             # encoding, N frames of the same bytes -- and rows sharing
@@ -749,6 +769,8 @@ class FanoutEngine(object):
             telemetry.metric('sync.fanout.coalesced_peers', staged)
             if staged > 1:
                 telemetry.metric('sync.fanout.encode_reuse', staged - 1)
+            encoded_b += len(buf)
+            fanned_b += len(buf) * staged
         # stragglers group by believed clock: a reconnect stampede (or
         # a shed cohort regressed to the same acked row) pays ONE
         # filtered-delta fetch and ONE encoding per distinct clock --
@@ -775,16 +797,22 @@ class FanoutEngine(object):
                 frame['presence'] = presence
             buf = self._encode(frame)
             telemetry.metric('sync.fanout.bytes_encoded', len(buf))
+            staged_g = 0
             for row in rows_g:
-                self._stage(pending, row, buf, enq_t, post_vec, doc_id)
+                if self._stage(pending, row, buf, enq_t, post_vec,
+                               doc_id):
+                    staged_g += 1
             if len(rows_g) > 1:
                 telemetry.metric('sync.fanout.straggler_reuse',
                                  len(rows_g) - 1)
+            encoded_b += len(buf)
+            fanned_b += len(buf) * staged_g
         if stragglers:
             telemetry.metric('sync.fanout.straggler_peers',
                              len(stragglers))
         if uptodate:
             telemetry.metric('sync.fanout.uptodate_peers', uptodate)
+        capacity.note_fanout(doc_id, encoded_b, fanned_b, len(rows))
 
     # -- observability --------------------------------------------------
 

@@ -292,6 +292,7 @@ KNOWN_STORAGE_KEYS = ('columnar.encodes', 'columnar.decodes',
                       'gc.ops_folded', 'gc.rechunks',
                       'evictions', 'reloads', 'reload_failed',
                       'evict_failed', 'cold_bytes_written',
+                      'evicted_bytes', 'pressure_evictions',
                       'native_encodes', 'python_encodes',
                       'native_decodes', 'python_decodes',
                       'native_loads', 'durable_writes',
@@ -306,6 +307,17 @@ KNOWN_STORAGE_KEYS = ('columnar.encodes', 'columnar.decodes',
 # dump_failed   dumps that could not be written (full disk, bad dir);
 #                 the triggering failure is never re-raised
 KNOWN_RECORDER_KEYS = ('dumps', 'dump_failed')
+
+# per-doc capacity accounting counters (`telemetry.metric(
+# 'capacity.<name>')` call sites in telemetry/capacity.py; capacity
+# section: docs/OBSERVABILITY.md), pre-seeded into every bench_block:
+# refreshes       native per-doc stats passes (throttled by
+#                   AMTPU_CAPACITY_REFRESH_S; healthz scrapes and
+#                   per-flush pressure checks share one)
+# pressure_high   refreshes that measured memory pressure at or past
+#                   AMTPU_MEM_PRESSURE_EVICT (the proactive-eviction
+#                   signal)
+KNOWN_CAPACITY_KEYS = ('refreshes', 'pressure_high')
 
 # SLO / attribution counters (`telemetry.metric('slo.<name>')` call
 # sites in telemetry/attribution.py; request-stage glossary:
@@ -626,6 +638,10 @@ def bench_block():
     slo.update({k.split('.', 1)[1]: round(v, 6)
                 for k, v in flat.items()
                 if k.startswith('slo.')})
+    cap = {r: 0.0 for r in KNOWN_CAPACITY_KEYS}
+    cap.update({k.split('.', 1)[1]: round(v, 6)
+                for k, v in flat.items()
+                if k.startswith('capacity.')})
     block = {
         'fallbacks': fallbacks,
         'collect': collect,
@@ -639,6 +655,7 @@ def bench_block():
         'storage': storage,
         'recorder': rec,
         'slo': slo,
+        'capacity': cap,
         'device_s': round(flat.get('device.dispatch_sync_s', 0.0), 4),
         'device_dispatches': int(flat.get('device.dispatches', 0)),
         'batch_latency': BATCH_LATENCY.snapshot() or {},
@@ -674,7 +691,7 @@ def reset_all():
     phase_reset()
 
 
-# imported LAST: both modules resolve names from this module (registry,
+# imported LAST: these modules resolve names from this module (registry,
 # buckets, metric) lazily, so they must load after those exist
-from . import attribution, recorder  # noqa: E402,F401
+from . import attribution, capacity, recorder  # noqa: E402,F401
 

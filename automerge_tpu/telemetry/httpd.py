@@ -1,18 +1,20 @@
 """Sidecar metrics listener: a tiny stdlib HTTP server exposing
 `/metrics` (Prometheus text exposition), `/healthz` (JSON liveness),
-and `/debug/recorder` (the flight recorder's ring as JSON, newest
-last, plus the recent exemplar roots) so a fleet of sidecars is
-scrapeable and post-mortem-able without touching the stream protocol.
-Runs as a daemon thread next to the stream loop; the same payloads are
-also answerable in-band via the `metrics` / `healthz` / `dump` request
-types (sidecar/server.py) for transports that already hold a stream
-open.
+`/debug/recorder` (the flight recorder's ring as JSON, newest last,
+plus the recent exemplar roots), and `/debug/docs` (the per-doc
+capacity surface: hot-doc cost vectors + headroom; `?k=n` bounds the
+table) so a fleet of sidecars is scrapeable and post-mortem-able
+without touching the stream protocol.  Runs as a daemon thread next to
+the stream loop; the same payloads are also answerable in-band via the
+`metrics` / `healthz` / `dump` request types (sidecar/server.py) for
+transports that already hold a stream open.
 """
 
 import json
 import threading
 
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs
 
 CONTENT_TYPE = 'text/plain; version=0.0.4; charset=utf-8'
 
@@ -20,7 +22,7 @@ CONTENT_TYPE = 'text/plain; version=0.0.4; charset=utf-8'
 class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         from . import healthz, render_prometheus
-        path = self.path.split('?', 1)[0]
+        path, _, query = self.path.partition('?')
         if path == '/metrics':
             body = render_prometheus().encode()
             ctype = CONTENT_TYPE
@@ -33,6 +35,15 @@ class _Handler(BaseHTTPRequestHandler):
                 {'events': recorder.events_json(),
                  'exemplars': attribution.recent_exemplars()},
                 default=str) + '\n').encode()
+            ctype = 'application/json'
+        elif path == '/debug/docs':
+            from . import capacity
+            try:
+                k = int(parse_qs(query).get('k', ['0'])[0]) or None
+            except ValueError:
+                k = None
+            body = (json.dumps(capacity.debug_docs(k=k), default=str)
+                    + '\n').encode()
             ctype = 'application/json'
         else:
             self.send_response(404)

@@ -532,6 +532,17 @@ struct DocState {
   std::vector<std::vector<OpRec>> undo_stack;
   size_t undo_pos = 0;
   std::vector<std::vector<OpRec>> redo_stack;
+  // per-doc resource accounting (ISSUE 15, amtpu_doc_stats): retained
+  // raw bytes / op records of the APPLIED states entries, kept in
+  // lockstep at the four sites that mutate them (update_states push,
+  // journal rollback pop, amtpu_truncate_history, amtpu_fold_settled).
+  // The causal queue is deliberately NOT tracked here -- it is tiny
+  // and walked fresh at stats time, so its accounting cannot drift.
+  // Totals across docs reconcile bit-exactly with amtpu_history_bytes
+  // / amtpu_op_count (the capacity tests pin it).
+  i64 acct_raw_bytes = 0;
+  i64 acct_ops = 0;
+  i64 acct_folded_ops = 0;   // op records freed by amtpu_fold_settled
 
   static u64 rkey(u32 obj, u32 key) {
     return (static_cast<u64>(obj) << 32) | key;
@@ -1441,9 +1452,15 @@ struct BeginJournal {
 
   void rollback(Batch& b) {
     for (auto it = state_pushes.rbegin(); it != state_pushes.rend(); ++it) {
-      auto& entries = b.bdocs[it->first]->states[it->second];
+      DocState& st = *b.bdocs[it->first];
+      auto& entries = st.states[it->second];
+      // per-doc accounting: the popped entry leaves the retained set
+      // (entries pushed this batch are never folded, so ops is exact)
+      st.acct_raw_bytes -=
+          static_cast<i64>(entries.back().change.raw.size());
+      st.acct_ops -= static_cast<i64>(entries.back().change.ops.size());
       entries.pop_back();
-      if (entries.empty()) b.bdocs[it->first]->states.erase(it->second);
+      if (entries.empty()) st.states.erase(it->second);
     }
     // reverse: per-doc sizes were recorded increasing, the earliest wins
     for (auto it = actor_orders.rbegin(); it != actor_orders.rend(); ++it)
@@ -1525,6 +1542,9 @@ static void update_states(Pool& pool, Batch& b, BeginJournal& j) {
     // the change MOVES into the states entry (its ops/raw heap data stays
     // put, so batch-held pointers into them remain valid)
     sit->second.push_back({std::move(ch), std::move(all_deps)});
+    st.acct_raw_bytes +=
+        static_cast<i64>(sit->second.back().change.raw.size());
+    st.acct_ops += static_cast<i64>(sit->second.back().change.ops.size());
     const Clock& adeps = sit->second.back().all_deps;
     j.state_pushes.emplace_back(ac.doc, actor);
     clock_set_max(st.clock, actor, seq);
@@ -5916,6 +5936,7 @@ int64_t amtpu_truncate_history(void* pool_ptr, const char* doc_id,
     for (auto& [a, s] : st.history)
       if (s > clock_get(f, a)) keep.emplace_back(a, s);
     st.history.swap(keep);
+    st.acct_raw_bytes -= freed;   // per-doc accounting (amtpu_doc_stats)
     return freed;
   } catch (const std::exception& e) {
     g_error = e.what(); g_error_kind = 0;
@@ -6201,6 +6222,8 @@ int64_t amtpu_fold_settled(void* pool_ptr, const char* doc_id,
         e.folded = true;
       }
     }
+    st.acct_ops -= freed;          // per-doc accounting (amtpu_doc_stats)
+    st.acct_folded_ops += freed;
     return freed;
   } catch (const std::exception& e) {
     g_error = e.what(); g_error_kind = 0;
@@ -6231,6 +6254,90 @@ int64_t amtpu_op_count(void* pool_ptr, const char* doc_id) {
     }
     auto it = pool.docs.find(doc_id);
     return it == pool.docs.end() ? 0 : sum_doc(it->second);
+  } catch (const std::exception& e) {
+    g_error = e.what(); g_error_kind = 0;
+    return -1;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// per-doc resource accounting (ISSUE 15, docs/OBSERVABILITY.md capacity
+// section): one C call returns the whole pool's per-doc cost rows.
+// ---------------------------------------------------------------------------
+
+// Doc ids of the pool in doc_order (first-seen) order as a msgpack
+// array of strings -- the row order of amtpu_doc_stats.  malloc'd
+// buffer (amtpu_buf_free), NULL on error.
+uint8_t* amtpu_doc_ids(void* pool_ptr, int64_t* len) {
+  Pool& pool = *static_cast<Pool*>(pool_ptr);
+  try {
+    Writer out;
+    out.array(pool.doc_order.size());
+    for (auto& id : pool.doc_order) out.str(id);
+    *len = static_cast<int64_t>(out.buf.size());
+    uint8_t* res = static_cast<uint8_t*>(std::malloc(out.buf.size()));
+    std::memcpy(res, out.buf.data(), out.buf.size());
+    return res;
+  } catch (const std::exception& e) {
+    g_error = e.what(); g_error_kind = 0;
+    *len = -1;
+    return nullptr;
+  }
+}
+
+// Per-doc resource stats, batch-wise: fills `out` with one 6-column
+// int64 row per doc in doc_order order (same order as amtpu_doc_ids):
+//   [0] hist_bytes   retained raw change bytes (states + causal queue)
+//   [1] ops          retained op records (states + causal queue)
+//   [2] folded_ops   op records freed by amtpu_fold_settled
+//   [3] changes      retained change records (state entries + queue)
+//   [4] queued       causally-parked queue length
+//   [5] resclk_rows  pool-resident clock rows keyed by this doc
+// `cap` is the out capacity in int64s; rows past it are not written.
+// Returns the number of ROWS written (never more than cap/6), -1 on
+// error.  Column totals across all docs reconcile EXACTLY with
+// amtpu_history_bytes(pool, "") / amtpu_op_count(pool, "") -- the
+// states contribution comes from the incrementally-maintained per-doc
+// counters and the queue is walked fresh here, so the capacity tests
+// can pin bit-equality.  resclk rows are attributed by matching the
+// table's DocState-pointer keys against LIVE docs only: amtpu_drop_doc
+// invalidates the table, so a reused DocState address can never
+// inherit a dropped doc's rows (the drop/re-add test pins it).
+int64_t amtpu_doc_stats(void* pool_ptr, int64_t* out, int64_t cap) {
+  Pool& pool = *static_cast<Pool*>(pool_ptr);
+  try {
+    std::unordered_map<const void*, size_t> doc_idx;
+    doc_idx.reserve(pool.docs.size() * 2);
+    size_t n_rows = std::min<size_t>(pool.doc_order.size(),
+                                     cap > 0 ? cap / 6 : 0);
+    for (size_t i = 0; i < n_rows; ++i) {
+      auto it = pool.docs.find(pool.doc_order[i]);
+      if (it == pool.docs.end()) {   // doc_order never dangles, but a
+        std::memset(out + i * 6, 0, 6 * sizeof(int64_t));  // zero row
+        continue;                    // is safer than UB if it ever did
+      }
+      DocState& st = it->second;
+      doc_idx[static_cast<const void*>(&st)] = i;
+      i64 qb = 0, qops = 0;
+      for (auto& ch : st.queue) {
+        qb += static_cast<i64>(ch.raw.size());
+        qops += static_cast<i64>(ch.ops.size());
+      }
+      i64 n_entries = 0;
+      for (auto& [a, entries] : st.states)
+        n_entries += static_cast<i64>(entries.size());
+      out[i * 6 + 0] = st.acct_raw_bytes + qb;
+      out[i * 6 + 1] = st.acct_ops + qops;
+      out[i * 6 + 2] = st.acct_folded_ops;
+      out[i * 6 + 3] = n_entries + static_cast<i64>(st.queue.size());
+      out[i * 6 + 4] = static_cast<i64>(st.queue.size());
+      out[i * 6 + 5] = 0;
+    }
+    for (auto& [key, _row] : pool.resclk.rows) {
+      auto dit = doc_idx.find(key.doc);
+      if (dit != doc_idx.end()) ++out[dit->second * 6 + 5];
+    }
+    return static_cast<int64_t>(n_rows);
   } catch (const std::exception& e) {
     g_error = e.what(); g_error_kind = 0;
     return -1;

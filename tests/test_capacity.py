@@ -1,0 +1,393 @@
+"""Per-doc resource accounting + capacity observability (ISSUE 15).
+
+Lanes:
+  * reconciliation -- `doc_stats` column totals equal the pool-wide
+    `history_bytes()` / `op_count()` BIT-EXACTLY across mutate / GC /
+    fold / evict / reload cycles, in both exec modes;
+  * space-saver sketch -- zipfian correctness vs exact counts +
+    overestimation bounds;
+  * headroom estimator -- budget / pressure / burn / exhaustion unit
+    lanes with injected used_fn + clock;
+  * tracker surfaces -- cost vectors, hot-doc tables, healthz section;
+  * DocEvictor -- per-eviction freed-bytes accounting + the
+    per-doc `storage.evict` recorder event; pressure mode ignores the
+    doc-count cap;
+  * drop/re-add resident-clock attribution (subprocess lane, forced
+    kernel path): `amtpu_drop_doc` must leave NO stale resclk row
+    attribution -- the doc-pointer-keyed cache is the known reuse
+    hazard.
+"""
+
+import os
+import random
+import subprocess
+import sys
+
+import pytest
+
+from automerge_tpu import telemetry
+from automerge_tpu.native import NativeDocPool, ShardedNativePool
+from automerge_tpu.storage.coldstore import ColdStore, DocEvictor
+from automerge_tpu.telemetry import capacity, recorder
+from automerge_tpu.telemetry.capacity import (HeadroomEstimator,
+                                              SpaceSaver)
+
+ROOT_ID = '00000000-0000-0000-0000-000000000000'
+
+
+def _changes(actor, seq0, n, keyspace=8, seed=0):
+    rng = random.Random(seed * 1000 + seq0)
+    out = []
+    for i in range(n):
+        out.append({'actor': actor, 'seq': seq0 + i + 1,
+                    'deps': {actor: seq0 + i} if seq0 + i else {},
+                    'ops': [{'action': 'set', 'obj': ROOT_ID,
+                             'key': 'k%d' % rng.randrange(keyspace),
+                             'value': 'v%d' % rng.randrange(1 << 16)}]})
+    return out
+
+
+def _reconciled(pool):
+    ids, stats = pool.doc_stats()
+    hist = int(stats[:, 0].sum()) if len(ids) else 0
+    ops = int(stats[:, 1].sum()) if len(ids) else 0
+    assert hist == pool.history_bytes()
+    assert ops == pool.op_count()
+    return ids, stats
+
+
+@pytest.mark.parametrize('host_full', ['0', '1'])
+def test_doc_stats_reconcile_churn_gc_evict_reload(host_full,
+                                                   monkeypatch):
+    monkeypatch.setenv('AMTPU_HOST_FULL', host_full)
+    pool = NativeDocPool()
+    evictor = DocEvictor(pool, max_resident=3, store=ColdStore(),
+                         gc_every=4)
+    seqs = {}
+    for rnd in range(3):
+        for d in range(6):
+            doc = 'doc%d' % d
+            n = 3 + (d % 2)
+            pool.apply_changes(doc, _changes('a%d' % (d % 2),
+                                             seqs.get(doc, 0), n,
+                                             seed=d))
+            seqs[doc] = seqs.get(doc, 0) + n
+            evictor.note_mutations(doc, n)   # folds past the cadence
+            evictor.note_touch([doc])
+        _reconciled(pool)
+        evictor.maybe_evict()
+        _reconciled(pool)
+    failed = evictor.ensure_resident(list(seqs))
+    assert not failed
+    ids, stats = _reconciled(pool)
+    assert len(ids) == 6
+    # per-doc rows agree with the per-doc pool queries too
+    for i, key in enumerate(ids):
+        assert int(stats[i, 0]) == pool.history_bytes(key)
+        assert int(stats[i, 1]) == pool.op_count(key)
+
+
+def test_doc_stats_sharded_concat():
+    pool = ShardedNativePool(3)
+    for d in range(9):
+        pool.apply_changes('s%d' % d, _changes('w', 0, 2, seed=d))
+    ids, stats = pool.doc_stats()
+    assert sorted(ids) == sorted('s%d' % d for d in range(9))
+    assert int(stats[:, 0].sum()) == pool.history_bytes()
+    assert int(stats[:, 1].sum()) == pool.op_count()
+
+
+def test_doc_stats_folded_and_queued_columns():
+    pool = NativeDocPool()
+    pool.apply_changes('f', _changes('w', 0, 12, seed=1))
+    assert pool.compact('f') > 0          # folds the settled prefix
+    ids, stats = _reconciled(pool)
+    i = ids.index(NativeDocPool._doc_key('f'))
+    assert int(stats[i, 2]) > 0           # folded_ops recorded
+    # a causally-parked change lands in the queued column
+    pool.apply_changes('f', [{'actor': 'q', 'seq': 2,
+                              'deps': {'q': 1},
+                              'ops': [{'action': 'set', 'obj': ROOT_ID,
+                                       'key': 'z', 'value': 1}]}])
+    ids, stats = _reconciled(pool)
+    i = ids.index(NativeDocPool._doc_key('f'))
+    assert int(stats[i, 4]) == 1
+    # delivering the missing dep drains the queue; still reconciled
+    pool.apply_changes('f', [{'actor': 'q', 'seq': 1, 'deps': {},
+                              'ops': [{'action': 'set', 'obj': ROOT_ID,
+                                       'key': 'z', 'value': 0}]}])
+    ids, stats = _reconciled(pool)
+    i = ids.index(NativeDocPool._doc_key('f'))
+    assert int(stats[i, 4]) == 0
+
+
+def test_doc_stats_rollback_and_local_change_paths():
+    """Accounting survives the non-batch mutation paths: a FAILED
+    batch's journal rollback restores the exact pre-batch rows, and
+    the local-change / undo / redo pipeline stays reconciled."""
+    pool = NativeDocPool()
+    pool.apply_local_change('lc', {'requestType': 'change',
+                                   'actor': 'me', 'seq': 1, 'deps': {},
+                                   'ops': [{'action': 'set',
+                                            'obj': ROOT_ID, 'key': 'a',
+                                            'value': 1}]})
+    _reconciled(pool)
+    pre = pool.doc_stats()[1].copy()
+    with pytest.raises(Exception):
+        # inconsistent seq reuse: validation fails post-schedule and
+        # the begin journal rolls everything back
+        pool.apply_batch({'lc': [{'actor': 'me', 'seq': 1, 'deps': {},
+                                  'ops': [{'action': 'set',
+                                           'obj': ROOT_ID, 'key': 'a',
+                                           'value': 999}]}]})
+    _ids, stats = _reconciled(pool)
+    assert (stats == pre).all()
+    pool.apply_local_change('lc', {'requestType': 'change',
+                                   'actor': 'me', 'seq': 2, 'deps': {},
+                                   'ops': [{'action': 'set',
+                                            'obj': ROOT_ID, 'key': 'b',
+                                            'value': 2}]})
+    pool.apply_local_change('lc', {'requestType': 'undo', 'actor': 'me',
+                                   'seq': 3, 'deps': {}})
+    pool.apply_local_change('lc', {'requestType': 'redo', 'actor': 'me',
+                                   'seq': 4, 'deps': {}})
+    _reconciled(pool)
+
+
+def test_space_saver_zipfian_vs_exact():
+    rng = random.Random(7)
+    sketch = SpaceSaver(48)
+    exact = {}
+    for _ in range(20000):
+        k = 'd%d' % min(int(rng.paretovariate(1.15)) - 1, 499)
+        w = rng.randrange(1, 512)
+        sketch.offer(k, w)
+        exact[k] = exact.get(k, 0) + w
+    top_exact = [k for k, _ in sorted(exact.items(),
+                                      key=lambda kv: -kv[1])]
+    top_sketch = [k for k, _v, _e in sketch.top()]
+    assert top_sketch[:3] == top_exact[:3]
+    assert sketch.total == sum(exact.values())
+    for k, est, err in sketch.top():
+        assert est - err <= exact.get(k, 0) <= est
+    # the guarantee: any key heavier than total/k is present
+    thresh = sketch.total / sketch.k
+    for k, v in exact.items():
+        if v > thresh:
+            assert k in sketch.counts
+
+
+def test_space_saver_bounded_memory():
+    sketch = SpaceSaver(16)
+    for i in range(5000):
+        sketch.offer('k%d' % i, 1 + i % 7)
+    assert len(sketch.counts) <= 16
+    assert len(sketch.errs) <= 16
+    assert len(sketch._heap) <= 8 * 16
+
+
+def test_headroom_estimator_lanes():
+    used = {'v': 100}
+    t = {'v': 0.0}
+    est = HeadroomEstimator(budget_bytes=1000,
+                            used_fn=lambda: used['v'],
+                            clock=lambda: t['v'])
+    out = est.sample({})
+    assert out['pressure'] == 0.1
+    assert out['burn_bytes_s'] is None and out['exhaustion_s'] is None
+    used['v'], t['v'] = 400, 1.0         # +300 B/s
+    out = est.sample({})
+    assert out['pressure'] == 0.4
+    assert out['burn_bytes_s'] == 300.0
+    assert out['exhaustion_s'] == 2.0    # (1000-400)/300
+    # pressure eviction trips at the configured fraction
+    os.environ['AMTPU_MEM_PRESSURE_EVICT'] = '0.5'
+    try:
+        assert not est.evict_due(0.4)
+        assert est.evict_due(0.6)
+    finally:
+        del os.environ['AMTPU_MEM_PRESSURE_EVICT']
+    # no budget -> no pressure, never evict-due
+    est2 = HeadroomEstimator(budget_bytes=0, used_fn=lambda: 10**9)
+    out = est2.sample({})
+    assert out['pressure'] == 0.0
+    assert not est2.evict_due(99.0)
+
+
+def test_pressure_pass_cooldown(monkeypatch):
+    """A stuck-high pressure signal gets ONE bounded eviction pass per
+    cooldown window, never one per flush (evict/reload thrash guard)."""
+    monkeypatch.setenv('AMTPU_MEM_PRESSURE_EVICT', '0.5')
+    monkeypatch.setenv('AMTPU_CAPACITY_REFRESH_S', '0')
+    tr = capacity.CapacityTracker()
+    tr.estimator = HeadroomEstimator(budget_bytes=100,
+                                     used_fn=lambda: 90)  # 0.9 > 0.5
+    monkeypatch.setenv('AMTPU_PRESSURE_EVICT_COOLDOWN_S', '3600')
+    assert tr.evict_due()
+    tr.note_pressure_pass()
+    assert not tr.evict_due()             # inside the window
+    monkeypatch.setenv('AMTPU_PRESSURE_EVICT_COOLDOWN_S', '0')
+    assert tr.evict_due()                 # 0 disables the cooldown
+
+
+def test_headroom_component_sum_fallback():
+    est = HeadroomEstimator(budget_bytes=0)
+    out = est.sample({'rss': 0, 'arena': 30, 'wal': 10,
+                      'cold_disk': 999})
+    # cold disk is not memory: excluded from the component-sum fallback
+    assert out['used_bytes'] == 40
+
+
+def test_tracker_cost_vectors_and_section():
+    pool = NativeDocPool()
+    pool.apply_changes('big', _changes('w', 0, 20, seed=2))
+    pool.apply_changes('small', _changes('w', 0, 2, seed=3))
+    evictor = DocEvictor(pool, max_resident=0, store=ColdStore(),
+                         gc_every=0)
+    blob = pool.save('small')
+    evictor.store.put('small', blob)
+    tr = capacity.CapacityTracker()
+    tr.attach(pool=pool, storage_tier=evictor)
+    tr.note_fanout('big', 100, 700, 7)
+    tr.note_egress('big', 256)
+    vecs = tr.cost_vectors()
+    key = NativeDocPool._doc_key('big')
+    assert vecs[key]['arena_bytes'] == pool.history_bytes('big')
+    assert vecs[key]['fanned_bytes'] == 700
+    assert vecs[key]['egress_bytes'] == 256
+    assert vecs[key]['subscribers'] == 7
+    assert vecs['small']['disk_bytes'] == len(blob)
+    section = tr.capacity_section()
+    assert section['top']['arena'][0]['doc'] == key
+    assert section['totals']['disk_bytes'] == len(blob)
+    assert 'headroom' in section
+    fan_row = section['top']['fanned'][0]
+    assert fan_row['doc'] == 'big'
+    assert fan_row['encoded_bytes'] == 100
+    assert fan_row['amplification'] == 7.0      # 700 fanned / 100 enc
+    # a flush that finds the doc subscriber-less zeroes its count
+    tr.note_fanout('big', 0, 0, 0)
+    snap = tr.refresh(force=True)
+    assert snap['top']['fanned'][0]['subscribers'] == 0
+    dbg = tr.debug_docs()
+    assert any(r['doc'] == key for r in dbg['hot_docs'])
+    assert dbg['cost_fields'] == list(capacity.COST_FIELDS)
+
+
+def test_evictor_records_freed_bytes_and_event():
+    telemetry.metrics_reset()
+    pool = NativeDocPool()
+    for d in range(4):
+        pool.apply_changes('e%d' % d, _changes('w', 0, 4, seed=d))
+    per_doc = {d: pool.history_bytes('e%d' % d) for d in range(4)}
+    evictor = DocEvictor(pool, max_resident=2, store=ColdStore(),
+                         gc_every=0)
+    evictor.note_touch(['e0', 'e1', 'e2', 'e3'])
+    assert evictor.maybe_evict() == 2     # e0, e1 LRU out
+    flat = telemetry.metrics_snapshot()
+    assert flat['storage.evictions'] == 2
+    assert flat['storage.evicted_bytes'] == per_doc[0] + per_doc[1]
+    evs = [e for e in recorder.events_json()
+           if e['event'] == 'storage.evict' and e['doc'] == 'e0']
+    assert evs and evs[-1]['n'] == per_doc[0]
+    # healthz carries the running totals
+    hz = evictor.healthz_section()
+    assert hz['evicted_bytes'] == per_doc[0] + per_doc[1]
+    assert hz['pressure_evictions'] == 0
+
+
+def test_evictor_pressure_mode_ignores_doc_cap():
+    telemetry.metrics_reset()
+    pool = NativeDocPool()
+    for d in range(4):
+        pool.apply_changes('pe%d' % d, _changes('w', 0, 2, seed=d))
+    evictor = DocEvictor(pool, max_resident=0, store=ColdStore(),
+                         gc_every=0)
+    evictor.note_touch(['pe%d' % d for d in range(4)])
+    assert evictor.maybe_evict() == 0     # cap disabled: LRU mode idle
+    n = evictor.maybe_evict(protect=['pe3'], pressure=True,
+                            max_evict=2)
+    assert n == 2
+    flat = telemetry.metrics_snapshot()
+    assert flat['storage.pressure_evictions'] == 2
+    assert flat['storage.evicted_bytes'] > 0
+    assert 'pe3' not in evictor.store    # protected doc stayed hot
+
+
+def test_bench_block_capacity_preseed():
+    block = telemetry.bench_block()
+    assert set(telemetry.KNOWN_CAPACITY_KEYS) <= set(block['capacity'])
+
+
+_DROP_READD_SCRIPT = r'''
+import os, ctypes
+os.environ['JAX_PLATFORMS'] = 'cpu'
+os.environ['AMTPU_RESIDENT'] = '1'      # force the kernel path: the
+                                        # resident clock table engages
+from automerge_tpu.native import NativeDocPool, lib
+
+ROOT = '00000000-0000-0000-0000-000000000000'
+
+def concurrent_batch(pool, doc, seq=1):
+    # two (pool-known) actors writing the SAME key concurrently: a
+    # non-trivial register group, so clock rows actually densify into
+    # the pool table (fixed actor names -- a first-seen actor would
+    # invalidate every cached row, which is correct but not this lane)
+    pool.apply_batch({doc: [
+        {'actor': 'A', 'seq': seq, 'deps': {'A': seq - 1} if seq > 1
+         else {},
+         'ops': [{'action': 'set', 'obj': ROOT, 'key': 'k',
+                  'value': 1}]},
+        {'actor': 'B', 'seq': seq, 'deps': {'B': seq - 1} if seq > 1
+         else {},
+         'ops': [{'action': 'set', 'obj': ROOT, 'key': 'k',
+                  'value': 2}]},
+    ]})
+
+def resclk_rows(pool):
+    info = (ctypes.c_int64 * 4)()
+    lib().amtpu_resclk_info(pool._pool, info)
+    return int(info[0])
+
+pool = NativeDocPool()
+concurrent_batch(pool, 'd1')
+concurrent_batch(pool, 'd2')
+concurrent_batch(pool, 'd1', seq=2)     # actors are pool-known now:
+concurrent_batch(pool, 'd2', seq=2)     # these rows PERSIST
+ids, stats = pool.doc_stats()
+total = int(stats[:, 5].sum())
+assert total == resclk_rows(pool) > 0, (total, resclk_rows(pool))
+assert all(int(stats[i, 5]) > 0 for i in range(len(ids))), stats[:, 5]
+
+# drop d1: the pool table invalidates (rows key on the DocState
+# POINTER; a reused address must never inherit them)
+pool.drop_doc('d1')
+ids, stats = pool.doc_stats()
+assert int(stats[:, 5].sum()) == resclk_rows(pool) == 0
+
+# re-add a doc with the SAME id (the address-reuse hazard) and batch
+# again: attribution must cover exactly the live rows, on live docs
+concurrent_batch(pool, 'd1')
+ids, stats = pool.doc_stats()
+assert int(stats[:, 5].sum()) == resclk_rows(pool) > 0
+assert set(ids) == {'d1', 'd2'}
+i1 = ids.index('d1')
+assert int(stats[i1, 5]) > 0            # the NEW rows, on the new doc
+assert int(stats[:, 0].sum()) == pool.history_bytes()
+print('OK')
+'''
+
+
+def test_drop_readd_resclk_attribution_subprocess():
+    """ISSUE 15 satellite: amtpu_doc_stats rows for docs dropped via
+    amtpu_drop_doc must leave no stale resident-clock attribution
+    (subprocess: AMTPU_RESIDENT latches at the first batch)."""
+    env = dict(os.environ)
+    env.pop('XLA_FLAGS', None)
+    out = subprocess.run([sys.executable, '-c', _DROP_READD_SCRIPT],
+                         env=env, capture_output=True, text=True,
+                         timeout=240,
+                         cwd=os.path.dirname(os.path.dirname(
+                             os.path.abspath(__file__))))
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    assert 'OK' in out.stdout

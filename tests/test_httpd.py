@@ -78,6 +78,34 @@ def test_debug_recorder(server):
     assert 'exemplars' in payload
 
 
+def test_debug_docs(server):
+    from automerge_tpu.native import NativeDocPool
+    from automerge_tpu.telemetry import capacity
+    pool = NativeDocPool()
+    pool.apply_changes('httpd-doc', [
+        {'actor': 'h', 'seq': 1, 'deps': {},
+         'ops': [{'action': 'set',
+                  'obj': '00000000-0000-0000-0000-000000000000',
+                  'key': 'x', 'value': 1}]}])
+    capacity.TRACKER.reset()
+    capacity.attach(pool=pool)
+    try:
+        capacity.note_fanout('httpd-doc', 10, 50, 5)
+        status, ctype, body = _get(server, '/debug/docs')
+        assert status == 200
+        assert ctype == 'application/json'
+        payload = json.loads(body)
+        assert payload['totals']['arena_bytes'] == pool.history_bytes()
+        docs = {r['doc'] for r in payload['hot_docs']}
+        assert 'httpd-doc' in docs
+        # ?k=n bounds the hot-doc table
+        payload = json.loads(_get(server, '/debug/docs?k=1')[2])
+        assert len(payload['hot_docs']) <= 1
+    finally:
+        capacity.detach()
+        capacity.TRACKER.reset()
+
+
 def test_unknown_path_404(server):
     with pytest.raises(urllib.error.HTTPError) as ei:
         _get(server, '/nope')

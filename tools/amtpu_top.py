@@ -56,6 +56,67 @@ def _bar(frac, width=BAR_W):
     return '#' * n + '.' * (width - n)
 
 
+def _mb(n):
+    try:
+        return '%.1fMB' % (float(n) / 1048576.0)
+    except (TypeError, ValueError):
+        return '?'
+
+
+def render_capacity(health, out):
+    """The capacity panel (ISSUE 15): headroom bar, eviction pressure
+    state, and the top-K hot docs by arena / disk / fanned bytes from
+    the healthz `capacity` + `storage` sections."""
+    cap = health.get('capacity') or {}
+    if not cap or 'error' in cap:
+        return
+    sto = health.get('storage') or {}
+    head = cap.get('headroom') or {}
+    tot = cap.get('totals') or {}
+    budget = head.get('budget_bytes') or 0
+    used = head.get('used_bytes') or 0
+    pressure = head.get('pressure') or 0.0
+    out.append('')
+    if budget:
+        eta = head.get('exhaustion_s')
+        # CURRENT pressure state, not the cumulative eviction counter
+        # (which would stay lit forever after one eviction)
+        evict_frac = head.get('pressure_evict') or 0
+        hot_now = evict_frac > 0 and pressure >= evict_frac
+        out.append('capacity: used %s / %s |%s| %5.1f%%  burn %s/s  '
+                   'eta %s%s'
+                   % (_mb(used), _mb(budget), _bar(min(1.0, pressure)),
+                      100 * pressure, _mb(head.get('burn_bytes_s') or 0),
+                      '%.0fs' % eta if eta is not None else '-',
+                      '  PRESSURE' if hot_now else ''))
+    else:
+        out.append('capacity: used %s (no AMTPU_MEM_BUDGET_MB set)'
+                   % _mb(used))
+    out.append('  arena %s  disk %s (%s cold docs)  fanned %s  '
+               'egress %s  | evictions %s (%s freed, %s pressure)'
+               % (_mb(tot.get('arena_bytes', 0)),
+                  _mb(tot.get('disk_bytes', 0)),
+                  tot.get('cold_docs', 0),
+                  _mb(tot.get('fanned_bytes', 0)),
+                  _mb(tot.get('egress_bytes', 0)),
+                  sto.get('evictions', 0),
+                  _mb(sto.get('evicted_bytes', 0)),
+                  sto.get('pressure_evictions', 0)))
+    top = cap.get('top') or {}
+    for tier, field in (('arena', 'arena_bytes'), ('disk', 'disk_bytes'),
+                        ('fanned', 'fanned_bytes')):
+        rows = top.get(tier) or []
+        if not rows:
+            continue
+        cells = []
+        for r in rows[:5]:
+            cell = '%s=%s' % (r.get('doc'), _mb(r.get(field, 0)))
+            if r.get('subscribers'):
+                cell += '(%d subs)' % r['subscribers']
+            cells.append(cell)
+        out.append('  hot(%s): %s' % (tier, '  '.join(cells)))
+
+
 def render(health, stages, prev_stages, runtime, prev_runtime,
            interval_s):
     out = []
@@ -131,6 +192,7 @@ def render(health, stages, prev_stages, runtime, prev_runtime,
                   int(res.get('rollback', 0)),
                   rec.get('events', '?'), rec.get('size', '?'),
                   int(runtime.get('recorder.dumps', 0))))
+    render_capacity(health, out)
     return '\n'.join(out)
 
 

@@ -1,0 +1,266 @@
+"""CI gate: per-doc resource accounting + capacity observability
+(ISSUE 15, docs/OBSERVABILITY.md capacity section).
+
+Four acceptance checks, one process:
+
+  1. **reconciliation** -- under a churn + GC + fold + evict + reload
+     workload, the per-doc ``amtpu_doc_stats`` rows must sum
+     BIT-EXACTLY to the pool-wide ``amtpu_history_bytes`` /
+     ``amtpu_op_count`` at every checkpoint, in BOTH exec modes
+     (kernel + full-host) and on a dp=4 ``MeshDocPool``;
+  2. **hot-doc ranking** -- on a zipfian fan-out stream the space-saver
+     sketch's top docs must match the exact per-doc totals, and the
+     arena hot-doc table must rank by the real per-doc history bytes;
+  3. **pressure eviction** -- with ``AMTPU_MEM_BUDGET_MB`` modeled by
+     the headroom estimator, proactive eviction must fire BEFORE the
+     budget is breached (the used-bytes curve never crosses it) and
+     record the bytes it freed;
+  4. **oracle-free** -- ``fallback.oracle == 0`` throughout.
+
+The always-on accounting COST is priced by `make telemetry-check`
+(its raw arm no-ops the `capacity.note_*` seams; same 6% bar as the
+flight recorder).
+
+Usage: [JAX_PLATFORMS=cpu] python tools/capacity_check.py [--out F]
+"""
+import argparse
+import json
+import os
+import random
+import re
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+sys.path.insert(0, ROOT)
+
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+# the mesh lane needs 4 virtual devices (same conftest pattern)
+flags = re.sub(r'--xla_force_host_platform_device_count=\d+', '',
+               os.environ.get('XLA_FLAGS', ''))
+os.environ['XLA_FLAGS'] = (
+    flags + ' --xla_force_host_platform_device_count=4').strip()
+
+ROOT_ID = '00000000-0000-0000-0000-000000000000'
+N_DOCS = int(os.environ.get('AMTPU_BENCH_CAPACITY_DOCS', '32'))
+
+
+def _changes(doc_i, seq0, n, rng):
+    actor = 'w%d' % (doc_i % 4)
+    out = []
+    for i in range(n):
+        out.append({'actor': actor, 'seq': seq0 + i + 1,
+                    'deps': {actor: seq0 + i} if seq0 + i else {},
+                    'ops': [{'action': 'set', 'obj': ROOT_ID,
+                             'key': 'k%d' % rng.randrange(12),
+                             'value': 'v%d' % rng.randrange(1 << 20)}]})
+    return out
+
+
+def _assert_reconciled(pool, problems, label, where):
+    ids, stats = pool.doc_stats()
+    hist = pool.history_bytes()
+    ops = pool.op_count()
+    s_hist = int(stats[:, 0].sum()) if len(ids) else 0
+    s_ops = int(stats[:, 1].sum()) if len(ids) else 0
+    if s_hist != hist or s_ops != ops:
+        problems.append(
+            '%s/%s: per-doc stats do not reconcile (hist %d vs %d, '
+            'ops %d vs %d)' % (label, where, s_hist, hist, s_ops, ops))
+        return False
+    return True
+
+
+def _churn_evict(pool, problems, label):
+    """Churn + GC + fold + evict + reload on `pool`, reconciling at
+    every phase boundary."""
+    from automerge_tpu.storage.coldstore import ColdStore, DocEvictor
+    rng = random.Random(23)
+    seqs = {}
+    evictor = DocEvictor(pool, max_resident=max(4, N_DOCS // 2),
+                         store=ColdStore(), gc_every=8)
+    for rnd in range(4):
+        for d in range(N_DOCS):
+            doc = 'cap%d' % d
+            chs = _changes(d, seqs.get(doc, 0), 4, rng)
+            seqs[doc] = seqs.get(doc, 0) + 4
+            pool.apply_changes(doc, chs)
+            evictor.note_mutations(doc, 4)     # GC + op-state folding
+            evictor.note_touch([doc])
+        _assert_reconciled(pool, problems, label, 'round%d' % rnd)
+        evictor.maybe_evict()                  # LRU past the cap
+        _assert_reconciled(pool, problems, label,
+                           'round%d-evicted' % rnd)
+    # reload-on-touch: every cold doc replays back in one batch
+    failed = evictor.ensure_resident(['cap%d' % d
+                                      for d in range(N_DOCS)])
+    if failed:
+        problems.append('%s: %d cold docs failed to reload'
+                        % (label, len(failed)))
+    ok = _assert_reconciled(pool, problems, label, 'reloaded')
+    return ok
+
+
+def check_reconcile(problems, report):
+    from automerge_tpu.native import NativeDocPool
+    from automerge_tpu.native.mesh_pool import MeshDocPool
+    modes = {}
+    for mode, env in (('kernel', '0'), ('host_full', '1')):
+        os.environ['AMTPU_HOST_FULL'] = env
+        pool = NativeDocPool()
+        modes[mode] = _churn_evict(pool, problems, mode)
+    os.environ['AMTPU_HOST_FULL'] = '0'
+    mesh = MeshDocPool(dp=4)
+    modes['mesh_dp4'] = _churn_evict(mesh, problems, 'mesh_dp4')
+    report['reconcile'] = {'docs': N_DOCS, 'modes': modes}
+
+
+def check_hot_docs(problems, report):
+    from automerge_tpu.native import NativeDocPool
+    from automerge_tpu.telemetry.capacity import SpaceSaver, TRACKER
+    rng = random.Random(41)
+    # zipfian fan-out stream over many more docs than the sketch holds
+    sketch = SpaceSaver(64)
+    exact = {}
+    n_keys = 800
+    for _ in range(40000):
+        d = 'z%d' % min(int(rng.paretovariate(1.1)) - 1, n_keys - 1)
+        b = rng.randrange(64, 4096)
+        sketch.offer(d, b)
+        exact[d] = exact.get(d, 0) + b
+    exact_top = [d for d, _ in sorted(exact.items(),
+                                      key=lambda kv: -kv[1])[:5]]
+    sketch_top = [d for d, _v, _e in sketch.top(5)]
+    if sketch_top[:3] != exact_top[:3]:
+        problems.append('sketch top-3 %r != exact top-3 %r'
+                        % (sketch_top[:3], exact_top[:3]))
+    over = [(d, v, e) for d, v, e in sketch.top()
+            if not (v - e <= exact.get(d, 0) <= v)]
+    if over:
+        problems.append('sketch bounds violated for %r' % over[:3])
+    # arena ranking: one deliberately heavy doc must lead the table
+    os.environ['AMTPU_HOST_FULL'] = '1'
+    pool = NativeDocPool()
+    for d in range(8):
+        n = 40 if d == 3 else 4
+        pool.apply_changes('h%d' % d,
+                           _changes(d, 0, n, random.Random(d)))
+    TRACKER.reset()
+    TRACKER.attach(pool=pool)
+    snap = TRACKER.refresh(force=True)
+    top = snap['top']['arena']
+    if not top or top[0]['doc'] != 'h3':
+        problems.append('arena hot-doc table does not lead with the '
+                        'heavy doc: %r' % top[:3])
+    if top and top[0]['arena_bytes'] != pool.history_bytes('h3'):
+        problems.append('arena table bytes %r != per-doc history bytes '
+                        '%r' % (top[0]['arena_bytes'],
+                                pool.history_bytes('h3')))
+    TRACKER.detach()
+    report['hot_docs'] = {'sketch_top': sketch_top[:5],
+                          'exact_top': exact_top[:5],
+                          'arena_top': [r['doc'] for r in top[:3]]}
+
+
+def check_pressure(problems, report):
+    """Budget-modeled pressure eviction: grow resident docs; the
+    estimator (used = base + live arena bytes) must trip proactive
+    eviction before `used` ever crosses the budget."""
+    from automerge_tpu import telemetry
+    from automerge_tpu.native import NativeDocPool
+    from automerge_tpu.storage.coldstore import ColdStore, DocEvictor
+    from automerge_tpu.telemetry.capacity import (HeadroomEstimator,
+                                                  TRACKER)
+    os.environ['AMTPU_HOST_FULL'] = '1'
+    os.environ['AMTPU_CAPACITY_REFRESH_S'] = '0'
+    pool = NativeDocPool()
+    evictor = DocEvictor(pool, max_resident=0, store=ColdStore(),
+                         gc_every=0)
+    base = 4096
+    budget = 64 * 1024
+    TRACKER.reset()
+    TRACKER.attach(pool=pool, storage_tier=evictor)
+    TRACKER.estimator = HeadroomEstimator(
+        budget_bytes=budget, used_fn=lambda: base + pool.history_bytes())
+    os.environ['AMTPU_MEM_PRESSURE_EVICT'] = '0.75'
+    # no cooldown: the lane models many flush cycles in a tight loop
+    os.environ['AMTPU_PRESSURE_EVICT_COOLDOWN_S'] = '0'
+    rng = random.Random(5)
+    breached = False
+    evictions = 0
+    seqs = {}
+    lru = []
+    for step in range(400):
+        doc = 'p%d' % step
+        pool.apply_changes(doc, _changes(step, 0, 3, rng))
+        seqs[doc] = 3
+        evictor.note_touch([doc])
+        lru.append(doc)
+        used = base + pool.history_bytes()
+        if used > budget:
+            breached = True
+        if TRACKER.evict_due():
+            evictions += evictor.maybe_evict(protect=[doc],
+                                             pressure=True)
+    flat = telemetry.metrics_snapshot()
+    report['pressure'] = {
+        'budget_bytes': budget, 'evictions': evictions,
+        'pressure_evictions': int(flat.get('storage.pressure_evictions',
+                                           0)),
+        'evicted_bytes': int(flat.get('storage.evicted_bytes', 0)),
+        'final_used': base + pool.history_bytes(),
+        'cold_docs': len(evictor.store)}
+    if breached:
+        problems.append('memory budget was breached before pressure '
+                        'eviction relieved it')
+    if evictions <= 0 or flat.get('storage.pressure_evictions', 0) <= 0:
+        problems.append('pressure eviction never fired '
+                        '(storage.pressure_evictions == 0)')
+    if flat.get('storage.evicted_bytes', 0) <= 0:
+        problems.append('evictions recorded no freed bytes '
+                        '(storage.evicted_bytes == 0)')
+    # the evicted docs are whole: reload one and reconcile
+    cold = evictor.store.doc_ids()
+    if cold:
+        failed = evictor.ensure_resident(cold[:4])
+        if failed:
+            problems.append('post-pressure reload failed: %r'
+                            % list(failed))
+        _assert_reconciled(pool, problems, 'pressure', 'reloaded')
+    TRACKER.detach()
+    os.environ.pop('AMTPU_MEM_PRESSURE_EVICT', None)
+    os.environ.pop('AMTPU_PRESSURE_EVICT_COOLDOWN_S', None)
+    os.environ.pop('AMTPU_CAPACITY_REFRESH_S', None)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--out', default=os.path.join(ROOT,
+                                                  '.capacity_check.json'))
+    args = ap.parse_args()
+    from automerge_tpu import telemetry
+    problems, report = [], {}
+    check_reconcile(problems, report)
+    check_hot_docs(problems, report)
+    check_pressure(problems, report)
+    flat = telemetry.metrics_snapshot()
+    oracle = flat.get('fallback.oracle', 0)
+    report['fallback_oracle'] = oracle
+    if oracle:
+        problems.append('fallback.oracle == %s (must be 0)' % oracle)
+    report['ok'] = not problems
+    report['problems'] = problems
+    with open(args.out, 'w') as f:
+        json.dump(report, f, indent=1, sort_keys=True)
+    for p in problems:
+        print('capacity-check: FAIL -- %s' % p)
+    if problems:
+        return 1
+    print('capacity-check: PASS (%d docs x 3 pool modes reconciled '
+          'bit-exact; hot docs ranked; pressure eviction fired inside '
+          'the budget; %s)' % (N_DOCS, args.out))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -145,6 +145,11 @@ def check_phase1(problems):
             health = c.healthz()
             metrics = c.metrics()['body']
         port = metrics_port(stderr_path)
+        import urllib.request
+        with urllib.request.urlopen(
+                'http://127.0.0.1:%d/debug/docs' % port,
+                timeout=30) as r:
+            debug_docs = json.loads(r.read())
         top = subprocess.run(
             [sys.executable, os.path.join(REPO, 'tools', 'amtpu_top.py'),
              '--url', 'http://127.0.0.1:%d' % port, '--once'],
@@ -215,10 +220,26 @@ def check_phase1(problems):
     if not glob.glob(os.path.join(rec_dir, '*sigterm*.jsonl')):
         problems.append('phase1: no sigterm recorder dump in %s'
                         % rec_dir)
+
+    # 1f. the capacity surface (ISSUE 15): healthz `capacity` section,
+    # /debug/docs, and the amtpu_top capacity panel all render the
+    # live hot-doc table
+    cap = health.get('capacity') or {}
+    if not (cap.get('totals') or {}).get('arena_bytes'):
+        problems.append('phase1: healthz capacity section has no arena '
+                        'total: %r' % sorted(cap))
+    elif not (cap.get('top') or {}).get('arena'):
+        problems.append('phase1: healthz capacity hot-doc table empty')
+    if not debug_docs.get('hot_docs'):
+        problems.append('phase1: /debug/docs served no hot docs: %r'
+                        % sorted(debug_docs))
+    if 'capacity:' not in top.stdout or 'hot(arena):' not in top.stdout:
+        problems.append('phase1: amtpu_top frame has no capacity '
+                        'panel: %s' % top.stdout[-300:])
     if not problems:
         print('obs-check: phase 1 OK (%d reqs attributed; stage sums '
-              '%.1f ms ~= total %.1f ms; %d exemplars; amtpu_top ok; '
-              'sigterm dump present)'
+              '%.1f ms ~= total %.1f ms; %d exemplars; amtpu_top + '
+              'capacity panel ok; sigterm dump present)'
               % (n_mut, parts, total, len(roots)))
 
 

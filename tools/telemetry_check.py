@@ -44,7 +44,7 @@ import msgpack  # noqa: E402
 
 from automerge_tpu import telemetry, trace  # noqa: E402
 from automerge_tpu.native import NativeDocPool, ShardedNativePool  # noqa: E402
-from automerge_tpu.telemetry import attribution, recorder  # noqa: E402
+from automerge_tpu.telemetry import attribution, capacity, recorder  # noqa: E402
 from automerge_tpu.telemetry.spans import NULL_SPAN  # noqa: E402
 
 PAIRS = int(os.environ.get('AMTPU_TCHECK_PAIRS', 5))
@@ -72,6 +72,10 @@ _PATCHES = [
     # disabled-path cost honestly
     (recorder, 'record', _noop),
     (attribution, 'note_flush_phase', _noop),
+    # the always-on capacity seams (ISSUE 15): per-doc fan-out/egress
+    # attribution is priced against the same bar as the recorder
+    (capacity, 'note_fanout', _noop),
+    (capacity, 'note_egress', _noop),
 ]
 
 
