@@ -52,6 +52,8 @@ PRESEED_BLOCKS = {
     'recorder': 'KNOWN_RECORDER_KEYS',
     'slo': 'KNOWN_SLO_KEYS',
     'capacity': 'KNOWN_CAPACITY_KEYS',
+    'trace': 'KNOWN_TRACE_KEYS',
+    'fleet': 'KNOWN_FLEET_KEYS',
 }
 
 

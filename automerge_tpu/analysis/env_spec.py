@@ -31,6 +31,12 @@ ENV_FLAGS = (
     EnvFlag('AMTPU_TRACE_FILE', 'str', '', False, 'telemetry/spans.py'),
     EnvFlag('AMTPU_TRACE_FILE_MAX_MB', 'int', 256, False,
             'telemetry/spans.py (keep-1 rotation cap; <=0 disables)'),
+    EnvFlag('AMTPU_TRACE_WIRE', 'bool', True, False,
+            'sidecar/client.py (stamp the wire trace context on every '
+            'outbound request; read once per client)'),
+    EnvFlag('AMTPU_REPLICA_ID', 'str', '', False,
+            'telemetry/__init__.py (fleet replica identity; empty -> '
+            'hostname:pid)'),
     EnvFlag('AMTPU_RECORDER_EVENTS', 'int', 4096, False,
             'telemetry/recorder.py (ring size; read once at import)'),
     EnvFlag('AMTPU_RECORDER_DIR', 'str', '', False,

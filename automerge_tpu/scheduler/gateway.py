@@ -520,7 +520,8 @@ class GatewayServer(object):
             op = PendingOp(conn, rid, cmd, req, docs, 1, batchable=False)
             # marked BEFORE offer: the dispatcher may claim (and stamp)
             # the op the instant offer releases the queue lock
-            op.clock = attribution.Clock(attribution.class_of(cmd), t0=t0)
+            op.clock = attribution.Clock(attribution.class_of(cmd), t0=t0,
+                                         trace=req.get('trace'))
             op.clock.mark('admit')
             try:
                 # presence is ephemeral -- shedding it under overload
@@ -551,7 +552,8 @@ class GatewayServer(object):
                 # pool-lock wait + backend handle, emit the send.
                 telemetry.metric('scheduler.bypass_reads')
                 clock = attribution.Clock(attribution.class_of(cmd),
-                                          t0=t0)
+                                          t0=t0,
+                                          trace=req.get('trace'))
                 clock.mark('admit')
                 with self.pool_lock:
                     if docs is not None and self.storage_tier \
@@ -582,7 +584,8 @@ class GatewayServer(object):
                                    doc=docs[0] if docs else None)
                 return
             op = PendingOp(conn, rid, cmd, req, docs, 1, batchable=False)
-            op.clock = attribution.Clock(attribution.class_of(cmd), t0=t0)
+            op.clock = attribution.Clock(attribution.class_of(cmd), t0=t0,
+                                         trace=req.get('trace'))
             op.clock.mark('admit')
             try:
                 self.queue.offer(op, admit_always=True)
@@ -603,7 +606,8 @@ class GatewayServer(object):
             op = PendingOp(conn, rid, cmd, req, docs,
                            _op_weight(cmd, req),
                            batchable=(cmd in BATCH_CMDS))
-            op.clock = attribution.Clock(attribution.class_of(cmd), t0=t0)
+            op.clock = attribution.Clock(attribution.class_of(cmd), t0=t0,
+                                         trace=req.get('trace'))
             op.clock.mark('admit')
             try:
                 self.queue.offer(op)
@@ -678,7 +682,7 @@ class GatewayServer(object):
                 # originator (conn, submitted-clock) for echo
                 # suppression
                 fan = {'updates': {}, 'quarantined': {}, 'enq': {},
-                       'origins': {}} \
+                       'origins': {}, 'traces': {}} \
                     if self.fanout is not None else None
                 if batch:
                     self._run_batch(batch, fsp, fan)
@@ -945,9 +949,15 @@ class GatewayServer(object):
     def _fan_note(self, fan, op, doc, result):
         """Records one committed per-doc result into the flush's fan-out
         inputs: the post clock for healthy docs, the error envelope for
-        quarantined ones."""
+        quarantined ones -- and the originating request's trace id, so
+        fan-out event frames are correlatable with the request's
+        cross-process trace tree (the per-doc FIFO admits one op per doc
+        per flush, so the doc's originating trace is unique)."""
         if doc is None:
             return
+        tctx = op.req.get('trace')
+        if isinstance(tctx, dict) and tctx.get('traceId'):
+            fan['traces'][doc] = tctx['traceId']
         if is_quarantined(result):
             fan['quarantined'][doc] = result
         else:
@@ -1035,7 +1045,8 @@ class GatewayServer(object):
                                 flush=getattr(fsp, 'span_id', None)):
                 self.fanout.on_flush(fan['updates'],
                                      fan['quarantined'], fan['enq'],
-                                     fan['origins'])
+                                     fan['origins'],
+                                     traces=fan['traces'])
         except Exception as e:
             # fan-out failures must never re-answer (or hang) the
             # flush's already-answered requests

@@ -31,7 +31,7 @@ import threading
 import time
 
 from .. import telemetry
-from ..utils.common import env_float, env_int
+from ..utils.common import env_bool, env_float, env_int
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -75,7 +75,10 @@ class CheckpointWAL:
         self.compact_every = max(1, compact_every)
         self.max_bytes = max_bytes
         self.snapshots = {}      # doc -> checkpoint_b64
-        self.log = []            # (cmd, kwargs, n_bytes) in ack order
+        self.log = []            # (cmd, kwargs, trace, n_bytes) in ack
+        #                          order; trace is the request's wire
+        #                          context so a replay re-sends it under
+        #                          its ORIGINAL trace id (ISSUE 16)
         self.docs = set()
         self.log_bytes = 0
         self.snap_bytes = 0
@@ -106,10 +109,10 @@ class CheckpointWAL:
                              now - self._gauged)
             self._gauged = now
 
-    def record(self, cmd, kwargs):
+    def record(self, cmd, kwargs, trace=None):
         """One mutating request was ACKNOWLEDGED by the server."""
         n = self._entry_bytes(kwargs)
-        self.log.append((cmd, kwargs, n))
+        self.log.append((cmd, kwargs, trace, n))
         self.log_bytes += n
         self.docs.update(self._docs_of(cmd, kwargs))
         self._gauge()
@@ -142,11 +145,14 @@ class CheckpointWAL:
 
     def replay(self, call_raw):
         """Rebuilds a FRESH server's state: snapshots first, then the
-        residual log, in order."""
+        residual log, in order.  Each residual entry replays under its
+        ORIGINAL trace context, so the new server incarnation's spans
+        join the traces that produced the state (one client-visible
+        request = one trace id, across incarnations)."""
         for doc in sorted(self.snapshots):
             call_raw('load', {'doc': doc, 'data': self.snapshots[doc]})
-        for cmd, kwargs, _n in self.log:
-            call_raw(cmd, dict(kwargs))
+        for cmd, kwargs, trace, _n in self.log:
+            call_raw(cmd, dict(kwargs), trace=trace)
         telemetry.metric('sidecar.client.wal_replays')
 
 
@@ -167,6 +173,9 @@ class SidecarClient:
     _dead = False
     _heal = False
     _wal = None
+    #: wire trace-context stamping (ISSUE 16); class-level so
+    #: hand-assembled clients stamp too, latched per client in __init__
+    _wire_trace = True
     _deadline_s = None
     _heartbeat_s = None
     _max_respawns = 3
@@ -213,6 +222,9 @@ class SidecarClient:
         """
         self._msgpack = use_msgpack
         self._next_id = 0
+        # AMTPU_TRACE_WIRE=0 turns off wire trace-context stamping
+        # (latched per client: the stamp must not flip mid-stream)
+        self._wire_trace = env_bool('AMTPU_TRACE_WIRE', True)
         self._init_locks()
         self._proc = None
         self._sock = None
@@ -628,17 +640,20 @@ class SidecarClient:
                     return None
                 self._resp_cond.wait(wait)
 
-    def _call_raw(self, cmd, kwargs):
+    def _call_raw(self, cmd, kwargs, trace=None):
         """Request + protocol error mapping, NO healing and NO WAL
         recording -- the primitive heal/replay/compaction run on (a
-        replayed request must not re-enter the WAL)."""
+        replayed request must not re-enter the WAL).  `trace` is the
+        wire context to stamp (WAL replay passes each entry's original
+        context); without one the ambient span's context is used."""
         if self._id_lock is None:
             self._init_locks()
         with self._id_lock:
             self._next_id += 1
             rid = self._next_id
         req = dict(kwargs, cmd=cmd, id=rid)
-        tctx = telemetry.current_trace_context()
+        tctx = trace if trace is not None \
+            else telemetry.current_trace_context()
         if tctx is not None:
             req.setdefault('trace', tctx)
         resp = self._roundtrip(req)
@@ -698,37 +713,62 @@ class SidecarClient:
 
     # -- rpc ------------------------------------------------------------
 
+    def _request_trace(self):
+        """The wire context for ONE logical request (ISSUE 16): the
+        ambient span's ids when the caller is traced, else a freshly
+        minted root -- every outbound request carries a trace, so the
+        gateway's spans, exemplars, recorder events, and fan-out frames
+        are correlatable even when the caller runs untraced.  Minted
+        ONCE per logical request, before the retry loop: a respawn
+        retry re-sends the SAME ids (the request never got a response,
+        so one client-visible request stays one trace)."""
+        if not self._wire_trace:
+            return None
+        tctx = telemetry.current_trace_context()
+        if tctx is not None:
+            telemetry.metric('trace.propagated')
+            return tctx
+        telemetry.metric('trace.roots')
+        return telemetry.new_root_context()
+
     def call(self, cmd, **kwargs):
         if self._dead:
             raise ConnectionError(
                 'sidecar client is dead (server lost or close() called); '
                 'build a new SidecarClient')
-        heals = 0
-        while True:
-            try:
-                if (self._heartbeat_s is not None and cmd != 'ping'
-                        and time.monotonic() - self._last_ok
-                        > self._heartbeat_s):
-                    # cheap liveness probe: catch a dead server before
-                    # shipping (and possibly losing) a batch
-                    self._call_raw('ping', {})
-                result = self._call_raw(cmd, kwargs)
-                break
-            except ConnectionError as e:
-                telemetry.metric('sidecar.client.transport_errors')
-                if not self._heal or self._proc is None \
-                        or heals >= self._max_respawns:
-                    # reuse after this point would desync request ids /
-                    # framing -- refuse loudly instead
-                    self._dead = True
-                    raise
-                heals += 1
-                with self._life_lock:
-                    if not self._dead:     # another thread may have
-                        self._respawn_and_replay()   # healed already
+        # the client-side hop span: when span tracing is on, this is
+        # the record `tools/amtpu_trace.py` anchors cross-process
+        # assembly on (its wall is the client-observed request time);
+        # the wire context is captured INSIDE it so the server's spans
+        # become its children
+        with telemetry.span('sidecar.client.request', cmd=cmd):
+            tctx = self._request_trace()
+            heals = 0
+            while True:
+                try:
+                    if (self._heartbeat_s is not None and cmd != 'ping'
+                            and time.monotonic() - self._last_ok
+                            > self._heartbeat_s):
+                        # cheap liveness probe: catch a dead server
+                        # before shipping (and possibly losing) a batch
+                        self._call_raw('ping', {})
+                    result = self._call_raw(cmd, kwargs, trace=tctx)
+                    break
+                except ConnectionError as e:
+                    telemetry.metric('sidecar.client.transport_errors')
+                    if not self._heal or self._proc is None \
+                            or heals >= self._max_respawns:
+                        # reuse after this point would desync request
+                        # ids / framing -- refuse loudly instead
+                        self._dead = True
+                        raise
+                    heals += 1
+                    with self._life_lock:
+                        if not self._dead:   # another thread may have
+                            self._respawn_and_replay()  # healed already
         if self._wal is not None and cmd in WAL_CMDS:
             with self._life_lock:
-                self._wal.record(cmd, kwargs)
+                self._wal.record(cmd, kwargs, trace=tctx)
                 self._wal.maybe_compact(self._call_raw)
         return result
 

@@ -54,8 +54,13 @@ text exposition>} for the whole process (docs/OBSERVABILITY.md), and
 `healthz` a liveness dict -- the same payloads the optional HTTP
 listener (--metrics-port) serves at /metrics and /healthz.  Requests may
 carry {"trace": {"traceId": ..., "spanId": ...}} to resume a client-side
-trace; the envelope is consumed server-side (responses are unchanged)
-and surfaces in the JSONL span export (AMTPU_TRACE_FILE).
+trace (traceId is 128-bit/32-hex, spanId 64-bit/16-hex; SidecarClient
+stamps it on every outbound request, minting a root when the caller has
+no ambient span, and keeps it stable across respawn retries and WAL
+replay); the envelope is consumed server-side (responses are unchanged)
+and surfaces in the JSONL span export (AMTPU_TRACE_FILE) -- each process
+writes its OWN trace file and tools/amtpu_trace.py assembles the
+cross-process tree.
 
 Checkpoints are binary; on the wire they travel base64-encoded
 ({"checkpoint_b64": ...} from save, and load's "data" field accepts the

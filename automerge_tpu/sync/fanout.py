@@ -445,7 +445,7 @@ class FanoutEngine(object):
     # -- the batched flush pass ----------------------------------------
 
     def on_flush(self, updates, quarantined=None, enq=None,
-                 origins=None):
+                 origins=None, traces=None):
         """One fan-out pass for one gateway flush.
 
         `updates`: {doc_id: post-flush clock dict} for every doc the
@@ -455,15 +455,20 @@ class FanoutEngine(object):
         `origins`: {doc_id: [(cid, submitted_clock)]} -- the
         originating connection's subscriptions advance by exactly what
         they shipped BEFORE classification, so a writer never receives
-        its own change back (the reference's receive-side clock union).
+        its own change back (the reference's receive-side clock union);
+        `traces`: {doc_id: trace id} of the originating request (the
+        per-doc FIFO makes it unique per flush) -- stamped onto the
+        doc's change/quarantined event frames so a subscriber can join
+        what it received to the cross-process trace tree (ISSUE 16).
         Caller holds the pool lock (straggler backfills query it).
         """
         quarantined = quarantined or {}
         enq = enq or {}
         origins = origins or {}
+        traces = traces or {}
         with self._lock:
             frames = self._flush_locked(updates, quarantined, enq,
-                                        origins)
+                                        origins, traces)
         return frames
 
     def _note_origins(self, origins):  # holds-lock: self._lock
@@ -613,7 +618,8 @@ class FanoutEngine(object):
         if attached:
             telemetry.metric('sync.fanout.prefix_attaches', attached)
 
-    def _flush_locked(self, updates, quarantined, enq, origins):  # holds-lock: self._lock
+    def _flush_locked(self, updates, quarantined, enq, origins,  # holds-lock: self._lock
+                      traces):
         presence, self._presence = self._presence, {}
         # 0. wildcard auto-attach, then echo suppression (either may
         #    intern new actors -- both must precede the pre-flush row
@@ -688,7 +694,7 @@ class FanoutEngine(object):
                 pending, doc_id, drow, pre, rows,
                 behind[cls] if rows else (), exact[cls] if rows else (),
                 quarantined.get(doc_id), presence.pop(doc_id, None),
-                enq.get(doc_id))
+                enq.get(doc_id), traces.get(doc_id))
 
         # 4. presence-only docs (no mutation this flush)
         for doc_id, states in presence.items():
@@ -709,16 +715,20 @@ class FanoutEngine(object):
         return n_frames
 
     def _stage_doc(self, pending, doc_id, drow, pre, rows, behind,  # holds-lock: self._lock
-                   exact, envelope, presence, enq_t):
+                   exact, envelope, presence, enq_t, trace=None):
         """Stages one dirty doc's frames for its classified
-        subscribers."""
+        subscribers.  `trace` (the originating request's trace id)
+        rides on every change/quarantined frame as ``frame['trace']``."""
         if envelope is not None:
             # quarantined: every subscriber gets the resilience
             # envelope, not silence -- believed clocks stay put (the
             # doc state they describe did not advance)
-            buf = self._encode({'event': 'quarantined', 'doc': doc_id,
-                                'error': envelope.get('error'),
-                                'errorType': envelope.get('errorType')})
+            qframe = {'event': 'quarantined', 'doc': doc_id,
+                      'error': envelope.get('error'),
+                      'errorType': envelope.get('errorType')}
+            if trace:
+                qframe['trace'] = trace
+            buf = self._encode(qframe)
             telemetry.metric('sync.fanout.bytes_encoded', len(buf))
             staged = 0
             for row in rows:
@@ -759,6 +769,8 @@ class FanoutEngine(object):
                      'changes': delta}
             if presence:
                 frame['presence'] = presence
+            if trace:
+                frame['trace'] = trace
             buf = self._encode(frame)
             telemetry.metric('sync.fanout.bytes_encoded', len(buf))
             staged = 0
@@ -795,6 +807,8 @@ class FanoutEngine(object):
                      'changes': delta}
             if presence:
                 frame['presence'] = presence
+            if trace:
+                frame['trace'] = trace
             buf = self._encode(frame)
             telemetry.metric('sync.fanout.bytes_encoded', len(buf))
             staged_g = 0

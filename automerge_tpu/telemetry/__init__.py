@@ -37,11 +37,39 @@ from .metrics import (DEFAULT_BUCKETS, MetricRegistry,  # noqa: F401
                       format_value)
 from .spans import (NULL_SPAN, current_span,  # noqa: F401
                     current_trace_context, disable, enable, enabled,
-                    new_id, phase_add, phase_count, phase_report,
-                    phase_reset, phase_snapshot, set_trace_file, span,
+                    new_id, new_root_context, new_trace_id, phase_add,
+                    phase_count, phase_report, phase_reset,
+                    phase_snapshot, set_trace_file, span,
                     span_with_context, trace_file)
 
 _START_TIME = time.time()
+
+
+def uptime_s():
+    """Seconds since this process imported telemetry -- the per-replica
+    uptime healthz and /debug/slo_slots report (fleet skew tables key
+    on it to spot the freshly-restarted replica)."""
+    return time.time() - _START_TIME
+
+
+_replica_id_cached = None
+
+
+def replica_id():
+    """A stable identity for THIS replica, latched at first use:
+    ``AMTPU_REPLICA_ID`` when set (a fleet operator names replicas),
+    else ``<hostname>:<pid>`` -- unique per process, stable for its
+    lifetime, and debuggable at a glance.  Carried by healthz and
+    ``/debug/slo_slots`` so the fleet plane (telemetry/fleet.py) can
+    attribute merged windows and headroom skew per replica."""
+    global _replica_id_cached
+    if _replica_id_cached is None:
+        import socket as _socket
+        from ..utils.common import env_str
+        import os as _os
+        _replica_id_cached = env_str('AMTPU_REPLICA_ID', '') \
+            or '%s:%d' % (_socket.gethostname(), _os.getpid())
+    return _replica_id_cached
 
 registry = MetricRegistry()
 
@@ -329,6 +357,26 @@ KNOWN_CAPACITY_KEYS = ('refreshes', 'pressure_high')
 #               failed/quarantined requests)
 KNOWN_SLO_KEYS = ('requests', 'breaches', 'exemplars')
 
+# distributed-tracing counters (`telemetry.metric('trace.<name>')` call
+# sites in telemetry/spans.py + sidecar/client.py; distributed-tracing
+# section: docs/OBSERVABILITY.md), pre-seeded into every bench_block:
+# roots        outbound sidecar requests stamped with a freshly minted
+#                root wire context (the caller had no ambient span)
+# propagated   outbound requests that carried the caller's ambient span
+#                context across the wire instead
+# rotations    size-capped trace-file rotations (keep-1; the single
+#                -winner path of the ISSUE 16 race fix)
+KNOWN_TRACE_KEYS = ('roots', 'propagated', 'rotations')
+
+# fleet aggregation counters (`telemetry.metric('fleet.<name>')` call
+# sites in telemetry/fleet.py; fleet section: docs/OBSERVABILITY.md),
+# pre-seeded into every bench_block:
+# scrapes        replica healthz/slo-slot scrapes that answered
+# scrape_errors  replicas that failed to answer a scrape (the merged
+#                  surface marks them down instead of silently
+#                  shrinking the fleet)
+KNOWN_FLEET_KEYS = ('scrapes', 'scrape_errors')
+
 # docs per gateway flush are effectively powers of two: exact log2 bounds
 BATCH_OCCUPANCY_BUCKETS = tuple(float(2 ** i) for i in range(13))
 
@@ -568,7 +616,8 @@ def healthz():
             # liveness answer itself
             extra[name] = {'error': '%s: %s' % (type(e).__name__, e)}
     return dict(extra, **{
-        'ok': True, 'uptime_s': round(time.time() - _START_TIME, 3),
+        'ok': True, 'uptime_s': round(uptime_s(), 3),
+            'replica_id': replica_id(),
             'telemetry_enabled': enabled(),
             'batches': BATCHES.snapshot() or {},
             'restarts': restarts,
@@ -642,6 +691,14 @@ def bench_block():
     cap.update({k.split('.', 1)[1]: round(v, 6)
                 for k, v in flat.items()
                 if k.startswith('capacity.')})
+    trc = {r: 0.0 for r in KNOWN_TRACE_KEYS}
+    trc.update({k.split('.', 1)[1]: round(v, 6)
+                for k, v in flat.items()
+                if k.startswith('trace.')})
+    fleet = {r: 0.0 for r in KNOWN_FLEET_KEYS}
+    fleet.update({k.split('.', 1)[1]: round(v, 6)
+                  for k, v in flat.items()
+                  if k.startswith('fleet.')})
     block = {
         'fallbacks': fallbacks,
         'collect': collect,
@@ -656,6 +713,8 @@ def bench_block():
         'recorder': rec,
         'slo': slo,
         'capacity': cap,
+        'trace': trc,
+        'fleet': fleet,
         'device_s': round(flat.get('device.dispatch_sync_s', 0.0), 4),
         'device_dispatches': int(flat.get('device.dispatches', 0)),
         'batch_latency': BATCH_LATENCY.snapshot() or {},

@@ -101,17 +101,21 @@ class Clock(object):
     `add(stage, s)` attributes extra seconds outside the partition
     (the fan-out tail)."""
 
-    __slots__ = ('t0', 'prev', 'stages', 'cls')
+    __slots__ = ('t0', 'prev', 'stages', 'cls', 'trace')
 
-    def __init__(self, cls, t0=None):
+    def __init__(self, cls, t0=None, trace=None):
         """`t0` backdates the clock to frame receipt (the gateway reader
         stamps it before decoding), so `admit` really covers decode ->
-        routing -> admission, not just Clock construction."""
+        routing -> admission, not just Clock construction.  `trace` is
+        the request's wire context (`{'traceId','spanId'}` or None):
+        the exemplar tree adopts it so cross-process assembly sees one
+        trace, not a freshly minted island (ISSUE 16)."""
         t = time.perf_counter() if t0 is None else t0
         self.t0 = t
         self.prev = t
         self.stages = []
         self.cls = cls
+        self.trace = trace
 
     def mark(self, stage):
         t = time.perf_counter()
@@ -203,7 +207,7 @@ def _emit_exemplar(clock, ok, total_ms, cmd, rid, doc):
     global _exemplar_last
     from . import metric
     from .recorder import RECORDER, record
-    from .spans import export_record, new_id
+    from .spans import export_record, new_id, new_trace_id
     # rate limit (AMTPU_EXEMPLAR_MIN_S, default 50ms): exemplars are a
     # TAIL SAMPLE, not a log -- under a quarantine storm or an error
     # -spamming client, every failing request would otherwise pay a
@@ -217,14 +221,20 @@ def _emit_exemplar(clock, ok, total_ms, cmd, rid, doc):
         return
     _exemplar_last = now_mono
     metric('slo.exemplars')
-    record('request.slow', doc=doc, n=int(total_ms),
-           detail=cmd if ok else '%s!' % (cmd,))
-    trace_id = new_id()
+    # adopt the request's wire trace context (ISSUE 16): the exemplar
+    # tree and the recorder event join the cross-process trace the
+    # client started, so `amtpu_trace` assembles one tree per request
+    # instead of per-process islands; parent = the client's span id
+    tctx = clock.trace if isinstance(clock.trace, dict) else {}
+    trace_id = tctx.get('traceId') or new_trace_id()
+    parent_id = tctx.get('spanId')
     root_id = new_id()
+    record('request.slow', doc=doc, n=int(total_ms),
+           detail=cmd if ok else '%s!' % (cmd,), trace=trace_id)
     now = time.time()
     start = now - (time.perf_counter() - clock.t0)
     root = {'name': 'request.exemplar', 'trace': trace_id,
-            'span': root_id, 'parent': None,
+            'span': root_id, 'parent': parent_id,
             'start': round(start, 6), 'dur_s': round(total_ms / 1e3, 6),
             'attrs': {'cmd': cmd, 'rid': rid, 'doc': doc,
                       'class': clock.cls, 'ok': bool(ok),
@@ -307,63 +317,92 @@ class _SloWindows(object):
             if breach:
                 ent[2] += 1
 
-    def _merged(self, cls, window_s, now_slot):
-        cutoff = now_slot - max(1, window_s // _SLOT_S)
-        counts = None
-        total = breaches = 0
+    def slots_snapshot(self):
+        """JSON-safe deep copy of the raw mergeable slot state:
+        ``{class: {slot_index: [bucket_counts, total, breaches]}}``.
+        This -- not the derived percentiles -- is the unit the fleet
+        plane aggregates: slots from N replicas SUM element-wise, and
+        :func:`section_from_slots` over the sum is bit-identical to one
+        replica having observed all the traffic (percentile averaging
+        is a lie; docs/OBSERVABILITY.md fleet section).  Served raw by
+        ``/debug/slo_slots`` (telemetry/httpd.py)."""
         with self._lock:
-            for slot, (bc, t, br) in self._slots[cls].items():
-                if slot <= cutoff:
-                    continue
-                if counts is None:
-                    counts = list(bc)
-                else:
-                    counts = [a + b for a, b in zip(counts, bc)]
-                total += t
-                breaches += br
-        return counts, total, breaches
-
-    def _quantile(self, counts, total, q):
-        from .metrics import quantile_from_counts
-        if counts is None:
-            return 0.0
-        return quantile_from_counts(self._bounds, counts, total, q)
+            return {cls: {slot: [list(ent[0]), ent[1], ent[2]]
+                          for slot, ent in slots.items()}
+                    for cls, slots in self._slots.items()}
 
     def section(self):
         """The healthz ``slo`` payload: per class per window
         {count, p50_ms, p99_ms, breach_frac}, plus burn rates for the
         two slowest windows against the 1% budget."""
+        out = section_from_slots(self.slots_snapshot())
+        out['exemplars_kept'] = len(_exemplars)
+        return out
+
+
+def section_from_slots(slots_by_class, now_slot=None, bounds=None):
+    """Derives the ``slo`` section from a slot snapshot
+    (:meth:`_SloWindows.slots_snapshot` shape; slot keys may be ints or
+    the strings JSON made of them).  PURE and deterministic: the single
+    -replica healthz section and the fleet-merged section both come
+    from here, so an N-replica merge is bit-consistent with a
+    per-replica recompute by construction -- integer bucket counts sum
+    in any order, and the quantile estimator is metrics.py's."""
+    from .metrics import quantile_from_counts
+    if bounds is None:
+        from . import QUEUE_WAIT_BUCKETS
+        bounds = QUEUE_WAIT_BUCKETS
+    if now_slot is None:
         now_slot = int(time.time()) // _SLOT_S
-        classes = {}
+
+    def merged(cls, window_s):
+        cutoff = now_slot - max(1, window_s // _SLOT_S)
+        counts = None
+        total = breaches = 0
+        for slot in sorted(slots_by_class.get(cls, {})):
+            bc, t, br = slots_by_class[cls][slot]
+            if int(slot) <= cutoff:
+                continue
+            if counts is None:
+                counts = list(bc)
+            else:
+                counts = [a + b for a, b in zip(counts, bc)]
+            total += t
+            breaches += br
+        return counts, total, breaches
+
+    def quant(counts, total, q):
+        if counts is None:
+            return 0.0
+        return quantile_from_counts(bounds, counts, total, q)
+
+    classes = {}
+    for cls in CLASSES:
+        per = {}
+        for w in WINDOWS_S:
+            counts, total, breaches = merged(cls, w)
+            per['%ds' % w] = {
+                'count': total,
+                'p50_ms': round(quant(counts, total, 0.50), 3),
+                'p99_ms': round(quant(counts, total, 0.99), 3),
+                'breach_frac': round(breaches / total, 6)
+                if total else 0.0,
+            }
+        classes[cls] = per
+    burn = {}
+    for w in WINDOWS_S[-2:]:
+        tot = br = 0
         for cls in CLASSES:
-            per = {}
-            for w in WINDOWS_S:
-                counts, total, breaches = self._merged(cls, w, now_slot)
-                per['%ds' % w] = {
-                    'count': total,
-                    'p50_ms': round(self._quantile(counts, total, 0.50),
-                                    3),
-                    'p99_ms': round(self._quantile(counts, total, 0.99),
-                                    3),
-                    'breach_frac': round(breaches / total, 6)
-                    if total else 0.0,
-                }
-            classes[cls] = per
-        burn = {}
-        for w in WINDOWS_S[-2:]:
-            tot = br = 0
-            for cls in CLASSES:
-                _c, t, b = self._merged(cls, w, now_slot)
-                tot += t
-                br += b
-            # budget: 1% of requests may exceed the p99 target; burn
-            # 1.0 = spending exactly budget over this window
-            burn['%ds' % w] = round((br / tot) / 0.01, 3) if tot else 0.0
-        return {'target_p99_ms': slo_p99_ms(),
-                'slow_ms': slow_ms(),
-                'classes': classes,
-                'burn': burn,
-                'exemplars_kept': len(_exemplars)}
+            _c, t, b = merged(cls, w)
+            tot += t
+            br += b
+        # budget: 1% of requests may exceed the p99 target; burn
+        # 1.0 = spending exactly budget over this window
+        burn['%ds' % w] = round((br / tot) / 0.01, 3) if tot else 0.0
+    return {'target_p99_ms': slo_p99_ms(),
+            'slow_ms': slow_ms(),
+            'classes': classes,
+            'burn': burn}
 
 
 _SLO = _SloWindows()
@@ -371,3 +410,9 @@ _SLO = _SloWindows()
 
 def slo_section():
     return _SLO.section()
+
+
+def slo_slots():
+    """The raw mergeable slot snapshot of this process (the fleet
+    plane's scrape unit)."""
+    return _SLO.slots_snapshot()

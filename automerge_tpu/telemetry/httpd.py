@@ -1,9 +1,13 @@
 """Sidecar metrics listener: a tiny stdlib HTTP server exposing
 `/metrics` (Prometheus text exposition), `/healthz` (JSON liveness),
 `/debug/recorder` (the flight recorder's ring as JSON, newest last,
-plus the recent exemplar roots), and `/debug/docs` (the per-doc
+plus the recent exemplar roots), `/debug/docs` (the per-doc
 capacity surface: hot-doc cost vectors + headroom; `?k=n` bounds the
-table) so a fleet of sidecars is scrapeable and post-mortem-able
+table), and `/debug/slo_slots` (the raw mergeable SLO window slots
+plus replica identity -- what the fleet aggregation plane
+(telemetry/fleet.py) sums across replicas before recomputing
+percentiles, so a fleet merge is bit-identical to a single-replica
+recompute) so a fleet of sidecars is scrapeable and post-mortem-able
 without touching the stream protocol.  Runs as a daemon thread next to
 the stream loop; the same payloads are also answerable in-band via the
 `metrics` / `healthz` / `dump` request types (sidecar/server.py) for
@@ -34,6 +38,14 @@ class _Handler(BaseHTTPRequestHandler):
             body = (json.dumps(
                 {'events': recorder.events_json(),
                  'exemplars': attribution.recent_exemplars()},
+                default=str) + '\n').encode()
+            ctype = 'application/json'
+        elif path == '/debug/slo_slots':
+            from . import attribution, replica_id, uptime_s
+            body = (json.dumps(
+                {'replica_id': replica_id(),
+                 'uptime_s': round(uptime_s(), 3),
+                 'slots': attribution.slo_slots()},
                 default=str) + '\n').encode()
             ctype = 'application/json'
         elif path == '/debug/docs':

@@ -7,7 +7,12 @@ request, one bench batch) and inherited by every nested span, so a
 JSONL export groups all phase timings of one request under one id --
 including across the sidecar process boundary, where the client injects
 `{"trace": {"traceId":..., "spanId":...}}` into the request envelope and
-the server resumes the trace (`span_with_context`).
+the server resumes the trace (`span_with_context`).  Trace ids are
+128-bit (32 hex chars, W3C-traceparent-shaped) so a fleet of replicas
+never collides ids; span ids stay 64-bit (16 hex).  Each process writes
+its OWN trace file -- `tools/amtpu_trace.py` assembles the cross-process
+tree by trace id with per-process clock-skew normalization
+(docs/OBSERVABILITY.md distributed-tracing section).
 
 Cost model: when disabled, `span()` returns a shared no-op object after
 ONE attribute check -- no allocation, no clock read (the overhead gate
@@ -69,6 +74,21 @@ def new_id():
     return os.urandom(8).hex()
 
 
+def new_trace_id():
+    """32-hex-char trace id (128 random bits, the W3C traceparent
+    width): fleet-wide uniqueness so multi-replica assembly never
+    merges unrelated requests."""
+    return os.urandom(16).hex()
+
+
+def new_root_context():
+    """A fresh root wire context `{'traceId', 'spanId'}` -- what
+    SidecarClient stamps on an outbound request when the caller has no
+    ambient span (the request IS the root; the server's spans become
+    its children)."""
+    return {'traceId': new_trace_id(), 'spanId': new_id()}
+
+
 class _NullSpan(object):
     """Shared no-op for the disabled path."""
     __slots__ = ()
@@ -127,7 +147,7 @@ def span(name, **attrs):
     parent = _current.get()
     if parent is not None:
         return Span(name, parent.trace_id, parent.span_id, attrs)
-    return Span(name, new_id(), None, attrs)
+    return Span(name, new_trace_id(), None, attrs)
 
 
 def span_with_context(name, trace_id, parent_span_id, **attrs):
@@ -180,15 +200,28 @@ def _max_export_bytes():
     return env_int('AMTPU_TRACE_FILE_MAX_MB', 256) * 1024 * 1024
 
 
-def _rotate_locked():
+def _maybe_rotate_locked(cap):
     """Keep-1 rotation (caller holds _export_lock): the live file moves
     to ``<path>.1`` (replacing any previous rotation) and a fresh file
     opens, so the export footprint is bounded at ~2x the cap while the
-    most recent cap's worth of spans always survives."""
+    most recent cap's worth of spans always survives.
+
+    Single-winner by construction: the size is re-read from the LIVE
+    handle here, under the lock, immediately before the replace.  A
+    thread that observed the over-cap condition but reached this point
+    after another thread already rotated finds the fresh (small) file
+    and returns without rotating -- two threads crossing the cap
+    concurrently can no longer both rotate and drop the just-written
+    ``<path>.1`` (the ISSUE 16 rotation-race fix; regression test in
+    tests/test_tracing.py)."""
     global _export_file
+    if _export_file is None or _export_file.tell() <= cap:
+        return
     _export_file.close()
     _export_file = None
     os.replace(_export_path, _export_path + '.1')
+    from . import metric
+    metric('trace.rotations')
 
 
 def _export(sp, dur):
@@ -222,8 +255,8 @@ def _write_line(line):
             _export_file.write(line)
             _export_file.flush()
             cap = _max_export_bytes()
-            if cap > 0 and _export_file.tell() > cap:
-                _rotate_locked()
+            if cap > 0:
+                _maybe_rotate_locked(cap)
         except OSError as e:
             # a broken export path (bad dir, full disk) must degrade
             # TRACING, never the instrumented operation: disable the

@@ -1,0 +1,226 @@
+"""Fleet aggregation tests (ISSUE 16): mergeable SLO window slots
+(element-wise sums recomputed through the SAME pure function each
+replica's healthz uses -- never averaged percentiles), headroom/skew
+aggregation, scrape-error degradation, and the amtpu_top restart
+detection + fleet rendering satellites."""
+
+import io
+import json
+import os
+import sys
+
+import pytest
+
+from automerge_tpu import telemetry
+from automerge_tpu.telemetry import QUEUE_WAIT_BUCKETS, attribution, fleet
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, 'tools'))
+
+import amtpu_fleet  # noqa: E402
+import amtpu_top  # noqa: E402
+
+NB = len(QUEUE_WAIT_BUCKETS) + 1     # bucket counts incl. +Inf
+
+
+@pytest.fixture(autouse=True)
+def _isolate():
+    telemetry.reset_all()
+    yield
+    telemetry.reset_all()
+
+
+def _slot(hits, breaches=0):
+    """One raw slot entry ``[bucket_counts, total, breaches]`` from
+    {bucket_index: n}."""
+    counts = [0] * NB
+    for b, n in hits.items():
+        counts[b] += n
+    return [counts, sum(hits.values()), breaches]
+
+
+# ---------------------------------------------------------------------------
+# merge_slots
+# ---------------------------------------------------------------------------
+
+def test_merge_slots_sums_elementwise_and_normalizes_keys():
+    # replica A's slot keys are ints (in-process snapshot), replica B's
+    # are strings (JSON wire) -- both must land in the same merged slot
+    a = {'mutate': {100: _slot({3: 5}, breaches=1)}}
+    b = {'mutate': {'100': _slot({3: 2, 7: 1})},
+         'read': {'101': _slot({0: 4})}}
+    merged = fleet.merge_slots([a, b])
+    m = merged['mutate'][100]
+    assert m[0][3] == 7 and m[0][7] == 1
+    assert m[1] == 8 and m[2] == 1
+    assert merged['read'][101][1] == 4
+
+
+def test_merge_slots_pads_short_count_lists():
+    a = {'read': {5: [[1, 2], 3, 0]}}
+    b = {'read': {5: [[1, 1, 1], 3, 1]}}
+    m = fleet.merge_slots([a, b])['read'][5]
+    assert m[0] == [2, 3, 1] and m[1] == 6 and m[2] == 1
+
+
+def test_merged_section_bit_identical_to_single_replica():
+    """The load-bearing property: splitting one replica's traffic
+    across two replicas and merging their slots reproduces the single
+    replica's SLO section EXACTLY (same percentiles, same burn), not
+    approximately."""
+    now_slot = 101
+    whole = {'mutate': {99: _slot({4: 10, 9: 2}, breaches=1),
+                        100: _slot({4: 6}),
+                        101: _slot({2: 3, 12: 1}, breaches=1)},
+             'read': {100: _slot({1: 20})},
+             'control': {}}
+    half_a = {'mutate': {99: _slot({4: 10}, breaches=1),
+                         101: _slot({2: 3})},
+              'read': {100: _slot({1: 8})},
+              'control': {}}
+    half_b = {'mutate': {99: _slot({9: 2}),
+                         100: _slot({4: 6}),
+                         101: _slot({12: 1}, breaches=1)},
+              'read': {'100': _slot({1: 12})},
+              'control': {}}
+    merged = fleet.merge_slots([half_a, half_b])
+    got = attribution.section_from_slots(merged, now_slot=now_slot)
+    want = attribution.section_from_slots(whole, now_slot=now_slot)
+    assert got == want
+    # sanity: the section actually carries signal
+    assert want['classes']['mutate']['3600s']['count'] == 22
+    assert want['classes']['mutate']['3600s']['p99_ms'] > 0
+
+
+def test_window_counts_additive_across_replicas():
+    now_slot = 50
+    a = {'mutate': {49: _slot({3: 4})}, 'read': {}, 'control': {}}
+    b = {'mutate': {49: _slot({3: 6}), 50: _slot({5: 1})},
+         'read': {}, 'control': {}}
+    sec_a = attribution.section_from_slots(a, now_slot=now_slot)
+    sec_b = attribution.section_from_slots(b, now_slot=now_slot)
+    sec_m = attribution.section_from_slots(fleet.merge_slots([a, b]),
+                                           now_slot=now_slot)
+    for w in ('60s', '300s', '3600s'):
+        assert sec_m['classes']['mutate'][w]['count'] == \
+            sec_a['classes']['mutate'][w]['count'] + \
+            sec_b['classes']['mutate'][w]['count']
+
+
+# ---------------------------------------------------------------------------
+# fleet_section / headroom / degradation
+# ---------------------------------------------------------------------------
+
+def _good_scrape(rid, used, budget, slots=None):
+    return {'url': 'http://%s:9464' % rid,
+            'replica_id': rid,
+            'uptime_s': 12.5,
+            'healthz': {'capacity': {
+                'headroom': {'used_bytes': used, 'budget_bytes': budget,
+                             'pressure': used / budget if budget else 0.0,
+                             'exhaustion_s': None},
+                'totals': {'arena_bytes': used, 'egress_bytes': 0}}},
+            'slots': slots or {'mutate': {10: _slot({3: 2})},
+                               'read': {}, 'control': {}}}
+
+
+def test_fleet_section_degrades_on_scrape_error():
+    good = _good_scrape('r1', 100, 1000)
+    bad = {'url': 'http://dead:9464', 'error': 'URLError: refused'}
+    section = fleet.fleet_section([good, bad], now_slot=11)
+    assert [r['replica_id'] for r in section['replicas']] == ['r1']
+    assert section['errors'] == [{'url': 'http://dead:9464',
+                                  'error': 'URLError: refused'}]
+    # the merged SLO section is the SURVIVOR's section, not poisoned
+    assert section['slo']['classes']['mutate']['3600s']['count'] == 2
+
+
+def test_fleet_headroom_aggregates_and_skews():
+    hr = fleet.fleet_headroom([_good_scrape('r1', 100, 1000),
+                               _good_scrape('r2', 900, 1000)])
+    assert hr['used_bytes'] == 1000 and hr['budget_bytes'] == 2000
+    assert hr['pressure'] == 0.5
+    assert hr['pressure_skew'] == 0.8          # 0.9 - 0.1
+    assert [r['replica_id'] for r in hr['replicas']] == ['r1', 'r2']
+
+
+def test_scrape_unreachable_returns_error_row():
+    row = fleet.scrape('http://127.0.0.1:9', timeout=0.5)
+    assert row['url'] == 'http://127.0.0.1:9'
+    assert 'error' in row
+    assert telemetry.metrics_snapshot().get('fleet.scrape_errors') == 1.0
+
+
+def test_amtpu_fleet_render_smoke():
+    good = _good_scrape('r1', 100, 1000)
+    bad = {'url': 'http://dead:9464', 'error': 'URLError: refused'}
+    section = fleet.fleet_section([good, bad], now_slot=11)
+    out = io.StringIO()
+    amtpu_fleet.render([good, bad], section, out=out)
+    text = out.getvalue()
+    assert '1 replicas up, 1 unreachable' in text
+    assert 'r1' in text and 'DOWN' in text
+    assert 'slo (merged windows' in text and 'headroom:' in text
+
+
+# ---------------------------------------------------------------------------
+# amtpu_top restart detection (ISSUE 16 satellite)
+# ---------------------------------------------------------------------------
+
+def test_counters_reset_detects_backwards_runtime():
+    assert amtpu_top.counters_reset(
+        {}, {}, {'slo.requests': 2.0}, {'slo.requests': 10.0})
+    assert not amtpu_top.counters_reset(
+        {}, {}, {'slo.requests': 11.0}, {'slo.requests': 10.0})
+
+
+def test_counters_reset_detects_backwards_stage_histogram():
+    prev = {'total': {'sum': 50.0, 'count': 9.0}}
+    assert amtpu_top.counters_reset(
+        {'total': {'sum': 1.0, 'count': 1.0}}, prev, {}, {})
+    assert not amtpu_top.counters_reset(
+        {'total': {'sum': 50.0, 'count': 9.0}}, prev, {}, {})
+    # first poll: no baseline, never "restarted"
+    assert not amtpu_top.counters_reset({}, None, {}, None)
+
+
+def test_render_restarted_clamps_rate_and_marks_frame():
+    health = {'uptime_s': 1.2, 'scheduler': {}, 'slo': {},
+              'recorder': {}, 'resilience': {}}
+    stages = {'total': {'sum': 4.0, 'count': 2.0}}
+    runtime = {'slo.requests': 2.0}
+    # a naive delta against the dead process's counters would be
+    # negative; the frame clamps at 0 and carries the marker
+    frame = amtpu_top.render(health, stages, None, runtime,
+                             {'slo.requests': 50.0}, 2.0,
+                             restarted=True)
+    assert 'RESTARTED' in frame
+    assert 'req/s 0.0' in frame
+    normal = amtpu_top.render(health, stages, None, runtime, None, 2.0)
+    assert 'RESTARTED' not in normal
+
+
+def test_amtpu_top_requires_fleet_for_multiple_urls():
+    with pytest.raises(SystemExit):
+        amtpu_top.main(['--url', 'http://a', '--url', 'http://b',
+                        '--once'])
+
+
+def test_amtpu_fleet_once_json_rc(monkeypatch, capsys):
+    """--once --json against stubbed scrapes: JSON on stdout, rc 1
+    when any replica was unreachable, 0 when all answered."""
+    good = _good_scrape('r1', 100, 1000)
+    bad = {'url': 'http://dead:9464', 'error': 'URLError: refused'}
+
+    def fake_scrape_fleet(urls, timeout=2.0):
+        rows = [bad if 'dead' in u else good for u in urls]
+        return rows, fleet.fleet_section(rows, now_slot=11)
+
+    monkeypatch.setattr(fleet, 'scrape_fleet', fake_scrape_fleet)
+    rc = amtpu_fleet.main(['--url', 'http://a', '--url', 'http://dead',
+                           '--once', '--json'])
+    assert rc == 1
+    section = json.loads(capsys.readouterr().out.strip())
+    assert [r['replica_id'] for r in section['replicas']] == ['r1']
+    rc = amtpu_fleet.main(['--url', 'http://a', '--once', '--json'])
+    assert rc == 0

@@ -230,17 +230,34 @@ def test_client_injects_trace_context():
     with telemetry.span('frontend.change') as root:
         assert c.call('ping') == {'ok': True}
     sent = json.loads(c._w.getvalue())
-    assert sent['trace'] == {'traceId': root.trace_id,
-                             'spanId': root.span_id}
+    # same trace as the caller's span; the parent span id is the
+    # client-hop span (sidecar.client.request) nested under it, so the
+    # server's spans become children of the hop, not of the frontend
+    assert sent['trace']['traceId'] == root.trace_id
+    assert sent['trace']['spanId'] != root.span_id
+    assert len(sent['trace']['spanId']) == 16
     # ...and the server resumes exactly that trace
     out = io.BytesIO()
     serve_stream(io.BytesIO(c._w.getvalue()), out)
     assert json.loads(out.getvalue())['result'] == {'ok': True}
 
-    # without an active span (or with tracing off) no envelope is sent
+    # without an ambient span the client still stamps: a freshly minted
+    # ROOT context (ISSUE 16 always-stamp; 128-bit trace id), distinct
+    # from the earlier trace
+    telemetry.disable()
     c._w = io.BytesIO()
     c.__dict__['_r'] = io.BytesIO(
         (json.dumps({'id': 2, 'result': {'ok': True}}) + '\n').encode())
+    c.call('ping')
+    sent2 = json.loads(c._w.getvalue())
+    assert len(sent2['trace']['traceId']) == 32
+    assert sent2['trace']['traceId'] != sent['trace']['traceId']
+
+    # AMTPU_TRACE_WIRE=0 (latched per client) turns stamping off
+    c._wire_trace = False
+    c._w = io.BytesIO()
+    c.__dict__['_r'] = io.BytesIO(
+        (json.dumps({'id': 3, 'result': {'ok': True}}) + '\n').encode())
     c.call('ping')
     assert 'trace' not in json.loads(c._w.getvalue())
 

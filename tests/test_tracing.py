@@ -1,0 +1,372 @@
+"""Distributed tracing tests (ISSUE 16): 128-bit trace ids, the
+always-stamped wire context (stable across respawn retries and WAL
+replay), the single-winner trace-file rotation, and cross-process
+trace assembly with clock-skew normalization (tools/amtpu_trace.py).
+The heavyweight lane -- one client-visible request whose trace spans
+two server incarnations across a SIGKILL -- rides a real sidecar
+subprocess, mirroring tests/test_chaos.py."""
+
+import io
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+import pytest
+
+from automerge_tpu import telemetry
+from automerge_tpu.telemetry import spans
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, 'tools'))
+
+import amtpu_trace  # noqa: E402
+
+ROOT_ID = '00000000-0000-0000-0000-000000000000'
+
+CHS = [
+    {'actor': 'a', 'seq': 1, 'deps': {}, 'ops': [
+        {'action': 'set', 'obj': ROOT_ID, 'key': 'bird',
+         'value': 'magpie'}]},
+    {'actor': 'a', 'seq': 2, 'deps': {'a': 1}, 'ops': [
+        {'action': 'set', 'obj': ROOT_ID, 'key': 'fish',
+         'value': 'pike'}]},
+]
+
+
+@pytest.fixture(autouse=True)
+def _isolate():
+    """Telemetry state is process-global: zero it around every test and
+    restore the enable flag + exporter."""
+    telemetry.reset_all()
+    was = telemetry.enabled()
+    was_file = telemetry.trace_file()
+    yield
+    telemetry.set_trace_file(was_file)
+    if was:
+        telemetry.enable()
+    else:
+        telemetry.disable()
+    telemetry.reset_all()
+
+
+# ---------------------------------------------------------------------------
+# ids + wire context shape
+# ---------------------------------------------------------------------------
+
+def test_id_widths():
+    tid = telemetry.new_trace_id()
+    sid = telemetry.new_id()
+    assert len(tid) == 32 and int(tid, 16) >= 0      # 128-bit
+    assert len(sid) == 16 and int(sid, 16) >= 0      # 64-bit
+    assert telemetry.new_trace_id() != tid
+
+
+def test_new_root_context_shape():
+    ctx = telemetry.new_root_context()
+    assert set(ctx) == {'traceId', 'spanId'}
+    assert len(ctx['traceId']) == 32 and len(ctx['spanId']) == 16
+
+
+def test_root_span_mints_128_bit_trace():
+    telemetry.enable()
+    with telemetry.span('t.root') as sp:
+        assert len(sp.trace_id) == 32
+        assert len(sp.span_id) == 16
+        with telemetry.span('t.child') as child:
+            assert child.trace_id == sp.trace_id
+
+
+# ---------------------------------------------------------------------------
+# client stamping: always-stamp + once-per-logical-request
+# ---------------------------------------------------------------------------
+
+def _hand_client(responses):
+    """A SidecarClient around BytesIO pipes (no process), the
+    test_telemetry.py idiom."""
+    from automerge_tpu.sidecar.client import SidecarClient
+    c = SidecarClient.__new__(SidecarClient)
+    c._msgpack = False
+    c._next_id = 0
+    c._proc = c._sock = None
+    c._r = io.BytesIO(''.join(
+        json.dumps(r) + '\n' for r in responses).encode())
+    c._w = io.BytesIO()
+    return c
+
+
+def test_always_stamp_counts_roots_and_propagated():
+    c = _hand_client([{'id': 1, 'result': {'ok': True}}])
+    telemetry.disable()           # no ambient span possible
+    c.call('ping')
+    snap = telemetry.metrics_snapshot()
+    assert snap.get('trace.roots') == 1.0
+    assert 'trace.propagated' not in snap
+
+    telemetry.enable()            # call() opens the client-hop span
+    c._w = io.BytesIO()
+    c.__dict__['_r'] = io.BytesIO(
+        (json.dumps({'id': 2, 'result': {'ok': True}}) + '\n').encode())
+    c.call('ping')
+    assert telemetry.metrics_snapshot().get('trace.propagated') == 1.0
+
+
+def test_trace_stable_across_respawn_retries():
+    """The respawn retry re-sends the SAME wire context: one
+    client-visible request is one trace even when the first attempt
+    died with the server."""
+    from automerge_tpu.sidecar.client import SidecarClient
+    c = SidecarClient.__new__(SidecarClient)
+    c._init_locks()
+    c._heal = True
+    c._proc = object()            # "owns a process"
+    stamped = []
+
+    def fake_call_raw(cmd, kwargs, trace=None):
+        stamped.append((cmd, trace))
+        if len(stamped) == 1:
+            raise ConnectionError('server died mid-request')
+        return {'ok': True}
+
+    c._call_raw = fake_call_raw
+    c._respawn_and_replay = lambda: None
+    assert c.call('apply_changes', doc='d', changes=[]) == {'ok': True}
+    assert [cmd for cmd, _ in stamped] == ['apply_changes',
+                                           'apply_changes']
+    first, retry = stamped[0][1], stamped[1][1]
+    assert first is not None and first is retry
+
+
+def test_wal_records_and_replays_original_trace():
+    from automerge_tpu.sidecar.client import CheckpointWAL
+    wal = CheckpointWAL(compact_every=1000, max_bytes=0)
+    tctx = {'traceId': 'f' * 32, 'spanId': '1' * 16}
+    wal.record('apply_changes', {'doc': 'd', 'changes': []}, trace=tctx)
+    assert wal.log[0][2] is tctx            # 4-tuple carries the trace
+    replayed = []
+
+    def call_raw(cmd, kwargs, trace=None):
+        replayed.append((cmd, trace))
+        return {}
+
+    wal.replay(call_raw)
+    assert replayed == [('apply_changes', tctx)]
+
+
+# ---------------------------------------------------------------------------
+# rotation: single-winner (ISSUE 16 satellite)
+# ---------------------------------------------------------------------------
+
+def test_rotation_loser_does_not_re_rotate(tmp_path, monkeypatch):
+    """A thread that observed the over-cap size but lost the race must
+    NOT rotate again: the re-check under the lock sees the fresh file
+    and returns, so the just-written ``<path>.1`` survives."""
+    monkeypatch.setattr(spans, '_max_export_bytes', lambda: 256)
+    path = str(tmp_path / 't.jsonl')
+    telemetry.set_trace_file(path)
+    telemetry.enable()
+    for i in range(8):
+        with telemetry.span('rot.winner', i=i, pad='x' * 64):
+            pass
+    assert os.path.exists(path + '.1')      # the cap tripped at least once
+    rotations = telemetry.metrics_snapshot().get('trace.rotations')
+    assert rotations and rotations >= 1
+    kept = open(path + '.1').read()
+    assert kept
+    # the "loser" re-enters with the stale over-cap observation: no-op
+    with spans._export_lock:
+        spans._maybe_rotate_locked(256)
+    assert open(path + '.1').read() == kept
+    # ...and after one small write the fresh file is still under cap
+    with telemetry.span('rot.small'):
+        pass
+    with spans._export_lock:
+        spans._maybe_rotate_locked(256)
+    assert open(path + '.1').read() == kept
+    telemetry.set_trace_file(None)
+
+
+def test_rotation_race_no_torn_lines(tmp_path, monkeypatch):
+    """Concurrent writers crossing the cap: every surviving line in the
+    live file AND the rotation must parse (no torn/interleaved lines,
+    no lost fresh rotation)."""
+    monkeypatch.setattr(spans, '_max_export_bytes', lambda: 1024)
+    path = str(tmp_path / 'race.jsonl')
+    telemetry.set_trace_file(path)
+    telemetry.enable()
+
+    def writer(tid):
+        for i in range(100):
+            with telemetry.span('rot.race', t=tid, i=i, pad='y' * 32):
+                pass
+
+    threads = [threading.Thread(target=writer, args=(t,))
+               for t in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    telemetry.set_trace_file(None)
+    assert telemetry.metrics_snapshot().get('trace.rotations', 0) >= 1
+    parsed = 0
+    for p in (path, path + '.1'):
+        if not os.path.exists(p):
+            continue
+        for line in open(p):
+            rec = json.loads(line)            # raises on a torn line
+            assert rec['name'].startswith('rot.')
+            parsed += 1
+    assert parsed > 0
+
+
+# ---------------------------------------------------------------------------
+# cross-process assembly (tools/amtpu_trace.py)
+# ---------------------------------------------------------------------------
+
+def _write_jsonl(path, records):
+    with open(path, 'w') as f:
+        for r in records:
+            f.write(json.dumps(r) + '\n')
+
+
+def test_assembly_and_clock_skew(tmp_path):
+    """Two synthetic process files with a deliberate +1000 s server
+    clock: assembly joins them by trace id and the skew estimate
+    (min child-parent delta over cross-process edges) normalizes the
+    server spans back onto the client timeline."""
+    tid = 'a' * 32
+    client = str(tmp_path / 'client.jsonl')
+    server = str(tmp_path / 'server.jsonl')
+    _write_jsonl(client, [
+        {'name': 'sidecar.client.request', 'trace': tid, 'span': 'c' * 16,
+         'parent': None, 'start': 100.0, 'dur_s': 0.05,
+         'attrs': {'cmd': 'apply_changes'}},
+    ])
+    _write_jsonl(server, [
+        'not json at all',                    # torn line: skipped
+        {'name': 'sidecar.request', 'trace': tid, 'span': 's' * 16,
+         'parent': 'c' * 16, 'start': 1100.01, 'dur_s': 0.04,
+         'attrs': {'cmd': 'apply_changes'}},
+        {'name': 'pool.apply', 'trace': tid, 'span': 'd' * 16,
+         'parent': 's' * 16, 'start': 1100.02, 'dur_s': 0.01},
+    ])
+    records = amtpu_trace.load_files([client, server])
+    assert len(records) == 3                  # the torn line is skipped
+    traces = amtpu_trace.group_traces(records)
+    nodes = traces[tid]
+
+    offsets = amtpu_trace.estimate_offsets(nodes)
+    assert offsets[client] == 0.0
+    assert abs(offsets[server] - 1000.01) < 1e-9
+
+    roots = amtpu_trace.build_tree(nodes)
+    assert len(roots) == 1
+    root = roots[0]
+    assert root['name'] == 'sidecar.client.request'
+    hop = root['children'][0]
+    assert hop['name'] == 'sidecar.request'
+    assert hop['start_n'] >= root['start_n']  # normalized onto client time
+    assert abs(hop['start_n'] - 100.0) < 1e-6
+
+    summary = amtpu_trace.summarize(tid, nodes)
+    assert summary['procs'] == 2
+    assert summary['cmd'] == 'apply_changes'
+    assert abs(summary['client_wall_s'] - 0.05) < 1e-9
+    assert abs(summary['server_s'] - 0.04) < 1e-9
+    assert abs(summary['wire_s'] - 0.01) < 1e-9
+
+    crit = amtpu_trace.critical_path(root)
+    assert {'c' * 16, 's' * 16, 'd' * 16} == crit
+
+    out = io.StringIO()
+    amtpu_trace.render_waterfall(tid, nodes, out=out)
+    text = out.getvalue()
+    assert 'sidecar.request' in text and '*' in text
+
+
+def test_load_files_reads_rotation_sibling(tmp_path):
+    path = str(tmp_path / 't.jsonl')
+    _write_jsonl(path + '.1', [
+        {'name': 'old', 'trace': 't' * 32, 'span': '1' * 16,
+         'start': 1.0, 'dur_s': 0.1}])
+    _write_jsonl(path, [
+        {'name': 'new', 'trace': 't' * 32, 'span': '2' * 16,
+         'start': 2.0, 'dur_s': 0.1}])
+    recs = amtpu_trace.load_files([path])
+    assert [r['name'] for r in recs] == ['old', 'new']
+    assert all(r['_proc'] == path for r in recs)   # one skew domain
+
+
+# ---------------------------------------------------------------------------
+# recorder trace field
+# ---------------------------------------------------------------------------
+
+def test_recorder_event_carries_trace():
+    from automerge_tpu.telemetry import recorder
+    r = recorder.Recorder(8)
+    r.record('request.slow', doc='d', n=3, detail='apply_changes',
+             trace='b' * 32)
+    r.record('batch.begin')
+    evs = r.events_json()
+    assert evs[-2]['trace'] == 'b' * 32
+    assert evs[-1]['trace'] is None
+    assert r.tail(0)[-2]['trace'] == 'b' * 32
+
+
+# ---------------------------------------------------------------------------
+# satellite 3: one request's trace spans two server incarnations
+# ---------------------------------------------------------------------------
+
+def test_trace_survives_kill_respawn_and_wal_replay(tmp_path,
+                                                    monkeypatch):
+    """SIGKILL the sidecar mid-session: the retried request keeps its
+    trace id across the respawn, the WAL replay re-executes the first
+    request under its ORIGINAL trace id in the new incarnation, and
+    `amtpu_trace` assembles both traces across the client + server
+    trace files."""
+    from automerge_tpu.sidecar.client import SidecarClient
+    server_trace = str(tmp_path / 'server.jsonl')
+    client_trace = str(tmp_path / 'client.jsonl')
+    monkeypatch.setenv('AMTPU_TRACE', '1')
+    monkeypatch.setenv('AMTPU_TRACE_FILE', server_trace)
+    telemetry.enable()
+    telemetry.set_trace_file(client_trace)
+    c = SidecarClient()
+    try:
+        c.apply_changes('doc1', [CHS[0]])
+        os.kill(c._proc.pid, signal.SIGKILL)
+        time.sleep(0.2)
+        c.apply_changes('doc1', [CHS[1]])
+        assert c.restarts == 1
+    finally:
+        c.close()
+        telemetry.set_trace_file(None)
+
+    crecs = [json.loads(ln) for ln in open(client_trace)]
+    hops = [r for r in crecs if r['name'] == 'sidecar.client.request']
+    assert len(hops) == 2
+    trace_a, trace_b = hops[0]['trace'], hops[1]['trace']
+    assert trace_a != trace_b and len(trace_a) == 32
+
+    srecs = [json.loads(ln) for ln in open(server_trace)]
+
+    def server_applies(tid):
+        return [r for r in srecs
+                if r['trace'] == tid and r['name'] == 'sidecar.request'
+                and (r.get('attrs') or {}).get('cmd') == 'apply_changes']
+
+    # request 1 executed in incarnation 1 AND replayed (same trace id)
+    # into incarnation 2 -- the state both requests built on is fully
+    # attributed to the request that created it
+    assert len(server_applies(trace_a)) >= 2
+    # the retried request 2 landed server-side under its original id
+    assert server_applies(trace_b)
+
+    traces = amtpu_trace.group_traces(
+        amtpu_trace.load_files([client_trace, server_trace]))
+    for tid in (trace_a, trace_b):
+        s = amtpu_trace.summarize(tid, traces[tid])
+        assert s['procs'] == 2                # joined across both files
+        assert 'sidecar.client.request' in s['roots']

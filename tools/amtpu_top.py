@@ -8,9 +8,16 @@ differences the cumulative stage histograms, so the waterfall shows
 the LAST interval's mean milliseconds per stage (and each stage's
 share of the total as a bar), not the process-lifetime average.
 
+A restarted sidecar resets every cumulative counter to zero; the tool
+detects the backwards step, drops the stale baseline (the frame falls
+back to lifetime means instead of printing garbage negative shares),
+clamps the rate at 0, and flags the frame RESTARTED.
+
 Run:  python tools/amtpu_top.py --url http://127.0.0.1:9464
       python tools/amtpu_top.py --url ... --once        # one frame (CI)
       python tools/amtpu_top.py --url ... --interval 2
+      python tools/amtpu_top.py --fleet --url http://h1:9464 \
+          --url http://h2:9464     # merged multi-replica view
 """
 
 import argparse
@@ -117,19 +124,39 @@ def render_capacity(health, out):
         out.append('  hot(%s): %s' % (tier, '  '.join(cells)))
 
 
+def counters_reset(stages, prev_stages, runtime, prev_runtime):
+    """True when any cumulative counter moved BACKWARDS since the last
+    poll -- the server restarted (counters are monotone within one
+    process lifetime).  The caller drops its stale baseline: keeping
+    it would difference a fresh process against the dead one and
+    render negative rates / garbage share bars (ISSUE 16
+    satellite)."""
+    for cur, prev in ((runtime, prev_runtime),):
+        for k, v in (prev or {}).items():
+            if cur.get(k, v) < v:
+                return True
+    for s, prev_kinds in (prev_stages or {}).items():
+        cur_kinds = stages.get(s, {})
+        for kind, v in prev_kinds.items():
+            if cur_kinds.get(kind, v) < v:
+                return True
+    return False
+
+
 def render(health, stages, prev_stages, runtime, prev_runtime,
-           interval_s):
+           interval_s, restarted=False):
     out = []
     sched = health.get('scheduler') or {}
     slo = health.get('slo') or {}
     rec = health.get('recorder') or {}
     res = health.get('resilience') or {}
     reqs = runtime.get('slo.requests', 0.0)
-    rate = ((reqs - prev_runtime.get('slo.requests', reqs))
-            / interval_s) if prev_runtime else 0.0
-    out.append('amtpu-top  up %ss  conns %s  req/s %.1f  %s%s'
+    rate = max(0.0, (reqs - prev_runtime.get('slo.requests', reqs))
+               / interval_s) if prev_runtime else 0.0
+    out.append('amtpu-top  up %ss  conns %s  req/s %.1f  %s%s%s'
                % (health.get('uptime_s', '?'),
                   sched.get('connections', '?'), rate,
+                  'RESTARTED  ' if restarted else '',
                   'SHEDDING  ' if sched.get('shedding') else '',
                   'DEGRADED' if health.get('degraded') else ''))
     out.append('queue: depth %s/%s ops  queued %s  pending docs %s  '
@@ -196,18 +223,48 @@ def render(health, stages, prev_stages, runtime, prev_runtime,
     return '\n'.join(out)
 
 
+def _fleet_loop(args):
+    """--fleet mode: scrape EVERY --url replica and render the merged
+    fleet view (summed SLO slots recomputed through the per-replica
+    code path, headroom skew table) via telemetry/fleet.py."""
+    import os
+    here = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, os.path.dirname(here))
+    sys.path.insert(0, here)
+    from automerge_tpu.telemetry import fleet
+    from amtpu_fleet import render as fleet_render
+    while True:
+        scrapes, section = fleet.scrape_fleet(
+            [u.rstrip('/') for u in args.url], timeout=args.timeout)
+        if args.once:
+            fleet_render(scrapes, section)
+            return 1 if section['errors'] else 0
+        sys.stdout.write('\x1b[2J\x1b[H')
+        fleet_render(scrapes, section)
+        sys.stdout.flush()
+        time.sleep(args.interval)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument('--url', required=True,
+    ap.add_argument('--url', action='append', required=True,
                     help='base URL of the sidecar metrics listener, '
-                         'e.g. http://127.0.0.1:9464')
+                         'e.g. http://127.0.0.1:9464 (repeat with '
+                         '--fleet for a multi-replica view)')
     ap.add_argument('--interval', type=float, default=2.0)
     ap.add_argument('--once', action='store_true',
                     help='print one frame and exit (no screen clears; '
                          'the obs-check CI mode)')
     ap.add_argument('--timeout', type=float, default=10.0)
+    ap.add_argument('--fleet', action='store_true',
+                    help='aggregate ALL --url replicas into one '
+                         'merged view (telemetry/fleet.py)')
     args = ap.parse_args(argv)
-    base = args.url.rstrip('/')
+    if args.fleet:
+        return _fleet_loop(args)
+    if len(args.url) > 1:
+        ap.error('multiple --url endpoints require --fleet')
+    base = args.url[0].rstrip('/')
     prev_stages = prev_runtime = None
     while True:
         try:
@@ -220,8 +277,15 @@ def main(argv=None):
                 return 1
             time.sleep(args.interval)
             continue
+        restarted = counters_reset(stages, prev_stages, runtime,
+                                   prev_runtime)
+        if restarted:
+            # the dead process's counters are not a baseline for the
+            # fresh one: fall back to lifetime means for this frame
+            prev_stages = prev_runtime = None
         frame = render(health, stages, prev_stages, runtime,
-                       prev_runtime, args.interval)
+                       prev_runtime, args.interval,
+                       restarted=restarted)
         if args.once:
             print(frame)
             return 0

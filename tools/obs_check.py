@@ -3,7 +3,7 @@ recorder, the per-request critical-path attribution, and the SLO
 surface must actually work against a LIVE gateway, not just in unit
 tests.
 
-Two phases, each against a real server subprocess on a unix socket:
+Four phases, each against real server subprocess(es) on unix sockets:
 
   1. **attribution + SLO + exemplars** -- 8 concurrent connections of
      mixed traffic (mutations + bypass reads) with ``AMTPU_SLOW_MS``
@@ -26,6 +26,22 @@ Two phases, each against a real server subprocess on a unix socket:
      JSONL contains the injected ``fault.injected`` event (the
      post-mortem exists without anyone asking for it), while an
      on-demand ``dump`` request round-trips a fresh file.
+  3. **two-process distributed tracing** (ISSUE 16) -- THIS process
+     traces as the client (own ``AMTPU_TRACE_FILE``) against a traced
+     server writing ITS own file; ``tools/amtpu_trace.py`` must
+     assemble cross-process trees spanning both files.  Gates: joined
+     trees exist; the server-side stage partition (the exemplar's
+     stage children) accounts for the client wall within 5% (the
+     residual is wire + client overhead); the SAME trace id shows up
+     in the gateway's recorder ``request.slow`` events, in the request
+     exemplars, and on the fan-out ``change`` frames a subscriber
+     receives.
+  4. **fleet aggregation** (ISSUE 16) -- two live replicas with
+     distinct ``AMTPU_REPLICA_ID``s; ``amtpu_fleet --once --json``
+     must merge them, and the merged SLO windows must equal the
+     recompute from the summed slots (mergeable-slot additivity: the
+     merged per-class window counts are exactly the per-replica sums
+     through the same pure ``section_from_slots``).
 
 Run: JAX_PLATFORMS=cpu python tools/obs_check.py      (make obs-check)
 """
@@ -309,11 +325,238 @@ def check_phase2(problems):
               ' quarantine)' % (len(events), on_demand['path']))
 
 
+def check_phase3(problems):
+    """Two-process tracing: this process is the traced client, the
+    server subprocess the traced hop; the assembled tree must join
+    them."""
+    import urllib.request
+
+    from automerge_tpu import telemetry
+    from automerge_tpu.sidecar.client import SidecarClient
+    sys.path.insert(0, os.path.join(REPO, 'tools'))
+    import amtpu_trace
+    tmp = tempfile.mkdtemp(prefix='amtpu-obs3-')
+    sock = os.path.join(tmp, 'gw.sock')
+    server_trace = os.path.join(tmp, 'server_spans.jsonl')
+    client_trace = os.path.join(tmp, 'client_spans.jsonl')
+    stderr_path = os.path.join(tmp, 'server.stderr')
+    proc = spawn_server(sock, {
+        'AMTPU_TRACE': '1',
+        'AMTPU_TRACE_FILE': server_trace,
+        'AMTPU_SLOW_MS': '0.01',         # every request leaves an
+        'AMTPU_RECORDER_DIR': tmp,       # exemplar (rate limit aside)
+        # server-resident wall >> wire: the flush deadline dominates
+        # each request, so the 5% partition budget prices the real
+        # wire + client overhead, not scheduling noise
+        'AMTPU_FLUSH_DEADLINE_MS': '25',
+    }, stderr_path=stderr_path)
+    telemetry.enable()
+    telemetry.set_trace_file(client_trace)
+    fan_events = []
+    try:
+        with SidecarClient(sock_path=sock) as sub:
+            sub.subscribe(doc='obs-00')
+            drive_traffic(sock)
+            deadline = time.time() + 30
+            while time.time() < deadline and len(fan_events) < 4:
+                ev = sub.next_event(timeout=2)
+                if ev is None:
+                    break
+                fan_events.append(ev)
+        port = metrics_port(stderr_path)
+        with urllib.request.urlopen(
+                'http://127.0.0.1:%d/debug/recorder' % port,
+                timeout=30) as r:
+            dbg = json.loads(r.read())
+    finally:
+        telemetry.set_trace_file(None)
+        telemetry.disable()
+        stop_server(proc)
+
+    # 3a. cross-process assembly: trees spanning BOTH trace files
+    traces = amtpu_trace.group_traces(
+        amtpu_trace.load_files([client_trace, server_trace]))
+    joined = {tid: nodes for tid, nodes in traces.items()
+              if len({n['_proc'] for n in nodes}) >= 2}
+    if not joined:
+        problems.append('phase3: no trace joined both files '
+                        '(%d client-only/server-only traces)'
+                        % len(traces))
+        return
+
+    # 3b. the per-hop stage partition accounts for the client wall:
+    # exemplar stage children ~= exemplar total (2%, the attribution
+    # invariant), and total ~= client wall within 5% (the residual is
+    # wire + client-side overhead)
+    best = None
+    partitioned = 0
+    for tid, nodes in joined.items():
+        spans = {n['span']: n for n in nodes}
+        client = next((n for n in nodes
+                       if n['name'] == 'sidecar.client.request'
+                       and n.get('parent') not in spans), None)
+        ex = next((n for n in nodes
+                   if n['name'] == 'request.exemplar'), None)
+        if client is None or ex is None:
+            continue
+        kids = [n for n in nodes
+                if str(n['name']).startswith('request.stage.')
+                and n.get('parent') == ex['span']]
+        stage_sum = sum(n['dur_s'] for n in kids
+                        if n['name'] != 'request.stage.fanout')
+        wall = client.get('dur_s', 0.0)
+        if not kids or wall <= 0 or ex['dur_s'] <= 0:
+            continue
+        if abs(stage_sum - ex['dur_s']) > 0.02 * ex['dur_s']:
+            continue
+        partitioned += 1
+        residual = (wall - ex['dur_s']) / wall
+        if best is None or abs(residual) < abs(best):
+            best = residual
+    if not partitioned:
+        problems.append('phase3: no joined trace carried a stage-'
+                        'partitioned exemplar (of %d joined)'
+                        % len(joined))
+    elif best is None or not -0.05 <= best <= 0.05:
+        problems.append('phase3: per-hop stages leave %.1f%% of the '
+                        'client wall unaccounted (budget 5%%)'
+                        % (100 * (best or 1.0)))
+
+    # 3c. the SAME trace ids in the gateway recorder + exemplars
+    rec_traced = {e.get('trace') for e in dbg.get('events', ())
+                  if e.get('event') == 'request.slow' and e.get('trace')}
+    if not rec_traced & set(joined):
+        problems.append('phase3: no recorder request.slow event '
+                        'carries a joined trace id (%d traced events)'
+                        % len(rec_traced))
+    ex_traced = [x for x in dbg.get('exemplars', ())
+                 if x.get('trace') in joined and x.get('parent')]
+    if not ex_traced:
+        problems.append('phase3: no served exemplar adopted a joined '
+                        'wire trace (parent span + trace id)')
+
+    # 3d. fan-out event frames carry the originating trace id
+    fan_traced = [ev for ev in fan_events
+                  if ev.get('event') == 'change' and ev.get('trace')]
+    if not fan_traced:
+        problems.append('phase3: no fan-out change frame carried a '
+                        'trace id (%d frames)' % len(fan_events))
+    elif not {ev['trace'] for ev in fan_traced} & set(traces):
+        problems.append('phase3: fan-out frame trace ids match no '
+                        'client trace')
+    if not problems:
+        print('obs-check: phase 3 OK (%d/%d traces joined 2 files; '
+              'best wall residual %.2f%%; recorder/exemplar/fan-out '
+              'frames all trace-correlated)'
+              % (len(joined), len(traces), 100 * (best or 0.0)))
+
+
+def check_phase4(problems):
+    """Fleet arm: two live replicas, one merged view, merged SLO
+    windows == per-replica recompute sums."""
+    from automerge_tpu.telemetry import fleet
+    from automerge_tpu.telemetry.attribution import section_from_slots
+    tmp = tempfile.mkdtemp(prefix='amtpu-obs4-')
+    procs = []
+    try:
+        socks = []
+        for i in (1, 2):
+            sock = os.path.join(tmp, 'gw%d.sock' % i)
+            procs.append(spawn_server(sock, {
+                'AMTPU_FLUSH_DEADLINE_MS': '5',
+                'AMTPU_REPLICA_ID': 'obs-replica-%d' % i,
+            }, stderr_path=os.path.join(tmp, 'server%d.stderr' % i)))
+            socks.append(sock)
+        for sock in socks:
+            drive_traffic(sock)
+        urls = ['http://127.0.0.1:%d'
+                % metrics_port(os.path.join(tmp, 'server%d.stderr' % i))
+                for i in (1, 2)]
+        scrapes = [fleet.scrape(u, timeout=30) for u in urls]
+        env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS='cpu')
+        cli = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, 'tools', 'amtpu_fleet.py'),
+             '--url', urls[0], '--url', urls[1], '--once', '--json',
+             '--timeout', '30'],
+            capture_output=True, text=True, timeout=120, env=env)
+    finally:
+        for p in procs:
+            stop_server(p)
+
+    errs = [s for s in scrapes if 'error' in s]
+    if errs:
+        problems.append('phase4: scrape failed: %r' % errs)
+        return
+    ids = {s['replica_id'] for s in scrapes}
+    if ids != {'obs-replica-1', 'obs-replica-2'}:
+        problems.append('phase4: replica ids wrong: %r' % sorted(ids))
+
+    # merged windows equal the per-replica recompute sums through the
+    # SAME pure function, at one aligned now_slot (bit-consistency of
+    # the mergeable-slot design)
+    all_slots = [s['slots'] for s in scrapes]
+    slot_keys = [int(k) for slots in all_slots
+                 for per_cls in slots.values() for k in per_cls]
+    if not slot_keys:
+        problems.append('phase4: no SLO slots scraped')
+        return
+    now_slot = max(slot_keys) + 1
+    merged_sec = section_from_slots(fleet.merge_slots(all_slots),
+                                    now_slot=now_slot)
+    per_secs = [section_from_slots(s, now_slot=now_slot)
+                for s in all_slots]
+    for cls, wins in merged_sec['classes'].items():
+        for win, row in wins.items():
+            want = sum(p['classes'].get(cls, {}).get(win, {})
+                       .get('count', 0) for p in per_secs)
+            if row['count'] != want:
+                problems.append(
+                    'phase4: merged %s/%s count %d != per-replica sum '
+                    '%d' % (cls, win, row['count'], want))
+    mut = merged_sec['classes'].get('mutate', {}).get('3600s', {})
+    if mut.get('count', 0) < 2 * N_CONNS * ROUNDS:
+        problems.append('phase4: merged mutate window count %s < both '
+                        'replicas\' traffic (%d)'
+                        % (mut.get('count'), 2 * N_CONNS * ROUNDS))
+
+    # the CLI recomputes the same merge from its own scrape (slots are
+    # frozen once traffic stops, so the hour window must agree exactly)
+    if cli.returncode != 0:
+        problems.append('phase4: amtpu_fleet --once failed (rc %s): %s'
+                        % (cli.returncode, cli.stderr[-300:]))
+        return
+    try:
+        section = json.loads(cli.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        problems.append('phase4: amtpu_fleet --json unparseable: %r'
+                        % cli.stdout[-300:])
+        return
+    if len(section.get('replicas', ())) != 2 or section.get('errors'):
+        problems.append('phase4: fleet section roll-call wrong: %r/%r'
+                        % (section.get('replicas'),
+                           section.get('errors')))
+    cli_mut = (section.get('slo', {}).get('classes', {})
+               .get('mutate', {}).get('3600s', {}))
+    if cli_mut.get('count') != mut.get('count'):
+        problems.append('phase4: amtpu_fleet merged count %s != local '
+                        'recompute %s'
+                        % (cli_mut.get('count'), mut.get('count')))
+    if not problems:
+        print('obs-check: phase 4 OK (2 replicas merged; %d requests '
+              'in the merged mutate window == per-replica sums; '
+              'amtpu_fleet --once agrees)' % mut.get('count', 0))
+
+
 def main():
     problems = []
     check_phase1(problems)
     if not problems:
         check_phase2(problems)
+    if not problems:
+        check_phase3(problems)
+    if not problems:
+        check_phase4(problems)
     if problems:
         for p in problems:
             print('obs-check: FAIL %s' % p)

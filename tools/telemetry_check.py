@@ -76,6 +76,10 @@ _PATCHES = [
     # attribution is priced against the same bar as the recorder
     (capacity, 'note_fanout', _noop),
     (capacity, 'note_egress', _noop),
+    # the wire-trace stamping seam (ISSUE 16): SidecarClient consults
+    # the ambient span context on EVERY outbound request, so the raw
+    # arm prices that lookup alongside the other always-on hooks
+    (telemetry, 'current_trace_context', _noop),
 ]
 
 
