@@ -156,6 +156,19 @@ ENV_FLAGS = (
             '-history folds; 0 disables GC)'),
     EnvFlag('AMTPU_RESIDENT_DOCS_MAX', 'int', 0, False,
             'storage/coldstore.py (0 = no cold-doc eviction)'),
+    # -- clock folding + parallel restore (ISSUE 17) ------------------------
+    EnvFlag('AMTPU_STORAGE_FOLD_CLOCKS', 'bool', True, False,
+            'native/__init__.py (0 = keep per-change all_deps clock '
+            'vectors sparse, the unfolded A/B-oracle arm)'),
+    EnvFlag('AMTPU_FOLDCLK_MAX_ACTORS', 'int', 256, False,
+            'native/__init__.py (per-doc actor-population cap for the '
+            'densified clock-fold table; busier docs stay sparse)'),
+    EnvFlag('AMTPU_RESTORE_THREADS', 'int', 0, False,
+            'native/__init__.py (restore_from_store fan-out; 0 = auto '
+            'min(8, cores), 1 = the serial A/B arm)'),
+    EnvFlag('AMTPU_RESTORE_BATCH', 'int', 8192, False,
+            'native/__init__.py (docs per decode+apply batch during '
+            'restore_from_store)'),
     # -- sidecar client -----------------------------------------------------
     EnvFlag('AMTPU_WAL_COMPACT', 'int', 32, False, 'sidecar/client.py'),
     EnvFlag('AMTPU_WAL_MAX_BYTES', 'int', 67108864, False,

@@ -211,6 +211,12 @@ def _load():
     lib.amtpu_fold_settled.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
         ctypes.c_int64]
+    lib.amtpu_fold_clocks.restype = ctypes.c_int64
+    lib.amtpu_fold_clocks.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_int64, ctypes.c_int64]
+    lib.amtpu_clock_pairs.restype = ctypes.c_int64
+    lib.amtpu_clock_pairs.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.amtpu_op_count.restype = ctypes.c_int64
     lib.amtpu_op_count.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.amtpu_doc_ids.restype = ctypes.POINTER(ctypes.c_uint8)
@@ -663,6 +669,151 @@ def _load_batch(pool, blobs):
     for doc_id, key, frontier, chunks in adopts:
         _base_pool_of(pool, doc_id)._adopt_snapshot(key, frontier,
                                                     chunks)
+
+
+def _restore_threads():
+    """``AMTPU_RESTORE_THREADS``: restore fan-out width (0 = auto, one
+    worker per core capped at 8; 1 = serial -- the A/B arm the
+    coldstart gate compares against)."""
+    n = env_int('AMTPU_RESTORE_THREADS', 0)
+    if n <= 0:
+        n = min(8, os.cpu_count() or 1)
+    return n
+
+
+def restore_from_store(pool, store, doc_ids=None, batch=None,
+                       threads=None):
+    """Parallel arena-direct restore straight off a ColdStore's durable
+    manifest (ISSUE 17 tentpole): walks the store's doc inventory, reads
+    + checksums blobs, and fans per-shard doc groups across a thread
+    pool where each shard runs its own `amtpu_begin_columnar` decode +
+    apply with the GIL released -- the 1M-doc cold-start entry point.
+
+    * **Sharding.** Docs group by base pool (`_base_pool_of`); each
+      group restores on its own worker, serially batched
+      (``AMTPU_RESTORE_BATCH``, default 8192 docs) -- a single
+      NativeDocPool applies single-threaded by contract, so the
+      parallel axis is the shard, exactly like the dict-replay arm's
+      threaded shard runner.  Within a group, the next batch's blob
+      reads prefetch on a side thread while the current batch applies
+      (I/O overlaps decode even at one shard).
+    * **Failure isolation.** A corrupt blob (checksum mismatch --
+      `ColdStoreCorrupt`) quarantines THAT doc: typed per-doc error in
+      the summary + ``storage.restore.corrupt``, never a whole-restore
+      failure.  A failed batch apply falls back to per-doc application
+      (the `DocEvictor.ensure_resident` pattern); docs that still fail
+      land in the summary as resilience error envelopes +
+      ``storage.restore.failed``.
+    * **Progress.** ``storage.restore.{docs,bytes,batches}`` advance
+      per applied batch (scrapable mid-restore) and the flight recorder
+      logs start/finish + every quarantined doc.
+
+    Returns a summary dict: ``{'docs', 'bytes', 'batches', 'corrupt':
+    {doc: error}, 'failed': {doc: error}, 'elapsed_s'}``.
+    """
+    from ..storage.coldstore import ColdStoreCorrupt
+    from .. import resilience
+    t0 = time.perf_counter()
+    if doc_ids is None:
+        doc_ids = sorted(store.doc_ids())
+    else:
+        doc_ids = list(doc_ids)
+    if batch is None:
+        batch = max(1, env_int('AMTPU_RESTORE_BATCH', 8192))
+    if threads is None:
+        threads = _restore_threads()
+    recorder.record('restore.start', n=len(doc_ids),
+                    detail='threads=%d batch=%d' % (threads, batch))
+    groups = {}          # id(base pool) -> (base pool, [doc ids])
+    if hasattr(pool, '_shard_of'):
+        pool.pools     # materialize the lazy shard list on THIS thread
+    for d in doc_ids:
+        bp = _base_pool_of(pool, d)
+        groups.setdefault(id(bp), (bp, []))[1].append(d)
+    lock = threading.Lock()
+    summary = {'docs': 0, 'bytes': 0, 'batches': 0,
+               'corrupt': {}, 'failed': {}}
+
+    def read_blobs(ids):
+        """One batch's blobs off the store, checksums verified; corrupt
+        docs quarantine here (typed, counted, skipped)."""
+        blobs = {}
+        for d in ids:
+            try:
+                blobs[d] = store.get(d)
+            except ColdStoreCorrupt as e:
+                telemetry.metric('storage.restore.corrupt')
+                recorder.record('restore.corrupt', doc=doc_key(d),
+                                detail=str(e))
+                with lock:
+                    summary['corrupt'][d] = resilience.error_envelope(e)
+            except KeyError:
+                pass   # dropped between inventory walk and read
+        return blobs
+
+    def apply_blobs(bp, blobs):
+        if not blobs:
+            return
+        try:
+            _load_batch(bp, blobs)
+        except Exception as batch_exc:
+            # per-doc isolation (the ensure_resident pattern): one
+            # poison blob must not fail the other docs of its batch
+            for d, data in blobs.items():
+                try:
+                    _load_batch(bp, {d: data})
+                except Exception as e:
+                    telemetry.metric('storage.restore.failed')
+                    recorder.record('restore.failed', doc=doc_key(d),
+                                    detail=str(e))
+                    with lock:
+                        summary['failed'][d] = \
+                            resilience.error_envelope(e)
+            del batch_exc
+        with lock:
+            summary['docs'] += len(blobs)
+            summary['bytes'] += sum(len(v) for v in blobs.values())
+            summary['batches'] += 1
+        telemetry.metric('storage.restore.docs', len(blobs))
+        telemetry.metric('storage.restore.bytes',
+                         sum(len(v) for v in blobs.values()))
+        telemetry.metric('storage.restore.batches')
+
+    def run_group(bp, ids):
+        import concurrent.futures
+        chunks = [ids[i:i + batch] for i in range(0, len(ids), batch)]
+        # single-reader prefetch: batch k+1's store reads overlap batch
+        # k's decode+apply (reads release the GIL around file I/O)
+        with concurrent.futures.ThreadPoolExecutor(1) as reader:
+            pending = reader.submit(read_blobs, chunks[0]) \
+                if chunks else None
+            for i in range(len(chunks)):
+                blobs = pending.result()
+                pending = reader.submit(read_blobs, chunks[i + 1]) \
+                    if i + 1 < len(chunks) else None
+                apply_blobs(bp, blobs)
+
+    group_list = [g for g in groups.values() if g[1]]
+    if len(group_list) > 1 and threads > 1:
+        import concurrent.futures
+        with concurrent.futures.ThreadPoolExecutor(
+                max_workers=min(threads, len(group_list))) as ex:
+            futs = [ex.submit(run_group, bp, ids)
+                    for bp, ids in group_list]
+            errors = [f.exception() for f in futs
+                      if f.exception() is not None]
+        if errors:
+            raise errors[0]
+    else:
+        for bp, ids in group_list:
+            run_group(bp, ids)
+    summary['elapsed_s'] = round(time.perf_counter() - t0, 3)
+    recorder.record('restore.done', n=summary['docs'],
+                    detail='%.3fs corrupt=%d failed=%d'
+                           % (summary['elapsed_s'],
+                              len(summary['corrupt']),
+                              len(summary['failed'])))
+    return summary
 
 
 def _apply_batch_dicts(pool, changes_by_doc):
@@ -2232,6 +2383,14 @@ class NativeDocPool:
         pass instead of one device round trip per doc."""
         _load_batch(self, blobs)
 
+    def restore_from_store(self, store, doc_ids=None, batch=None,
+                           threads=None):
+        """Restores the store's whole manifest inventory into this pool
+        (module-level `restore_from_store`; a single pool applies
+        serially with the next batch's blob reads prefetching)."""
+        return restore_from_store(self, store, doc_ids=doc_ids,
+                                  batch=batch, threads=threads)
+
     def get_missing_deps(self, doc_id):
         out_len = ctypes.c_int64()
         ptr = lib().amtpu_get_missing_deps(
@@ -2397,6 +2556,7 @@ class NativeDocPool:
                               'chunks': list(chunks)}
         self._truncate(key, frontier)
         self._fold_settled(key, frontier)
+        self._fold_clocks(key, frontier)
 
     def _truncate(self, key, frontier):
         fb = msgpack.packb(dict(frontier), use_bin_type=True)
@@ -2458,6 +2618,7 @@ class NativeDocPool:
             st['frontier'][a] = max(st['frontier'].get(a, 0), s)
         self._truncate(key, st['frontier'])
         self._fold_settled(key, st['frontier'])
+        self._fold_clocks(key, st['frontier'])
         self._maybe_rechunk(key, st)
         telemetry.metric('storage.gc.compactions')
         telemetry.metric('storage.gc.changes_folded', len(fold))
@@ -2482,6 +2643,52 @@ class NativeDocPool:
         if n:
             telemetry.metric('storage.gc.ops_folded', n)
         return int(n)
+
+    def _fold_clocks(self, key, frontier):
+        """Clock-vector folding (ISSUE 17 tentpole): settled changes at
+        or behind `frontier` move their sparse per-change ``all_deps``
+        vector clocks into the doc's densified C++ fold table (or a
+        zero-byte sentinel for empty / linear-history shapes) and free
+        the vectors -- the last per-history memory term goes O(live
+        frontier) instead of O(changes).  Causal queries (straggler
+        closure walks, `get_missing_clock`, conflict concurrency) keep
+        answering through the folded rows -- the clock-fold parity
+        suite pins them against an unfolded twin.
+        ``AMTPU_STORAGE_FOLD_CLOCKS=0`` is the unfolded A/B arm;
+        ``AMTPU_FOLDCLK_MAX_ACTORS`` (default 256) caps the per-doc
+        folded actor population (row width is the doc's actor count --
+        past the cap, non-trivial vectors stay sparse)."""
+        if not frontier or \
+                not env_bool('AMTPU_STORAGE_FOLD_CLOCKS', True):
+            return 0
+        fb = msgpack.packb(dict(frontier), use_bin_type=True)
+        n = lib().amtpu_fold_clocks(
+            self._pool, key.encode(), fb, len(fb),
+            env_int('AMTPU_FOLDCLK_MAX_ACTORS', 256))
+        if n < 0:
+            _raise_last()
+        if n:
+            telemetry.metric('storage.gc.clocks_folded', n)
+        return int(n)
+
+    def clock_pairs(self, doc_id=None):
+        """Retained sparse all_deps clock pairs (one doc, or the whole
+        pool), walked fresh in C++ -- the reconciliation oracle the
+        clock-fold lane gates against `doc_stats`'s incrementally-
+        maintained ``clk_pairs`` column."""
+        key = '' if doc_id is None else self._doc_key(doc_id)
+        n = lib().amtpu_clock_pairs(self._pool, key.encode())
+        if n < 0:
+            _raise_last()
+        return int(n)
+
+    def resclk_row_bytes(self):
+        """Bytes one pool-resident clock-table row costs (padded actor
+        width x int32) -- converts `doc_stats`'s ``resclk_rows`` count
+        into the byte tier the capacity cost vector reports."""
+        info = (ctypes.c_int64 * 4)()
+        lib().amtpu_resclk_info(self._pool, info)
+        return int(info[1]) * 4
 
     def _maybe_rechunk(self, key, st):
         """Chunk re-compaction (ISSUE 14): a long-lived doc accumulates
@@ -2534,7 +2741,8 @@ class NativeDocPool:
     #: amtpu_doc_stats columns, in ABI order (core.cpp has the
     #: authoritative comment); telemetry/capacity.py reads these names
     DOC_STAT_COLS = ('hist_bytes', 'ops', 'folded_ops', 'changes',
-                     'queued', 'resclk_rows')
+                     'queued', 'resclk_rows', 'clk_pairs',
+                     'foldclk_bytes')
 
     def doc_stats(self):
         """Per-doc resource accounting in ONE C call for the whole pool
@@ -2833,6 +3041,14 @@ class ShardedNativePool:
         routes docs to their shards)."""
         _load_batch(self, blobs)
 
+    def restore_from_store(self, store, doc_ids=None, batch=None,
+                           threads=None):
+        """Parallel per-shard restore off the store's durable manifest:
+        each shard's doc group decodes + applies on its own thread with
+        the GIL released (module-level `restore_from_store`)."""
+        return restore_from_store(self, store, doc_ids=doc_ids,
+                                  batch=batch, threads=threads)
+
     def get_missing_deps(self, doc_id):
         return self.pools[self._shard_of(doc_id)].get_missing_deps(doc_id)
 
@@ -2869,6 +3085,17 @@ class ShardedNativePool:
         if doc_id is not None:
             return self.pools[self._shard_of(doc_id)].op_count(doc_id)
         return sum(p.op_count() for p in self.pools)
+
+    def clock_pairs(self, doc_id=None):
+        if doc_id is not None:
+            return self.pools[self._shard_of(doc_id)].clock_pairs(doc_id)
+        return sum(p.clock_pairs() for p in self.pools)
+
+    def resclk_row_bytes(self):
+        """Widest shard's row cost: shards serve one doc population, so
+        actor widths track each other -- the capacity tier wants a
+        stable per-row conversion, not per-shard precision."""
+        return max(p.resclk_row_bytes() for p in self.pools)
 
     DOC_STAT_COLS = NativeDocPool.DOC_STAT_COLS
 

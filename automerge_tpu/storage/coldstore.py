@@ -49,6 +49,21 @@ from ..utils.common import env_bool, env_int, env_str
 MANIFEST = 'manifest.amtm'
 
 
+class ColdStoreCorrupt(ValueError):
+    """A cold blob failed its manifest checksum at read time (torn
+    write survived a crash, bit rot, external truncation).  Subclasses
+    ValueError so pre-existing whole-restore callers keep their raise
+    contract; the parallel restore path (`native.restore_from_store`,
+    ISSUE 17) catches THIS type to quarantine the one doc (typed
+    per-doc error + ``storage.restore.corrupt``) instead of failing a
+    million-doc restore on one bad blob."""
+
+    def __init__(self, doc_id, detail):
+        super(ColdStoreCorrupt, self).__init__(
+            'cold blob checksum mismatch for %r (%s)' % (doc_id, detail))
+        self.doc_id = doc_id
+
+
 class ColdStore(object):
     """File-per-doc blob store: checkpoint containers keyed by doc id."""
 
@@ -226,14 +241,15 @@ class ColdStore(object):
         reload cannot destroy the only copy of a doc.  Durable mode
         verifies the manifest checksum, so a torn or bit-rotted blob
         raises here instead of replaying garbage."""
-        path, _n, digest = self._index[doc_id]
+        path, n, digest = self._index[doc_id]
         with open(path, 'rb') as f:
             data = f.read()
         if digest is not None \
                 and hashlib.sha1(data).hexdigest() != digest:
             telemetry.metric('storage.checksum_failed')
-            raise ValueError('cold blob checksum mismatch for %r'
-                             % (doc_id,))
+            raise ColdStoreCorrupt(
+                doc_id, '%d bytes on disk, %d committed'
+                        % (len(data), n))
         return data
 
     def discard(self, doc_id):

@@ -311,6 +311,15 @@ KNOWN_EGRESS_KEYS = ('staged_frames', 'staged_bytes', 'writes',
 # evict_failed               docs that refused to checkpoint (kept
 #                              resident)
 # cold_bytes_written         checkpoint bytes written to the cold store
+# gc.clocks_folded           per-change all_deps clock pairs freed by
+#                              folding into the densified clock table
+# restore.docs/.bytes        docs + blob bytes restored from the cold
+#                              store by restore_from_store
+# restore.batches            decode+apply batches the restore ran
+# restore.corrupt            blobs quarantined on checksum failure
+#                              (doc skipped, restore continues)
+# restore.failed             docs whose decode/apply raised (skipped
+#                              via the resilience path)
 KNOWN_STORAGE_KEYS = ('columnar.encodes', 'columnar.decodes',
                       'columnar.changes', 'columnar.residual_changes',
                       'columnar.bytes_in', 'columnar.bytes_out',
@@ -325,7 +334,11 @@ KNOWN_STORAGE_KEYS = ('columnar.encodes', 'columnar.decodes',
                       'native_decodes', 'python_decodes',
                       'native_loads', 'durable_writes',
                       'manifest_writes', 'manifest_recovered',
-                      'manifest_corrupt', 'checksum_failed')
+                      'manifest_corrupt', 'checksum_failed',
+                      'gc.clocks_folded',
+                      'restore.docs', 'restore.bytes',
+                      'restore.batches', 'restore.corrupt',
+                      'restore.failed')
 
 # flight-recorder counters (`telemetry.metric('recorder.<name>')` call
 # sites in telemetry/recorder.py; event catalog: docs/OBSERVABILITY.md),

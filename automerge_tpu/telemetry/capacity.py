@@ -58,7 +58,7 @@ from . import metric, metrics_snapshot, registry
 
 #: cost-vector field names, in surface order (docs/OBSERVABILITY.md)
 COST_FIELDS = ('arena_bytes', 'ops', 'disk_bytes', 'subscribers',
-               'fanned_bytes', 'egress_bytes')
+               'fanned_bytes', 'egress_bytes', 'clock_bytes')
 
 DOC_COST = registry.gauge(
     'amtpu_doc_cost_bytes',
@@ -66,7 +66,9 @@ DOC_COST = registry.gauge(
     'capacity section): arena = retained raw change bytes, disk = '
     'ColdStore on-disk bytes, fanned = cumulative fan-out wire bytes '
     'attributed per doc, egress = cumulative per-doc bytes staged on '
-    'bounded egress queues', ('tier',))
+    'bounded egress queues, clock = causal-clock state (sparse '
+    'all_deps pairs + densified fold table + resident clock rows; '
+    'ISSUE 17 -- clock folding shrinks this tier)', ('tier',))
 MEM_USED = registry.gauge(
     'amtpu_mem_used_bytes',
     'Headroom estimator components (ISSUE 15): rss (process resident '
@@ -369,9 +371,10 @@ class CapacityTracker(object):
                 self._pool, self._pool_lock, self._storage, \
                 self._egress_fn
         snap = {'ts': round(time.time(), 3)}
-        arena_total = ops_total = 0
-        arena_top = []
+        arena_total = ops_total = clock_total = 0
+        arena_top, clock_top = [], []
         native = None
+        clock_by_doc = {}
         if pool is not None:
             try:
                 if pool_lock is not None:
@@ -388,6 +391,25 @@ class CapacityTracker(object):
                     arena_top = [(ids[i], int(stats[i, 0]),
                                   int(stats[i, 1]))
                                  for i in order if stats[i, 0] > 0]
+                    # clock tier (ISSUE 17): sparse all_deps pairs
+                    # (8 B each) + densified per-doc fold table +
+                    # pool-resident clock rows converted to bytes --
+                    # the per-doc surface clock folding shrinks
+                    if stats.shape[1] >= 8:
+                        row_b = 0
+                        try:
+                            row_b = int(pool.resclk_row_bytes())
+                        except Exception:
+                            pass
+                        clk = (stats[:, 6] * 8 + stats[:, 7] +
+                               stats[:, 5] * row_b)
+                        clock_total = int(clk.sum())
+                        corder = clk.argsort()[::-1][:k]
+                        clock_top = [(ids[i], int(clk[i]),
+                                      int(stats[i, 6]))
+                                     for i in corder if clk[i] > 0]
+                        clock_by_doc = {d: int(v)
+                                        for d, v in zip(ids, clk)}
                 snap['docs_resident'] = len(ids)
             except Exception as e:
                 snap['native_error'] = '%s: %s' % (type(e).__name__, e)
@@ -427,11 +449,14 @@ class CapacityTracker(object):
                           'disk_bytes': disk_total,
                           'cold_docs': cold_docs,
                           'fanned_bytes': fanned_total,
-                          'egress_bytes': egress_total}
+                          'egress_bytes': egress_total,
+                          'clock_bytes': clock_total}
         snap['top'] = {
             'arena': [{'doc': d, 'arena_bytes': b, 'ops': o,
                        'subscribers': subs.get(d, 0)}
                       for d, b, o in arena_top],
+            'clock': [{'doc': d, 'clock_bytes': b, 'clk_pairs': p}
+                      for d, b, p in clock_top],
             'disk': [{'doc': d, 'disk_bytes': b} for d, b in disk_top],
             'fanned': [{'doc': d, 'fanned_bytes': v, 'err': e,
                         'encoded_bytes': encoded.get(d, 0),
@@ -452,6 +477,7 @@ class CapacityTracker(object):
         DOC_COST.labels('disk').set(disk_total)
         DOC_COST.labels('fanned').set(fanned_total)
         DOC_COST.labels('egress').set(egress_total)
+        DOC_COST.labels('clock').set(clock_total)
         for comp, v in components.items():
             MEM_USED.labels(comp).set(v)
         MEM_BUDGET.set(headroom['budget_bytes'])
@@ -461,6 +487,7 @@ class CapacityTracker(object):
             self._snap = snap
             self._last_refresh = now
             self._native = native
+            self._clock_by_doc = clock_by_doc
         return snap
 
     def pressure(self):
@@ -500,6 +527,7 @@ class CapacityTracker(object):
             self.refresh(force=True)
         with self._lock:
             native = getattr(self, '_native', None)
+            clock_by_doc = getattr(self, '_clock_by_doc', {})
             storage = self._storage
             fanned = dict(self._fanned.counts)
             egressed = dict(self._egressed.counts)
@@ -513,7 +541,8 @@ class CapacityTracker(object):
                           'disk_bytes': 0,
                           'subscribers': subs.get(d, 0),
                           'fanned_bytes': int(fanned.get(d, 0)),
-                          'egress_bytes': int(egressed.get(d, 0))}
+                          'egress_bytes': int(egressed.get(d, 0)),
+                          'clock_bytes': clock_by_doc.get(d, 0)}
         if storage is not None:
             try:
                 for d in storage.store.doc_ids():
@@ -521,7 +550,8 @@ class CapacityTracker(object):
                         d, {'arena_bytes': 0, 'ops': 0, 'disk_bytes': 0,
                             'subscribers': subs.get(d, 0),
                             'fanned_bytes': int(fanned.get(d, 0)),
-                            'egress_bytes': int(egressed.get(d, 0))})
+                            'egress_bytes': int(egressed.get(d, 0)),
+                            'clock_bytes': 0})
                     v['disk_bytes'] = storage.store.disk_bytes(d)
             except Exception:
                 pass

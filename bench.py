@@ -944,14 +944,33 @@ BUILDERS = {1: build_config_1, 2: build_config_2, 3: build_config_3,
             4: build_config_4}
 
 
+def _rss_mb():
+    """Current (not peak) resident set in MB via /proc -- the churn
+    arm's flatness signal; ru_maxrss only ratchets."""
+    try:
+        with open('/proc/self/statm') as f:
+            pages = int(f.read().split()[1])
+        return pages * (os.sysconf('SC_PAGE_SIZE') / 1e6)
+    except Exception:
+        import resource
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+
 def run_coldstart(args):
-    """--coldstart (ISSUE 14): the scale bench behind the CI miniature
-    -- a timed cold restart of ``AMTPU_BENCH_COLDSTART_DOCS`` (default
-    100k) saved docs through the native arena-direct decode
-    (`amtpu_begin_columnar`), recording wall time, changes/s, and the
-    process peak RSS (the "working-set >> RAM" soak), plus the Python-
-    codec dict-replay arm on a subset for the A/B ratio and a sampled
-    per-doc byte-parity check between the arms.  Emits one
+    """--coldstart (ISSUE 14 + 17): the scale bench behind the CI
+    miniature -- a timed cold restart of ``AMTPU_BENCH_COLDSTART_DOCS``
+    (default 100k; 1M is the headline shape) saved docs through the
+    native arena-direct decode (`amtpu_begin_columnar`), recording wall
+    time, changes/s, and the process peak RSS (the "working-set >> RAM"
+    soak), plus the Python-codec dict-replay arm on a subset for the
+    A/B ratio and a sampled per-doc byte-parity check between the arms.
+    ISSUE 17 adds (a) the parallel arena-direct `restore_from_store`
+    arm from a real ColdStore -- serial (AMTPU_RESTORE_THREADS=1) vs
+    auto fan-out across shard pools -- emitting `docs_per_gb` and
+    `restore_s_per_doc` as first-class metrics, and (b) a steady-state
+    churn arm where GC + op-state folding + clock folding must hold
+    RSS FLAT, with byte-identical patches vs an unfolded
+    (AMTPU_STORAGE_FOLD_CLOCKS=0) oracle twin.  Emits one
     BENCH_COLDSTART JSON line (--out writes it)."""
     import resource
     sys.path.insert(0, os.path.join(
@@ -1008,6 +1027,102 @@ def run_coldstart(args):
           '%.1fx the python arm), peak RSS %.0f MB, parity %s'
           % (n_docs, native_s, native_rate, speedup, peak_rss_mb,
              parity), file=sys.stderr)
+    del pool
+
+    # -- ISSUE 17 (a): parallel arena-direct restore from a real cold
+    # store: serial (threads=1) vs auto fan-out over shard pools
+    import tempfile
+
+    from automerge_tpu.native import ShardedNativePool, _restore_threads
+    from automerge_tpu.storage.coldstore import ColdStore
+    store = ColdStore(root=tempfile.mkdtemp(prefix='amtpu-coldstart-'))
+    for d in docs:
+        store.put(d, bytes(blobs[d]))
+    shards = env_int('AMTPU_BENCH_COLDSTART_SHARDS', 4)
+    serial_pool = ShardedNativePool(shards)
+    t0 = time.perf_counter()
+    serial_pool.restore_from_store(store, threads=1)
+    serial_s = time.perf_counter() - t0
+    serial_rate = n_changes / serial_s
+    del serial_pool
+    pool = ShardedNativePool(shards)
+    t0 = time.perf_counter()
+    rsum = pool.restore_from_store(store)
+    par_s = time.perf_counter() - t0
+    par_rate = n_changes / par_s
+    par_speedup = par_rate / serial_rate
+    resident_mb = _rss_mb()
+    par_parity = all(pool.save(d) == sample_saves[d]
+                     for d in sample_docs)
+    cores = os.cpu_count() or 1
+    restore_s_per_doc = par_s / n_docs
+    docs_per_gb = n_docs / max(resident_mb / 1024.0, 1e-9)
+    print('coldstart: store restore %d docs serial %.1fs parallel '
+          '%.1fs (%.2fx, %d threads on %d cores), %.2fus/doc, '
+          '%.0f docs/GB resident, parity %s'
+          % (n_docs, serial_s, par_s, par_speedup,
+             _restore_threads(), cores, restore_s_per_doc * 1e6,
+             docs_per_gb, par_parity), file=sys.stderr)
+
+    # -- ISSUE 17 (b): steady-state churn -- GC + op folding + clock
+    # folding must hold RSS flat; patches must match an unfolded twin
+    churn_rounds = env_int('AMTPU_BENCH_COLDSTART_CHURN_ROUNDS', 12)
+    churn_docs = min(n_docs, env_int('AMTPU_BENCH_COLDSTART_CHURN_DOCS',
+                                     2048))
+    churn = None
+    if churn_rounds > 0:
+        cd = docs[:churn_docs]
+        twin_docs = cd[::max(1, churn_docs // 128)]
+        os.environ['AMTPU_STORAGE_FOLD_CLOCKS'] = '0'
+        twin = NativeDocPool()
+        twin.load_batch({d: blobs[d] for d in twin_docs})
+        os.environ.pop('AMTPU_STORAGE_FOLD_CLOCKS', None)
+        seqs, rss_series = {}, []
+        muts = 6
+        for r in range(churn_rounds):
+            payload = {}
+            for d in cd:
+                seq0 = seqs.get(d, 0)
+                payload[d] = [
+                    {'actor': 'churn', 'seq': seq0 + i + 1,
+                     'deps': {'churn': seq0 + i} if seq0 + i else {},
+                     'ops': [{'action': 'set', 'obj': cc.ROOT_ID,
+                              'key': 'k%d' % (i % 8),
+                              'value': r * 100 + i}]}
+                    for i in range(muts)]
+                seqs[d] = seq0 + muts
+            pool.apply_batch(payload)
+            for d in cd:
+                pool.compact(d)
+            os.environ['AMTPU_STORAGE_FOLD_CLOCKS'] = '0'
+            twin.apply_batch({d: payload[d] for d in twin_docs})
+            for d in twin_docs:
+                twin.compact(d)
+            os.environ.pop('AMTPU_STORAGE_FOLD_CLOCKS', None)
+            rss_series.append(round(_rss_mb(), 1))
+        warm = max(1, churn_rounds // 3)
+        early = max(rss_series[warm:2 * warm] or rss_series[:1])
+        late = max(rss_series[-warm:])
+        rss_flat = late <= early * 1.05 + 16
+        fold_parity = all(
+            pool.get_patch(d) == twin.get_patch(d)
+            and pool.save(d) == twin.save(d) for d in twin_docs)
+        clock_pairs = pool.clock_pairs()
+        churn = {
+            'docs': churn_docs, 'rounds': churn_rounds,
+            'changes': churn_rounds * churn_docs * muts,
+            'rss_mb_series': rss_series, 'rss_flat': rss_flat,
+            'fold_parity_vs_unfolded': fold_parity,
+            'clock_pairs_after': clock_pairs,
+        }
+        del twin
+        print('coldstart: churn %d docs x %d rounds, RSS %s -> %s MB '
+              '(flat %s), fold parity %s, %d sparse clock pairs left'
+              % (churn_docs, churn_rounds, rss_series[0],
+                 rss_series[-1], rss_flat, fold_parity, clock_pairs),
+              file=sys.stderr)
+    peak_rss_mb = resource.getrusage(
+        resource.RUSAGE_SELF).ru_maxrss / 1024.0
     result = {
         'metric': 'coldstart_restore',
         'value': round(native_rate, 1),
@@ -1023,6 +1138,24 @@ def run_coldstart(args):
         'baseline': 'python-codec-dict-replay',
         'peak_rss_mb': round(peak_rss_mb, 1),
         'parity': parity,
+        # ISSUE 17 first-class economics metrics (bench_compare pairs
+        # these across BENCH_COLDSTART_*.json like ops/s)
+        'docs_per_gb': round(docs_per_gb, 1),
+        'restore_s_per_doc': round(restore_s_per_doc, 8),
+        'resident_rss_mb': round(resident_mb, 1),
+        'restore_parallel': {
+            'shards': shards, 'threads': _restore_threads(),
+            'cores': cores,
+            'serial_s': round(serial_s, 3),
+            'parallel_s': round(par_s, 3),
+            'serial_changes_per_s': round(serial_rate, 1),
+            'parallel_changes_per_s': round(par_rate, 1),
+            'speedup': round(par_speedup, 2),
+            'parity': par_parity,
+            'summary': {k: (len(v) if isinstance(v, dict) else v)
+                        for k, v in rsum.items()},
+        },
+        'churn': churn,
         'telemetry': telemetry.bench_block(),
     }
     print(json.dumps(result))
@@ -1030,7 +1163,14 @@ def run_coldstart(args):
         with open(args.out, 'w') as f:
             f.write(json.dumps(result) + '\n')
         print('wrote %s' % args.out, file=sys.stderr)
-    return 0 if parity and speedup >= 4.0 else 1
+    ok = parity and par_parity and speedup >= 4.0
+    if churn is not None:
+        ok = ok and churn['rss_flat'] and churn['fold_parity_vs_unfolded']
+    # the >=2x parallel gate only binds on multi-core hosts (1-core
+    # ceiling is 1x by construction; coldstart-check skips loudly too)
+    if cores >= 2:
+        ok = ok and par_speedup >= 2.0
+    return 0 if ok else 1
 
 
 def run_fanout(args):

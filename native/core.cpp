@@ -441,6 +441,44 @@ static bool changes_equal(const ChangeRec& a, const ChangeRec& b) {
   return true;
 }
 
+// Clock-vector folding (ISSUE 17 tentpole b): behind the settled GC
+// frontier the per-change sparse `all_deps` vectors are the LAST
+// per-history memory term -- O(actors) pairs per change, forever.
+// amtpu_fold_clocks moves them into this per-doc densified table
+// (the pool-resident clock table's row layout, doc-local actor ranks)
+// and frees the sparse vectors; causal reads answer through the rows.
+// Two sentinel encodings skip the table entirely:
+//   * EMPTY   -- all_deps was {} (an actor's first change with no deps)
+//   * TRIVIAL -- all_deps was exactly {(actor, seq-1)}, the linear-
+//     history shape that dominates real corpora: ZERO retained bytes.
+// Ranks are doc-local and append-only; rank lookup is a linear scan of
+// `actor_order` (per-doc actor populations are small -- a pool-global
+// sid-indexed vector per doc would dwarf the folded clocks at 1M docs).
+// Rows re-widen in place when a new actor pushes A past the padded
+// width Ap (bucket growth, floor 4).
+static constexpr u32 FOLDROW_NONE = 0xffffffffu;     // sparse vector live
+static constexpr u32 FOLDROW_EMPTY = 0xfffffffeu;    // all_deps == {}
+static constexpr u32 FOLDROW_TRIVIAL = 0xfffffffdu;  // {(actor, seq-1)}
+static constexpr u32 FOLDROW_MAX = 0xfffffffcu;      // highest real row
+
+struct FoldClocks {
+  std::vector<u32> actor_order;   // actor sids, first-folded order
+  i64 A = 0, Ap = 0;              // actor count, padded row width
+  std::vector<u32> tab;           // [n_rows * Ap] densified seqs
+  i64 n_rows() const {
+    return Ap ? static_cast<i64>(tab.size()) / Ap : 0;
+  }
+  i64 bytes() const {
+    return static_cast<i64>(tab.size() * sizeof(u32) +
+                            actor_order.size() * sizeof(u32));
+  }
+  i32 rank(u32 sid) const {   // linear: A is the doc's actor count
+    for (size_t i = 0; i < actor_order.size(); ++i)
+      if (actor_order[i] == sid) return static_cast<i32>(i);
+    return -1;
+  }
+};
+
 struct StateEntry {
   ChangeRec change;
   Clock all_deps;
@@ -448,10 +486,12 @@ struct StateEntry {
   // deps / message were freed -- everything behind the settled frontier
   // is re-derivable from the doc's columnar snapshot, and the live
   // register/arena state already holds the fold's final values.
-  // all_deps stays (straggler closure walks read it); duplicate
-  // consistency checks skip folded entries (the original bytes were
-  // validated when the change first applied).
+  // all_deps stays sparse until amtpu_fold_clocks moves it into the
+  // doc's FoldClocks row `fold_row` (straggler closure walks then read
+  // the row); duplicate consistency checks skip folded entries (the
+  // original bytes were validated when the change first applied).
   bool folded = false;
+  u32 fold_row = FOLDROW_NONE;
 };
 
 struct InboundRef {
@@ -543,6 +583,12 @@ struct DocState {
   i64 acct_raw_bytes = 0;
   i64 acct_ops = 0;
   i64 acct_folded_ops = 0;   // op records freed by amtpu_fold_settled
+  // retained sparse all_deps pairs (update_states push / journal
+  // rollback pop / amtpu_fold_clocks free); reconciles bit-exactly with
+  // the fresh walk amtpu_clock_pairs does (the clock-fold tests pin it)
+  i64 acct_clock_pairs = 0;
+  // densified fold target for settled all_deps (amtpu_fold_clocks)
+  FoldClocks foldclk;
 
   static u64 rkey(u32 obj, u32 key) {
     return (static_cast<u64>(obj) << 32) | key;
@@ -1369,12 +1415,59 @@ static inline double mono_now() {
 // phase 1: schedule + prepass + encode
 // ---------------------------------------------------------------------------
 
-static const Clock& all_deps_of(DocState& st, u32 actor, u32 seq) {
-  static const Clock kEmpty;
+// The transitively-closed clock of (actor, seq), readable whether the
+// entry still holds its sparse all_deps vector or amtpu_fold_clocks
+// already moved it into the doc's FoldClocks row.  Three access shapes
+// replace the old materializing `all_deps_of` reference (a folded row
+// has no sparse vector to reference):
+//   * for_each_dep   -- iterate (actor, seq) pairs (closure walks,
+//                       densify, actor marking)
+//   * clock_get_deps -- O(rank) point lookup (rec_concurrent)
+//   * read_all_deps  -- merge the pairs into a caller clock
+// Pair ORDER is not part of the contract: every consumer merges via
+// clock_set_max, densifies into ranked rows, or compares per-actor
+// coverage -- clock semantics are order-insensitive throughout.
+static const StateEntry* state_entry_of(DocState& st, u32 actor, u32 seq) {
   auto it = st.states.find(actor);
-  if (it == st.states.end()) return kEmpty;
-  if (seq == 0 || seq > it->second.size()) return kEmpty;
-  return it->second[seq - 1].all_deps;
+  if (it == st.states.end()) return nullptr;
+  if (seq == 0 || seq > it->second.size()) return nullptr;
+  return &it->second[seq - 1];
+}
+
+template <class F>
+static void for_each_dep(DocState& st, u32 actor, u32 seq, F&& f) {
+  const StateEntry* e = state_entry_of(st, actor, seq);
+  if (!e) return;
+  if (e->fold_row == FOLDROW_NONE) {
+    for (auto& [a, s] : e->all_deps) f(a, s);
+  } else if (e->fold_row == FOLDROW_EMPTY) {
+    // no deps
+  } else if (e->fold_row == FOLDROW_TRIVIAL) {
+    f(actor, seq - 1);
+  } else {
+    const FoldClocks& fc = st.foldclk;
+    const u32* row = fc.tab.data() +
+                     static_cast<size_t>(e->fold_row) * fc.Ap;
+    for (i64 r = 0; r < fc.A; ++r)
+      if (row[r]) f(fc.actor_order[r], row[r]);
+  }
+}
+
+static u32 clock_get_deps(DocState& st, u32 actor, u32 seq, u32 qa) {
+  const StateEntry* e = state_entry_of(st, actor, seq);
+  if (!e) return 0;
+  if (e->fold_row == FOLDROW_NONE) return clock_get(e->all_deps, qa);
+  if (e->fold_row == FOLDROW_EMPTY) return 0;
+  if (e->fold_row == FOLDROW_TRIVIAL) return qa == actor ? seq - 1 : 0;
+  const FoldClocks& fc = st.foldclk;
+  i32 r = fc.rank(qa);
+  if (r < 0) return 0;
+  return fc.tab[static_cast<size_t>(e->fold_row) * fc.Ap + r];
+}
+
+static void read_all_deps(DocState& st, u32 actor, u32 seq, Clock& out) {
+  for_each_dep(st, actor, seq,
+               [&](u32 a, u32 s) { clock_set_max(out, a, s); });
 }
 
 static void schedule(Pool& pool, Batch& b,
@@ -1459,6 +1552,10 @@ struct BeginJournal {
       st.acct_raw_bytes -=
           static_cast<i64>(entries.back().change.raw.size());
       st.acct_ops -= static_cast<i64>(entries.back().change.ops.size());
+      // entries pushed this batch are never clock-folded, so the sparse
+      // all_deps vector is still the whole contribution
+      st.acct_clock_pairs -=
+          static_cast<i64>(entries.back().all_deps.size());
       entries.pop_back();
       if (entries.empty()) st.states.erase(it->second);
     }
@@ -1523,11 +1620,10 @@ static void update_states(Pool& pool, Batch& b, BeginJournal& j) {
     // ch.deps first; iterating it directly drops one Clock alloc+copy
     // per change.)
     Clock all_deps;
-    if (seq > 1) all_deps = all_deps_of(st, actor, seq - 1);
+    if (seq > 1) read_all_deps(st, actor, seq - 1, all_deps);
     auto cover = [&](u32 da, u32 ds) {
       if (ds == 0 || clock_get(all_deps, da) >= ds) return;
-      const Clock& trans = all_deps_of(st, da, ds);
-      for (auto& [ta, ts] : trans) clock_set_max(all_deps, ta, ts);
+      read_all_deps(st, da, ds, all_deps);
       clock_set_max(all_deps, da, ds);
     };
     cover(actor, seq - 1);
@@ -1545,6 +1641,8 @@ static void update_states(Pool& pool, Batch& b, BeginJournal& j) {
     st.acct_raw_bytes +=
         static_cast<i64>(sit->second.back().change.raw.size());
     st.acct_ops += static_cast<i64>(sit->second.back().change.ops.size());
+    st.acct_clock_pairs +=
+        static_cast<i64>(sit->second.back().all_deps.size());
     const Clock& adeps = sit->second.back().all_deps;
     j.state_pushes.emplace_back(ac.doc, actor);
     clock_set_max(st.clock, actor, seq);
@@ -1733,8 +1831,8 @@ static void encode(Pool& pool, Batch& b) {
     for (auto& ac : b.applied) {
       DocState& st = *b.bdocs[ac.doc];
       mark(ac.change.actor);
-      for (auto& [da, ds] : all_deps_of(st, ac.change.actor, ac.change.seq))
-        mark(da);
+      for_each_dep(st, ac.change.actor, ac.change.seq,
+                   [&](u32 da, u32) { mark(da); });
     }
   }
 
@@ -1770,8 +1868,8 @@ static void encode(Pool& pool, Batch& b) {
           if (reg) {
             for (auto& rec : *reg) {
               mark(rec.actor);
-              for (auto& [da, ds] : all_deps_of(st, rec.actor, rec.seq))
-                mark(da);
+              for_each_dep(st, rec.actor, rec.seq,
+                           [&](u32 da, u32) { mark(da); });
             }
           }
         }
@@ -1873,12 +1971,12 @@ static void encode(Pool& pool, Batch& b) {
   }
 
   // --- register rows ------------------------------------------------------
-  auto densify = [&](const Clock& c, i32* row) {
+  auto densify = [&](DocState& st, u32 actor, u32 seq, i32* row) {
     std::memset(row, 0, sizeof(i32) * b.Ap);
-    for (auto& [a, s] : c) {
+    for_each_dep(st, actor, seq, [&](u32 a, u32 s) {
       i32 r = (a < b.rank_of.size()) ? b.rank_of[a] : -1;
       if (r >= 0) row[r] = static_cast<i32>(s);
-    }
+    });
   };
 
   // clock rows dedup to one table entry per (doc, actor, seq).  In
@@ -1900,8 +1998,7 @@ static void encode(Pool& pool, Batch& b) {
       }
       u32 idx = static_cast<u32>(rc.tab.size() / rc.Ap);
       rc.tab.resize(rc.tab.size() + rc.Ap);
-      densify(all_deps_of(st, actor, seq),
-              rc.tab.data() + rc.tab.size() - rc.Ap);
+      densify(st, actor, seq, rc.tab.data() + rc.tab.size() - rc.Ap);
       rc.rows.emplace(rk, idx);
       b.resclk_appended = true;
       return idx;
@@ -1911,7 +2008,7 @@ static void encode(Pool& pool, Batch& b) {
     if (cit != clock_cache.end()) return cit->second;
     u32 idx = static_cast<u32>(b.clock_tab.size() / b.Ap);
     b.clock_tab.resize(b.clock_tab.size() + b.Ap);
-    densify(all_deps_of(st, actor, seq),
+    densify(st, actor, seq,
             b.clock_tab.data() + b.clock_tab.size() - b.Ap);
     clock_cache.emplace(ck, idx);
     return idx;
@@ -2284,9 +2381,8 @@ static void build_lin_sort(Batch& b) {
 // ---------------------------------------------------------------------------
 
 static bool rec_concurrent(DocState& st, const OpRec& o1, const OpRec& o2) {
-  const Clock& c1 = all_deps_of(st, o1.actor, o1.seq);
-  const Clock& c2 = all_deps_of(st, o2.actor, o2.seq);
-  return clock_get(c1, o2.actor) < o2.seq && clock_get(c2, o1.actor) < o1.seq;
+  return clock_get_deps(st, o1.actor, o1.seq, o2.actor) < o2.seq &&
+         clock_get_deps(st, o2.actor, o2.seq, o1.actor) < o1.seq;
 }
 
 // Built at the end of begin(): per-object dominance timelines and the
@@ -2708,10 +2804,10 @@ static Register* host_resolve_step(Pool& pool, Batch& b, u32 doc,
         b.dense_seq.resize(pool.intern.size(), 0);
       }
       ++b.dense_epoch;
-      for (auto& [a, s] : all_deps_of(st, op.actor, op.seq)) {
+      for_each_dep(st, op.actor, op.seq, [&](u32 a, u32 s) {
         b.dense_stamp[a] = b.dense_epoch;
         b.dense_seq[a] = s;
-      }
+      });
       b.dense_doc = doc;
       b.dense_actor = op.actor;
       b.dense_seqno = op.seq;
@@ -5965,10 +6061,18 @@ uint8_t* amtpu_get_missing_clock(void* pool_ptr, const char* doc_id,
     Clock all_deps;
     for (auto& [da, ds] : have_deps) {
       if (ds == 0) continue;
-      for (auto& [ta, ts] : all_deps_of(st, da, ds))
-        clock_set_max(all_deps, ta, ts);
+      read_all_deps(st, da, ds, all_deps);
       clock_set_max(all_deps, da, ds);
     }
+    // canonical actor-string order: the closure's pair order would
+    // otherwise depend on whether entries were clock-folded (folded
+    // rows iterate in doc-rank order, sparse vectors in insertion
+    // order) -- sorting makes the bytes identical across fold arms
+    std::sort(all_deps.begin(), all_deps.end(),
+              [&](const std::pair<u32, u32>& x,
+                  const std::pair<u32, u32>& y) {
+                return pool.intern.str(x.first) < pool.intern.str(y.first);
+              });
     Writer out;
     write_clock(out, pool, all_deps);
     *len = static_cast<int64_t>(out.buf.size());
@@ -6231,6 +6335,133 @@ int64_t amtpu_fold_settled(void* pool_ptr, const char* doc_id,
   }
 }
 
+// Clock-vector folding (ISSUE 17 tentpole b): settled changes at or
+// behind `frontier` move their sparse all_deps vectors into the doc's
+// densified FoldClocks table (or a zero-byte sentinel for empty /
+// linear-history shapes) and free the vectors -- the last per-history
+// memory term goes O(live frontier) instead of O(changes).  Causal
+// queries (rec_concurrent, straggler closure walks, clock-row densify)
+// keep answering through the folded rows via for_each_dep /
+// clock_get_deps; amtpu_get_missing_clock emits canonical actor order
+// so its bytes cannot drift across fold arms.  Call on the same
+// compact cadence as amtpu_fold_settled (any frontier clamped to the
+// doc's clock is safe; folding is idempotent per entry).  Docs whose
+// folded actor population would exceed `max_actors` stop folding
+// NON-trivial entries (row width is the doc's actor count -- an
+// unbounded population would make every row pay for every actor);
+// sentinel folds still apply.  Returns sparse pairs freed (0 if the
+// doc is unknown), -1 on error.
+int64_t amtpu_fold_clocks(void* pool_ptr, const char* doc_id,
+                          const uint8_t* frontier, int64_t flen,
+                          int64_t max_actors) {
+  Pool& pool = *static_cast<Pool*>(pool_ptr);
+  try {
+    auto it = pool.docs.find(doc_id);
+    if (it == pool.docs.end()) return 0;
+    DocState& st = it->second;
+    FoldClocks& fc = st.foldclk;
+    Reader r(frontier, static_cast<size_t>(flen));
+    Clock f;
+    size_t n = r.read_map();
+    for (size_t i = 0; i < n; ++i) {
+      u32 a = pool.intern.id_of(r.read_str());
+      i64 s = r.read_int();
+      i64 applied = clock_get(st.clock, a);
+      if (s > applied) s = applied;   // clamp, like fold_settled
+      if (s > 0)
+        clock_set_max(f, a, static_cast<u32>(s));
+    }
+    // doc-local rank, registering on first sight; re-widens every
+    // existing row in place when A outgrows the padded width Ap
+    auto rank_or_add = [&](u32 sid) {
+      i32 rk = fc.rank(sid);
+      if (rk >= 0) return rk;
+      rk = static_cast<i32>(fc.actor_order.size());
+      fc.actor_order.push_back(sid);
+      fc.A = static_cast<i64>(fc.actor_order.size());
+      if (fc.A > fc.Ap) {
+        i64 new_ap = bucket(fc.A, 4);
+        i64 rows = fc.Ap ? static_cast<i64>(fc.tab.size()) / fc.Ap : 0;
+        std::vector<u32> wide(static_cast<size_t>(rows * new_ap), 0);
+        for (i64 row = 0; row < rows; ++row)
+          std::memcpy(wide.data() + row * new_ap,
+                      fc.tab.data() + row * fc.Ap,
+                      static_cast<size_t>(fc.Ap) * sizeof(u32));
+        fc.tab.swap(wide);
+        fc.Ap = new_ap;
+      }
+      return rk;
+    };
+    int64_t freed = 0;
+    for (auto& [a, s] : f) {
+      auto sit = st.states.find(a);
+      if (sit == st.states.end()) continue;
+      auto& entries = sit->second;
+      size_t upto = std::min<size_t>(s, entries.size());
+      for (size_t i = 0; i < upto; ++i) {
+        StateEntry& e = entries[i];
+        if (e.fold_row != FOLDROW_NONE) continue;   // already folded
+        const u32 seq = static_cast<u32>(i + 1);
+        if (e.all_deps.empty()) {
+          e.fold_row = FOLDROW_EMPTY;
+        } else if (e.all_deps.size() == 1 && e.all_deps[0].first == a &&
+                   e.all_deps[0].second == seq - 1) {
+          e.fold_row = FOLDROW_TRIVIAL;
+        } else {
+          // population cap: leave the sparse vector in place (still
+          // readable through the FOLDROW_NONE path); sentinels above
+          // keep applying either way
+          i64 need = fc.A;
+          for (auto& [da, ds] : e.all_deps)
+            if (fc.rank(da) < 0) ++need;
+          if (need > max_actors) continue;
+          for (auto& [da, ds] : e.all_deps) rank_or_add(da);
+          u32 row = static_cast<u32>(fc.n_rows());
+          if (row > FOLDROW_MAX) continue;   // sentinel space exhausted
+          fc.tab.resize(fc.tab.size() + fc.Ap, 0);
+          u32* dst = fc.tab.data() + fc.tab.size() - fc.Ap;
+          for (auto& [da, ds] : e.all_deps) dst[fc.rank(da)] = ds;
+          e.fold_row = row;
+        }
+        freed += static_cast<int64_t>(e.all_deps.size());
+        Clock().swap(e.all_deps);
+      }
+    }
+    st.acct_clock_pairs -= freed;  // per-doc accounting (amtpu_doc_stats)
+    return freed;
+  } catch (const std::exception& e) {
+    g_error = e.what(); g_error_kind = 0;
+    return -1;
+  }
+}
+
+// Retained sparse all_deps pairs of one doc (or, with doc_id = "", the
+// whole pool), walked FRESH -- the reconciliation oracle the clock-fold
+// tests pin against the incrementally-maintained acct_clock_pairs /
+// amtpu_doc_stats column.
+int64_t amtpu_clock_pairs(void* pool_ptr, const char* doc_id) {
+  Pool& pool = *static_cast<Pool*>(pool_ptr);
+  try {
+    auto sum_doc = [](const DocState& st) {
+      int64_t n = 0;
+      for (auto& [a, entries] : st.states)
+        for (auto& e : entries)
+          n += static_cast<int64_t>(e.all_deps.size());
+      return n;
+    };
+    if (doc_id == nullptr || doc_id[0] == '\0') {
+      int64_t total = 0;
+      for (auto& [id, st] : pool.docs) total += sum_doc(st);
+      return total;
+    }
+    auto it = pool.docs.find(doc_id);
+    return it == pool.docs.end() ? 0 : sum_doc(it->second);
+  } catch (const std::exception& e) {
+    g_error = e.what(); g_error_kind = 0;
+    return -1;
+  }
+}
+
 // Retained op records (applied history + causal queue) of one doc (or,
 // with doc_id = "", the whole pool) -- the arena-growth measure the
 // op-state folding lane gates on (flat, not merely sub-linear, under
@@ -6285,7 +6516,7 @@ uint8_t* amtpu_doc_ids(void* pool_ptr, int64_t* len) {
   }
 }
 
-// Per-doc resource stats, batch-wise: fills `out` with one 6-column
+// Per-doc resource stats, batch-wise: fills `out` with one 8-column
 // int64 row per doc in doc_order order (same order as amtpu_doc_ids):
 //   [0] hist_bytes   retained raw change bytes (states + causal queue)
 //   [1] ops          retained op records (states + causal queue)
@@ -6293,27 +6524,33 @@ uint8_t* amtpu_doc_ids(void* pool_ptr, int64_t* len) {
 //   [3] changes      retained change records (state entries + queue)
 //   [4] queued       causally-parked queue length
 //   [5] resclk_rows  pool-resident clock rows keyed by this doc
+//   [6] clk_pairs    retained sparse all_deps pairs (what
+//                    amtpu_fold_clocks has NOT yet folded; queued
+//                    changes carry no all_deps, so states-only)
+//   [7] foldclk_bytes  the doc's densified FoldClocks table bytes
+//                    (rows + actor order -- the fold's residual cost)
 // `cap` is the out capacity in int64s; rows past it are not written.
-// Returns the number of ROWS written (never more than cap/6), -1 on
+// Returns the number of ROWS written (never more than cap/8), -1 on
 // error.  Column totals across all docs reconcile EXACTLY with
-// amtpu_history_bytes(pool, "") / amtpu_op_count(pool, "") -- the
-// states contribution comes from the incrementally-maintained per-doc
-// counters and the queue is walked fresh here, so the capacity tests
-// can pin bit-equality.  resclk rows are attributed by matching the
-// table's DocState-pointer keys against LIVE docs only: amtpu_drop_doc
-// invalidates the table, so a reused DocState address can never
-// inherit a dropped doc's rows (the drop/re-add test pins it).
+// amtpu_history_bytes(pool, "") / amtpu_op_count(pool, "") /
+// amtpu_clock_pairs(pool, "") -- the states contribution comes from
+// the incrementally-maintained per-doc counters and the queue is
+// walked fresh here, so the capacity tests can pin bit-equality.
+// resclk rows are attributed by matching the table's DocState-pointer
+// keys against LIVE docs only: amtpu_drop_doc invalidates the table,
+// so a reused DocState address can never inherit a dropped doc's rows
+// (the drop/re-add test pins it).
 int64_t amtpu_doc_stats(void* pool_ptr, int64_t* out, int64_t cap) {
   Pool& pool = *static_cast<Pool*>(pool_ptr);
   try {
     std::unordered_map<const void*, size_t> doc_idx;
     doc_idx.reserve(pool.docs.size() * 2);
     size_t n_rows = std::min<size_t>(pool.doc_order.size(),
-                                     cap > 0 ? cap / 6 : 0);
+                                     cap > 0 ? cap / 8 : 0);
     for (size_t i = 0; i < n_rows; ++i) {
       auto it = pool.docs.find(pool.doc_order[i]);
       if (it == pool.docs.end()) {   // doc_order never dangles, but a
-        std::memset(out + i * 6, 0, 6 * sizeof(int64_t));  // zero row
+        std::memset(out + i * 8, 0, 8 * sizeof(int64_t));  // zero row
         continue;                    // is safer than UB if it ever did
       }
       DocState& st = it->second;
@@ -6326,16 +6563,18 @@ int64_t amtpu_doc_stats(void* pool_ptr, int64_t* out, int64_t cap) {
       i64 n_entries = 0;
       for (auto& [a, entries] : st.states)
         n_entries += static_cast<i64>(entries.size());
-      out[i * 6 + 0] = st.acct_raw_bytes + qb;
-      out[i * 6 + 1] = st.acct_ops + qops;
-      out[i * 6 + 2] = st.acct_folded_ops;
-      out[i * 6 + 3] = n_entries + static_cast<i64>(st.queue.size());
-      out[i * 6 + 4] = static_cast<i64>(st.queue.size());
-      out[i * 6 + 5] = 0;
+      out[i * 8 + 0] = st.acct_raw_bytes + qb;
+      out[i * 8 + 1] = st.acct_ops + qops;
+      out[i * 8 + 2] = st.acct_folded_ops;
+      out[i * 8 + 3] = n_entries + static_cast<i64>(st.queue.size());
+      out[i * 8 + 4] = static_cast<i64>(st.queue.size());
+      out[i * 8 + 5] = 0;
+      out[i * 8 + 6] = st.acct_clock_pairs;
+      out[i * 8 + 7] = st.foldclk.bytes();
     }
     for (auto& [key, _row] : pool.resclk.rows) {
       auto dit = doc_idx.find(key.doc);
-      if (dit != doc_idx.end()) ++out[dit->second * 6 + 5];
+      if (dit != doc_idx.end()) ++out[dit->second * 8 + 5];
     }
     return static_cast<int64_t>(n_rows);
   } catch (const std::exception& e) {
@@ -6438,8 +6677,7 @@ uint8_t* amtpu_get_missing_changes(void* pool_ptr, const char* doc_id,
     Clock all_deps;
     for (auto& [da, ds] : have_deps) {
       if (ds == 0) continue;
-      for (auto& [ta, ts] : all_deps_of(st, da, ds))
-        clock_set_max(all_deps, ta, ts);
+      read_all_deps(st, da, ds, all_deps);
       clock_set_max(all_deps, da, ds);
     }
     Writer out;

@@ -99,9 +99,10 @@ def render_capacity(health, out):
     else:
         out.append('capacity: used %s (no AMTPU_MEM_BUDGET_MB set)'
                    % _mb(used))
-    out.append('  arena %s  disk %s (%s cold docs)  fanned %s  '
+    out.append('  arena %s  clock %s  disk %s (%s cold docs)  fanned %s  '
                'egress %s  | evictions %s (%s freed, %s pressure)'
                % (_mb(tot.get('arena_bytes', 0)),
+                  _mb(tot.get('clock_bytes', 0)),
                   _mb(tot.get('disk_bytes', 0)),
                   tot.get('cold_docs', 0),
                   _mb(tot.get('fanned_bytes', 0)),
@@ -110,7 +111,8 @@ def render_capacity(health, out):
                   _mb(sto.get('evicted_bytes', 0)),
                   sto.get('pressure_evictions', 0)))
     top = cap.get('top') or {}
-    for tier, field in (('arena', 'arena_bytes'), ('disk', 'disk_bytes'),
+    for tier, field in (('arena', 'arena_bytes'), ('clock', 'clock_bytes'),
+                        ('disk', 'disk_bytes'),
                         ('fanned', 'fanned_bytes')):
         rows = top.get(tier) or []
         if not rows:

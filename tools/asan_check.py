@@ -34,7 +34,8 @@ ASAN_LIB = os.path.join(ROOT, 'automerge_tpu', 'native',
 #: native, ISSUE 14) -- broad begin/emit coverage without the slow
 #: subprocess lanes
 SUBSET = ('tests/test_native.py', 'tests/test_atomicity.py',
-          'tests/test_backend.py', 'tests/test_storage_native.py')
+          'tests/test_backend.py', 'tests/test_storage_native.py',
+          'tests/test_clock_fold.py')
 
 
 def _gxx_lib(name):

@@ -14,8 +14,13 @@ Accepts every artifact shape the repo emits:
 Lines pair by ``(config, mode)`` (falling back to ``metric``); for each
 pair the table reports ops/s delta, collect-share delta (from the
 embedded telemetry block when present), and the biggest per-phase
-second movers.  Exit code: 1 when any pair regresses past the
-thresholds (``--tol-ops`` fractional ops/s drop, default 0.10;
+second movers.  Coldstart artifacts (``metric ==
+'coldstart_restore'``, BENCH_COLDSTART_*.json) additionally pair the
+ISSUE-17 economics metrics -- ``docs_per_gb`` (higher is better),
+``restore_s_per_doc`` and ``peak_rss_mb`` (lower is better) -- and
+report their regressions like ops/s.  Exit code: 1 when any pair
+regresses past the thresholds (``--tol-ops`` fractional ops/s drop,
+default 0.10, which also bounds the coldstart economics metrics;
 ``--tol-share`` absolute collect-share increase, default 0.10) --
 unless ``--soft``, the report-only mode `make check` wires in (this
 host's windows jitter far past any honest hard gate; the table is for
@@ -96,6 +101,7 @@ def compare(old_path, new_path, tol_ops, tol_share, top_phases=4):
               'share old', 'share new')
     rows = []
     regressions = []
+    econ_lines = []
     for key in sorted(keys):
         ol, nl = old[key], new[key]
         ov, nv = float(ol['value']), float(nl['value'])
@@ -111,10 +117,31 @@ def compare(old_path, new_path, tol_ops, tol_share, top_phases=4):
                 and nshare - oshare > tol_share:
             regressions.append('%s/%s: collect share %.3f -> %.3f'
                                % (key[0], key[1], oshare, nshare))
+        # coldstart economics (ISSUE 17): docs_per_gb up is good,
+        # restore_s_per_doc / peak_rss_mb down is good
+        for field, better in (('docs_per_gb', 'higher'),
+                              ('restore_s_per_doc', 'lower'),
+                              ('peak_rss_mb', 'lower')):
+            o, n = ol.get(field), nl.get(field)
+            if o is None or n is None or not float(o):
+                continue
+            o, n = float(o), float(n)
+            frac = (n - o) / o
+            econ_lines.append('  %s/%s: %s %.6g -> %.6g (%s)'
+                              % (key[0], key[1], field, o, n,
+                                 _fmt_pct(frac)))
+            worse = frac < -tol_ops if better == 'higher' \
+                else frac > tol_ops
+            if worse:
+                regressions.append('%s/%s: %s %.6g -> %.6g (%s)'
+                                   % (key[0], key[1], field, o, n,
+                                      _fmt_pct(frac)))
     widths = [max(len(r[i]) for r in [header] + rows)
               for i in range(len(header))]
     for r in [header] + rows:
         print('  ' + '  '.join(c.rjust(w) for c, w in zip(r, widths)))
+    for ln in econ_lines:
+        print(ln)
     # phase movers: the per-phase seconds that moved most, per pair
     for key in sorted(keys):
         op, np_ = phases_of(old[key]), phases_of(new[key])

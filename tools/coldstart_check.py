@@ -1,7 +1,7 @@
 """CI gate: native columnar cold-start economics (ISSUE 14,
 docs/STORAGE.md).
 
-Six acceptance checks, one process, on a scaled (~2k-doc) corpus:
+Eight acceptance checks, one process, on a scaled (~2k-doc) corpus:
 
   1. **native decode speed** -- columnar decode through the native
      codec must sustain >= 10x the Python codec's changes/s on BOTH
@@ -21,7 +21,17 @@ Six acceptance checks, one process, on a scaled (~2k-doc) corpus:
   5. **arena-direct path engaged** -- `storage.native_loads` > 0 in the
      native arm (the gate must fail if the fast path silently falls
      back to dict replay);
-  6. **oracle-free** -- `fallback.oracle == 0` across all of it.
+  6. **oracle-free** -- `fallback.oracle == 0` across all of it;
+  7. **parallel store restore** (ISSUE 17) -- `restore_from_store`
+     auto fan-out must be >= 2x the serial (threads=1) arm's changes/s
+     on multi-core hosts (1-core hosts skip loudly like mesh-check),
+     with the `storage.restore.*` counters engaged and byte parity;
+  8. **clock folding** (ISSUE 17) -- `amtpu_fold_clocks` must hold
+     clock memory strictly below the unfolded
+     (`AMTPU_STORAGE_FOLD_CLOCKS=0`) arm on a churned corpus, with
+     byte-identical saves/patches/missing-clock frames and the
+     `clk_pairs` accounting column reconciling against the fresh-walk
+     oracle.
 
 Usage: [JAX_PLATFORMS=cpu] python tools/coldstart_check.py
 Corpus size: AMTPU_SMOKE_COLDSTART_DOCS (default 2048).
@@ -231,6 +241,151 @@ def check_speed_and_parity(problems, report, blobs, builder):
     report['parity'] = bad == 0
 
 
+def check_parallel_restore(problems, report, blobs, builder):
+    """ISSUE 17: `restore_from_store` serial (threads=1) vs auto
+    fan-out over shard pools must hit >= 2x changes/s on multi-core
+    hosts; on 1-core hosts the gate is vacuous by construction
+    (ceiling 1x) and SKIPS LOUDLY like mesh-check's scaling gate.
+    Parity + restore-counter engagement gate on every host shape."""
+    import tempfile
+
+    from automerge_tpu import telemetry
+    from automerge_tpu.native import ShardedNativePool, _restore_threads
+    from automerge_tpu.storage.coldstore import ColdStore
+    os.environ.pop('AMTPU_STORAGE_NATIVE', None)
+    store = ColdStore(root=tempfile.mkdtemp(prefix='amtpu-cs-par-'))
+    for d, b in blobs.items():
+        store.put(d, bytes(b))
+    n_changes = 17 * len(blobs)
+    cores = os.cpu_count() or 1
+    trials = {1: [], 0: []}
+    pool = None
+    for t in range(3 if cores >= 2 else 1):
+        for threads in (1, 0) if t % 2 == 0 else (0, 1):
+            p = ShardedNativePool(4)
+            t0 = time.perf_counter()
+            summary = p.restore_from_store(store, threads=threads or None)
+            trials[threads].append(time.perf_counter() - t0)
+            if summary['docs'] != len(blobs) or summary['corrupt'] \
+                    or summary['failed']:
+                problems.append('restore_from_store summary off: %r'
+                                % {k: summary[k] for k in
+                                   ('docs', 'corrupt', 'failed')})
+            pool = p
+    serial_s = statistics.median(trials[1])
+    par_s = statistics.median(trials[0])
+    speedup = serial_s / max(par_s, 1e-9)
+    report['restore_parallel'] = {
+        'cores': cores, 'threads': _restore_threads(),
+        'serial_changes_per_s': round(n_changes / serial_s),
+        'parallel_changes_per_s': round(n_changes / par_s),
+        'speedup': round(speedup, 2),
+    }
+    print('coldstart-check: store restore serial %.3fs parallel %.3fs '
+          '(%.2fx on %d cores)' % (serial_s, par_s, speedup, cores),
+          file=sys.stderr)
+    if cores < 2:
+        print('coldstart-check: parallel-restore gate SKIPPED '
+              '(1 physical core; ceiling 1x; measured %.2fx recorded '
+              'in the JSON)' % speedup, file=sys.stderr)
+    elif speedup < 2.0:
+        problems.append('parallel restore %.2fx < 2x the serial arm '
+                        'on %d cores' % (speedup, cores))
+    snap = telemetry.metrics_snapshot()
+    if not snap.get('storage.restore.docs'):
+        problems.append('storage.restore.docs == 0: restore_from_store '
+                        'never counted')
+    sample = sorted(blobs)[::max(1, len(blobs) // 64)]
+    for doc in sample:
+        if pool.save(doc) != builder.save(doc):
+            problems.append('restore_from_store save bytes diverged '
+                            'for %s' % doc)
+            break
+
+
+def check_clock_fold(problems, report):
+    """ISSUE 17: clock folding (`amtpu_fold_clocks`) must hold clock
+    memory STRICTLY below the unfolded (AMTPU_STORAGE_FOLD_CLOCKS=0)
+    arm on the same churned corpus, with byte-identical saves, patches
+    and missing-clock frames across the arms."""
+    from automerge_tpu.native import NativeDocPool
+    n_docs = 64
+
+    def _run(folded, arm_rng):
+        os.environ['AMTPU_STORAGE_FOLD_CLOCKS'] = '1' if folded else '0'
+        pool = NativeDocPool()
+        for base in range(0, n_docs, 32):
+            pool.apply_batch({('doc-%05d' % d): _doc_changes(d, arm_rng)
+                              for d in range(base,
+                                             min(base + 32, n_docs))})
+        seqs = {}
+        for r in range(6):
+            payload = {}
+            for d in range(n_docs):
+                doc = 'doc-%05d' % d
+                s0 = seqs.get(doc, 0)
+                payload[doc] = [
+                    {'actor': 'churn', 'seq': s0 + i + 1,
+                     'deps': {'churn': s0 + i} if s0 + i else {},
+                     'ops': [{'action': 'set', 'obj': ROOT_ID,
+                              'key': 'k%d' % (i % 4), 'value': r + i}]}
+                    for i in range(4)]
+                seqs[doc] = s0 + 4
+            pool.apply_batch(payload)
+            for doc in payload:
+                pool.compact(doc)
+        return pool
+
+    folded = _run(True, random.Random(23))
+    unfolded = _run(False, random.Random(23))
+    os.environ.pop('AMTPU_STORAGE_FOLD_CLOCKS', None)
+    # clock memory: sparse pairs (8 B each) + the densified fold table
+    ids, stats = folded.doc_stats()
+    fold_mem = int((stats[:, 6] * 8 + stats[:, 7]).sum())
+    _ids, ustats = unfolded.doc_stats()
+    unfold_mem = int((ustats[:, 6] * 8 + ustats[:, 7]).sum())
+    report['clock_fold'] = {
+        'folded_clock_bytes': fold_mem,
+        'unfolded_clock_bytes': unfold_mem,
+        'sparse_pairs_left': int(folded.clock_pairs()),
+    }
+    print('coldstart-check: clock fold %d B vs unfolded %d B '
+          '(%d sparse pairs left)' % (fold_mem, unfold_mem,
+                                      int(folded.clock_pairs())),
+          file=sys.stderr)
+    if not fold_mem < unfold_mem:
+        problems.append('folded clock memory %d B not strictly below '
+                        'the unfolded arm %d B' % (fold_mem, unfold_mem))
+    # acct column must reconcile with the fresh-walk oracle
+    for pool, arm in ((folded, 'folded'), (unfolded, 'unfolded')):
+        pids, pstats = pool.doc_stats()
+        oracle = pool.clock_pairs()
+        acct = int(pstats[:, 6].sum())
+        if acct != oracle:
+            problems.append('clk_pairs acct %d != oracle %d (%s arm)'
+                            % (acct, oracle, arm))
+    for d in range(0, n_docs, 7):
+        doc = 'doc-%05d' % d
+        if folded.save(doc) != unfolded.save(doc):
+            problems.append('clock fold: save bytes diverged for %s'
+                            % doc)
+            break
+        if folded.get_patch(doc) != unfolded.get_patch(doc):
+            problems.append('clock fold: patch diverged for %s' % doc)
+            break
+        if folded._missing_clock(doc, {}) \
+                != unfolded._missing_clock(doc, {}):
+            problems.append('clock fold: missing-clock frame diverged '
+                            'for %s' % doc)
+            break
+        if folded.get_missing_changes(doc, {'churn': 2, 'a1': 2}) \
+                != unfolded.get_missing_changes(doc, {'churn': 2,
+                                                      'a1': 2}):
+            problems.append('clock fold: straggler backfill diverged '
+                            'for %s' % doc)
+            break
+
+
 def check_durable_recovery(problems, report):
     import tempfile
 
@@ -274,6 +429,8 @@ def main():
     check_decode_speed(problems, report, blobs)
     check_decode_speed_config4(problems, report, rng)
     check_speed_and_parity(problems, report, blobs, builder)
+    check_parallel_restore(problems, report, blobs, builder)
+    check_clock_fold(problems, report)
     check_durable_recovery(problems, report)
     snap = telemetry.metrics_snapshot()
     report['fallback_oracle'] = int(snap.get('fallback.oracle', 0))
@@ -286,10 +443,14 @@ def main():
             print('  - %s' % p)
         return 1
     print('coldstart-check: PASS (%d docs, codec %.1fx / restore '
-          '%.1fx vs the Python arm, parity + durable recovery + '
-          'oracle-free)'
+          '%.1fx vs the Python arm, parallel store restore %.2fx, '
+          'clock fold %d B < %d B unfolded, parity + durable recovery '
+          '+ oracle-free)'
           % (n_docs, report['decode_speedup'],
-             report['restore_speedup']))
+             report['restore_speedup'],
+             report['restore_parallel']['speedup'],
+             report['clock_fold']['folded_clock_bytes'],
+             report['clock_fold']['unfolded_clock_bytes']))
     return 0
 
 
