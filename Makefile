@@ -4,7 +4,7 @@
 	clean wheel telemetry-check fallback-check perf-smoke chaos-check \
 	serve-check mesh-check static-check asan-check fanout-check \
 	bench-fanout storage-check obs-check backpressure-check \
-	coldstart-check bench-coldstart capacity-check
+	coldstart-check bench-coldstart capacity-check route-check
 
 all: native
 
@@ -69,6 +69,7 @@ check: native
 	$(MAKE) coldstart-check
 	$(MAKE) capacity-check
 	$(MAKE) obs-check
+	$(MAKE) route-check
 	$(MAKE) mesh-check
 	$(MAKE) asan-check
 	@cp .bench_smoke.json .bench_smoke.prev.json
@@ -203,6 +204,19 @@ static-check: native
 # classes every hardening round re-found by hand now fail CI.
 asan-check: native
 	JAX_PLATFORMS=cpu python tools/asan_check.py
+
+# Fleet-router gate (ISSUE 18, docs/SERVING.md routing section): 3
+# replica server subprocesses behind the consistent-hash RouterGateway
+# must serve a zipfian workload with per-doc byte parity vs ONE
+# single-pool serial replay and fallback.oracle == 0 on every replica;
+# a cost-driven rebalance under sustained load must commit >= 1
+# migration with every (doc, seq) acked exactly once and strictly
+# lower occupancy skew after; and a migration whose TARGET replica is
+# SIGKILLed between migrate_out and migrate_in must recover off the
+# durable handoff manifest with no lost acks.  Writes the
+# BENCH_ROUTER artifact (per-replica ops/s, routed p50/p99, skew).
+route-check: native
+	JAX_PLATFORMS=cpu python tools/route_check.py
 
 # Mesh-execution gate (ISSUE 7, docs/ARCHITECTURE.md mesh section):
 # MeshDocPool under AMTPU_MESH=4 must serve a mixed real workload with

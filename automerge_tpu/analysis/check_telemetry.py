@@ -54,6 +54,8 @@ PRESEED_BLOCKS = {
     'capacity': 'KNOWN_CAPACITY_KEYS',
     'trace': 'KNOWN_TRACE_KEYS',
     'fleet': 'KNOWN_FLEET_KEYS',
+    'router': 'KNOWN_ROUTER_KEYS',
+    'migrate': 'KNOWN_MIGRATE_KEYS',
 }
 
 

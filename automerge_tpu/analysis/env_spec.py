@@ -213,6 +213,28 @@ ENV_FLAGS = (
     # -- analysis / sanitizer ----------------------------------------------
     EnvFlag('AMTPU_SANITIZE', 'bool', False, False,
             'analysis/sanitize.py (poisons staging buffers post-dispatch)'),
+    # -- fleet router / rebalancer (ISSUE 18) ------------------------------
+    EnvFlag('AMTPU_ROUTE_VNODES', 'int', 64, False,
+            'router/ring.py (virtual nodes per replica on the '
+            'consistent-hash ring)'),
+    EnvFlag('AMTPU_ROUTE_REDIRECTS', 'int', 3, False,
+            'router/gateway.py + sidecar/client.py (max WrongReplica '
+            'redirect hops per request before the error surfaces)'),
+    EnvFlag('AMTPU_ROUTE_HANDOFF_DIR', 'str', '', False,
+            'router/rebalance.py (root dir for durable migration '
+            'handoff stores; empty -> per-process tempdir)'),
+    EnvFlag('AMTPU_REBALANCE_INTERVAL_S', 'float', 5.0, False,
+            'router/rebalance.py (seconds between rebalancer scrape '
+            'passes)'),
+    EnvFlag('AMTPU_REBALANCE_TOPK', 'int', 4, False,
+            'router/rebalance.py (max hot-doc victims one rebalance '
+            'pass migrates)'),
+    EnvFlag('AMTPU_REBALANCE_MIN_SKEW', 'float', 0.5, False,
+            'router/rebalance.py (relative occupancy spread '
+            '(max-min)/mean below which the fleet counts as balanced)'),
+    EnvFlag('AMTPU_REBALANCE_PRESSURE', 'float', 0.8, False,
+            'router/rebalance.py (memory pressure on any replica past '
+            'which a rebalance triggers regardless of skew)'),
 )
 
 SPEC = {f.name: f for f in ENV_FLAGS}

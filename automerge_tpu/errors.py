@@ -20,3 +20,19 @@ class OverloadedError(AutomergeError):
     def __init__(self, msg, retry_after_ms=None):
         super().__init__(msg)
         self.retry_after_ms = retry_after_ms
+
+
+class WrongReplicaError(AutomergeError):
+    """A replica answered an op for a doc it no longer owns
+    (docs/SERVING.md routing section): the doc was migrated away and
+    the wire envelope (``errorType: "WrongReplica"``) names the new
+    owner (``owner``) and the ring version of the move
+    (``ring_version``).  The fleet router redirects transparently;
+    ``SidecarClient`` retries a bounded number of times
+    (AMTPU_ROUTE_REDIRECTS) for the stale-direct-connection case and
+    then surfaces this so the caller can re-resolve placement."""
+
+    def __init__(self, msg, owner=None, ring_version=None):
+        super().__init__(msg)
+        self.owner = owner
+        self.ring_version = ring_version

@@ -1,0 +1,16 @@
+"""Fleet routing tier (ISSUE 18, docs/SERVING.md routing section).
+
+One gateway over one pool cannot serve millions of users.  This
+package fronts N shared-nothing gateway+pool replicas with a
+:class:`~automerge_tpu.router.gateway.RouterGateway` speaking the
+sidecar's existing JSONL/msgpack framing, places docs on a
+consistent-hash ring (:mod:`automerge_tpu.router.ring`), and moves
+hot docs between replicas live
+(:mod:`automerge_tpu.router.rebalance`) without losing, duplicating,
+or reordering a single op.
+"""
+
+from .ring import HashRing                      # noqa: F401
+from .gateway import RouterGateway              # noqa: F401
+from .rebalance import (MigrationExecutor,      # noqa: F401
+                        Rebalancer)

@@ -96,6 +96,15 @@ EXEC_CMDS = ('apply_local_change', 'load')
 #: subscription lifecycle admits always (control plane)
 FANOUT_CMDS = ('subscribe', 'unsubscribe', 'presence')
 
+#: live-migration control plane (ISSUE 18, docs/SERVING.md routing
+#: section): migrate_out saves this replica's copy of the named docs
+#: into a durable handoff ColdStore and disowns them; migrate_in
+#: restores them from the handoff manifest on the new owner.  Both
+#: ride the admission queue keyed on their docs (admit_always), so a
+#: migrate_out serializes AFTER every in-flight op on those docs --
+#: the per-doc FIFO is what makes the router's parking race-free.
+ROUTER_CMDS = ('migrate_out', 'migrate_in')
+
 
 def _op_weight(cmd, req):
     """Queued-op count a request admits as (the admission unit): number
@@ -330,6 +339,16 @@ class GatewayServer(object):
         self._accept_thread = None
         self._dispatch_thread = None
         self._stopping = False
+        # fleet routing state (ISSUE 18): docs this replica migrated
+        # away (-> the typed WrongReplica envelope names the new
+        # owner), the last ring version a migrate command carried, and
+        # the in/out migration counters the healthz `routing` section
+        # reports
+        self._routing_lock = threading.Lock()
+        self._disowned = {}       # guarded-by: self._routing_lock
+        self._ring_version = 0    # guarded-by: self._routing_lock
+        self._migrations_in = 0   # guarded-by: self._routing_lock
+        self._migrations_out = 0  # guarded-by: self._routing_lock
 
     # -- lifecycle ------------------------------------------------------
 
@@ -362,6 +381,8 @@ class GatewayServer(object):
                         egress_fn=self._egress_healthz_section)
         telemetry.register_healthz_section(
             'capacity', capacity.capacity_section)
+        telemetry.register_healthz_section(
+            'routing', self._routing_section)
         self._dispatch_thread = threading.Thread(
             target=self._dispatch_loop, name='amtpu-gw-dispatch',
             daemon=True)
@@ -403,6 +424,7 @@ class GatewayServer(object):
         telemetry.register_healthz_section('fanout', None)
         telemetry.register_healthz_section('storage', None)
         telemetry.register_healthz_section('capacity', None)
+        telemetry.register_healthz_section('routing', None)
         capacity.detach()
 
     def _healthz_section(self):
@@ -416,6 +438,30 @@ class GatewayServer(object):
         stats['fallback_oracle'] = telemetry.metrics_snapshot().get(
             'fallback.oracle', 0.0)
         return stats
+
+    def _routing_section(self):
+        """healthz `routing` (ISSUE 18): who this replica is in the
+        fleet, the last ring version a migrate command carried, how
+        many docs it serves vs has disowned, and the migration
+        counters -- the router's gossip scrape reads exactly this."""
+        with self._routing_lock:
+            disowned = len(self._disowned)
+            ring_version = self._ring_version
+            mig_in = self._migrations_in
+            mig_out = self._migrations_out
+        owned = None
+        try:
+            owned = int(self.backend.pool.doc_count())
+        except Exception:
+            pass
+        if self.storage_tier is not None:
+            owned = (owned or 0) + len(self.storage_tier.store)
+        return {'replica_id': telemetry.replica_id(),
+                'ring_version': ring_version,
+                'owned_docs': owned,
+                'disowned_docs': disowned,
+                'migrations_in': mig_in,
+                'migrations_out': mig_out}
 
     # -- connection layer -----------------------------------------------
 
@@ -501,6 +547,36 @@ class GatewayServer(object):
         rid = req.get('id')
         if cmd in PURE_CMDS:
             conn.send(self.backend.handle(req))
+            return
+        if cmd in ROUTER_CMDS:
+            docs = req.get('docs')
+            if not isinstance(docs, list) or not docs or any(
+                    isinstance(d, (dict, list, set)) for d in docs):
+                conn.send({'id': rid,
+                           'error': "%s requires 'docs': [doc, ...]"
+                                    % cmd,
+                           'errorType': 'RangeError'})
+                return
+            op = PendingOp(conn, rid, cmd, req, tuple(docs), 1,
+                           batchable=False)
+            op.clock = attribution.Clock(attribution.class_of(cmd),
+                                         t0=t0, trace=req.get('trace'))
+            op.clock.mark('admit')
+            try:
+                # control plane: shedding a migrate op would wedge the
+                # router's parked FIFO, so it always admits
+                self.queue.offer(op, admit_always=True)
+            except Overloaded as e:     # only on gateway shutdown
+                conn.send({'id': rid, 'error': str(e),
+                           'errorType': 'Overloaded',
+                           'retryAfterMs': e.retry_after_ms})
+            return
+        resp = self._check_disowned(cmd, rid, req)
+        if resp is not None:
+            # a doc this replica migrated away: answer the typed
+            # WrongReplica envelope naming the new owner instead of
+            # silently re-creating a fresh empty doc
+            conn.send(resp)
             return
         if cmd in FANOUT_CMDS:
             if self.fanout is None:
@@ -664,6 +740,11 @@ class GatewayServer(object):
         with telemetry.span('scheduler.flush', batched=len(batch),
                             exec_ops=len(execs)) as fsp:
             with self.pool_lock:
+                # WrongReplica shed FIRST: an op that passed submit's
+                # disowned check but queued behind the migrate_out that
+                # disowned its doc would otherwise execute against the
+                # dropped doc and silently create a fresh one
+                batch, execs = self._shed_disowned(batch, execs)
                 touched = {d for op in batch + execs for d in op.docs}
                 if self.storage_tier is not None and touched:
                     # reload-on-touch BEFORE the ops run: a cold doc's
@@ -734,6 +815,61 @@ class GatewayServer(object):
                     continue
                 self._finish(op, self._cold_error(op.rid, bad,
                                                   failed[bad]))
+        return keep_batch, keep_execs
+
+    # -- fleet routing: disowned docs (ISSUE 18) ------------------------
+
+    @staticmethod
+    def _wrong_replica(rid, doc, owner, ring_version):
+        """The typed envelope for an op on a doc this replica migrated
+        away: names the new owner so the router (or a stale direct
+        client) can re-route instead of guessing."""
+        return {'id': rid,
+                'error': 'doc %r has migrated to replica %r'
+                         % (doc, owner),
+                'errorType': 'WrongReplica', 'owner': owner,
+                'ringVersion': ring_version}
+
+    def _check_disowned(self, cmd, rid, req):
+        """Submit-time fast reject: the WrongReplica envelope for a
+        request touching a disowned doc, or None to admit.  Flush-time
+        `_shed_disowned` closes the race this check alone would leave
+        (an op admitted before the migrate_out claimed)."""
+        with self._routing_lock:
+            if not self._disowned:
+                return None
+            docs = _op_docs(cmd, req)
+            if not docs:
+                return None
+            for d in docs:
+                hit = self._disowned.get(d)
+                if hit is not None:
+                    telemetry.metric('migrate.wrong_replica')
+                    return self._wrong_replica(rid, d, hit[0], hit[1])
+        return None
+
+    def _shed_disowned(self, batch, execs):
+        """Answers every claimed op touching a disowned doc with the
+        typed WrongReplica envelope (running it would CREATE a fresh
+        empty doc and silently fork the migrated history) and returns
+        the survivors.  Migrate commands are exempt: migrate_in is
+        exactly how a disowned doc comes back."""
+        with self._routing_lock:
+            if not self._disowned:
+                return batch, execs
+            disowned = dict(self._disowned)
+        keep_batch, keep_execs = [], []
+        for ops, keep in ((batch, keep_batch), (execs, keep_execs)):
+            for op in ops:
+                bad = None if op.cmd in ROUTER_CMDS else next(
+                    (d for d in op.docs if d in disowned), None)
+                if bad is None:
+                    keep.append(op)
+                    continue
+                owner, rv = disowned[bad]
+                telemetry.metric('migrate.wrong_replica')
+                self._finish(op, self._wrong_replica(op.rid, bad,
+                                                     owner, rv))
         return keep_batch, keep_execs
 
     def _storage_upkeep(self, batch, execs, touched):
@@ -893,6 +1029,12 @@ class GatewayServer(object):
                 op.clock.mark('dispatch')
             self._finish(op, resp)
             return
+        if op.cmd in ROUTER_CMDS:
+            resp = self._migrate_cmd(op)
+            if op.clock is not None:
+                op.clock.mark('dispatch')
+            self._finish(op, resp)
+            return
         resp = self.backend.handle(op.req)
         if op.clock is not None:
             op.clock.mark('dispatch')
@@ -1033,6 +1175,142 @@ class GatewayServer(object):
             return {'id': rid,
                     'error': '%s: %s' % (type(e).__name__, e),
                     'errorType': 'InternalError'}
+
+    # -- live doc migration (ISSUE 18, docs/SERVING.md routing) ---------
+
+    def _migrate_cmd(self, op):
+        """migrate_out / migrate_in, executed under the pool lock and
+        ordered through the per-doc FIFO like any other op -- a
+        migrate_out therefore serializes AFTER every in-flight op on
+        its docs, which is what makes the router's parking race-free.
+        The handoff transport is a DURABLE ColdStore (fsynced blobs +
+        checksummed manifest), so a kill at any point leaves either the
+        source's committed copy or a manifest the target can restore
+        from."""
+        from ..errors import AutomergeError, RangeError
+        req, rid = op.req, op.rid
+        try:
+            store_dir = req['store_dir']
+            if not isinstance(store_dir, str) or not store_dir:
+                raise RangeError('store_dir must be a directory path')
+            if op.cmd == 'migrate_out':
+                res = self._migrate_out(op.docs, store_dir,
+                                        req.get('new_owner'),
+                                        req.get('ring_version'))
+            else:
+                res = self._migrate_in(op.docs, store_dir,
+                                       req.get('ring_version'))
+            return {'id': rid, 'result': res}
+        except KeyError as e:
+            return {'id': rid,
+                    'error': 'missing required field: %s' % e,
+                    'errorType': 'RangeError'}
+        except (AutomergeError, RangeError, TypeError) as e:
+            return {'id': rid, 'error': str(e),
+                    'errorType': type(e).__name__}
+        except Exception as e:
+            telemetry.metric('migrate.errors')
+            return {'id': rid,
+                    'error': '%s: %s' % (type(e).__name__, e),
+                    'errorType': 'InternalError'}
+
+    def _migrate_out(self, docs, store_dir, new_owner, ring_version):
+        """save -> durable put_many -> drop: checkpoints each doc into
+        the handoff store (canonically keyed so the manifest round
+        -trips int ids), drops it from the pool + cold tier, and
+        records it disowned -- every later op answers WrongReplica.
+        Per-doc failures (unknown doc) report in `failed`; the rest of
+        the batch still moves."""
+        from ..storage.coldstore import ColdStore
+        from ..utils.common import doc_key
+        store = ColdStore(store_dir, durable=True)
+        blobs, failed = {}, {}
+        order = []
+        for d in docs:
+            try:
+                blobs[doc_key(d)] = self.backend.pool.save(d)
+                order.append(d)
+            except Exception as e:
+                failed[str(d)] = '%s: %s' % (type(e).__name__, e)
+        nbytes = sum(len(b) for b in blobs.values())
+        if blobs:
+            store.put_many(blobs)
+            for d in order:
+                self.backend.pool.drop_doc(d)
+                if self.storage_tier is not None:
+                    self.storage_tier.forget(d)
+        with self._routing_lock:
+            for d in order:
+                self._disowned[d] = (new_owner, ring_version)
+            if isinstance(ring_version, int):
+                self._ring_version = max(self._ring_version,
+                                         ring_version)
+            self._migrations_out += 1
+        telemetry.metric('migrate.out_docs', len(order))
+        telemetry.metric('migrate.out_bytes', nbytes)
+        telemetry.recorder.record('migrate.out', n=len(order),
+                                  detail=str(new_owner))
+        return {'migrated': order, 'failed': failed, 'bytes': nbytes}
+
+    def _migrate_in(self, docs, store_dir, ring_version):
+        """Restores the named docs from the handoff manifest via the
+        parallel arena-direct path (`restore_from_store`, ISSUE 17),
+        falling back to a batched replay for pools without it.  Docs
+        absent from the manifest (or corrupt) report per-doc in
+        `failed`; accepting a doc clears any disowned record for it
+        (a doc can migrate back)."""
+        from ..storage.coldstore import ColdStore
+        from ..utils.common import doc_key
+        store = ColdStore(store_dir, durable=True)
+        keys = {d: doc_key(d) for d in docs}
+        have = [d for d in docs if keys[d] in store]
+        failed = {str(d): 'not in handoff manifest'
+                  for d in docs if keys[d] not in store}
+        restored, nbytes = [], 0
+        if have:
+            try:
+                res = self.backend.pool.restore_from_store(
+                    store, doc_ids=[keys[d] for d in have])
+                bad = {}
+                for m in (res.get('corrupt') or {},
+                          res.get('failed') or {}):
+                    bad.update({str(k): str(v) for k, v in m.items()})
+                restored = [d for d in have
+                            if str(keys[d]) not in bad]
+                failed.update(bad)
+                nbytes = int(res.get('bytes') or 0)
+            except AttributeError:
+                # pools without the parallel restore entry point (test
+                # fakes, dict pools): the DocEvictor reload pattern --
+                # batched replay, per-doc isolation on failure
+                blobs = {d: store.get(keys[d]) for d in have}
+                try:
+                    self.backend.pool.load_batch(blobs)
+                    restored = have
+                except Exception:
+                    for d in have:
+                        try:
+                            self.backend.pool.load_batch(
+                                {d: blobs[d]})
+                            restored.append(d)
+                        except Exception as e:
+                            failed[str(d)] = '%s: %s' \
+                                % (type(e).__name__, e)
+                nbytes = sum(len(blobs[d]) for d in restored)
+        if restored and self.storage_tier is not None:
+            self.storage_tier.note_touch(restored)
+        with self._routing_lock:
+            for d in restored:
+                self._disowned.pop(d, None)
+            if isinstance(ring_version, int):
+                self._ring_version = max(self._ring_version,
+                                         ring_version)
+            self._migrations_in += 1
+        telemetry.metric('migrate.in_docs', len(restored))
+        telemetry.metric('migrate.in_bytes', nbytes)
+        telemetry.recorder.record('migrate.in', n=len(restored))
+        return {'restored': restored, 'failed': failed,
+                'bytes': nbytes}
 
     def _fanout_flush(self, fan, fsp):
         """Hands the flush's committed docs to the fan-out engine; the

@@ -179,6 +179,12 @@ class SidecarClient:
     _deadline_s = None
     _heartbeat_s = None
     _max_respawns = 3
+    #: bounded WrongReplica auto-redirect retries (ISSUE 18): a doc
+    #: migrated away mid-stream re-sends the SAME request (the op was
+    #: NOT executed, so the retry is exactly-once) -- through a router
+    #: the ring catches up within a try or two; a stale direct
+    #: connection exhausts the budget and surfaces the typed error
+    _max_redirects = 3
     _respawns = 0
     _last_ok = 0.0
     _proc = None
@@ -238,6 +244,7 @@ class SidecarClient:
         if max_respawns is None:
             max_respawns = env_int('AMTPU_SIDECAR_MAX_RESPAWNS', 3)
         self._max_respawns = max_respawns
+        self._max_redirects = env_int('AMTPU_ROUTE_REDIRECTS', 3)
         if sock_path or proc is not None:
             # healing means killing + respawning the server from OUR
             # spawn recipe -- only meaningful for a server this client
@@ -659,13 +666,17 @@ class SidecarClient:
         resp = self._roundtrip(req)
         if 'error' in resp:
             from ..errors import (AutomergeError, OverloadedError,
-                                  RangeError)
+                                  RangeError, WrongReplicaError)
             types = {'AutomergeError': AutomergeError,
                      'RangeError': RangeError, 'TypeError': TypeError,
                      'KeyError': KeyError}
             if resp.get('errorType') == 'Overloaded':
                 raise OverloadedError(resp['error'],
                                       resp.get('retryAfterMs'))
+            if resp.get('errorType') == 'WrongReplica':
+                raise WrongReplicaError(
+                    resp['error'], owner=resp.get('owner'),
+                    ring_version=resp.get('ringVersion'))
             raise types.get(resp.get('errorType'), AutomergeError)(
                 resp['error'])
         return resp['result']
@@ -741,9 +752,10 @@ class SidecarClient:
         # assembly on (its wall is the client-observed request time);
         # the wire context is captured INSIDE it so the server's spans
         # become its children
+        from ..errors import WrongReplicaError
         with telemetry.span('sidecar.client.request', cmd=cmd):
             tctx = self._request_trace()
-            heals = 0
+            heals = redirects = 0
             while True:
                 try:
                     if (self._heartbeat_s is not None and cmd != 'ping'
@@ -754,6 +766,17 @@ class SidecarClient:
                         self._call_raw('ping', {})
                     result = self._call_raw(cmd, kwargs, trace=tctx)
                     break
+                except WrongReplicaError:
+                    # the doc migrated away (ISSUE 18): the op did NOT
+                    # execute, so re-sending the SAME request is
+                    # exactly-once -- through a router the ring catches
+                    # up; past the budget the typed error surfaces with
+                    # the new owner attached
+                    telemetry.metric('sidecar.client.redirects')
+                    redirects += 1
+                    if redirects > self._max_redirects:
+                        raise
+                    time.sleep(0.01 * redirects)
                 except ConnectionError as e:
                     telemetry.metric('sidecar.client.transport_errors')
                     if not self._heal or self._proc is None \

@@ -163,7 +163,8 @@ class SidecarBackend:
                 'apply_local_change', 'get_patch', 'save', 'load',
                 'get_missing_deps', 'get_missing_changes',
                 'get_changes_for_actor', 'metrics', 'healthz', 'dump',
-                'subscribe', 'unsubscribe', 'presence')
+                'subscribe', 'unsubscribe', 'presence',
+                'migrate_out', 'migrate_in')
 
     def handle(self, req):
         """Wraps dispatch in the per-request telemetry: a span resuming
@@ -222,9 +223,12 @@ class SidecarBackend:
             elif cmd == 'get_changes_for_actor':
                 result = self.get_changes_for_actor(
                     req['doc'], req['actor'], req.get('after_seq', 0))
-            elif cmd in ('subscribe', 'unsubscribe', 'presence'):
-                # the fan-out control plane lives in the gateway's flush
-                # cycle; a serial/stdio server has no dispatcher to ride
+            elif cmd in ('subscribe', 'unsubscribe', 'presence',
+                         'migrate_out', 'migrate_in'):
+                # the fan-out AND migration control planes live in the
+                # gateway's flush cycle (migration needs the per-doc
+                # FIFO to serialize against in-flight ops); a
+                # serial/stdio server has no dispatcher to ride
                 raise RangeError(
                     '%s requires the continuous-batching gateway '
                     '(socket mode without --serial/AMTPU_GATEWAY=0)'

@@ -10,10 +10,17 @@ takes a transparent reload-on-touch: the gateway re-loads it inside the
 flush that wants it, under the pool lock, so the scheduler's per-doc
 FIFO parks followers exactly as it would behind an in-flight op.
 
-Thread model: every method is called under the gateway's pool lock
-(the single serialization point for all pool state); the store itself
-is therefore single-threaded by construction and keeps its index as a
-plain dict.  The disk directory (``AMTPU_STORAGE_DIR``, default a
+Thread model: the gateway's own tier runs under the pool lock, but the
+store is no longer single-threaded by construction -- live migration
+(ISSUE 18) writes handoff batches from the router's migration threads
+while WAL compaction and the flush path may race the same directory's
+manifest.  Every public method therefore serializes on an internal
+RLock: blob writes and the read-modify-write manifest rewrite
+(`put_many` -> `_write_manifest`) are atomic with respect to each
+other, so concurrent callers can never interleave a manifest that
+drops the other writer's committed docs (`make static-check` enforces
+the guarded-by discipline).  The disk directory
+(``AMTPU_STORAGE_DIR``, default a
 fresh tempdir) is by default an extension of pool memory, not durable
 storage -- a process that dies with evicted docs loses them exactly as
 it loses resident ones (durability remains the checkpoint-WAL's job).
@@ -39,6 +46,7 @@ import collections
 import hashlib
 import os
 import tempfile
+import threading
 
 import msgpack
 
@@ -75,39 +83,50 @@ class ColdStore(object):
         if durable is None:
             durable = env_bool('AMTPU_STORAGE_DURABLE', False)
         self.durable = durable
-        self._index = {}         # doc id -> (path, n_bytes, sha1|None)
+        # concurrent callers (migration threads + WAL compaction +
+        # the gateway flush) serialize here; RLock so the compound
+        # public paths (pop = get + discard) stay atomic
+        self._lock = threading.RLock()
+        # doc id -> (path, n_bytes, sha1|None)
+        self._index = {}          # guarded-by: self._lock
         if self.durable:
-            self._recover()
+            with self._lock:
+                self._recover()
 
     def _path(self, doc_id):
         h = hashlib.sha1(str(doc_id).encode('utf-8')).hexdigest()
         return os.path.join(self.root, h + '.amtc')
 
     def __contains__(self, doc_id):
-        return doc_id in self._index
+        with self._lock:
+            return doc_id in self._index
 
     def __len__(self):
-        return len(self._index)
+        with self._lock:
+            return len(self._index)
 
     def doc_ids(self):
         """Committed doc ids (durable mode: exactly what a fresh
         process recovers from the manifest -- the handoff inventory)."""
-        return list(self._index)
+        with self._lock:
+            return list(self._index)
 
     def disk_bytes(self, doc_id):
         """On-disk bytes of one cold doc (0 when not stored) -- the
         `disk_bytes` tier of the capacity cost vector
         (telemetry/capacity.py)."""
-        entry = self._index.get(doc_id)
+        with self._lock:
+            entry = self._index.get(doc_id)
         return entry[1] if entry is not None else 0
 
     @property
     def bytes(self):
-        return sum(e[1] for e in self._index.values())
+        with self._lock:
+            return sum(e[1] for e in self._index.values())
 
     # -- durable-mode manifest ------------------------------------------
 
-    def _recover(self):
+    def _recover(self):  # holds-lock: self._lock
         """Rebuilds the index from the manifest: only entries whose
         file exists at the recorded size are adopted (a killed save
         leaves at most a stray ``.tmp``, which is ignored -- the
@@ -145,7 +164,7 @@ class ColdStore(object):
         except OSError:
             pass
 
-    def _write_manifest(self):
+    def _write_manifest(self):  # holds-lock: self._lock
         docs = {}
         for doc_id, (path, n, digest) in self._index.items():
             docs[str(doc_id)] = {'file': os.path.basename(path),
@@ -163,7 +182,7 @@ class ColdStore(object):
 
     # -- blob I/O -------------------------------------------------------
 
-    def _put_blob(self, doc_id, blob):
+    def _put_blob(self, doc_id, blob):  # holds-lock: self._lock
         """Writes one blob crash-safely and updates the in-memory
         index; returns the obsolete prior path (durable mode) for the
         caller to unlink AFTER the manifest commits.
@@ -220,20 +239,24 @@ class ColdStore(object):
                 pass
 
     def put(self, doc_id, blob):
-        prior = self._put_blob(doc_id, blob)
-        if self.durable:
-            self._write_manifest()
-            self._retire([prior])
+        with self._lock:
+            prior = self._put_blob(doc_id, blob)
+            if self.durable:
+                self._write_manifest()
+                self._retire([prior])
 
     def put_many(self, blobs):
         """Batched handoff writes ({doc_id: blob}): one manifest
         rewrite + fsync for the whole batch instead of one per doc --
         the replica-handoff path saves thousands of docs in a burst,
-        and per-put manifests would make that O(n^2)."""
-        priors = [self._put_blob(d, b) for d, b in blobs.items()]
-        if self.durable:
-            self._write_manifest()
-            self._retire(priors)
+        and per-put manifests would make that O(n^2).  The whole batch
+        (blobs + manifest) commits under the store lock, so a racing
+        writer's manifest can never drop this batch's docs."""
+        with self._lock:
+            priors = [self._put_blob(d, b) for d, b in blobs.items()]
+            if self.durable:
+                self._write_manifest()
+                self._retire(priors)
 
     def get(self, doc_id):
         """Reads a cold blob WITHOUT removing it -- reload reads first
@@ -241,9 +264,10 @@ class ColdStore(object):
         reload cannot destroy the only copy of a doc.  Durable mode
         verifies the manifest checksum, so a torn or bit-rotted blob
         raises here instead of replaying garbage."""
-        path, n, digest = self._index[doc_id]
-        with open(path, 'rb') as f:
-            data = f.read()
+        with self._lock:
+            path, n, digest = self._index[doc_id]
+            with open(path, 'rb') as f:
+                data = f.read()
         if digest is not None \
                 and hashlib.sha1(data).hexdigest() != digest:
             telemetry.metric('storage.checksum_failed')
@@ -253,19 +277,21 @@ class ColdStore(object):
         return data
 
     def discard(self, doc_id):
-        entry = self._index.pop(doc_id, None)
-        if entry is None:
-            return
-        try:
-            os.unlink(entry[0])
-        except OSError:
-            pass
-        if self.durable:
-            self._write_manifest()
+        with self._lock:
+            entry = self._index.pop(doc_id, None)
+            if entry is None:
+                return
+            try:
+                os.unlink(entry[0])
+            except OSError:
+                pass
+            if self.durable:
+                self._write_manifest()
 
     def pop(self, doc_id):
-        blob = self.get(doc_id)
-        self.discard(doc_id)
+        with self._lock:
+            blob = self.get(doc_id)
+            self.discard(doc_id)
         return blob
 
 
@@ -342,6 +368,16 @@ class DocEvictor(object):
         for d in docs:
             self._lru[d] = True
             self._lru.move_to_end(d)
+
+    def forget(self, doc):
+        """Drops every trace of a doc this replica migrated away
+        (ISSUE 18): LRU slot, GC debt, and any cold copy -- the new
+        owner serves it now, and a stale cold blob here would resurrect
+        pre-migration state on a later reload-on-touch."""
+        self._lru.pop(doc, None)
+        self._gc_debt.pop(doc, None)
+        if doc in self.store:
+            self.store.discard(doc)
 
     def maybe_evict(self, protect=(), pressure=False, max_evict=None):
         """Evicts least-recently-touched docs past the residency cap

@@ -390,6 +390,48 @@ KNOWN_TRACE_KEYS = ('roots', 'propagated', 'rotations')
 #                  shrinking the fleet)
 KNOWN_FLEET_KEYS = ('scrapes', 'scrape_errors')
 
+# fleet-router counters (`telemetry.metric('router.<name>')` call sites
+# in router/gateway.py; routing section: docs/OBSERVABILITY.md),
+# pre-seeded into every bench_block:
+# requests         frames forwarded to an owner replica
+# local            pure commands (ping/metrics/healthz/dump) answered
+#                    from the router process itself
+# split_ops        requests that spanned owners and fanned into
+#                    per-owner sub-requests (apply_batch / doc-set or
+#                    prefix subscribe)
+# parked           frames queued in a per-doc FIFO behind a live
+#                    migration (released in arrival order at commit)
+# redirects        WrongReplica answers re-forwarded to the owner the
+#                    envelope named (bounded by AMTPU_ROUTE_REDIRECTS)
+# upstream_errors  forwards answered with a retryable Overloaded
+#                    envelope because the owner replica was unreachable
+#                    or its connection died mid-request
+# resyncs          migration-handoff resync events staged to
+#                    subscribed connections (their auto-resubscribe
+#                    re-homes the stream on the new owner)
+KNOWN_ROUTER_KEYS = ('requests', 'local', 'split_ops', 'parked',
+                     'redirects', 'upstream_errors', 'resyncs')
+
+# live-migration counters (`telemetry.metric('migrate.<name>')` call
+# sites in scheduler/gateway.py + router/rebalance.py; migration
+# section: docs/OBSERVABILITY.md), pre-seeded into every bench_block:
+# out_docs / out_bytes   docs / handoff bytes a source replica saved
+#                          into the durable handoff store (migrate_out)
+# in_docs / in_bytes     docs / handoff bytes a target replica restored
+#                          (migrate_in; retries re-count)
+# wrong_replica          ops a replica refused with the typed
+#                          WrongReplica envelope (doc migrated away)
+# migrations             docs whose move fully committed (ring override
+#                          installed)
+# failed                 migrations abandoned past the executor deadline
+#                          (drain or migrate_in never completed)
+# errors                 unexpected migrate_out/migrate_in/scan faults
+#                          answered as InternalError
+# rebalance_passes       rebalancer scrape->score->plan passes
+KNOWN_MIGRATE_KEYS = ('out_docs', 'out_bytes', 'in_docs', 'in_bytes',
+                      'wrong_replica', 'migrations', 'failed',
+                      'errors', 'rebalance_passes')
+
 # docs per gateway flush are effectively powers of two: exact log2 bounds
 BATCH_OCCUPANCY_BUCKETS = tuple(float(2 ** i) for i in range(13))
 
@@ -712,6 +754,14 @@ def bench_block():
     fleet.update({k.split('.', 1)[1]: round(v, 6)
                   for k, v in flat.items()
                   if k.startswith('fleet.')})
+    router = {r: 0.0 for r in KNOWN_ROUTER_KEYS}
+    router.update({k.split('.', 1)[1]: round(v, 6)
+                   for k, v in flat.items()
+                   if k.startswith('router.')})
+    migrate = {r: 0.0 for r in KNOWN_MIGRATE_KEYS}
+    migrate.update({k.split('.', 1)[1]: round(v, 6)
+                    for k, v in flat.items()
+                    if k.startswith('migrate.')})
     block = {
         'fallbacks': fallbacks,
         'collect': collect,
@@ -728,6 +778,8 @@ def bench_block():
         'capacity': cap,
         'trace': trc,
         'fleet': fleet,
+        'router': router,
+        'migrate': migrate,
         'device_s': round(flat.get('device.dispatch_sync_s', 0.0), 4),
         'device_dispatches': int(flat.get('device.dispatches', 0)),
         'batch_latency': BATCH_LATENCY.snapshot() or {},

@@ -134,11 +134,43 @@ def fleet_headroom(scrapes):
     return out
 
 
+def fleet_routing(scrapes):
+    """Doc-placement view across the fleet (ISSUE 18): one row per
+    member that serves a ``routing`` healthz section (replicas report
+    owned/disowned docs and migration counters; a router reports ring
+    membership and live migrations), plus a ring-version consistency
+    verdict -- during a rebalance the versions legitimately diverge,
+    and ``consistent`` flips back once every member has seen the
+    latest placement."""
+    rows, versions = [], []
+    for s in scrapes:
+        if 'error' in s:
+            continue
+        rt = (s.get('healthz') or {}).get('routing')
+        if not isinstance(rt, dict):
+            continue
+        row = {'replica_id': rt.get('replica_id') or s.get('replica_id'),
+               'role': rt.get('role', 'replica'),
+               'ring_version': rt.get('ring_version')}
+        for k in ('owned_docs', 'disowned_docs', 'migrations_in',
+                  'migrations_out', 'members', 'overrides',
+                  'migrating_docs'):
+            if k in rt:
+                row[k] = rt[k]
+        rows.append(row)
+        if isinstance(rt.get('ring_version'), int):
+            versions.append(rt['ring_version'])
+    return {'members': rows,
+            'ring_version_min': min(versions) if versions else None,
+            'ring_version_max': max(versions) if versions else None,
+            'consistent': len(set(versions)) <= 1}
+
+
 def fleet_section(scrapes, now_slot=None):
     """The whole fleet view from a list of `scrape()` results: replica
-    roll-call (live/error rows), the merged SLO section, and the
-    headroom table.  Pure given its inputs -- tests and the obs-check
-    gate recompute it from captured scrapes."""
+    roll-call (live/error rows), the merged SLO section, the headroom
+    table, and the routing/placement table.  Pure given its inputs --
+    tests and the obs-check gate recompute it from captured scrapes."""
     errors = [{'url': s['url'], 'error': s['error']}
               for s in scrapes if 'error' in s]
     live = [s for s in scrapes if 'error' not in s]
@@ -148,7 +180,8 @@ def fleet_section(scrapes, now_slot=None):
                          for s in live],
             'errors': errors,
             'slo': fleet_slo_section(scrapes, now_slot=now_slot),
-            'headroom': fleet_headroom(scrapes)}
+            'headroom': fleet_headroom(scrapes),
+            'routing': fleet_routing(scrapes)}
 
 
 def scrape_fleet(urls, timeout=2.0):

@@ -1,0 +1,460 @@
+"""Fleet-router tests (ISSUE 18, docs/SERVING.md routing section):
+consistent-hash ring determinism + minimal disruption, the live
+RouterGateway over real unix sockets (forwarding, split-join,
+proxied-subscribe byte parity), parked-op FIFO during a live
+migration, WrongReplica redirects (router-transparent and
+direct-client), the ColdStore concurrency regression, and a 3-replica
+live end-to-end lane with migrations under concurrent writers.
+"""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from automerge_tpu import telemetry
+from automerge_tpu.errors import WrongReplicaError
+from automerge_tpu.router import (HashRing, MigrationExecutor,
+                                  Rebalancer, RouterGateway)
+from automerge_tpu.scheduler import GatewayServer
+from automerge_tpu.sidecar.client import SidecarClient
+from automerge_tpu.sidecar.server import SidecarBackend
+from automerge_tpu.storage.coldstore import ColdStore
+
+ROOT_ID = '00000000-0000-0000-0000-000000000000'
+
+
+@pytest.fixture(autouse=True)
+def _hygiene():
+    # reset_all, not metrics_reset: the live-gateway lanes bump the
+    # registry histograms (BATCH_OCCUPANCY etc.) that later suites
+    # assert exact counts on
+    telemetry.reset_all()
+    os.environ['AMTPU_FLUSH_DEADLINE_MS'] = '5'
+    yield
+    del os.environ['AMTPU_FLUSH_DEADLINE_MS']
+    telemetry.reset_all()
+
+
+def change(actor, seq, key='k', value=None):
+    return {'actor': actor, 'seq': seq, 'deps': {},
+            'ops': [{'action': 'set', 'obj': ROOT_ID, 'key': key,
+                     'value': value if value is not None
+                     else '%s-%d' % (actor, seq)}]}
+
+
+def _flat():
+    return telemetry.metrics_snapshot()
+
+
+# ---------------------------------------------------------------------------
+# ring lanes
+# ---------------------------------------------------------------------------
+
+def test_ring_deterministic_and_balanced():
+    a = HashRing(['r0', 'r1', 'r2'], vnodes=64)
+    b = HashRing(['r2', 'r0', 'r1'], vnodes=64)
+    docs = ['doc-%d' % i for i in range(500)]
+    pa = {d: a.owner(d) for d in docs}
+    assert pa == {d: b.owner(d) for d in docs}, \
+        'placement must not depend on membership insertion order'
+    by_owner = {}
+    for d, o in pa.items():
+        by_owner[o] = by_owner.get(o, 0) + 1
+    assert set(by_owner) == {'r0', 'r1', 'r2'}
+    assert min(by_owner.values()) > 500 / 3 / 2.5, by_owner
+
+
+def test_ring_minimal_disruption_on_membership_change():
+    ring = HashRing(['r0', 'r1', 'r2'], vnodes=64)
+    docs = ['doc-%d' % i for i in range(500)]
+    before = {d: ring.owner(d) for d in docs}
+    v0 = ring.version
+    assert ring.add('r3') == v0 + 1
+    after = {d: ring.owner(d) for d in docs}
+    moved = [d for d in docs if before[d] != after[d]]
+    # adding one replica of four remaps ~1/4 of the space, never more
+    assert 0 < len(moved) < 500 * 0.45, len(moved)
+    assert all(after[d] == 'r3' for d in moved), \
+        'docs may only move TO the new member'
+    # removing it restores the exact prior placement
+    ring.remove('r3')
+    assert {d: ring.owner(d) for d in docs} == before
+
+
+def test_ring_overrides_and_version():
+    ring = HashRing(['r0', 'r1'], vnodes=32)
+    d = 'doc-x'
+    home = ring.owner(d)
+    other = 'r1' if home == 'r0' else 'r0'
+    v = ring.version
+    assert ring.set_overrides({d: other}) == v + 1
+    assert ring.owner(d) == other
+    assert ring.hash_owner(d) == home
+    # overriding back to the hash home DROPS the override
+    ring.set_overrides({d: home})
+    assert ring.owner(d) == home and ring.overrides() == {}
+    # int ids canonicalize: 5 and 'i:5' are the same doc
+    assert ring.owner(5) == ring.owner('i:5')
+    # removing the override target sends its docs back to hash owners
+    ring.set_overrides({d: other})
+    ring.remove(other)
+    assert ring.overrides() == {}
+
+
+# ---------------------------------------------------------------------------
+# live router harness
+# ---------------------------------------------------------------------------
+
+class Fleet(object):
+    """N in-process replica gateways + one router, torn down in one
+    place.  (Real deployments run replicas as processes; in-process
+    pools are isolated enough for these lanes and keep them fast.)"""
+
+    def __init__(self, tmp, n=2):
+        self.replicas = {}
+        self.gateways = []
+        for i in range(n):
+            path = str(tmp / ('r%d.sock' % i))
+            self.gateways.append(
+                GatewayServer(path, backend=SidecarBackend()).start())
+            self.replicas['r%d' % i] = path
+        self.router_path = str(tmp / 'router.sock')
+        self.router = RouterGateway(self.router_path,
+                                    self.replicas).start()
+
+    def stop(self):
+        self.router.stop()
+        for gw in self.gateways:
+            gw.stop()
+
+
+@pytest.fixture()
+def fleet(tmp_path):
+    f = Fleet(tmp_path, n=2)
+    yield f
+    f.stop()
+
+
+def test_router_forwards_and_answers_pure(fleet):
+    with SidecarClient(sock_path=fleet.router_path) as c:
+        docs = ['doc-%d' % i for i in range(6)]
+        for d in docs:
+            r = c.apply_changes(d, [change('a', 1)])
+            assert r['clock'] == {'a': 1}, r
+        for d in docs:
+            assert c.get_patch(d)['clock'] == {'a': 1}
+        assert c.call('ping') == {'ok': True}
+        hz = c.healthz()
+        assert hz['routing']['role'] == 'router'
+        assert hz['routing']['members'] == ['r0', 'r1']
+    flat = _flat()
+    assert flat.get('router.requests', 0) >= 12
+    assert flat.get('router.local', 0) >= 2
+
+
+def test_router_cross_owner_apply_batch_split_join(fleet):
+    ring = fleet.router.ring
+    docs = ['doc-%d' % i for i in range(12)]
+    owners = {ring.owner(d) for d in docs}
+    assert owners == {'r0', 'r1'}, 'need docs on both replicas'
+    with SidecarClient(sock_path=fleet.router_path) as c:
+        res = c.call('apply_batch',
+                     docs={d: [change('a', 1)] for d in docs})
+        assert set(res) == set(docs)
+        for d in docs:
+            assert res[d]['clock'] == {'a': 1}, (d, res[d])
+    assert _flat().get('router.split_ops', 0) >= 1
+
+
+def test_proxied_subscribe_byte_parity_vs_direct(fleet):
+    """The router forwards upstream frames verbatim: a subscriber via
+    the router reads BYTE-IDENTICAL fan-out frames to one connected
+    directly to the owner replica."""
+    doc = 'parity-doc'
+    owner_path = fleet.replicas[fleet.router.ring.owner(doc)]
+
+    def raw_subscribe(path):
+        s = socket.socket(socket.AF_UNIX)
+        s.connect(path)
+        s.sendall((json.dumps(
+            {'id': 1, 'cmd': 'subscribe', 'doc': doc,
+             'peer': 'p-parity'}) + '\n').encode())
+        f = s.makefile('rb')
+        f.readline()                      # subscribe response
+        return s, f
+
+    with SidecarClient(sock_path=fleet.router_path) as w:
+        w.apply_changes(doc, [change('a', 1)])
+        s_direct, f_direct = raw_subscribe(owner_path)
+        s_router, f_router = raw_subscribe(fleet.router_path)
+        try:
+            for seq in range(2, 6):
+                w.apply_changes(doc, [change('a', seq)])
+            for _ in range(4):
+                direct = f_direct.readline()
+                routed = f_router.readline()
+                assert direct == routed, (direct, routed)
+                assert json.loads(direct)['event'] == 'change'
+        finally:
+            s_direct.close()
+            s_router.close()
+
+
+def test_parked_ops_fifo_during_migration(fleet):
+    """Frames touching a migrating doc park in arrival order and
+    release in the same order at commit: pipelined seqs 2..6 (which
+    MUST apply in order -- automerge rejects seq gaps) all land."""
+    doc = 'parked-doc'
+    with SidecarClient(sock_path=fleet.router_path) as c:
+        c.apply_changes(doc, [change('a', 1)])
+    router = fleet.router
+    router.begin_migration([doc])
+    s = socket.socket(socket.AF_UNIX)
+    s.connect(fleet.router_path)
+    f = s.makefile('rb')
+    try:
+        for seq in range(2, 7):
+            s.sendall((json.dumps(
+                {'id': seq, 'cmd': 'apply_changes', 'doc': doc,
+                 'changes': [change('a', seq)]}) + '\n').encode())
+        deadline = time.time() + 5
+        while _flat().get('router.parked', 0) < 5:
+            assert time.time() < deadline, _flat()
+            time.sleep(0.01)
+        s.settimeout(0.3)
+        with pytest.raises(socket.timeout):
+            s.recv(1)                     # parked: nothing answers
+        s.settimeout(None)
+        router.end_migration([doc])
+        rids = [json.loads(f.readline())['id'] for _ in range(5)]
+        assert rids == [2, 3, 4, 5, 6], rids
+    finally:
+        s.close()
+    with SidecarClient(sock_path=fleet.router_path) as c:
+        assert c.get_patch(doc)['clock'] == {'a': 6}
+
+
+def test_live_migration_moves_doc_and_redirects(fleet, tmp_path):
+    doc = 'mig-doc'
+    router = fleet.router
+    with SidecarClient(sock_path=fleet.router_path) as c:
+        for seq in (1, 2):
+            c.apply_changes(doc, [change('a', seq)])
+        src = router.ring.owner(doc)
+        dst = 'r1' if src == 'r0' else 'r0'
+        ex = MigrationExecutor(router,
+                               handoff_dir=str(tmp_path / 'handoff'))
+        res = ex.migrate([doc], src, dst)
+        assert res['docs'] == [doc] and not res['failed'], res
+        assert router.ring.owner(doc) == dst
+        # the doc keeps serving through the router, history intact
+        r = c.apply_changes(doc, [change('a', 3)])
+        assert r['clock'] == {'a': 3}
+        assert c.get_patch(doc)['clock'] == {'a': 3}
+    flat = _flat()
+    assert flat.get('migrate.migrations', 0) == 1
+    assert flat.get('migrate.out_docs', 0) == 1
+    assert flat.get('migrate.in_docs', 0) == 1
+    # replica-side booking (read the section directly: in-process the
+    # healthz registry is shared, so the router's 'routing' section
+    # shadows the replicas'; real replicas are separate processes)
+    src_gw = fleet.gateways[int(src[1:])]
+    rt = src_gw._routing_section()
+    assert rt['migrations_out'] == 1 and rt['disowned_docs'] == 1
+
+
+def test_router_transparent_redirect_on_stale_ring(fleet, tmp_path):
+    """A doc migrated BEHIND the router's back (stale ring): the old
+    owner answers WrongReplica, the router re-forwards the original
+    frame to the named owner and learns the placement."""
+    doc = 'stale-doc'
+    router = fleet.router
+    with SidecarClient(sock_path=fleet.router_path) as c:
+        c.apply_changes(doc, [change('a', 1)])
+        src = router.ring.owner(doc)
+        dst = 'r1' if src == 'r0' else 'r0'
+        store = str(tmp_path / 'stale-handoff')
+        out = router.control_call(src, 'migrate_out', docs=[doc],
+                                  store_dir=store, new_owner=dst,
+                                  ring_version=99)
+        assert out['migrated'] == [doc], out
+        router.control_call(dst, 'migrate_in', docs=[doc],
+                            store_dir=store, ring_version=99)
+        # ring still says src; the redirect is invisible to the client
+        assert router.ring.owner(doc) == src
+        r = c.apply_changes(doc, [change('a', 2)])
+        assert r['clock'] == {'a': 2}
+        assert router.ring.owner(doc) == dst, \
+            'the WrongReplica envelope must teach the ring'
+    assert _flat().get('router.redirects', 0) >= 1
+
+
+def test_direct_client_wrong_replica_typed_error(fleet, tmp_path):
+    doc = 'direct-doc'
+    router = fleet.router
+    with SidecarClient(sock_path=fleet.router_path) as c:
+        c.apply_changes(doc, [change('a', 1)])
+    src = router.ring.owner(doc)
+    dst = 'r1' if src == 'r0' else 'r0'
+    ex = MigrationExecutor(router,
+                           handoff_dir=str(tmp_path / 'handoff'))
+    assert ex.migrate([doc], src, dst)['docs'] == [doc]
+    cd = SidecarClient(sock_path=fleet.replicas[src])
+    try:
+        cd._max_redirects = 1
+        with pytest.raises(WrongReplicaError) as ei:
+            cd.get_patch(doc)
+        assert ei.value.owner == dst
+        assert isinstance(ei.value.ring_version, int)
+    finally:
+        cd.close()
+    assert _flat().get('sidecar.client.redirects', 0) >= 1
+    assert _flat().get('migrate.wrong_replica', 0) >= 1
+
+
+def test_subscriber_resync_handoff_across_migration(fleet, tmp_path):
+    doc = 'sub-doc'
+    router = fleet.router
+    with SidecarClient(sock_path=fleet.router_path) as w, \
+            SidecarClient(sock_path=fleet.router_path) as sub:
+        w.apply_changes(doc, [change('a', 1)])
+        sub.subscribe(doc, peer='alice')
+        src = router.ring.owner(doc)
+        dst = 'r1' if src == 'r0' else 'r0'
+        ex = MigrationExecutor(router,
+                               handoff_dir=str(tmp_path / 'handoff'))
+        assert ex.migrate([doc], src, dst)['docs'] == [doc]
+        w.apply_changes(doc, [change('a', 2)])
+        e = sub.next_event(timeout=30)
+        while e is not None and not (e['event'] == 'change'
+                                     and e['clock'] == {'a': 2}):
+            e = sub.next_event(timeout=10)
+        assert e is not None, \
+            'subscription must survive migration via resync handoff'
+    assert _flat().get('router.resyncs', 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# rebalancer planning (pure)
+# ---------------------------------------------------------------------------
+
+def _scrape(occ_bytes, top, pressure=0.0):
+    return {'capacity': {
+        'totals': {'arena_bytes': occ_bytes, 'ops': 0},
+        'top': {'arena': top},
+        'headroom': {'pressure': pressure}}}
+
+
+def test_rebalancer_plan_picks_hot_to_cold(tmp_path):
+    router = type('R', (), {'replicas': {'r0': '', 'r1': ''}})()
+    rb = Rebalancer(router, executor=object(), interval_s=999,
+                    topk=2, min_skew=0.5, pressure=0.8)
+    hot = [{'doc': 'h%d' % i, 'arena_bytes': 1000 - i, 'ops': 0,
+            'subscribers': 0} for i in range(6)]
+    plan = rb.plan({'r0': _scrape(10000, hot),
+                    'r1': _scrape(100, [])})
+    assert plan is not None
+    src, dst, victims = plan
+    assert (src, dst) == ('r0', 'r1')
+    assert victims == ['h0', 'h1']
+    # balanced fleet: no plan
+    assert rb.plan({'r0': _scrape(1000, hot),
+                    'r1': _scrape(990, [])}) is None
+    # pressure overrides skew
+    assert rb.plan({'r0': _scrape(1000, hot, pressure=0.95),
+                    'r1': _scrape(990, [])}) is not None
+
+
+# ---------------------------------------------------------------------------
+# ColdStore concurrency regression (the put_many/manifest race)
+# ---------------------------------------------------------------------------
+
+def test_coldstore_concurrent_put_many_manifest_safe(tmp_path):
+    """Migration threads + WAL compaction race put_many/discard; the
+    manifest must stay consistent with the blobs for a FRESH durable
+    recovery."""
+    path = str(tmp_path / 'cold')
+    store = ColdStore(path, durable=True)
+    errors = []
+
+    def writer(w):
+        try:
+            for i in range(20):
+                store.put_many({'w%d-doc%d' % (w, i):
+                                b'blob-%d-%d' % (w, i)})
+                if i % 5 == 4:
+                    store.discard('w%d-doc%d' % (w, i - 2))
+        except Exception as e:          # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer, args=(w,))
+               for w in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors
+    fresh = ColdStore(path, durable=True)
+    assert set(fresh.doc_ids()) == set(store.doc_ids())
+    for d in fresh.doc_ids():
+        assert fresh.get(d) == store.get(d)
+
+
+# ---------------------------------------------------------------------------
+# 3-replica live end-to-end lane
+# ---------------------------------------------------------------------------
+
+def test_three_replica_e2e_with_live_migration(tmp_path):
+    """Concurrent writers through the router while their docs migrate
+    mid-stream: every op acks exactly once, per-doc history complete
+    and in order afterwards."""
+    f = Fleet(tmp_path, n=3)
+    try:
+        router = f.router
+        docs = ['e2e-%d' % i for i in range(6)]
+        n_seq = 12
+        acks = {d: [] for d in docs}
+        errors = []
+
+        def writer(d):
+            try:
+                with SidecarClient(sock_path=f.router_path) as c:
+                    for seq in range(1, n_seq + 1):
+                        r = c.apply_changes(d, [change('w', seq)])
+                        acks[d].append(r['clock']['w'])
+            except Exception as e:      # noqa: BLE001
+                errors.append((d, e))
+
+        threads = [threading.Thread(target=writer, args=(d,))
+                   for d in docs]
+        for t in threads:
+            t.start()
+        # migrate each doc once, mid-stream, round-robin to the
+        # next replica over
+        ex = MigrationExecutor(router,
+                               handoff_dir=str(tmp_path / 'handoff'),
+                               timeout_s=30.0)
+        time.sleep(0.05)
+        for i, d in enumerate(docs):
+            src = router.ring.owner(d)
+            others = [r for r in sorted(f.replicas) if r != src]
+            res = ex.migrate([d], src, others[i % len(others)])
+            assert not res['failed'], res
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, errors
+        # exactly-once, in-order acks; complete history on the owner
+        with SidecarClient(sock_path=f.router_path) as c:
+            for d in docs:
+                assert acks[d] == list(range(1, n_seq + 1)), \
+                    (d, acks[d])
+                assert c.get_patch(d)['clock'] == {'w': n_seq}
+        flat = _flat()
+        assert flat.get('migrate.migrations', 0) == len(docs)
+        assert flat.get('migrate.failed', 0) == 0
+    finally:
+        f.stop()

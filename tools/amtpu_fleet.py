@@ -59,6 +59,25 @@ def render(scrapes, section, out=sys.stdout):
              r.get('pressure') if r.get('pressure') is not None else '-',
              '%ss' % r['exhaustion_s']
              if r.get('exhaustion_s') is not None else '-'))
+    rt = section.get('routing') or {}
+    if rt.get('members'):
+        w('routing: ring v%s..v%s  %s\n'
+          % (rt.get('ring_version_min'), rt.get('ring_version_max'),
+             'consistent' if rt.get('consistent')
+             else 'CONVERGING (rebalance in flight)'))
+        for m in rt['members']:
+            if m.get('role') == 'router':
+                w('  %-24s router  ring v%-4s members=%s overrides=%s'
+                  ' migrating=%s\n'
+                  % (m.get('replica_id'), m.get('ring_version'),
+                     len(m.get('members') or ()), m.get('overrides'),
+                     m.get('migrating_docs')))
+            else:
+                w('  %-24s replica ring v%-4s owned=%-6s disowned=%-4s'
+                  ' mig in/out=%s/%s\n'
+                  % (m.get('replica_id'), m.get('ring_version'),
+                     m.get('owned_docs'), m.get('disowned_docs'),
+                     m.get('migrations_in'), m.get('migrations_out')))
 
 
 def main(argv=None):
