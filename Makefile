@@ -4,7 +4,8 @@
 	clean wheel telemetry-check fallback-check perf-smoke chaos-check \
 	serve-check mesh-check static-check asan-check fanout-check \
 	bench-fanout storage-check obs-check backpressure-check \
-	coldstart-check bench-coldstart capacity-check route-check
+	coldstart-check bench-coldstart capacity-check route-check \
+	failover-check
 
 all: native
 
@@ -70,6 +71,7 @@ check: native
 	$(MAKE) capacity-check
 	$(MAKE) obs-check
 	$(MAKE) route-check
+	$(MAKE) failover-check
 	$(MAKE) mesh-check
 	$(MAKE) asan-check
 	@cp .bench_smoke.json .bench_smoke.prev.json
@@ -217,6 +219,18 @@ asan-check: native
 # BENCH_ROUTER artifact (per-replica ops/s, routed p50/p99, skew).
 route-check: native
 	JAX_PLATFORMS=cpu python tools/route_check.py
+
+# Fleet-failover gate (ISSUE 19, docs/RESILIENCE.md fleet degradation
+# tiers): a supervised 3-replica fleet under zipfian load must survive
+# a SIGKILL of one replica mid-flush -- death detected, docs restored
+# onto survivors from the write-through store, parked frames replayed,
+# a new generation respawned and rejoined pinned -- with exactly-once
+# acks, per-doc byte parity vs a serial replay, subscribers resynced
+# gapless, rebalance draining docs back onto the rejoiner, and
+# fallback.oracle == 0 throughout.  Writes the BENCH_FAILOVER artifact
+# (time-to-detect / time-to-restore / time-to-rejoin, retry counts).
+failover-check: native
+	JAX_PLATFORMS=cpu python tools/failover_check.py
 
 # Mesh-execution gate (ISSUE 7, docs/ARCHITECTURE.md mesh section):
 # MeshDocPool under AMTPU_MESH=4 must serve a mixed real workload with

@@ -56,6 +56,7 @@ PRESEED_BLOCKS = {
     'fleet': 'KNOWN_FLEET_KEYS',
     'router': 'KNOWN_ROUTER_KEYS',
     'migrate': 'KNOWN_MIGRATE_KEYS',
+    'failover': 'KNOWN_FAILOVER_KEYS',
 }
 
 

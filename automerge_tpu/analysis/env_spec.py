@@ -235,6 +235,29 @@ ENV_FLAGS = (
     EnvFlag('AMTPU_REBALANCE_PRESSURE', 'float', 0.8, False,
             'router/rebalance.py (memory pressure on any replica past '
             'which a rebalance triggers regardless of skew)'),
+    # -- fleet failover (ISSUE 19) ------------------------------------------
+    EnvFlag('AMTPU_FLEET_HEARTBEAT_S', 'float', 0.5, False,
+            'router/health.py (seconds between heartbeat probe sweeps '
+            'over the ring members)'),
+    EnvFlag('AMTPU_FLEET_DEADLINE_S', 'float', 0.5, False,
+            'router/health.py (per-probe answer deadline; a hung '
+            'replica counts as a miss)'),
+    EnvFlag('AMTPU_FLEET_MISS_MAX', 'int', 3, False,
+            'router/health.py (consecutive misses before a suspect '
+            'member is declared dead and failed over)'),
+    EnvFlag('AMTPU_FLEET_PARK_S', 'float', 10.0, False,
+            'router/gateway.py (max seconds a frame parks for a '
+            'suspect/dead member before the retryable envelope)'),
+    EnvFlag('AMTPU_FLEET_PARK_MB', 'int', 8, False,
+            'router/gateway.py (byte budget across all fleet-parked '
+            'frames; overflow answers the retryable envelope)'),
+    EnvFlag('AMTPU_FLEET_FLAP_MAX', 'int', 3, False,
+            'router/supervisor.py (lineage deaths before respawns '
+            'stop and the member is quarantined)'),
+    EnvFlag('AMTPU_STORAGE_SYNC', 'bool', False, False,
+            'scheduler/gateway.py (write-through checkpoint every '
+            'acked mutation into the durable store pre-ack; the '
+            'failover byte-parity guarantee rests on it)'),
 )
 
 SPEC = {f.name: f for f in ENV_FLAGS}

@@ -22,6 +22,34 @@ class OverloadedError(AutomergeError):
         self.retry_after_ms = retry_after_ms
 
 
+class ReplicaUnavailableError(AutomergeError):
+    """The fleet router lost its transport to the replica that owns the
+    request's doc mid-flight (docs/SERVING.md failover section): the op
+    MAY not have executed, so the wire envelope (``errorType:
+    "ReplicaUnavailable"``) is retryable -- re-sending the same change
+    is exactly-once under the CRDT's (actor, seq) dedup.
+    ``retry_after_ms`` carries the router's hint; by then the health
+    monitor has either recovered the member or failed its docs over to
+    survivors."""
+
+    def __init__(self, msg, retry_after_ms=None):
+        super().__init__(msg)
+        self.retry_after_ms = retry_after_ms
+
+
+class ReplicaFailedError(AutomergeError):
+    """A replica died and fleet failover could NOT recover this doc
+    (docs/RESILIENCE.md fleet degradation tiers): nothing durable to
+    restore from, or the restore itself failed on every survivor.  The
+    wire envelope (``errorType: "ReplicaFailed"``) names the doc;
+    retrying cannot help -- the caller must treat the doc's
+    unreplicated tail as lost."""
+
+    def __init__(self, msg, doc=None):
+        super().__init__(msg)
+        self.doc = doc
+
+
 class WrongReplicaError(AutomergeError):
     """A replica answered an op for a doc it no longer owns
     (docs/SERVING.md routing section): the doc was migrated away and

@@ -32,6 +32,16 @@ Sites (see docs/RESILIENCE.md for what each models):
                     tempfile exists, the atomic rename has not run --
                     models a kill mid-save; the prior committed copy
                     and the durable manifest must survive)
+  router.forward    router -> replica raw-frame forward (the data
+                    path); the router answers the retryable
+                    ReplicaUnavailable envelope, exactly as a dead
+                    upstream socket would
+  router.heartbeat  router health-monitor probe; `docs` carries the
+                    probed member id so `match` pins the fault to one
+                    replica -- a permanent spec drives the
+                    up -> suspect -> dead -> failover ladder
+                    deterministically, a counted transient spec clears
+                    as a recovery
 
 Arming:
 
@@ -61,7 +71,7 @@ from .utils.common import env_raw, env_str
 SITES = ('native.begin', 'native.mid', 'device.dispatch',
          'device.collect', 'escalation.tier', 'sidecar.frame',
          'checkpoint.load', 'fanout.write', 'fanout.stall',
-         'storage.save')
+         'storage.save', 'router.forward', 'router.heartbeat')
 
 KINDS = ('transient', 'permanent')
 

@@ -8,9 +8,19 @@ consistent-hash ring (:mod:`automerge_tpu.router.ring`), and moves
 hot docs between replicas live
 (:mod:`automerge_tpu.router.rebalance`) without losing, duplicating,
 or reordering a single op.
+
+Failover (ISSUE 19): :mod:`automerge_tpu.router.health` detects
+replica death (heartbeats + transport signals), :mod:`.failover`
+re-places a dead member's docs onto ring survivors from durable
+storage, and :mod:`.supervisor` respawns router-managed replicas with
+capped backoff -- docs/RESILIENCE.md "fleet degradation tiers" is the
+contract.
 """
 
 from .ring import HashRing                      # noqa: F401
 from .gateway import RouterGateway              # noqa: F401
 from .rebalance import (MigrationExecutor,      # noqa: F401
                         Rebalancer)
+from .health import HealthMonitor               # noqa: F401
+from .failover import FailoverExecutor          # noqa: F401
+from .supervisor import ReplicaSupervisor       # noqa: F401

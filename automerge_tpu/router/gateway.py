@@ -35,6 +35,22 @@ the in-flight ops, and only then runs migrate_out/migrate_in -- see
 with the typed ``WrongReplica`` envelope get it re-forwarded to the
 named owner (bounded by ``AMTPU_ROUTE_REDIRECTS``), and the envelope
 teaches the ring the doc's true placement.
+
+Failover (ISSUE 19, docs/RESILIENCE.md fleet degradation tiers): with
+a :class:`~automerge_tpu.router.health.HealthMonitor` attached, an
+unplanned replica death degrades instead of failing -- mutating frames
+for a *suspect* member's docs park in the same per-doc FIFOs (bounded
+by ``AMTPU_FLEET_PARK_MB`` / ``AMTPU_FLEET_PARK_S``), a *dead*
+member's docs are re-placed onto survivors by the
+:class:`~automerge_tpu.router.failover.FailoverExecutor` and the parks
+replay to the new owners, and anything unrecoverable answers the typed
+``ReplicaFailed`` envelope.  In-flight requests on a died upstream
+answer the retryable ``ReplicaUnavailable`` envelope (read-only ones
+park for one transparent post-failover retry instead).  Placement
+survives a ROUTER restart through a small journal
+(``journal_path``): membership + epoch + overrides, rewritten
+atomically on every change, so a reboot never resurrects a dead
+member's stale placement.
 """
 
 import json
@@ -45,18 +61,22 @@ import sys
 import threading
 import time
 
-from .. import telemetry
+from .. import faults, telemetry
 from ..scheduler.egress import EgressQueue
 from ..scheduler.gateway import (BATCH_CMDS, EXEC_CMDS, FANOUT_CMDS,
                                  PURE_CMDS, ROUTER_CMDS, _op_docs)
 from ..scheduler.queue import READ_CMDS
 from ..sidecar.client import SidecarClient
-from ..utils.common import doc_key, env_int
+from ..utils.common import doc_key, env_float, env_int
 from .ring import HashRing
 
 #: commands the router places by doc (everything the replica gateway
 #: itself routes through `_op_docs`)
 ROUTED_CMDS = BATCH_CMDS + EXEC_CMDS + FANOUT_CMDS + READ_CMDS
+
+#: commands that mutate doc state -- the ones fleet-parked while their
+#: owner is suspect (reads still forward: the process may well answer)
+MUTATING_CMDS = BATCH_CMDS + EXEC_CMDS
 
 #: the wildcard pseudo-doc prefix `_op_docs` mints for prefix
 #: subscriptions -- routed by broadcast, never by hash
@@ -197,11 +217,15 @@ class _RouterConn(object):
         return up
 
     def _upstream_dead(self, replica_id):
-        """A replica connection died mid-stream: every pending request
-        routed there answers the RETRYABLE Overloaded envelope (the op
-        may not have executed; the client's retry path -- not a silent
-        drop -- decides).  The next frame for that replica reconnects
-        lazily."""
+        """A replica connection died mid-stream: the health machine is
+        told (transport death suspects the member immediately), then
+        every pending request routed there answers the RETRYABLE typed
+        ``ReplicaUnavailable`` envelope (the op may not have executed;
+        re-sending is exactly-once under seq-dedup, so the client's
+        retry path -- not a silent drop -- decides).  Read-only
+        requests park instead for ONE transparent retry once the
+        failover (or recovery) re-places their docs.  The next frame
+        for that replica reconnects lazily."""
         with self._lock:
             self.upstreams.pop(replica_id, None)
             dead = [(rid, e) for rid, e in self.pending.items()
@@ -210,13 +234,14 @@ class _RouterConn(object):
                 self.pending.pop(rid, None)
         if self.closed or self.router._stopping:
             return
+        self.router._note_transport_death(replica_id)
         for _rid, entry in dead:
             telemetry.metric('router.upstream_errors')
-            self.router._answer_entry(self, entry, {
-                'id': None,
-                'error': 'replica %r connection lost; retry'
-                         % replica_id,
-                'errorType': 'Overloaded', 'retryAfterMs': 100})
+            if self.router._park_read_retry(self, entry, replica_id):
+                continue
+            self.router._answer_entry(
+                self, entry, self.router._replica_unavailable(
+                    replica_id))
 
     # -- reader --------------------------------------------------------
 
@@ -317,10 +342,11 @@ class RouterGateway(object):
     """
 
     def __init__(self, sock_path, replicas, use_msgpack=False,
-                 backlog=128, vnodes=None):
+                 backlog=128, vnodes=None, journal_path=None):
         self.sock_path = sock_path
         self.use_msgpack = use_msgpack
         self.replicas = dict(replicas)
+        self._vnodes = vnodes
         self.ring = HashRing(self.replicas, vnodes=vnodes)
         self.max_redirects = env_int('AMTPU_ROUTE_REDIRECTS', 3)
         self._srv = None
@@ -337,6 +363,22 @@ class RouterGateway(object):
         self._park_lock = threading.Lock()
         self._migrating = {}      # guarded-by: self._park_lock
         self._subs = {}           # guarded-by: self._park_lock
+        # fleet failover (ISSUE 19): `_park_meta` rides the SAME FIFOs
+        # as migration parking but tags each fleet-parked doc with its
+        # suspect member + park clock + byte share, so the health
+        # sweep can expire and the failover executor can replay/fail
+        # exactly the right queues
+        self._park_meta = {}      # guarded-by: self._park_lock
+        self._park_bytes = 0      # guarded-by: self._park_lock
+        self.park_s = env_float('AMTPU_FLEET_PARK_S', 10.0)
+        self.park_bytes_max = \
+            env_int('AMTPU_FLEET_PARK_MB', 8) * (1 << 20)
+        self._health = None       # HealthMonitor.start() attaches
+        self.journal_path = journal_path
+        # membership mutators (add/remove_member) serialize here and
+        # replace `self.replicas` copy-on-write, so lock-free readers
+        # (dispatch, the health prober) always see a coherent dict
+        self._members_lock = threading.Lock()
         # router-owned control clients, one per replica (migrate/healthz
         # RPCs -- never the data path)
         self._control_lock = threading.Lock()
@@ -345,6 +387,7 @@ class RouterGateway(object):
     # -- lifecycle ------------------------------------------------------
 
     def start(self):
+        self._load_journal()
         if os.path.exists(self.sock_path):
             os.unlink(self.sock_path)
         self._srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
@@ -498,7 +541,22 @@ class RouterGateway(object):
                            'errorType': 'InternalError'})
             return
         if len(owners) == 1:
-            self._forward(conn, next(iter(owners)), raw, req, docs,
+            owner = next(iter(owners))
+            if self._health is not None \
+                    and req.get('cmd') in MUTATING_CMDS \
+                    and self._health.is_parking(owner):
+                # suspect owner (ISSUE 19): hold the mutation in the
+                # doc's FIFO -- a recovery releases it unchanged, a
+                # failover replays it at the new owner.  Past the park
+                # budget the retryable envelope answers instead.
+                if self._fleet_park(owner, keys[0], conn, raw, req):
+                    telemetry.metric('router.health.parked')
+                    return
+                telemetry.metric('router.health.park_overflow')
+                conn.send_obj(self._replica_unavailable(
+                    owner, rid=req.get('id')))
+                return
+            self._forward(conn, owner, raw, req, docs,
                           attempts=attempts)
         else:
             self._split(conn, req, owners)
@@ -514,17 +572,19 @@ class RouterGateway(object):
             with conn._lock:
                 conn.pending[rid] = entry
         try:
+            if faults.ARMED:
+                # chaos site (docs/RESILIENCE.md): a fired fault takes
+                # the same exit as a dead upstream socket below
+                faults.fire('router.forward', docs=entry['docs'])
             conn.upstream(replica).send_raw(raw)
             telemetry.metric('router.requests')
-        except (OSError, KeyError) as e:
+        except (OSError, KeyError, faults.InjectedFault) as e:
             if rid is not None:
                 with conn._lock:
                     conn.pending.pop(rid, None)
             telemetry.metric('router.upstream_errors')
-            self._answer_entry(conn, entry, {
-                'id': None,
-                'error': 'replica %r unreachable: %s' % (replica, e),
-                'errorType': 'Overloaded', 'retryAfterMs': 100})
+            self._answer_entry(conn, entry, self._replica_unavailable(
+                replica, detail=str(e)))
 
     def _split(self, conn, req, owners):
         """Cross-owner fan-out: per-owner sub-requests under router
@@ -703,7 +763,9 @@ class RouterGateway(object):
         """Releases each doc's parked FIFO in order, then unmarks it.
         Frames arriving DURING the release still append to the FIFO
         (the doc stays marked until its queue is observed empty under
-        the lock), so claim order is never inverted."""
+        the lock), so claim order is never inverted.  Returns the
+        number of frames released (the failover replay accounting)."""
+        released = 0
         for d in docs:
             key = doc_key(d)
             while True:
@@ -713,19 +775,24 @@ class RouterGateway(object):
                         break
                     if not q:
                         del self._migrating[key]
+                        self._drop_park_meta(key)
                         break
                     conn, raw, req = q.pop(0)
                 if conn.closed:
                     continue
+                released += 1
                 dcs = _op_docs(req.get('cmd'), req) or ()
                 self._dispatch(conn, raw, req, dcs, exclude=(key,))
+        return released
 
-    def notify_migrated(self, docs):
+    def notify_migrated(self, docs, reason='migrated'):
         """Stages the typed resync envelope to every connection
         subscribed to a migrated doc: the client's auto-resubscribe
         re-issues the subscription at its last-seen clock, which this
         router then routes to the NEW owner -- the subscription stream
-        hands off without the client changing."""
+        hands off without the client changing.  Failover passes
+        ``reason='failover'`` (same recovery path, the envelope just
+        says why)."""
         with self._park_lock:
             targets = {}
             for d in docs:
@@ -737,12 +804,249 @@ class RouterGateway(object):
                 continue
             telemetry.metric('router.resyncs', len(ds))
             conn.send_obj({'event': 'resync', 'docs': ds,
-                           'reason': 'migrated'})
+                           'reason': reason})
 
     def _conn_sub_docs(self, conn):
         with self._park_lock:
             return sorted((subs[conn] for subs in self._subs.values()
                            if conn in subs), key=str)
+
+    def subscribed_doc_keys(self):
+        """Canonical keys of every doc any live connection is
+        subscribed to (the failover executor resyncs the subset the
+        dead member owned)."""
+        with self._park_lock:
+            return sorted(self._subs)
+
+    # -- fleet membership + failover (ISSUE 19) --------------------------
+
+    def attach_health(self, monitor):
+        """HealthMonitor.start()/stop() wire themselves here; with no
+        monitor attached the fleet-park and read-retry paths are
+        inert and the router behaves exactly as PR 18 shipped it."""
+        self._health = monitor
+
+    def add_member(self, member, sock_path, pins=None):
+        """Joins one replica to the membership + ring (copy-on-write,
+        journalled).  A supervised respawn rejoins through this as a
+        NEW member id; `pins` ({doc: current_owner}, typically
+        `FailoverExecutor.join_pins()`) holds every known doc at its
+        pre-join owner so the join remaps nothing implicitly -- the
+        rebalancer drains docs onto the joiner via real migrations."""
+        with self._members_lock:
+            replicas = dict(self.replicas)
+            replicas[member] = sock_path
+            self.replicas = replicas
+            if pins:
+                self.ring.add_pinned(member, pins)
+            else:
+                self.ring.add(member)
+            self._save_journal()
+
+    def remove_member(self, member):
+        """Drops one replica from the membership + ring (its overrides
+        fall home), closes its cached control client, and journals the
+        new epoch."""
+        with self._members_lock:
+            replicas = dict(self.replicas)
+            replicas.pop(member, None)
+            self.replicas = replicas
+            self.ring.remove(member)
+            self._save_journal()
+        with self._control_lock:
+            cli = self._control.pop(member, None)
+        if cli is not None:
+            try:
+                cli.close()
+            except Exception:
+                pass
+
+    def _note_transport_death(self, member):
+        if self._health is not None:
+            self._health.note_transport_death(member)
+
+    def _replica_unavailable(self, member, rid=None, detail=None):
+        """The retryable envelope for a member the router cannot reach
+        right now (satellite of ISSUE 19): by ``retryAfterMs`` the
+        health machine has either recovered it or failed it over."""
+        retry_ms = 100
+        if self._health is not None:
+            retry_ms = max(retry_ms, int(1000 * self._health.deadline_s))
+        return {'id': rid,
+                'error': 'replica %r unavailable%s; retry'
+                         % (member,
+                            ' (%s)' % detail if detail else ''),
+                'errorType': 'ReplicaUnavailable',
+                'retryAfterMs': retry_ms}
+
+    @staticmethod
+    def _replica_failed(member, doc, rid=None):
+        """The terminal per-doc envelope: the member died and failover
+        could not recover this doc from anything durable."""
+        return {'id': rid,
+                'error': 'doc %r lost with replica %r (nothing '
+                         'durable to restore)' % (doc, member),
+                'errorType': 'ReplicaFailed', 'doc': doc}
+
+    def _fleet_park(self, member, key, conn, raw, req):
+        """Parks one frame in `key`'s FIFO on behalf of a suspect/dead
+        `member`; False when the byte budget is exhausted (the caller
+        answers the retryable envelope instead)."""
+        with self._park_lock:
+            if self._park_bytes + len(raw) > self.park_bytes_max:
+                return False
+            self._migrating.setdefault(key, []).append(
+                (conn, raw, req))
+            meta = self._park_meta.setdefault(
+                key, {'since': time.monotonic(), 'bytes': 0,
+                      'member': member})
+            meta['bytes'] += len(raw)
+            self._park_bytes += len(raw)
+        return True
+
+    def _park_read_retry(self, conn, entry, member):
+        """A read-only request whose upstream died parks for ONE
+        transparent retry after the failover (or recovery) re-places
+        its doc -- the client never sees the blip.  Anything already
+        retried, split, or doc-less answers the envelope instead."""
+        if self._health is None \
+                or entry['req'].get('cmd') not in READ_CMDS \
+                or entry['attempts'] > 0 \
+                or entry.get('join') is not None \
+                or len(entry['docs']) != 1:
+            return False
+        if not self._health.is_parking(member):
+            return False
+        if not self._fleet_park(member, entry['docs'][0], conn,
+                                entry['raw'], entry['req']):
+            return False
+        telemetry.metric('failover.retried_reads')
+        return True
+
+    def _drop_park_meta(self, key):  # holds-lock: self._park_lock
+        meta = self._park_meta.pop(key, None)
+        if meta is not None:
+            self._park_bytes -= meta['bytes']
+
+    def parked_docs_for(self, member):
+        """Doc keys currently fleet-parked on behalf of `member`, in
+        park order (the failover executor's replay/fail worklist)."""
+        with self._park_lock:
+            got = [(meta['since'], key)
+                   for key, meta in self._park_meta.items()
+                   if meta['member'] == member]
+        return [key for _t, key in sorted(got)]
+
+    def release_member_parks(self, member):
+        """A suspect member recovered: replay its parked frames to it,
+        in arrival order, unchanged."""
+        return self.release_parked(self.parked_docs_for(member))
+
+    def release_parked(self, docs):
+        """Replays parked FIFOs through normal dispatch (post-failover
+        the ring now names the new owners).  Returns frames released."""
+        return self.end_migration(docs)
+
+    def fail_parked(self, docs, member):
+        """Flushes parked FIFOs with the terminal ``ReplicaFailed``
+        envelope -- the docs were on `member` and nothing durable
+        could restore them.  Returns frames answered."""
+        failed = 0
+        for key in docs:
+            while True:
+                with self._park_lock:
+                    q = self._migrating.get(key)
+                    if q is None:
+                        break
+                    if not q:
+                        del self._migrating[key]
+                        self._drop_park_meta(key)
+                        break
+                    conn, _raw, req = q.pop(0)
+                failed += 1
+                if not conn.closed:
+                    conn.send_obj(self._replica_failed(
+                        member, key, rid=req.get('id')))
+        return failed
+
+    def sweep_parked(self):
+        """Expires fleet parks older than ``AMTPU_FLEET_PARK_S`` with
+        the retryable envelope (the health monitor calls this each
+        tick): a wedged failover must not hold client frames hostage
+        forever."""
+        now = time.monotonic()
+        with self._park_lock:
+            expired = [(key, meta['member'])
+                       for key, meta in self._park_meta.items()
+                       if now - meta['since'] > self.park_s]
+        for key, member in expired:
+            while True:
+                with self._park_lock:
+                    q = self._migrating.get(key)
+                    if q is None:
+                        break
+                    if not q:
+                        del self._migrating[key]
+                        self._drop_park_meta(key)
+                        break
+                    conn, _raw, req = q.pop(0)
+                telemetry.metric('router.health.park_expired')
+                if not conn.closed:
+                    conn.send_obj(self._replica_unavailable(
+                        member, rid=req.get('id')))
+
+    def park_stats(self):
+        with self._park_lock:
+            return {'parked_docs': len(self._park_meta),
+                    'parked_bytes': self._park_bytes}
+
+    # -- placement journal (ISSUE 19 satellite) --------------------------
+
+    def _save_journal(self):
+        """Atomically rewrites the placement journal: membership (with
+        socket paths), epoch, overrides.  Cheap (one small JSON) and
+        only on membership/placement changes, never the data path."""
+        if self.journal_path is None:
+            return
+        data = {'epoch': self.ring.version,
+                'members': dict(self.replicas),
+                'overrides': self.ring.overrides()}
+        tmp = self.journal_path + '.tmp'
+        try:
+            with open(tmp, 'w') as f:
+                json.dump(data, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.journal_path)
+        except OSError as e:
+            print('router: journal write failed: %s' % e,
+                  file=sys.stderr)
+
+    def _load_journal(self):
+        """Restores journalled placement at start(): the journal's
+        membership REPLACES the constructor seed (a member failed over
+        before the restart must stay gone), overrides re-apply, and
+        the epoch floors the ring version so it stays monotonic across
+        the reboot."""
+        if self.journal_path is None \
+                or not os.path.exists(self.journal_path):
+            return
+        try:
+            with open(self.journal_path) as f:
+                data = json.load(f)
+            members = data.get('members')
+            if not isinstance(members, dict) or not members:
+                raise ValueError('no members in journal')
+        except (OSError, ValueError) as e:
+            print('router: ignoring unreadable journal %r: %s'
+                  % (self.journal_path, e), file=sys.stderr)
+            return
+        self.replicas = dict(members)
+        self.ring = HashRing(self.replicas, vnodes=self._vnodes)
+        overrides = data.get('overrides')
+        if isinstance(overrides, dict) and overrides:
+            self.ring.set_overrides(overrides)
+        self.ring.set_version_floor(int(data.get('epoch') or 0))
 
     # -- control plane ---------------------------------------------------
 

@@ -111,6 +111,9 @@ class MigrationExecutor(object):
             if restored:
                 ring.set_overrides({d: dst for d in restored})
                 telemetry.metric('migrate.migrations', len(restored))
+                # placement changed: journal it so a router restart
+                # serves the post-migration placement (ISSUE 19)
+                self.router._save_journal()
         finally:
             # parked frames release in arrival order even on failure:
             # ring placement decides where they go (committed moves ->

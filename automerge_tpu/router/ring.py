@@ -71,6 +71,23 @@ class HashRing(object):
             self.version += 1
             return self.version
 
+    def add_pinned(self, member, placements):
+        """Membership add + override batch in ONE atomic version bump:
+        a (re)joining member must not implicitly remap docs that live
+        elsewhere -- a request routed to the empty joiner would CREATE
+        a fresh doc and fork history.  The caller pins every known doc
+        to its pre-join owner (`placements`); pins matching the post
+        -join hash owner drop (nothing remapped there), the rest hold
+        the doc where its state is until the rebalancer migrates it
+        over for real."""
+        with self._lock:
+            if member not in self._members:
+                self._members.add(member)
+                self._rebuild()
+            self._apply_overrides(placements)
+            self.version += 1
+            return self.version
+
     def remove(self, member):
         """Removes a replica and every override pointing at it (its
         docs fall back to hash ownership); bumps the ring version."""
@@ -117,27 +134,41 @@ class HashRing(object):
                 i = 0
             return self._owners[i]
 
+    def _apply_overrides(self, placements):  # holds-lock: self._lock
+        for doc, member in placements.items():
+            key = doc_key(doc)
+            i = bisect.bisect_right(self._points, _hash64(key)) \
+                if self._points else 0
+            home = self._owners[i % len(self._owners)] \
+                if self._owners else None
+            if member == home:
+                self._overrides.pop(key, None)
+            else:
+                self._overrides[key] = member
+
     def set_overrides(self, placements):
         """Records migrated placements ({doc: replica}); an override
         matching the doc's hash owner is dropped instead of stored (the
         doc went home).  One version bump for the whole batch."""
         with self._lock:
-            for doc, member in placements.items():
-                key = doc_key(doc)
-                i = bisect.bisect_right(self._points, _hash64(key)) \
-                    if self._points else 0
-                home = self._owners[i % len(self._owners)] \
-                    if self._owners else None
-                if member == home:
-                    self._overrides.pop(key, None)
-                else:
-                    self._overrides[key] = member
+            self._apply_overrides(placements)
             self.version += 1
             return self.version
 
     def overrides(self):
         with self._lock:
             return dict(self._overrides)
+
+    def set_version_floor(self, version):
+        """Monotonic floor for the membership epoch: the router's
+        placement journal restores it across a restart, so a rebooted
+        router never hands out an epoch older than the failovers it
+        already committed (replicas compare epochs to spot stale
+        placement)."""
+        with self._lock:
+            if int(version) > self.version:
+                self.version = int(version)
+            return self.version
 
     def stats(self):
         with self._lock:

@@ -313,14 +313,25 @@ class GatewayServer(object):
     uses.
     """
 
+    # class-level default: skeleton instances (``__new__`` in tests)
+    # drive _run_batch/_run_exec without the ctor ever running
+    _sync_store = None
+
     def __init__(self, sock_path, use_msgpack=False, backend=None,
-                 queue=None, backlog=128):
+                 queue=None, backlog=128, sync_dir=None):
         if backend is None:
             from ..sidecar.server import SidecarBackend
             backend = SidecarBackend()
         self.sock_path = sock_path
         self.use_msgpack = use_msgpack
         self.backend = backend
+        # write-through checkpointing (ISSUE 19): with AMTPU_STORAGE_SYNC
+        # (or an explicit `sync_dir` -- in-process test fleets share one
+        # env), every acked mutation is saved to a durable ColdStore
+        # BEFORE the response goes out, so "acked" implies "restorable"
+        # -- the property fleet failover's byte-parity gate rests on
+        self._sync_dir = sync_dir
+        self._sync_store = None
         self.queue = queue if queue is not None else AdmissionQueue()
         self.backlog = backlog
         # one pool, many threads: inline reads and the dispatcher's
@@ -366,6 +377,10 @@ class GatewayServer(object):
         self.storage_tier = DocEvictor.from_env(self.backend.pool)
         telemetry.register_healthz_section(
             'storage', self.storage_tier.healthz_section)
+        if self._sync_dir or env_bool('AMTPU_STORAGE_SYNC', False):
+            from ..storage.coldstore import ColdStore
+            self._sync_store = ColdStore(self._sync_dir or None,
+                                         durable=True)
         if env_bool('AMTPU_FANOUT', True):
             from ..sync.fanout import FanoutEngine
             self.fanout = FanoutEngine(self.backend.pool,
@@ -974,6 +989,10 @@ class GatewayServer(object):
         for op in ops:
             if op.clock is not None:
                 op.clock.mark_split('dispatch', 'collect', collect_s)
+        # write-through (ISSUE 19): checkpoint every mutated doc BEFORE
+        # any response goes out -- an acked change must be restorable
+        if self._sync_store is not None:
+            self._sync_save(list(merged))
         flush_id = getattr(fsp, 'span_id', None)
         for op in ops:
             if op.cmd == 'apply_changes':
@@ -1038,6 +1057,9 @@ class GatewayServer(object):
         resp = self.backend.handle(op.req)
         if op.clock is not None:
             op.clock.mark('dispatch')
+        if self._sync_store is not None and 'error' not in resp \
+                and op.cmd in BATCH_CMDS + EXEC_CMDS:
+            self._sync_save(op.docs)
         if fan is not None and op.cmd in BATCH_CMDS + EXEC_CMDS:
             if 'error' not in resp:
                 result = resp.get('result')
@@ -1213,6 +1235,26 @@ class GatewayServer(object):
             return {'id': rid,
                     'error': '%s: %s' % (type(e).__name__, e),
                     'errorType': 'InternalError'}
+
+    def _sync_save(self, docs):
+        """Write-through checkpoint (AMTPU_STORAGE_SYNC): saves each
+        just-mutated doc into the durable sync store in one batched
+        manifest commit.  Runs pre-ack under pool_lock; a per-doc save
+        failure only skips that doc (counted) -- the response path is
+        never the place to invent new errors for committed changes."""
+        from ..utils.common import doc_key
+        blobs = {}
+        for d in docs:
+            try:
+                blobs[doc_key(d)] = self.backend.pool.save(d)
+            except Exception:
+                telemetry.metric('storage.sync_failed')
+        if blobs:
+            try:
+                self._sync_store.put_many(blobs)
+                telemetry.metric('storage.sync_saves', len(blobs))
+            except Exception:
+                telemetry.metric('storage.sync_failed', len(blobs))
 
     def _migrate_out(self, docs, store_dir, new_owner, ring_version):
         """save -> durable put_many -> drop: checkpoints each doc into

@@ -666,7 +666,9 @@ class SidecarClient:
         resp = self._roundtrip(req)
         if 'error' in resp:
             from ..errors import (AutomergeError, OverloadedError,
-                                  RangeError, WrongReplicaError)
+                                  RangeError, ReplicaFailedError,
+                                  ReplicaUnavailableError,
+                                  WrongReplicaError)
             types = {'AutomergeError': AutomergeError,
                      'RangeError': RangeError, 'TypeError': TypeError,
                      'KeyError': KeyError}
@@ -677,6 +679,14 @@ class SidecarClient:
                 raise WrongReplicaError(
                     resp['error'], owner=resp.get('owner'),
                     ring_version=resp.get('ringVersion'))
+            if resp.get('errorType') == 'ReplicaUnavailable':
+                # retryable (fleet failover in progress); re-sending the
+                # same change is exactly-once under (actor, seq) dedup
+                raise ReplicaUnavailableError(resp['error'],
+                                              resp.get('retryAfterMs'))
+            if resp.get('errorType') == 'ReplicaFailed':
+                raise ReplicaFailedError(resp['error'],
+                                         doc=resp.get('doc'))
             raise types.get(resp.get('errorType'), AutomergeError)(
                 resp['error'])
         return resp['result']

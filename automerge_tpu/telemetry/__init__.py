@@ -320,6 +320,10 @@ KNOWN_EGRESS_KEYS = ('staged_frames', 'staged_bytes', 'writes',
 #                              (doc skipped, restore continues)
 # restore.failed             docs whose decode/apply raised (skipped
 #                              via the resilience path)
+# sync_saves                 docs write-through checkpointed pre-ack
+#                              (AMTPU_STORAGE_SYNC; acked => durable)
+# sync_failed                write-through saves that raised (doc
+#                              skipped; the ack still goes out)
 KNOWN_STORAGE_KEYS = ('columnar.encodes', 'columnar.decodes',
                       'columnar.changes', 'columnar.residual_changes',
                       'columnar.bytes_in', 'columnar.bytes_out',
@@ -338,7 +342,8 @@ KNOWN_STORAGE_KEYS = ('columnar.encodes', 'columnar.decodes',
                       'gc.clocks_folded',
                       'restore.docs', 'restore.bytes',
                       'restore.batches', 'restore.corrupt',
-                      'restore.failed')
+                      'restore.failed',
+                      'sync_saves', 'sync_failed')
 
 # flight-recorder counters (`telemetry.metric('recorder.<name>')` call
 # sites in telemetry/recorder.py; event catalog: docs/OBSERVABILITY.md),
@@ -409,8 +414,50 @@ KNOWN_FLEET_KEYS = ('scrapes', 'scrape_errors')
 # resyncs          migration-handoff resync events staged to
 #                    subscribed connections (their auto-resubscribe
 #                    re-homes the stream on the new owner)
+# health.probes        heartbeat pings the fleet health monitor sent
+# health.misses        probe deadlines missed or transport deaths
+#                        reported (each feeds the per-member machine)
+# health.suspects      up -> suspect transitions (first miss)
+# health.deaths        suspect/up -> dead transitions (miss ladder,
+#                        transport storm, or supervisor kill report)
+# health.recoveries    suspect -> up transitions (a probe answered
+#                        again; that member's parked frames replay)
+# health.parked        mutating frames parked for a suspect/dead
+#                        member's docs (released or failed by the
+#                        failover executor)
+# health.park_overflow frames refused the park because the
+#                        AMTPU_FLEET_PARK_MB byte budget was full
+#                        (answered with the retryable envelope)
+# health.park_expired  parked frames flushed with the retryable
+#                        envelope after AMTPU_FLEET_PARK_S (a wedged
+#                        failover must not hold clients hostage)
 KNOWN_ROUTER_KEYS = ('requests', 'local', 'split_ops', 'parked',
-                     'redirects', 'upstream_errors', 'resyncs')
+                     'redirects', 'upstream_errors', 'resyncs',
+                     'health.probes', 'health.misses',
+                     'health.suspects', 'health.deaths',
+                     'health.recoveries', 'health.parked',
+                     'health.park_overflow', 'health.park_expired')
+
+# fleet-failover counters (`telemetry.metric('failover.<name>')` call
+# sites in router/failover.py, router/supervisor.py, router/gateway.py;
+# docs/RESILIENCE.md fleet degradation tiers), pre-seeded into every
+# bench_block:
+# failovers       dead members the executor finished re-placing
+# docs_recovered  docs restored onto survivors from the dead member's
+#                   durable store (exactly-once under (actor,seq) dedup)
+# docs_lost       docs with nothing durable to restore (their parked
+#                   frames answered the terminal ReplicaFailed envelope)
+# replayed        parked frames released (or failed) by a failover
+# rejoins         supervised respawns that joined the ring as a new
+#                   generation member
+# respawns        supervisor respawn attempts (capped backoff)
+# quarantined     lineages barred from respawn after
+#                   AMTPU_FLEET_FLAP_MAX deaths
+# retried_reads   read-only frames whose upstream died mid-flight and
+#                   were parked for one transparent post-failover retry
+KNOWN_FAILOVER_KEYS = ('failovers', 'docs_recovered', 'docs_lost',
+                       'replayed', 'rejoins', 'respawns',
+                       'quarantined', 'retried_reads')
 
 # live-migration counters (`telemetry.metric('migrate.<name>')` call
 # sites in scheduler/gateway.py + router/rebalance.py; migration
@@ -762,6 +809,10 @@ def bench_block():
     migrate.update({k.split('.', 1)[1]: round(v, 6)
                     for k, v in flat.items()
                     if k.startswith('migrate.')})
+    failover = {r: 0.0 for r in KNOWN_FAILOVER_KEYS}
+    failover.update({k.split('.', 1)[1]: round(v, 6)
+                     for k, v in flat.items()
+                     if k.startswith('failover.')})
     block = {
         'fallbacks': fallbacks,
         'collect': collect,
@@ -780,6 +831,7 @@ def bench_block():
         'fleet': fleet,
         'router': router,
         'migrate': migrate,
+        'failover': failover,
         'device_s': round(flat.get('device.dispatch_sync_s', 0.0), 4),
         'device_dispatches': int(flat.get('device.dispatches', 0)),
         'batch_latency': BATCH_LATENCY.snapshot() or {},

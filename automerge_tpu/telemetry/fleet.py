@@ -166,22 +166,58 @@ def fleet_routing(scrapes):
             'consistent': len(set(versions)) <= 1}
 
 
+def fleet_health(scrapes):
+    """Member liveness across the fleet (ISSUE 19): the router's
+    healthz ``fleet_health`` section (per-member up/suspect/dead/
+    quarantined state from the heartbeat monitor + current park
+    budget) merged across whichever scraped processes serve one --
+    normally just the router; rows from several routers union."""
+    members = {}
+    park = {'parked_docs': 0, 'parked_bytes': 0}
+    seen = False
+    for s in scrapes:
+        if 'error' in s:
+            continue
+        fh = (s.get('healthz') or {}).get('fleet_health')
+        if not isinstance(fh, dict):
+            continue
+        seen = True
+        members.update(fh.get('members') or {})
+        park['parked_docs'] += int(fh.get('parked_docs') or 0)
+        park['parked_bytes'] += int(fh.get('parked_bytes') or 0)
+    if not seen:
+        return None
+    states = [m.get('state') for m in members.values()]
+    out = {'members': members,
+           'up': states.count('up'),
+           'suspect': states.count('suspect'),
+           'dead': states.count('dead'),
+           'quarantined': states.count('quarantined')}
+    out.update(park)
+    return out
+
+
 def fleet_section(scrapes, now_slot=None):
     """The whole fleet view from a list of `scrape()` results: replica
     roll-call (live/error rows), the merged SLO section, the headroom
-    table, and the routing/placement table.  Pure given its inputs --
+    table, the routing/placement table, and (when a router is in the
+    scrape set) the member-liveness table.  Pure given its inputs --
     tests and the obs-check gate recompute it from captured scrapes."""
     errors = [{'url': s['url'], 'error': s['error']}
               for s in scrapes if 'error' in s]
     live = [s for s in scrapes if 'error' not in s]
-    return {'replicas': [{'replica_id': s.get('replica_id'),
-                          'url': s['url'],
-                          'uptime_s': s.get('uptime_s')}
-                         for s in live],
-            'errors': errors,
-            'slo': fleet_slo_section(scrapes, now_slot=now_slot),
-            'headroom': fleet_headroom(scrapes),
-            'routing': fleet_routing(scrapes)}
+    out = {'replicas': [{'replica_id': s.get('replica_id'),
+                         'url': s['url'],
+                         'uptime_s': s.get('uptime_s')}
+                        for s in live],
+           'errors': errors,
+           'slo': fleet_slo_section(scrapes, now_slot=now_slot),
+           'headroom': fleet_headroom(scrapes),
+           'routing': fleet_routing(scrapes)}
+    health = fleet_health(scrapes)
+    if health is not None:
+        out['health'] = health
+    return out
 
 
 def scrape_fleet(urls, timeout=2.0):

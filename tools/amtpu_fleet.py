@@ -78,6 +78,17 @@ def render(scrapes, section, out=sys.stdout):
                   % (m.get('replica_id'), m.get('ring_version'),
                      m.get('owned_docs'), m.get('disowned_docs'),
                      m.get('migrations_in'), m.get('migrations_out')))
+    fh = section.get('health')
+    if fh:
+        w('health: %d up / %d suspect / %d dead / %d quarantined'
+          '  parked %d docs (%s)\n'
+          % (fh['up'], fh['suspect'], fh['dead'], fh['quarantined'],
+             fh['parked_docs'], _fmt_mb(fh['parked_bytes'])))
+        for m, st in sorted(fh['members'].items()):
+            if st.get('state') != 'up':
+                w('  %-24s %-11s misses=%-3s for %ss\n'
+                  % (m, st.get('state'), st.get('misses'),
+                     st.get('for_s')))
 
 
 def main(argv=None):

@@ -1,0 +1,290 @@
+"""Fleet-failover gate (ISSUE 19, docs/RESILIENCE.md fleet degradation
+tiers): a 3-replica supervised fleet under zipfian load must survive a
+SIGKILL of one replica mid-flush with NO lost or duplicated acks, come
+back byte-identical to a serial replay, resync its subscribers
+gapless, and drain docs back onto the respawned generation.
+
+One continuous scenario against REAL replica server subprocesses
+spawned by the in-process :class:`ReplicaSupervisor` (write-through
+stores, ``AMTPU_STORAGE_SYNC=1``), fronted by an in-process
+:class:`RouterGateway` + :class:`HealthMonitor` + :class:`FailoverExecutor`:
+
+  1. **warmup** -- zipfian writers land phase-1 streams; a subscriber
+     attaches to the hottest victim-owned doc.
+  2. **SIGKILL mid-flush** -- phase-2 writers are mid-stream when the
+     victim replica is SIGKILLed.  The supervisor reports the exit,
+     the health machine declares it dead, the failover executor
+     restores its docs onto the survivors from its write-through
+     store, parked frames replay, and the supervisor respawns a new
+     generation that rejoins pinned (nothing implicitly remapped).
+     Gates: every in-flight and subsequent request is answered within
+     the park window (writers finish; retryable envelopes only --
+     ``requests_failed_hard == 0``); exactly-once in-order acks per
+     doc; ``fallback.oracle == 0`` on every live replica.
+  3. **parity + resync + drain-back** -- every doc's final patch is
+     byte-identical to the same streams replayed serially through ONE
+     single-pool server (zero duplicate applies under (actor, seq)
+     dedup); the subscriber observed the failover resync and reads
+     through to the final clock gapless; a rebalance pass migrates
+     >= 1 doc onto the rejoined generation and writes keep landing.
+
+Writes ``BENCH_FAILOVER_r19.json`` (time-to-detect / time-to-restore /
+time-to-rejoin, retry counts, recovered/lost/replayed).
+
+Run: JAX_PLATFORMS=cpu python tools/failover_check.py  (make failover-check)
+"""
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from route_check import (change, pctl, serial_replay,  # noqa: E402
+                         zipf_seqs)
+
+N_REPLICAS = 3
+N_DOCS = 15
+N_WRITERS = 5
+PHASE1_OPS = 120
+PHASE2_OPS = 150
+DETECT_GATE_S = 15.0      # generous: CI boxes stall; the distribution
+RESTORE_GATE_S = 30.0     # is what the artifact is for
+
+
+def run_writers(router_path, streams, acks, retries, errors):
+    """route_check's writer loop, plus a per-writer count of retryable
+    answers (Overloaded / ReplicaUnavailable) -- the gate's
+    ``requests_failed`` distribution.  Anything non-retryable is a
+    hard failure."""
+    from automerge_tpu.errors import (OverloadedError,
+                                      ReplicaUnavailableError)
+    from automerge_tpu.sidecar.client import SidecarClient
+
+    def writer(w):
+        try:
+            mine = [(d, s) for i, (d, chs) in enumerate(streams)
+                    for s in chs if i % N_WRITERS == w]
+            with SidecarClient(sock_path=router_path) as c:
+                for doc, ch in mine:
+                    while True:
+                        try:
+                            r = c.apply_changes(doc, [ch])
+                        except (OverloadedError,
+                                ReplicaUnavailableError) as e:
+                            retries.append((doc, ch['seq']))
+                            time.sleep((e.retry_after_ms or 50)
+                                       / 1000.0)
+                            continue
+                        assert r['clock']['w-%s' % doc] == ch['seq'], \
+                            'ack clock %r for %s seq %d' \
+                            % (r['clock'], doc, ch['seq'])
+                        acks.setdefault(doc, []).append(ch['seq'])
+                        break
+        except Exception as e:      # noqa: BLE001
+            errors.append('writer %d: %s: %s'
+                          % (w, type(e).__name__, e))
+
+    threads = [threading.Thread(target=writer, args=(w,))
+               for w in range(N_WRITERS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    if errors:
+        raise AssertionError('writers failed hard: %s' % errors)
+
+
+def poll(cond, deadline_s, what):
+    t0 = time.time()
+    while not cond():
+        if time.time() - t0 > deadline_s:
+            raise AssertionError('timed out (%.0fs) on %s'
+                                 % (deadline_s, what))
+        time.sleep(0.02)
+    return time.time() - t0
+
+
+def main():
+    from automerge_tpu import telemetry
+    from automerge_tpu.router import (FailoverExecutor, HealthMonitor,
+                                      ReplicaSupervisor, RouterGateway)
+    from automerge_tpu.router.rebalance import (MigrationExecutor,
+                                                Rebalancer)
+    from automerge_tpu.sidecar.client import SidecarClient
+    tmp = tempfile.mkdtemp(prefix='amtpu-failover-')
+    bench = {'replicas': N_REPLICAS, 'docs': N_DOCS}
+    router_path = os.path.join(tmp, 'router.sock')
+    router = RouterGateway(
+        router_path, {},
+        journal_path=os.path.join(tmp, 'placement.json')).start()
+    ex = FailoverExecutor(router)
+    hm = HealthMonitor(router, heartbeat_s=0.1, deadline_s=0.5,
+                       miss_max=3, on_dead=ex.fail_over).start()
+    sup = ReplicaSupervisor(
+        router, tmp, health=hm, failover=ex,
+        spawn_env={'AMTPU_FLUSH_DEADLINE_MS': '5',
+                   'AMTPU_CAPACITY_REFRESH_S': '0',
+                   'JAX_PLATFORMS': 'cpu'}).start()
+    try:
+        sup.spawn_fleet(N_REPLICAS)
+        ring = router.ring
+        docs = ['doc-%03d' % i for i in range(N_DOCS)]
+        seqs1 = zipf_seqs(docs, PHASE1_OPS)
+        seqs2 = zipf_seqs(docs, PHASE2_OPS)
+
+        # -- phase 1: warmup under zipfian load ------------------------
+        acks1, retries1, errs1 = {}, [], []
+        streams1 = [(d, [change(d, s) for s in range(1, seqs1[d] + 1)])
+                    for d in docs]
+        run_writers(router_path, streams1, acks1, retries1, errs1)
+        victim = ring.owner(docs[0])    # owner of the hottest doc
+        victim_docs = [d for d in docs if ring.owner(d) == victim]
+        sub_doc = victim_docs[0]
+        total = {d: seqs1[d] + seqs2[d] for d in docs}
+
+        # subscriber on a victim-owned doc: the client auto-resubscribes
+        # through the failover resync envelope; reading to the final
+        # clock proves the stream re-homed gapless
+        sub = SidecarClient(sock_path=router_path)
+        sub.subscribe(sub_doc, peer='failover-watch')
+
+        # -- phase 2: SIGKILL the victim mid-flush ---------------------
+        acks2, retries2, errs2 = {}, [], []
+        streams2 = [(d, [change(d, s)
+                         for s in range(seqs1[d] + 1, total[d] + 1)])
+                    for d in docs]
+        load = threading.Thread(
+            target=run_writers,
+            args=(router_path, streams2, acks2, retries2, errs2))
+        load.start()
+        time.sleep(0.3)                 # writers are mid-stream
+        t_kill = time.time()
+        sup.proc(victim).kill()
+        detect_s = poll(lambda: hm.state(victim) == 'dead',
+                        DETECT_GATE_S, 'death detection')
+        restore_s = poll(lambda: victim not in router.replicas,
+                         RESTORE_GATE_S, 'failover completion')
+        rejoin_s = poll(
+            lambda: any(m.endswith('-g1') for m in router.replicas),
+            60, 'supervised respawn rejoin')
+        load.join(timeout=300)
+        assert not errs2, 'hard failures under failover: %s' % errs2
+        joiner = [m for m in router.replicas if m.endswith('-g1')][0]
+
+        # exactly-once, in-order acks across the kill (retries that
+        # re-sent an already-applied change deduped on (actor, seq))
+        for d in docs:
+            want = list(range(seqs1[d] + 1, total[d] + 1))
+            assert acks2[d] == want, \
+                'ack stream for %s lost/dup/reordered: %r' \
+                % (d, acks2[d])
+        print('failover-check: SIGKILL survived (detect %.2fs, '
+              'restore %.2fs, rejoin %.2fs as %s; %d retried '
+              'requests, 0 hard failures)'
+              % (detect_s, restore_s, rejoin_s, joiner, len(retries2)))
+
+        # -- every doc answerable + byte parity vs serial replay -------
+        finals = {}
+        with SidecarClient(sock_path=router_path) as c:
+            for d in docs:
+                finals[d] = c.get_patch(d)
+                assert finals[d]['clock'] == {'w-%s' % d: total[d]}, \
+                    'clock for %s: %r (duplicate or lost applies)' \
+                    % (d, finals[d]['clock'])
+        _, serial_finals = serial_replay(tmp, total)
+        for d in docs:
+            assert json.dumps(finals[d], sort_keys=True) == \
+                json.dumps(serial_finals[d], sort_keys=True), \
+                'final patch divergence on %s after failover' % d
+        print('failover-check: parity OK (%d docs byte-identical to '
+              'serial replay; every doc answerable)' % N_DOCS)
+
+        # -- subscriber resynced gapless -------------------------------
+        deadline = time.time() + 60
+        seen = {}
+        while seen.get('w-%s' % sub_doc, 0) < total[sub_doc]:
+            assert time.time() < deadline, \
+                'subscriber never reached the final clock: %r' % seen
+            e = sub.next_event(timeout=30)
+            if e and e.get('event') == 'change':
+                for a, s in (e.get('clock') or {}).items():
+                    seen[a] = max(seen.get(a, 0), s)
+        sub.close()
+        flat = telemetry.metrics_snapshot()
+        assert flat.get('router.resyncs', 0) >= 1, \
+            'failover staged no subscriber resync'
+        print('failover-check: subscriber resynced gapless to clock '
+              '%d on %s' % (total[sub_doc], sub_doc))
+
+        # -- rebalance drains docs back onto the rejoiner --------------
+        executor = MigrationExecutor(
+            router, handoff_dir=os.path.join(tmp, 'handoff'),
+            timeout_s=60.0)
+        rebalancer = Rebalancer(router, executor=executor,
+                                interval_s=3600, topk=4,
+                                min_skew=0.2, pressure=0.8)
+        drained = 0
+        for _ in range(4):
+            res = rebalancer.scan()
+            if res is None:
+                break
+            assert not res['failed'], res
+            drained += sum(1 for d in res['docs']
+                           if router.ring.owner(d) == joiner)
+        assert drained >= 1, \
+            'rebalancer drained nothing onto the rejoiner %s' % joiner
+        moved_doc = next(d for d in docs
+                         if router.ring.owner(d) == joiner)
+        with SidecarClient(sock_path=router_path) as c:
+            r = c.apply_changes(
+                moved_doc, [change(moved_doc, total[moved_doc] + 1)])
+            assert r['clock']['w-%s' % moved_doc] == \
+                total[moved_doc] + 1
+        print('failover-check: rebalance drained %d docs onto %s, '
+              'writes landing' % (drained, joiner))
+
+        # -- oracle stays cold on every live replica -------------------
+        for member, path in sorted(router.replicas.items()):
+            with SidecarClient(sock_path=path) as c:
+                sched = c.healthz()['scheduler']
+                assert sched['fallback_oracle'] == 0, \
+                    'fallback.oracle != 0 on %s: %r' % (member, sched)
+
+        bench['detect_s'] = round(detect_s, 3)
+        bench['restore_s'] = round(restore_s, 3)
+        bench['rejoin_s'] = round(rejoin_s, 3)
+        bench['requests_retried'] = len(retries2)
+        bench['requests_retried_p99_per_doc'] = pctl(
+            sorted(sum(1 for rd, _ in retries2 if rd == d)
+                   for d in docs), 0.99)
+        bench['requests_failed_hard'] = 0
+        bench['victim_docs'] = len(victim_docs)
+        bench['drained_to_rejoiner'] = drained
+        for k in ('failovers', 'docs_recovered', 'docs_lost',
+                  'replayed', 'rejoins', 'respawns'):
+            bench[k] = int(flat.get('failover.%s' % k, 0))
+        assert bench['docs_lost'] == 0, bench
+    finally:
+        sup.stop()
+        hm.stop()
+        router.stop()
+
+    bench['ts'] = time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())
+    bench['cores'] = os.cpu_count() or 1
+    out = os.path.join(REPO, 'BENCH_FAILOVER_r19.json')
+    with open(out, 'w') as f:
+        json.dump(bench, f, indent=2, sort_keys=True)
+        f.write('\n')
+    print('failover-check: wrote %s' % out)
+    print('FAILOVER-CHECK GREEN')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

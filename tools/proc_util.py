@@ -1,0 +1,82 @@
+"""Shared subprocess plumbing for the fleet CI gates (route-check,
+failover-check): replica spawn-and-wait, one process-tree teardown
+ladder, and the loud single-core skip convention for timing gates.
+
+Every gate that SIGKILLs or respawns replica servers must tear the
+whole tree down through `stop_server`/`stop_all` -- an orphaned
+replica holding its unix socket makes the NEXT arm flaky in a way
+that only reproduces on loaded CI machines.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def spawn_server(path, extra_env=None, deadline_s=60):
+    """Spawns one replica server subprocess on `path` and waits for
+    its socket to appear (or raises, reaping the child)."""
+    if os.path.exists(path):
+        os.unlink(path)           # a stale socket from a killed proc
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS='cpu')
+    env.update(extra_env or {})
+    proc = subprocess.Popen(
+        [sys.executable, '-m', 'automerge_tpu.sidecar.server',
+         '--socket', path], env=env, cwd=REPO)
+    deadline = time.time() + deadline_s
+    while not os.path.exists(path):
+        if time.time() > deadline or proc.poll() is not None:
+            stop_server(proc)
+            raise RuntimeError('replica server did not come up '
+                               '(rc=%s)' % proc.returncode)
+        time.sleep(0.05)
+    return proc
+
+
+def stop_server(proc):
+    """terminate -> wait -> kill -> wait: the one teardown ladder.
+    Safe on already-dead processes."""
+    if proc is None or proc.poll() is not None:
+        return
+    try:
+        proc.terminate()
+    except OSError:
+        pass
+    try:
+        proc.wait(timeout=30)
+    except subprocess.TimeoutExpired:
+        try:
+            proc.kill()
+        except OSError:
+            pass
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            pass
+
+
+def stop_all(procs):
+    """Tears down every process in a dict/list, best-effort, never
+    raising -- gates call this from `finally`."""
+    vals = procs.values() if hasattr(procs, 'values') else procs
+    for proc in list(vals):
+        try:
+            stop_server(proc)
+        except Exception:
+            pass
+
+
+def single_core_skip(check, gate_desc, cores=None):
+    """True (and prints the loud skip line, mesh-check precedent) when
+    the machine has one core: timing gates assert nothing there, but
+    the measured numbers still land in the JSON artifact."""
+    cores = cores if cores is not None else (os.cpu_count() or 1)
+    if cores >= 2:
+        return False
+    print('%s: %s gate SKIPPED (1 physical core; measured values '
+          'recorded in the JSON)' % (check, gate_desc),
+          file=sys.stderr)
+    return True
