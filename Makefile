@@ -5,7 +5,7 @@
 	serve-check mesh-check static-check asan-check fanout-check \
 	bench-fanout storage-check obs-check backpressure-check \
 	coldstart-check bench-coldstart capacity-check route-check \
-	failover-check
+	failover-check readpath-check
 
 all: native
 
@@ -65,6 +65,7 @@ check: native
 	$(MAKE) chaos-check
 	$(MAKE) serve-check
 	$(MAKE) fanout-check
+	$(MAKE) readpath-check
 	$(MAKE) backpressure-check
 	$(MAKE) storage-check
 	$(MAKE) coldstart-check
@@ -117,6 +118,16 @@ serve-check: native
 # smoke gate, and fallback.oracle == 0.
 fanout-check: native
 	JAX_PLATFORMS=cpu python tools/fanout_check.py
+
+# Read-path gate (ISSUE 20, docs/SERVING.md read path): patch-mode
+# fan-out must beat change shipping on thin-client apply CPU with both
+# end states byte-identical to the get_patch oracle, a ReadReplica
+# must stay inside its staleness SLO under writer churn and close a
+# forced gap via resync, a snapshot cold-open must be byte-identical
+# to a full history replay (repeat fetch cache-hit), and
+# fallback.oracle == 0.  Writes BENCH_READPATH_r20.json.
+readpath-check: native
+	JAX_PLATFORMS=cpu python tools/readpath_check.py
 
 # Backpressure gate (ISSUE 13, docs/SERVING.md backpressure section):
 # one deliberately wedged consumer while 32 healthy connections stream

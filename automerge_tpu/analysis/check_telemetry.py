@@ -57,6 +57,7 @@ PRESEED_BLOCKS = {
     'router': 'KNOWN_ROUTER_KEYS',
     'migrate': 'KNOWN_MIGRATE_KEYS',
     'failover': 'KNOWN_FAILOVER_KEYS',
+    'readview': 'KNOWN_READVIEW_KEYS',
 }
 
 

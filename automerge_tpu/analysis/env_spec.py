@@ -258,6 +258,19 @@ ENV_FLAGS = (
             'scheduler/gateway.py (write-through checkpoint every '
             'acked mutation into the durable store pre-ack; the '
             'failover byte-parity guarantee rests on it)'),
+    # -- read path (patch shipping / replicas / snapshots) ------------------
+    EnvFlag('AMTPU_READ_PATCH', 'bool', True, False,
+            'sync/fanout.py (0 refuses mode:"patch" subscriptions '
+            'with a typed RangeError; change-mode fan-out unaffected)'),
+    EnvFlag('AMTPU_READ_SNAPSHOT_CACHE', 'int', 64, False,
+            'readview/snapshot.py (max resident frontier-clock-keyed '
+            'container blobs, LRU)'),
+    EnvFlag('AMTPU_READ_STALENESS_SLO_S', 'float', 5.0, False,
+            'readview/replica.py (seconds a replica doc may lag the '
+            'upstream frontier before a forced catch-up)'),
+    EnvFlag('AMTPU_READ_RESYNC_S', 'float', 2.0, False,
+            'readview/replica.py (staleness probe cadence against the '
+            'upstream get_clock frontier)'),
 )
 
 SPEC = {f.name: f for f in ENV_FLAGS}

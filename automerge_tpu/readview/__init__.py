@@ -1,0 +1,30 @@
+"""Read-path subsystem (ISSUE 20, ROADMAP #4; docs/SERVING.md read
+path): the read tier over the write tier the fleet PRs built.
+
+Three pieces:
+
+  * **Server-side patch shipping** -- subscriptions with
+    ``mode: "patch"`` receive the flush's server-computed patch (the
+    pool's per-doc apply result, byte-identical to the serial frontend
+    oracle) instead of change bytes, fanned through the existing
+    encode-once FanoutEngine/egress tiers (`sync/fanout.py` +
+    `scheduler/gateway.py` own the hot path; this package owns the
+    client/replica halves).
+  * **Materialized read replicas** (`replica.py`,
+    `tools/amtpu_replica.py`) -- a subscriber-mode process consuming
+    the fan-out stream into its own queryable pool, serving
+    get_patch/snapshot/healthz on a read-only listener, with per-doc
+    staleness as an SLO surface and resync-based catch-up.
+  * **Snapshot serving** (`snapshot.py` + the ``snapshot`` protocol
+    command) -- a doc's v2 container bytes, cache-keyed by frontier
+    clock, as the CDN-able cold-open artifact.
+
+`events.py` holds the typed client-side event objects
+`SidecarClient.next_event()` demuxes into (dict subclasses, so
+existing ``ev['event']`` consumers are untouched).
+"""
+
+from .events import (ChangeEvent, PatchEvent, PresenceEvent,  # noqa: F401
+                     QuarantinedEvent, ResyncEvent, Snapshot,
+                     typed_event)
+from .snapshot import SnapshotCache  # noqa: F401

@@ -318,13 +318,20 @@ class GatewayServer(object):
     _sync_store = None
 
     def __init__(self, sock_path, use_msgpack=False, backend=None,
-                 queue=None, backlog=128, sync_dir=None):
+                 queue=None, backlog=128, sync_dir=None,
+                 read_only=False):
         if backend is None:
             from ..sidecar.server import SidecarBackend
             backend = SidecarBackend()
         self.sock_path = sock_path
         self.use_msgpack = use_msgpack
         self.backend = backend
+        # read-only listener (ISSUE 20): a materialized read replica
+        # serves get_patch/snapshot/healthz off its own pool but must
+        # refuse mutations -- writes belong to the authoritative
+        # gateway (readview/replica.py applies upstream fan-out frames
+        # in-process, under pool_lock, never through the socket)
+        self.read_only = read_only
         # write-through checkpointing (ISSUE 19): with AMTPU_STORAGE_SYNC
         # (or an explicit `sync_dir` -- in-process test fleets share one
         # env), every acked mutation is saved to a durable ColdStore
@@ -563,6 +570,18 @@ class GatewayServer(object):
         if cmd in PURE_CMDS:
             conn.send(self.backend.handle(req))
             return
+        if self.read_only and (cmd in BATCH_CMDS or cmd in EXEC_CMDS
+                               or cmd in ROUTER_CMDS):
+            # a read replica's listener refuses mutations with a typed
+            # envelope naming the reason -- silently applying them
+            # would fork the replica's view from the authoritative doc
+            telemetry.metric('readview.read_only_refused')
+            conn.send({'id': rid,
+                       'error': '%s refused: this is a read-only '
+                                'replica (writes go to the '
+                                'authoritative gateway)' % cmd,
+                       'errorType': 'ReadOnly'})
+            return
         if cmd in ROUTER_CMDS:
             docs = req.get('docs')
             if not isinstance(docs, list) or not docs or any(
@@ -778,7 +797,7 @@ class GatewayServer(object):
                 # originator (conn, submitted-clock) for echo
                 # suppression
                 fan = {'updates': {}, 'quarantined': {}, 'enq': {},
-                       'origins': {}, 'traces': {}} \
+                       'origins': {}, 'traces': {}, 'patches': {}} \
                     if self.fanout is not None else None
                 if batch:
                     self._run_batch(batch, fsp, fan)
@@ -1116,7 +1135,16 @@ class GatewayServer(object):
         quarantined ones -- and the originating request's trace id, so
         fan-out event frames are correlatable with the request's
         cross-process trace tree (the per-doc FIFO admits one op per doc
-        per flush, so the doc's originating trace is unique)."""
+        per flush, so the doc's originating trace is unique).
+
+        For mutations whose result IS the per-doc patch (the pool's
+        apply output, byte-identical to the serial backend), the patch
+        is also captured into ``fan['patches']`` -- computed exactly
+        once per dirty doc, it is what patch-mode subscriptions fan
+        instead of change bytes (ISSUE 20).  `load` results are
+        excluded: their diffs describe a restore against EMPTY state,
+        not a delta an exact subscriber could apply incrementally (the
+        engine falls back to a full-state patch for those docs)."""
         if doc is None:
             return
         tctx = op.req.get('trace')
@@ -1125,6 +1153,14 @@ class GatewayServer(object):
         if is_quarantined(result):
             fan['quarantined'][doc] = result
         else:
+            if op.cmd in ('apply_changes', 'apply_batch',
+                          'apply_local_change') \
+                    and isinstance(result, dict) \
+                    and 'diffs' in result:
+                fan['patches'][doc] = {
+                    k: result[k] for k in ('clock', 'deps', 'canUndo',
+                                           'canRedo', 'diffs')
+                    if k in result}
             clock = result.get('clock') \
                 if isinstance(result, dict) else None
             if clock is None:
@@ -1164,17 +1200,23 @@ class GatewayServer(object):
                     raise RangeError('subscribe clock must be a '
                                      '{actor: seq} map')
                 backfill = bool(req.get('backfill', True))
+                mode = req.get('mode') or 'change'
                 if prefix is not None and doc_set is None:
+                    if mode != 'change':
+                        raise RangeError('prefix subscriptions do not '
+                                         'support mode=%r (attach doc '
+                                         'subscriptions for patch '
+                                         'shipping)' % (mode,))
                     res = self.fanout.subscribe_prefix(peer, prefix,
                                                        transport)
                 elif doc_set is not None:
                     res = self.fanout.subscribe_many(
                         peer, doc_set, clock, transport,
-                        backfill=backfill)
+                        backfill=backfill, mode=mode)
                 else:
                     res = self.fanout.subscribe(
                         peer, op.docs[0], clock, transport,
-                        backfill=backfill)
+                        backfill=backfill, mode=mode)
             elif op.cmd == 'unsubscribe':
                 if prefix is not None and doc_set is None:
                     removed = self.fanout.unsubscribe_prefix(peer,
@@ -1366,7 +1408,8 @@ class GatewayServer(object):
                 self.fanout.on_flush(fan['updates'],
                                      fan['quarantined'], fan['enq'],
                                      fan['origins'],
-                                     traces=fan['traces'])
+                                     traces=fan['traces'],
+                                     patches=fan['patches'])
         except Exception as e:
             # fan-out failures must never re-answer (or hang) the
             # flush's already-answered requests

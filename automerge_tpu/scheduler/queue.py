@@ -57,7 +57,8 @@ def max_batch_ops():
 #: read queues behind another read).  Owned here so the two users
 #: cannot drift.
 READ_CMDS = ('get_patch', 'save', 'get_missing_deps',
-             'get_missing_changes', 'get_changes_for_actor')
+             'get_missing_changes', 'get_changes_for_actor',
+             'snapshot', 'get_clock')
 
 
 class Overloaded(Exception):

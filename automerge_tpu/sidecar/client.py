@@ -517,11 +517,12 @@ class SidecarClient:
             resync = None
             with self._resp_cond:
                 if isinstance(resp, dict) and 'event' in resp:
-                    if resp['event'] == 'change' \
+                    if resp['event'] in ('change', 'patch') \
                             and isinstance(resp.get('clock'), dict):
                         # track where each subscription stands so a
                         # resync can resubscribe at the last-seen
                         # clock instead of refetching full history
+                        # (patch frames carry the same post clock)
                         self._sub_clocks[resp.get('doc')] = \
                             dict(resp['clock'])
                     elif resp['event'] == 'resync' \
@@ -609,7 +610,9 @@ class SidecarClient:
         """Backfill changes from an auto-resubscribe surface as
         synthetic change events (marked ``"resync": true``) so
         `next_event` consumers see a gapless stream -- including the
-        per-doc backfills of doc-set and prefix subscriptions."""
+        per-doc backfills of doc-set and prefix subscriptions.  A
+        patch-mode resubscribe's full-state backfill surfaces the same
+        way, as a ``full: true`` patch event (ISSUE 20)."""
         if not isinstance(res, dict):
             return
         per_doc = res.get('docs') if isinstance(res.get('docs'), dict) \
@@ -618,10 +621,17 @@ class SidecarClient:
             per_doc = {kw.get('doc'): res}
         evs = []
         for d, r in per_doc.items():
-            if isinstance(r, dict) and r.get('changes'):
+            if not isinstance(r, dict):
+                continue
+            if r.get('changes'):
                 evs.append({'event': 'change', 'doc': d,
                             'clock': r.get('clock'),
                             'changes': r['changes'], 'resync': True})
+            elif r.get('patch') is not None:
+                evs.append({'event': 'patch', 'doc': d,
+                            'clock': r.get('clock'),
+                            'patch': r['patch'], 'full': True,
+                            'resync': True})
         if evs:
             with self._resp_cond:
                 self._events.extend(evs)
@@ -629,15 +639,18 @@ class SidecarClient:
 
     def next_event(self, timeout=None):
         """Blocks for the next unsolicited fan-out event frame
-        (``{"event": "change"|"presence"|"quarantined", "doc": ...}``;
-        docs/SERVING.md fan-out section).  Returns None on timeout."""
+        (``{"event": "change"|"patch"|"presence"|"quarantined",
+        "doc": ...}``; docs/SERVING.md fan-out section), wrapped in its
+        typed class (`readview.events` -- dict subclasses, so string
+        demux keeps working).  Returns None on timeout."""
+        from ..readview.events import typed_event
         self._ensure_pump()
         deadline = None if timeout is None \
             else time.monotonic() + timeout
         with self._resp_cond:
             while True:
                 if self._events:
-                    return self._events.popleft()
+                    return typed_event(self._events.popleft())
                 if self._rx_exc is not None:
                     raise ConnectionError(
                         'sidecar transport failed: %s' % self._rx_exc)
@@ -826,10 +839,26 @@ class SidecarClient:
         return self.call('get_missing_changes', doc=doc,
                          have_deps=have_deps)
 
+    def get_clock(self, doc):
+        """Cheap frontier probe ({'clock', 'deps'}, no
+        materialization) -- what a read replica polls to measure
+        believed-vs-auth staleness (ISSUE 20)."""
+        return self.call('get_clock', doc=doc)
+
+    def snapshot(self, doc):
+        """The doc's v2 container bytes at its current frontier, as a
+        typed `readview.events.Snapshot` (``.data`` decodes the
+        base64; ``.clock`` is the cache key -- equal clocks mean
+        byte-identical artifacts).  The CDN-able cold-open path: load
+        the bytes with ``load`` into any pool instead of replaying
+        history (ISSUE 20)."""
+        from ..readview.events import Snapshot
+        return Snapshot(self.call('snapshot', doc=doc))
+
     # -- fan-out subscription surface (gateway socket mode) --------------
 
     def subscribe(self, doc=None, clock=None, peer=None, backfill=True,
-                  docs=None, prefix=None):
+                  docs=None, prefix=None, mode=None):
         """Subscribes this connection (optionally as named `peer`) to
         flush fan-out; returns the backfill ``{"doc", "clock",
         "changes"}``.  Event frames then arrive via `next_event()`.
@@ -839,7 +868,13 @@ class SidecarClient:
         ``docs=[...]`` subscribes every listed doc in one request
         (result: ``{"docs": {doc: backfill}}``), ``prefix="ws/"``
         follows every current AND future doc under the prefix.  The
-        subscription is recorded for resync auto-resubscribe."""
+        subscription is recorded for resync auto-resubscribe.
+
+        ``mode="patch"`` (ISSUE 20) asks for server-computed patch
+        frames instead of change bytes -- the thin-client protocol;
+        the backfill is then ``{"doc", "clock", "patch"}`` and
+        auto-resubscribe preserves the mode across resyncs (the
+        recorded kwargs carry it)."""
         self._ensure_pump()
         kwargs = {'clock': clock or {}}
         if doc is not None:
@@ -852,6 +887,8 @@ class SidecarClient:
             kwargs['peer'] = peer
         if not backfill:
             kwargs['backfill'] = False
+        if mode is not None:
+            kwargs['mode'] = mode
         res = self.call('subscribe', **kwargs)
         with self._resp_cond:
             self._subs[(doc, tuple(docs) if docs else None, prefix,

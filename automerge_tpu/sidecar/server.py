@@ -34,10 +34,19 @@ Requests (fields beyond `cmd`/`id` per command):
   {"id": 10, "cmd": "metrics"}
   {"id": 11, "cmd": "healthz"}
   {"id": 12, "cmd": "subscribe",   "doc": d, "clock": {...}, "peer": p?}
-      (doc-set/wildcard shapes: "docs": [d, ...] or "prefix": "ws/")
+      (doc-set/wildcard shapes: "docs": [d, ...] or "prefix": "ws/";
+       "mode": "patch" flips the subscription to server-computed patch
+       frames -- ISSUE 20, docs/SERVING.md read path)
   {"id": 13, "cmd": "unsubscribe", "doc": d, "peer": p?}
   {"id": 14, "cmd": "presence",    "doc": d, "state": ..., "peer": p?}
   {"id": 15, "cmd": "dump"}
+  {"id": 16, "cmd": "snapshot",    "doc": d}
+      -> {"doc": d, "clock": {...}, "snapshot_b64": <v2 container>}
+      (cache-keyed by frontier clock: an unchanged doc answers the
+       same CDN-able artifact without rebuilding it)
+  {"id": 17, "cmd": "get_clock",   "doc": d}
+      (the cheap frontier probe -- no materialization; read replicas
+       measure believed-vs-auth staleness with it)
 
 `dump` writes the always-on flight recorder's event ring as JSONL
 (docs/OBSERVABILITY.md) and answers {"path": ..., "events": n}; the
@@ -106,6 +115,10 @@ class SidecarBackend:
             from ..native import make_pool
             pool = make_pool()
         self.pool = pool
+        # frontier-clock-keyed v2 container memo for the `snapshot`
+        # command (ISSUE 20; readview/snapshot.py)
+        from ..readview.snapshot import SnapshotCache
+        self._snapshots = SnapshotCache()
 
     # -- commands -------------------------------------------------------
 
@@ -145,6 +158,26 @@ class SidecarBackend:
                 raise RangeError('checkpoint data is not valid base64')
         return self.pool.load(doc, data)
 
+    def get_clock(self, doc):
+        """Cheap frontier probe: the doc's {actor: seq} clock with no
+        materialization -- the staleness measurement a read replica
+        polls (ISSUE 20)."""
+        return self.pool.get_clock(doc)
+
+    def snapshot(self, doc):
+        """The doc's v2 container bytes, cache-keyed by frontier clock
+        (ISSUE 20 tentpole, piece c): a cold-opening client loads ONE
+        CDN-able artifact instead of replaying history, and an
+        unchanged doc serves the same bytes without rebuilding."""
+        import base64
+        clock = self.pool.get_clock(doc).get('clock') or {}
+        data = self._snapshots.get(doc, clock,
+                                   lambda: self.pool.save(doc))
+        telemetry.metric('readview.snapshots_served')
+        return {'doc': doc, 'clock': clock,
+                'snapshot_b64':
+                    base64.b64encode(data).decode('ascii')}
+
     def get_missing_deps(self, doc):
         return self.pool.get_missing_deps(doc)
 
@@ -164,7 +197,7 @@ class SidecarBackend:
                 'get_missing_deps', 'get_missing_changes',
                 'get_changes_for_actor', 'metrics', 'healthz', 'dump',
                 'subscribe', 'unsubscribe', 'presence',
-                'migrate_out', 'migrate_in')
+                'migrate_out', 'migrate_in', 'snapshot', 'get_clock')
 
     def handle(self, req):
         """Wraps dispatch in the per-request telemetry: a span resuming
@@ -215,6 +248,10 @@ class SidecarBackend:
                 result = self.save(req['doc'])
             elif cmd == 'load':
                 result = self.load(req['doc'], req['data'])
+            elif cmd == 'snapshot':
+                result = self.snapshot(req['doc'])
+            elif cmd == 'get_clock':
+                result = self.get_clock(req['doc'])
             elif cmd == 'get_missing_deps':
                 result = self.get_missing_deps(req['doc'])
             elif cmd == 'get_missing_changes':

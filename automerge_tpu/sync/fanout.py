@@ -45,6 +45,8 @@ Wire surface (gateway socket mode; docs/SERVING.md):
 
   {"cmd": "subscribe",   "doc": d, "clock": {...}, "peer": label?}
       -> {"result": {"doc": d, "clock": {...}, "changes": [...]}}
+  {"cmd": "subscribe",   "doc": d, "mode": "patch", ...}   (ISSUE 20)
+      -> {"result": {"doc": d, "clock": {...}, "patch": {...}}}
   {"cmd": "subscribe",   "docs": [d, ...], "clock": {...}}      (doc set)
       -> {"result": {"docs": {d: {...backfill...}}}}
   {"cmd": "subscribe",   "prefix": "ws/"}                      (wildcard)
@@ -56,10 +58,28 @@ Event frames (no ``id``; clients demux by the ``event`` key):
 
   {"event": "change", "doc": d, "clock": {...}, "changes": [...],
    "presence": {peer: state}?}
+  {"event": "patch", "doc": d, "clock": {...}, "patch": {...},
+   "full": bool}                (mode=patch subscribers; ISSUE 20 --
+                                 full=true replaces the client's view)
   {"event": "presence", "doc": d, "presence": {peer: state}}
   {"event": "quarantined", "doc": d, "error": ..., "errorType": ...}
   {"event": "resync", "docs": [...], "reason": "slow-consumer",
    "retryAfterMs": n}          (egress tier 2; docs/RESILIENCE.md)
+
+Patch shipping (ISSUE 20, docs/SERVING.md read path): a subscription
+registered with ``mode: "patch"`` receives the flush's SERVER-COMPUTED
+patch (the pool's per-doc apply result -- byte-identical to the serial
+frontend oracle by the pool's parity contract) instead of change
+bytes, so a thin client applies views with no CRDT engine.  The patch
+is captured once per dirty doc by the gateway (`fan['patches']`),
+encoded once, and fanned through the exact same egress tiers; ALL
+patch-mode stragglers (diverged believed clocks -- an incremental
+patch assumes exactly pre-flush state) share ONE full-state
+``pool.get_patch`` frame marked ``full: true``, and a patch-mode
+subscribe backfill is that same full-state patch.  Believed/acked
+clock accounting (and the shed -> regress -> heal ladder) is
+mode-agnostic.  ``AMTPU_READ_PATCH=0`` refuses patch-mode subscribes
+with a RangeError.
 
 `AMTPU_FANOUT_VECTOR=0` flips classification to the per-peer scalar
 dict loop (the reference shape) -- the parity oracle for tests and the
@@ -166,6 +186,13 @@ class FanoutEngine(object):
         self._presence = {}       # guarded-by: self._lock
         # -- wildcard/prefix subscriptions (ISSUE 13 satellite) --
         self._prefix_subs = {}    # guarded-by: self._lock
+        # -- patch-mode rows (ISSUE 20): rows absent here are change
+        # mode; membership decides which frame shape a row stages --
+        self._patch_rows = set()  # guarded-by: self._lock
+        # full-state patch memo: doc -> (auth-clock key, patch) so a
+        # flush's patch-mode stragglers and a resubscribe stampede pay
+        # the pool materialization ONCE per authoritative state
+        self._patch_memo = {}     # guarded-by: self._lock
         # -- subscribe-backfill memo: (doc, clock) -> (auth, changes),
         # so a reconnect stampede of peers sharing a clock fetches the
         # missing-changes walk ONCE (validated against the live auth
@@ -225,7 +252,8 @@ class FanoutEngine(object):
 
     # -- subscription management ---------------------------------------
 
-    def subscribe(self, peer, doc_id, clock, send, backfill=True):
+    def subscribe(self, peer, doc_id, clock, send, backfill=True,
+                  mode='change'):
         """Registers/refreshes `peer`'s subscription to `doc_id` with
         its advertised believed clock and returns the backfill: the
         authoritative clock plus every change the peer is missing
@@ -236,15 +264,37 @@ class FanoutEngine(object):
         ``backfill=False`` registers the subscription at the advertised
         clock WITHOUT shipping history -- the peer is then a straggler
         the next flush serves through the per-peer filter (test and
-        resume-elsewhere hook)."""
+        resume-elsewhere hook).
+
+        ``mode="patch"`` (ISSUE 20) flips the row to server-computed
+        patch frames; the backfill is then a full-state ``patch``
+        (there is no incremental patch against an arbitrary advertised
+        clock) instead of a ``changes`` list."""
+        if mode not in ('change', 'patch'):
+            from ..errors import RangeError
+            raise RangeError("subscribe mode must be 'change' or "
+                             "'patch', not %r" % (mode,))
+        if mode == 'patch' and not env_bool('AMTPU_READ_PATCH', True):
+            from ..errors import RangeError
+            raise RangeError('patch-mode subscriptions are disabled '
+                             'on this server (AMTPU_READ_PATCH=0)')
         auth = self._pool.get_clock(doc_id).get('clock') or {}
         changes = []
+        patch = None
         if backfill and auth:
-            changes = self._memoized_backfill(doc_id, clock, auth)
+            if mode == 'patch':
+                patch = self._memoized_full_patch(doc_id, auth)
+            else:
+                changes = self._memoized_backfill(doc_id, clock, auth)
         with self._lock:
             row = self._peer_row.get((peer, doc_id))
             if row is None:
                 row = self._alloc_row(peer, doc_id)
+            if mode == 'patch':
+                self._patch_rows.add(row)
+                telemetry.metric('sync.fanout.patch_subscribes')
+            else:
+                self._patch_rows.discard(row)
             # refresh the doc's authoritative row: the engine's pre
             # -flush baseline must match what coalesced subscribers
             # hold, and it may not have seen this doc since startup
@@ -265,7 +315,29 @@ class FanoutEngine(object):
             self._peer_send[peer] = send
             self._conn_peers.setdefault(peer[0], set()).add(peer)
             telemetry.metric('sync.fanout.subscribes')
+        if mode == 'patch':
+            return {'doc': doc_id, 'clock': auth, 'patch': patch}
         return {'doc': doc_id, 'clock': auth, 'changes': changes}
+
+    def _memoized_full_patch(self, doc_id, auth):
+        """One full-state materialization per doc per authoritative
+        state: a flush's patch-mode stragglers AND a patch-mode
+        resubscribe stampede share the pool's `get_patch` walk
+        (`sync.fanout.patch_full_reuse`).  Keyed by the auth clock's
+        value, so any intervening mutation invalidates it."""
+        akey = tuple(sorted((auth or {}).items()))
+        with self._lock:
+            hit = self._patch_memo.get(doc_id)
+        if hit is not None and hit[0] == akey:
+            telemetry.metric('sync.fanout.patch_full_reuse')
+            return hit[1]
+        patch = self._pool.get_patch(doc_id)
+        telemetry.metric('sync.fanout.patch_full_builds')
+        with self._lock:
+            if len(self._patch_memo) >= 512:
+                self._patch_memo.clear()
+            self._patch_memo[doc_id] = (akey, patch)
+        return patch
 
     def _memoized_backfill(self, doc_id, clock, auth):
         """One missing-changes walk per distinct (doc, advertised
@@ -291,14 +363,15 @@ class FanoutEngine(object):
             self._backfill_memo[(doc_id, ckey)] = (akey, changes)
         return changes
 
-    def subscribe_many(self, peer, doc_ids, clock, send, backfill=True):
+    def subscribe_many(self, peer, doc_ids, clock, send, backfill=True,
+                       mode='change'):
         """Doc-set subscription (`{"cmd": "subscribe", "docs": [...]}`):
         one subscription row per doc, one response carrying every
         backfill -- the shape ROADMAP #1's routing tier proxies."""
         out = {}
         for doc_id in doc_ids:
             out[doc_id] = self.subscribe(peer, doc_id, clock, send,
-                                         backfill=backfill)
+                                         backfill=backfill, mode=mode)
         return {'docs': out}
 
     def subscribe_prefix(self, peer, prefix, send):
@@ -364,6 +437,8 @@ class FanoutEngine(object):
             self._n_rows += 1
         self._believed[row] = 0
         self._acked[row] = 0
+        # a recycled row must not inherit the previous tenant's mode
+        self._patch_rows.discard(row)
         self._sub_doc[row] = self._drow(doc_id)
         self._row_peer[row] = peer
         self._peer_row[(peer, doc_id)] = row
@@ -383,6 +458,7 @@ class FanoutEngine(object):
                     continue
                 removed += 1
                 self._row_peer.pop(row, None)
+                self._patch_rows.discard(row)
                 subs = self._doc_subs.get(key[1])
                 if subs is not None:
                     subs.discard(row)
@@ -445,7 +521,7 @@ class FanoutEngine(object):
     # -- the batched flush pass ----------------------------------------
 
     def on_flush(self, updates, quarantined=None, enq=None,
-                 origins=None, traces=None):
+                 origins=None, traces=None, patches=None):
         """One fan-out pass for one gateway flush.
 
         `updates`: {doc_id: post-flush clock dict} for every doc the
@@ -459,16 +535,21 @@ class FanoutEngine(object):
         `traces`: {doc_id: trace id} of the originating request (the
         per-doc FIFO makes it unique per flush) -- stamped onto the
         doc's change/quarantined event frames so a subscriber can join
-        what it received to the cross-process trace tree (ISSUE 16).
+        what it received to the cross-process trace tree (ISSUE 16);
+        `patches`: {doc_id: the pool's per-doc apply-result patch} --
+        the flush's diff stream, computed once, that patch-mode rows
+        fan instead of change bytes (ISSUE 20; docs without an entry
+        fall back to a full-state patch).
         Caller holds the pool lock (straggler backfills query it).
         """
         quarantined = quarantined or {}
         enq = enq or {}
         origins = origins or {}
         traces = traces or {}
+        patches = patches or {}
         with self._lock:
             frames = self._flush_locked(updates, quarantined, enq,
-                                        origins, traces)
+                                        origins, traces, patches)
         return frames
 
     def _note_origins(self, origins):  # holds-lock: self._lock
@@ -619,7 +700,7 @@ class FanoutEngine(object):
             telemetry.metric('sync.fanout.prefix_attaches', attached)
 
     def _flush_locked(self, updates, quarantined, enq, origins,  # holds-lock: self._lock
-                      traces):
+                      traces, patches):
         presence, self._presence = self._presence, {}
         # 0. wildcard auto-attach, then echo suppression (either may
         #    intern new actors -- both must precede the pre-flush row
@@ -694,7 +775,8 @@ class FanoutEngine(object):
                 pending, doc_id, drow, pre, rows,
                 behind[cls] if rows else (), exact[cls] if rows else (),
                 quarantined.get(doc_id), presence.pop(doc_id, None),
-                enq.get(doc_id), traces.get(doc_id))
+                enq.get(doc_id), traces.get(doc_id),
+                patches.get(doc_id))
 
         # 4. presence-only docs (no mutation this flush)
         for doc_id, states in presence.items():
@@ -715,10 +797,13 @@ class FanoutEngine(object):
         return n_frames
 
     def _stage_doc(self, pending, doc_id, drow, pre, rows, behind,  # holds-lock: self._lock
-                   exact, envelope, presence, enq_t, trace=None):
+                   exact, envelope, presence, enq_t, trace=None,
+                   patch=None):
         """Stages one dirty doc's frames for its classified
         subscribers.  `trace` (the originating request's trace id)
-        rides on every change/quarantined frame as ``frame['trace']``."""
+        rides on every change/quarantined frame as ``frame['trace']``;
+        `patch` is the flush's captured per-doc apply patch that
+        patch-mode rows fan instead of change bytes (ISSUE 20)."""
         if envelope is not None:
             # quarantined: every subscriber gets the resilience
             # envelope, not silence -- believed clocks stay put (the
@@ -754,6 +839,14 @@ class FanoutEngine(object):
         stragglers = [row for row, b, e in zip(rows, behind, exact)
                       if b and not e]
         uptodate = len(rows) - len(coalesced) - len(stragglers)
+        # patch-mode rows peel off into their own staging lanes; the
+        # classification itself (and all believed/acked bookkeeping)
+        # is mode-agnostic
+        p_coal = [r for r in coalesced if r in self._patch_rows]
+        coalesced = [r for r in coalesced if r not in self._patch_rows]
+        p_strag = [r for r in stragglers if r in self._patch_rows]
+        stragglers = [r for r in stragglers
+                      if r not in self._patch_rows]
         # capacity cost vector, fan-out tier (telemetry/capacity.py):
         # encoded-once bytes vs total fanned bytes = this doc's
         # amplification; one note per dirty doc per flush
@@ -821,9 +914,60 @@ class FanoutEngine(object):
                                  len(rows_g) - 1)
             encoded_b += len(buf)
             fanned_b += len(buf) * staged_g
-        if stragglers:
+        # patch-mode lanes (ISSUE 20): coalesced rows share the flush's
+        # server-computed incremental patch (captured once by the
+        # gateway, encoded once here); stragglers -- and coalesced rows
+        # of a flush whose patch was not captured (e.g. a load-restored
+        # doc) -- share ONE full-state patch marked ``full: true`` that
+        # replaces the client's view (no incremental patch exists
+        # against a diverged believed clock)
+        p_full = p_strag
+        if p_coal:
+            if patch is not None:
+                frame = {'event': 'patch', 'doc': doc_id,
+                         'clock': post, 'patch': patch, 'full': False}
+                if presence:
+                    frame['presence'] = presence
+                if trace:
+                    frame['trace'] = trace
+                buf = self._encode(frame)
+                telemetry.metric('sync.fanout.bytes_encoded', len(buf))
+                staged = 0
+                for row in p_coal:
+                    if self._stage(pending, row, buf, enq_t, post_vec,
+                                   doc_id):
+                        staged += 1
+                telemetry.metric('sync.fanout.patch_frames', staged)
+                if staged > 1:
+                    telemetry.metric('sync.fanout.encode_reuse',
+                                     staged - 1)
+                encoded_b += len(buf)
+                fanned_b += len(buf) * staged
+            else:
+                p_full = p_coal + p_strag
+        if p_full:
+            full = self._memoized_full_patch(doc_id, post)
+            frame = {'event': 'patch', 'doc': doc_id, 'clock': post,
+                     'patch': full, 'full': True}
+            if presence:
+                frame['presence'] = presence
+            if trace:
+                frame['trace'] = trace
+            buf = self._encode(frame)
+            telemetry.metric('sync.fanout.bytes_encoded', len(buf))
+            staged = 0
+            for row in p_full:
+                if self._stage(pending, row, buf, enq_t, post_vec,
+                               doc_id):
+                    staged += 1
+            telemetry.metric('sync.fanout.patch_full_frames', staged)
+            if staged > 1:
+                telemetry.metric('sync.fanout.encode_reuse', staged - 1)
+            encoded_b += len(buf)
+            fanned_b += len(buf) * staged
+        if stragglers or p_strag:
             telemetry.metric('sync.fanout.straggler_peers',
-                             len(stragglers))
+                             len(stragglers) + len(p_strag))
         if uptodate:
             telemetry.metric('sync.fanout.uptodate_peers', uptodate)
         capacity.note_fanout(doc_id, encoded_b, fanned_b, len(rows))
@@ -837,6 +981,7 @@ class FanoutEngine(object):
             # below own the bare names
             stats = {
                 'live_subscriptions': len(self._peer_row),
+                'live_patch_subscriptions': len(self._patch_rows),
                 'live_peers': len(self._peer_send),
                 'live_docs': len(self._doc_subs),
                 'matrix_shape': list(self._believed.shape),

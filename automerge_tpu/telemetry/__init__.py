@@ -254,6 +254,14 @@ KNOWN_SCHEDULER_KEYS = ('flushes', 'coalesced_ops', 'batched_docs',
 #   scalar_passes         matrix vs the per-peer scalar loop
 #                         (AMTPU_FANOUT_VECTOR=0)
 # errors                fan-out passes that raised (flush survived)
+# patch_subscribes      mode:"patch" subscriptions accepted (thin
+#                         clients; docs/SERVING.md read path)
+# patch_frames          incremental patch frames staged (the flush's
+#                         captured patch, encoded once per doc)
+# patch_full_frames     full-state patch frames staged (stragglers,
+#                         resyncs, flushes with no captured patch)
+# patch_full_builds /   get_patch materializations for full-state
+#   patch_full_reuse      frames vs auth-clock memo hits
 KNOWN_FANOUT_KEYS = ('flushes', 'docs', 'frames', 'encode_reuse',
                      'coalesced_peers', 'straggler_peers',
                      'uptodate_peers', 'bytes_encoded',
@@ -263,7 +271,10 @@ KNOWN_FANOUT_KEYS = ('flushes', 'docs', 'frames', 'encode_reuse',
                      'vector_passes', 'scalar_passes', 'errors',
                      'straggler_reuse', 'backfill_reuse',
                      'regressed_peers', 'prefix_subscribes',
-                     'prefix_attaches', 'subscribe_shed')
+                     'prefix_attaches', 'subscribe_shed',
+                     'patch_subscribes', 'patch_frames',
+                     'patch_full_frames', 'patch_full_builds',
+                     'patch_full_reuse')
 
 # bounded-egress counters (`telemetry.metric('egress.<name>')` call
 # sites in scheduler/egress.py + scheduler/gateway.py; glossary:
@@ -478,6 +489,35 @@ KNOWN_FAILOVER_KEYS = ('failovers', 'docs_recovered', 'docs_lost',
 KNOWN_MIGRATE_KEYS = ('out_docs', 'out_bytes', 'in_docs', 'in_bytes',
                       'wrong_replica', 'migrations', 'failed',
                       'errors', 'rebalance_passes')
+
+# read-path counters (`telemetry.metric('readview.<name>')` call sites
+# in readview/snapshot.py, readview/replica.py, sidecar/server.py,
+# scheduler/gateway.py; read-path section: docs/SERVING.md, glossary:
+# docs/OBSERVABILITY.md), pre-seeded into every bench_block:
+# snapshots_served        `snapshot` requests answered (container bytes
+#                           + frontier clock)
+# snapshot_hits /         frontier-clock cache hits vs container builds
+#   snapshot_builds         (an unchanged doc serves cached bytes)
+# read_only_refused       mutations a read-only replica answered with
+#                           the typed ReadOnly envelope
+# replica_bootstrap_docs  docs a read replica restored arena-direct
+#                           from its ColdStore before subscribing
+# replica_events          fan-out frames the replica consumer drained
+# replica_changes         change bytes applied into the replica pool
+#                           (live frames, backfill, and resyncs)
+# replica_apply_errors    frames whose apply raised (the consumer
+#                           survives and forces a catch-up)
+# replica_probes          upstream frontier probes the staleness SLO
+#                           loop completed
+# replica_slo_breaches    docs stale past AMTPU_READ_STALENESS_SLO_S
+#                           (each forces a catch-up)
+# replica_resyncs         forced get_missing_changes catch-up walks
+KNOWN_READVIEW_KEYS = ('snapshots_served', 'snapshot_hits',
+                       'snapshot_builds', 'read_only_refused',
+                       'replica_bootstrap_docs', 'replica_events',
+                       'replica_changes', 'replica_apply_errors',
+                       'replica_probes', 'replica_slo_breaches',
+                       'replica_resyncs')
 
 # docs per gateway flush are effectively powers of two: exact log2 bounds
 BATCH_OCCUPANCY_BUCKETS = tuple(float(2 ** i) for i in range(13))
@@ -813,6 +853,10 @@ def bench_block():
     failover.update({k.split('.', 1)[1]: round(v, 6)
                      for k, v in flat.items()
                      if k.startswith('failover.')})
+    readview = {r: 0.0 for r in KNOWN_READVIEW_KEYS}
+    readview.update({k.split('.', 1)[1]: round(v, 6)
+                     for k, v in flat.items()
+                     if k.startswith('readview.')})
     block = {
         'fallbacks': fallbacks,
         'collect': collect,
@@ -832,6 +876,7 @@ def bench_block():
         'router': router,
         'migrate': migrate,
         'failover': failover,
+        'readview': readview,
         'device_s': round(flat.get('device.dispatch_sync_s', 0.0), 4),
         'device_dispatches': int(flat.get('device.dispatches', 0)),
         'batch_latency': BATCH_LATENCY.snapshot() or {},

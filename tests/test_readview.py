@@ -1,0 +1,531 @@
+"""Read-path subsystem (ISSUE 20, docs/SERVING.md read path):
+patch-mode fan-out byte parity against the serial backend/frontend
+oracle across both exec modes, full-state healing for stragglers and
+shed peers, quarantine envelopes in patch mode, the frontier-clock
+snapshot cache, typed client events, and the live gateway + read
+replica wiring.
+"""
+
+import base64
+import json
+import os
+import random
+import tempfile
+import time
+
+import pytest
+
+import automerge_tpu.backend as Backend
+import automerge_tpu.frontend as Frontend
+from automerge_tpu import telemetry
+from automerge_tpu.errors import RangeError
+from automerge_tpu.frontend import apply_patch
+from automerge_tpu.native import NativeDocPool
+from automerge_tpu.readview.events import (ChangeEvent, PatchEvent,
+                                           QuarantinedEvent, Snapshot,
+                                           typed_event)
+from automerge_tpu.readview.snapshot import SnapshotCache
+from automerge_tpu.sync.fanout import FanoutEngine
+
+ROOT = '00000000-0000-0000-0000-000000000000'
+DOC = 'patch-doc'
+
+#: the patch keys the gateway captures for fan-out (requester-specific
+#: actor/seq stripped -- the shared frame must be peer-agnostic)
+PATCH_KEYS = ('clock', 'deps', 'canUndo', 'canRedo', 'diffs')
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_hygiene():
+    yield
+    telemetry.reset_all()
+
+
+def ch(actor, seq, key, value, deps=None):
+    return {'actor': actor, 'seq': seq, 'deps': dict(deps or {}),
+            'ops': [{'action': 'set', 'obj': ROOT, 'key': key,
+                     'value': value}]}
+
+
+def canon(obj):
+    return json.dumps(obj, sort_keys=True, default=str)
+
+
+def norm_patch(patch):
+    return {k: patch[k] for k in PATCH_KEYS if k in patch}
+
+
+def fuzz_batches(seed, n_actors=3, n_batches=4):
+    """Random causally-ready multi-actor batches (the fuzz surface the
+    parity gate runs over)."""
+    rng = random.Random(seed)
+    seqs = {('a%d' % a): 0 for a in range(n_actors)}
+    batches = []
+    for _ in range(n_batches):
+        batch = []
+        for actor in sorted(seqs):
+            for _ in range(rng.randint(1, 3)):
+                seqs[actor] += 1
+                batch.append(ch(actor, seqs[actor],
+                                'k%d' % rng.randint(0, 4),
+                                rng.randint(0, 99)))
+        batches.append(batch)
+    return batches
+
+
+class PatchHarness(object):
+    """FanoutEngine over a real pool, staging JSON-lines frames, with
+    the gateway's patch capture emulated: each flush hands the pool's
+    apply patch (normalized exactly like `GatewayServer._fan_note`)
+    into `on_flush(patches=...)`."""
+
+    def __init__(self):
+        self.pool = NativeDocPool()
+        self.engine = FanoutEngine(
+            self.pool, lambda obj: (json.dumps(obj) + '\n').encode())
+        self.frames = {}
+
+    def send_for(self, peer):
+        def send(buf):
+            self.frames.setdefault(peer, []).append(buf)
+        return send
+
+    def subscribe(self, peer, clock=None, doc=DOC, **kw):
+        return self.engine.subscribe((1, peer), doc, clock or {},
+                                     self.send_for(peer), **kw)
+
+    def flush(self, batch, doc=DOC, capture=True):
+        res = self.pool.apply_changes(doc, batch)
+        self.engine.on_flush(
+            {doc: res['clock']}, enq={doc: time.perf_counter()},
+            patches={doc: norm_patch(res)} if capture else None)
+        return res
+
+    def events(self, peer):
+        return [json.loads(buf) for buf in self.frames.get(peer, ())]
+
+
+def serial_oracle(batches):
+    """The reference thin-client shape: a serial backend applies every
+    batch; a frontend applies each returned patch.  Returns (per-batch
+    normalized patches, final doc dict)."""
+    state = Backend.init()
+    doc = Frontend.init({'actorId': 'oracle'})
+    patches = []
+    for batch in batches:
+        state, patch = Backend.apply_changes(state, batch)
+        patches.append(norm_patch(patch))
+        doc = apply_patch(doc, patch)
+    return patches, dict(doc)
+
+
+def thin_view(sub_result, frames):
+    """What a patch-mode client materializes: the subscribe backfill
+    (full state) then each patch frame in order (`full: true`
+    REPLACES the view)."""
+    doc = Frontend.init({'actorId': 'thin'})
+    if sub_result.get('patch') is not None:
+        doc = apply_patch(doc, sub_result['patch'])
+    for f in frames:
+        if f.get('event') != 'patch':
+            continue
+        if f.get('full'):
+            doc = apply_patch(Frontend.init({'actorId': 'thin'}),
+                              f['patch'])
+        else:
+            doc = apply_patch(doc, f['patch'])
+    return dict(doc)
+
+
+# ---------------------------------------------------------------------------
+# patch parity: fanned frames vs the serial backend/frontend oracle
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize('vector', [True, False],
+                         ids=['vectorized', 'scalar-oracle'])
+@pytest.mark.parametrize('seed', [7, 23, 61])
+def test_patch_fan_parity_vs_serial_oracle(vector, seed, monkeypatch):
+    """N patch-mode peers across fuzzed multi-actor flushes: every
+    fanned incremental patch is byte-identical to the serial backend's
+    patch for the same batch, and every peer's materialized end state
+    is byte-identical to the serial frontend oracle."""
+    monkeypatch.setenv('AMTPU_FANOUT_VECTOR', '1' if vector else '0')
+    batches = fuzz_batches(seed)
+    h = PatchHarness()
+    peers = ['p%02d' % i for i in range(8)]
+    subs = {p: h.subscribe(p, mode='patch') for p in peers}
+    for batch in batches:
+        h.flush(batch)
+    oracle_patches, oracle_doc = serial_oracle(batches)
+    for p in peers:
+        evs = h.events(p)
+        got = [norm_patch(f['patch']) for f in evs
+               if f['event'] == 'patch' and not f['full']]
+        assert canon(got) == canon(oracle_patches), \
+            'patch stream diverged from the serial oracle for %s' % p
+        assert canon(thin_view(subs[p], evs)) == canon(oracle_doc)
+    snap = telemetry.metrics_snapshot()
+    # one patch frame per flush, fanned to all 8 peers, encoded once
+    assert snap['sync.fanout.patch_frames'] == len(batches) * len(peers)
+    assert snap['sync.fanout.encode_reuse'] >= \
+        len(batches) * (len(peers) - 1)
+    key = 'sync.fanout.%s_passes' % ('vector' if vector else 'scalar')
+    assert snap.get(key, 0) >= len(batches)
+
+
+def test_mixed_mode_fan_same_doc():
+    """Change-mode and patch-mode subscribers of one doc each get
+    their own frame kind from the same flush, both correct."""
+    h = PatchHarness()
+    fat = h.subscribe('fat')
+    thin = h.subscribe('thin', mode='patch')
+    assert 'changes' in fat and 'patch' in thin
+    batches = [[ch('a', 1, 'k', 1)], [ch('a', 2, 'k', 2, {'a': 1})]]
+    for b in batches:
+        h.flush(b)
+    fat_evs = h.events('fat')
+    assert [e['event'] for e in fat_evs] == ['change', 'change']
+    got_changes = [c for e in fat_evs for c in e['changes']]
+    assert canon(got_changes) == canon([c for b in batches for c in b])
+    oracle_patches, oracle_doc = serial_oracle(batches)
+    thin_evs = h.events('thin')
+    assert [norm_patch(e['patch']) for e in thin_evs] == oracle_patches
+    assert canon(thin_view(thin, thin_evs)) == canon(oracle_doc)
+
+
+def test_patch_subscribe_backfill_and_straggler_full_state():
+    """A patch-mode subscriber arriving mid-history gets a full-state
+    backfill; one subscribing with `backfill=False` is healed by the
+    next flush with a `full: true` frame -- end state identical to the
+    oracle either way."""
+    batches = fuzz_batches(5, n_batches=2)
+    h = PatchHarness()
+    h.flush(batches[0])
+    # late subscriber: full-state backfill covers batch 0
+    late = h.subscribe('late', mode='patch')
+    assert late['patch'] is not None
+    # straggler: registered at a zero clock with no backfill -> the
+    # next flush cannot ship it an incremental patch (it missed
+    # nothing-to-batch-0); it must get full state
+    h.subscribe('strag', mode='patch', backfill=False)
+    h.flush(batches[1])
+    _, oracle_doc = serial_oracle(batches)
+    assert canon(thin_view(late, h.events('late'))) == \
+        canon(oracle_doc)
+    strag_evs = h.events('strag')
+    assert [e['full'] for e in strag_evs
+            if e['event'] == 'patch'] == [True]
+    assert canon(thin_view({'patch': None}, strag_evs)) == \
+        canon(oracle_doc)
+    snap = telemetry.metrics_snapshot()
+    assert snap['sync.fanout.patch_full_frames'] >= 1
+    assert snap['sync.fanout.straggler_peers'] >= 1
+
+
+def test_uncaptured_patch_falls_back_to_full_state():
+    """A flush with NO captured patch (a `load`-style mutation) still
+    serves patch-mode peers -- with a full-state frame, never
+    silence."""
+    h = PatchHarness()
+    sub = h.subscribe('p', mode='patch')
+    h.flush([ch('a', 1, 'k', 1)], capture=False)
+    evs = h.events('p')
+    assert [e.get('full') for e in evs
+            if e['event'] == 'patch'] == [True]
+    _, oracle_doc = serial_oracle([[ch('a', 1, 'k', 1)]])
+    assert canon(thin_view(sub, evs)) == canon(oracle_doc)
+
+
+def test_quarantine_envelope_in_patch_mode():
+    h = PatchHarness()
+    h.subscribe('thin', mode='patch')
+    env = {'error': 'poisoned device batch',
+           'errorType': 'AutomergeError'}
+    h.engine.on_flush({}, quarantined={DOC: env})
+    frame = h.events('thin')[-1]
+    assert frame['event'] == 'quarantined'
+    assert frame['error'] == env['error']
+    assert frame['errorType'] == env['errorType']
+
+
+def test_patch_mode_refused_when_disabled(monkeypatch):
+    monkeypatch.setenv('AMTPU_READ_PATCH', '0')
+    h = PatchHarness()
+    with pytest.raises(RangeError):
+        h.subscribe('p', mode='patch')
+    # change mode unaffected
+    assert 'changes' in h.subscribe('p')
+
+
+def test_invalid_mode_rejected():
+    h = PatchHarness()
+    with pytest.raises(RangeError):
+        h.subscribe('p', mode='delta')
+
+
+# ---------------------------------------------------------------------------
+# shed -> regress -> heal in patch mode (egress tier 1)
+# ---------------------------------------------------------------------------
+
+class FakeEgress(object):
+    """Egress-shaped transport: frames deliver (on_write) or shed
+    (on_drop) under test control, synchronously."""
+
+    def __init__(self):
+        self.delivered = []
+        self.drop_next = 0
+
+    def stage(self, buf, kind='event', on_write=None, on_drop=None):
+        if kind == 'event' and self.drop_next > 0:
+            self.drop_next -= 1
+            if on_drop is not None:
+                on_drop()
+            return True
+        self.delivered.append(buf)
+        if on_write is not None:
+            on_write()
+        return True
+
+    def events(self):
+        return [json.loads(line) for buf in self.delivered
+                for line in buf.decode().splitlines()]
+
+
+def test_patch_shed_regress_heal_parity_vs_never_shed_twin():
+    """A patch-mode peer whose frame is tier-1 shed regresses to its
+    acked clock and is healed by the next flush with a `full: true`
+    frame; its materialized end state is byte-identical to a twin that
+    never shed (late, never wrong)."""
+    def build():
+        pool = NativeDocPool()
+        engine = FanoutEngine(
+            pool, lambda obj: (json.dumps(obj) + '\n').encode())
+        t = FakeEgress()
+        return pool, engine, t
+
+    pool_s, eng_s, t_shed = build()
+    pool_c, eng_c, t_clean = build()
+    sub_s = eng_s.subscribe((1, 'p'), DOC, {}, t_shed, mode='patch')
+    sub_c = eng_c.subscribe((1, 'p'), DOC, {}, t_clean, mode='patch')
+    batches = [[ch('a', 1, 'k', 1)], [ch('a', 2, 'k', 2, {'a': 1})],
+               [ch('b', 1, 'j', 3)]]
+    for i, batch in enumerate(batches):
+        if i == 1:
+            t_shed.drop_next = 1          # tier-1 sheds this flush
+        for pool, eng in ((pool_s, eng_s), (pool_c, eng_c)):
+            res = pool.apply_changes(DOC, batch)
+            eng.on_flush({DOC: res['clock']},
+                         enq={DOC: time.perf_counter()},
+                         patches={DOC: norm_patch(res)})
+    _, oracle_doc = serial_oracle(batches)
+    shed_view = thin_view(sub_s, t_shed.events())
+    clean_view = thin_view(sub_c, t_clean.events())
+    assert canon(shed_view) == canon(clean_view) == canon(oracle_doc)
+    # the healing frame replaced state instead of replaying the gap
+    fulls = [e for e in t_shed.events()
+             if e.get('event') == 'patch' and e.get('full')]
+    assert len(fulls) == 1
+    snap = telemetry.metrics_snapshot()
+    assert snap.get('sync.fanout.regressed_peers', 0) >= 1
+    assert snap.get('sync.fanout.patch_full_frames', 0) >= 1
+
+
+def test_full_patch_memo_reuses_and_invalidates():
+    """Patch-mode stragglers and resubscribes at the same frontier
+    share ONE get_patch materialization; any mutation invalidates by
+    value."""
+    h = PatchHarness()
+    h.pool.apply_changes(DOC, [ch('a', 1, 'k', 1)])
+    h.subscribe('p1', mode='patch')
+    h.subscribe('p2', mode='patch')
+    h.subscribe('p3', mode='patch')
+    snap = telemetry.metrics_snapshot()
+    assert snap['sync.fanout.patch_full_builds'] == 1
+    assert snap['sync.fanout.patch_full_reuse'] == 2
+    h.flush([ch('a', 2, 'k', 2, {'a': 1})])
+    r = h.subscribe('p4', mode='patch')
+    assert r['patch']['clock'] == {'a': 2}
+    snap = telemetry.metrics_snapshot()
+    assert snap['sync.fanout.patch_full_builds'] == 2
+
+
+# ---------------------------------------------------------------------------
+# snapshot cache + typed events
+# ---------------------------------------------------------------------------
+
+def test_snapshot_cache_hits_invalidation_and_lru():
+    cache = SnapshotCache(max_entries=2)
+    builds = []
+
+    def build_for(doc, data):
+        def build():
+            builds.append(doc)
+            return data
+        return build
+
+    assert cache.get('d1', {'a': 1}, build_for('d1', b'v1')) == b'v1'
+    assert cache.get('d1', {'a': 1}, build_for('d1', b'XX')) == b'v1'
+    assert builds == ['d1']
+    # mutation invalidates by clock value
+    assert cache.get('d1', {'a': 2}, build_for('d1', b'v2')) == b'v2'
+    assert builds == ['d1', 'd1']
+    # LRU: d1 evicted once d2+d3 land
+    cache.get('d2', {}, build_for('d2', b'v'))
+    cache.get('d3', {}, build_for('d3', b'v'))
+    assert len(cache) == 2
+    cache.get('d1', {'a': 2}, build_for('d1', b'v2'))
+    assert builds.count('d1') == 3
+    snap = telemetry.metrics_snapshot()
+    assert snap['readview.snapshot_hits'] == 1
+    assert snap['readview.snapshot_builds'] == 5
+
+
+def test_typed_event_factory_and_dict_compat():
+    pe = typed_event({'event': 'patch', 'doc': 'd', 'clock': {'a': 1},
+                      'patch': {'diffs': []}, 'full': True})
+    assert isinstance(pe, PatchEvent) and isinstance(pe, dict)
+    assert pe.doc == 'd' and pe.full and pe['event'] == 'patch'
+    ce = typed_event({'event': 'change', 'doc': 'd', 'changes': [1]})
+    assert isinstance(ce, ChangeEvent) and ce.changes == [1]
+    qe = typed_event({'event': 'quarantined', 'doc': 'd',
+                      'error': 'x', 'errorType': 'AutomergeError'})
+    assert isinstance(qe, QuarantinedEvent) and qe.error_type == \
+        'AutomergeError'
+    # unknown frames stay plain dicts (forward compatibility)
+    plain = typed_event({'event': 'hologram', 'doc': 'd'})
+    assert type(plain) is dict
+    snap = Snapshot({'doc': 'd', 'clock': {'a': 1},
+                     'snapshot_b64':
+                     base64.b64encode(b'bytes').decode()})
+    assert snap.data == b'bytes' and snap.clock == {'a': 1}
+
+
+# ---------------------------------------------------------------------------
+# live gateway: wire protocol, typed events, snapshot, read replica
+# ---------------------------------------------------------------------------
+
+def _live_gateway(tmp_path, monkeypatch):
+    from automerge_tpu.scheduler import GatewayServer
+    monkeypatch.setenv('AMTPU_FLUSH_DEADLINE_MS', '5')
+    path = os.path.join(str(tmp_path), 'gw.sock')
+    return GatewayServer(path).start(), path
+
+
+def test_gateway_patch_mode_and_snapshot_over_the_wire(tmp_path,
+                                                       monkeypatch):
+    """subscribe(mode='patch') over the socket: typed PatchEvent
+    frames, snapshot byte parity with pool.save, get_clock."""
+    from automerge_tpu.sidecar.client import SidecarClient
+    gw, path = _live_gateway(tmp_path, monkeypatch)
+    try:
+        w = SidecarClient(sock_path=path)
+        r = SidecarClient(sock_path=path)
+        w.apply_changes(DOC, [ch('a', 1, 'k', 1)])
+        sub = r.subscribe(doc=DOC, peer='thin', mode='patch')
+        assert sub['patch'] is not None and 'changes' not in sub
+        w.apply_changes(DOC, [ch('a', 2, 'k', 2, {'a': 1})])
+        ev = r.next_event(timeout=30)
+        assert isinstance(ev, PatchEvent) and not ev.full
+        oracle = w.call('get_patch', doc=DOC)
+        assert canon(norm_patch(ev.patch)) != ''
+        view = thin_view(sub, [dict(ev)])
+        fe = apply_patch(Frontend.init({'actorId': 'o'}), oracle)
+        assert canon(view) == canon(dict(fe))
+        assert ev.clock == oracle['clock']
+        # snapshot: byte parity with the pool checkpoint, cached
+        snap = r.snapshot(DOC)
+        assert isinstance(snap, Snapshot)
+        with gw.pool_lock:
+            assert snap.data == gw.backend.pool.save(DOC)
+        assert w.snapshot(DOC).data == snap.data
+        assert telemetry.metrics_snapshot()[
+            'readview.snapshot_hits'] >= 1
+        assert r.get_clock(DOC)['clock'] == oracle['clock']
+        w.close()
+        r.close()
+    finally:
+        gw.stop()
+
+
+def test_client_auto_resubscribe_preserves_patch_mode(tmp_path,
+                                                      monkeypatch):
+    """A resync (egress tier 2) on a patch-mode subscription heals
+    back into patch mode: the client re-subscribes with its recorded
+    kwargs and surfaces the backfill as a synthetic full patch."""
+    from automerge_tpu.sidecar.client import SidecarClient
+    gw, path = _live_gateway(tmp_path, monkeypatch)
+    try:
+        w = SidecarClient(sock_path=path)
+        r = SidecarClient(sock_path=path)
+        w.apply_changes(DOC, [ch('a', 1, 'k', 1)])
+        r.subscribe(doc=DOC, peer='thin', mode='patch')
+        sub_key = (DOC, None, None, 'thin')
+        assert r._subs[sub_key]['mode'] == 'patch'
+        # server-side resync envelope (what egress tier 2 emits)
+        w.apply_changes(DOC, [ch('a', 2, 'k', 2, {'a': 1})])
+        ev = r.next_event(timeout=30)
+        assert isinstance(ev, PatchEvent)
+        r._auto_resub_worker({'docs': [DOC]})
+        deadline = time.time() + 30
+        got = None
+        while time.time() < deadline:
+            got = r.next_event(timeout=1.0)
+            if got is not None:
+                break
+        assert isinstance(got, PatchEvent) and got.full \
+            and got.is_resync_backfill
+        # still in patch mode after the heal
+        assert r._subs[sub_key]['mode'] == 'patch'
+        w.close()
+        r.close()
+    finally:
+        gw.stop()
+
+
+def test_read_replica_materializes_serves_and_resyncs(tmp_path,
+                                                      monkeypatch):
+    """ReadReplica consumes the fan-out stream into its own pool,
+    serves get_patch/snapshot read-only, refuses writes, and closes a
+    forced gap via resync_doc."""
+    from automerge_tpu.errors import AutomergeError
+    from automerge_tpu.readview.replica import ReadReplica
+    from automerge_tpu.sidecar.client import SidecarClient
+    gw, up_path = _live_gateway(tmp_path, monkeypatch)
+    rd_path = os.path.join(str(tmp_path), 'read.sock')
+    rep = None
+    try:
+        w = SidecarClient(sock_path=up_path)
+        w.apply_changes(DOC, [ch('a', 1, 'k', 1)])
+        rep = ReadReplica(up_path, rd_path, docs=[DOC],
+                          probe_s=30.0, slo_s=30.0).start()
+        r = SidecarClient(sock_path=rd_path)
+        assert r.get_patch(DOC)['clock'] == {'a': 1}
+        w.apply_changes(DOC, [ch('a', 2, 'k', 2, {'a': 1})])
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if r.get_patch(DOC)['clock'] == {'a': 2}:
+                break
+            time.sleep(0.02)
+        assert r.get_patch(DOC)['clock'] == {'a': 2}
+        assert canon(r.get_patch(DOC)) == canon(w.get_patch(DOC))
+        # read-only: mutations answer the typed envelope
+        with pytest.raises(AutomergeError):
+            r.apply_changes(DOC, [ch('z', 1, 'k', 9)])
+        # snapshot serves from the replica's own pool
+        snap = r.snapshot(DOC)
+        with gw.pool_lock:
+            assert snap.data == gw.backend.pool.save(DOC)
+        # forced gap: a doc the replica never subscribed to
+        w.apply_changes('gap-doc', [ch('g', 1, 'k', 1)])
+        n = rep.resync_doc('gap-doc')
+        assert n == 1
+        with rep.gw.pool_lock:
+            got = rep.backend.pool.get_patch('gap-doc')
+        assert canon(got) == canon(w.get_patch('gap-doc'))
+        assert rep.healthz_section()['followed_docs'] >= 1
+        r.close()
+        w.close()
+    finally:
+        if rep is not None:
+            rep.stop()
+        gw.stop()

@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Run a materialized read replica (ISSUE 20; docs/SERVING.md).
+
+    python tools/amtpu_replica.py \
+        --upstream /run/amtpu/gw.sock --listen /run/amtpu/read0.sock \
+        --store /var/lib/amtpu/cold --prefix doc/
+
+Consumes the upstream gateway's fan-out stream into a local pool and
+serves reads (`get_patch`, `snapshot`, `healthz`, ...) on `--listen`
+as a read-only gateway; mutations answer a typed ``ReadOnly`` error.
+With `--store` the pool bootstraps arena-direct from the ColdStore
+manifest before subscribing, so upstream only backfills the tail.
+
+Staleness SLO: every `AMTPU_READ_RESYNC_S` the replica probes the
+upstream frontier per doc; a doc behind for longer than
+`AMTPU_READ_STALENESS_SLO_S` is force-caught-up via one
+``get_missing_changes`` walk.  `--status-interval N` prints the
+healthz ``readview`` section as a JSON line every N seconds.
+"""
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def main(argv=None):
+    from automerge_tpu.readview.replica import ReadReplica
+    ap = argparse.ArgumentParser(
+        description='materialized read replica over one gateway')
+    ap.add_argument('--upstream', required=True,
+                    help='authoritative gateway unix socket path')
+    ap.add_argument('--listen', required=True,
+                    help='unix socket path this replica serves reads on')
+    ap.add_argument('--doc', action='append', default=[],
+                    help='doc id to follow (repeatable)')
+    ap.add_argument('--prefix',
+                    help='follow every doc under this id prefix')
+    ap.add_argument('--store',
+                    help='ColdStore root to bootstrap the pool from')
+    ap.add_argument('--peer', default='replica',
+                    help='peer name for the upstream subscription')
+    ap.add_argument('--msgpack', action='store_true',
+                    help='msgpack framing on both sockets')
+    ap.add_argument('--status-interval', type=float, default=0.0,
+                    help='print the readview healthz section as JSON '
+                         'every N seconds (0: quiet)')
+    args = ap.parse_args(argv)
+    if not args.doc and args.prefix is None and not args.store:
+        ap.error('nothing to follow: pass --doc/--prefix/--store')
+    replica = ReadReplica(args.upstream, args.listen, docs=args.doc,
+                          prefix=args.prefix, store_dir=args.store,
+                          peer=args.peer, use_msgpack=args.msgpack)
+    replica.start()
+    print('replica: serving reads on %s (upstream %s)'
+          % (args.listen, args.upstream), file=sys.stderr)
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    try:
+        last = time.monotonic()
+        while not stop:
+            time.sleep(0.2)
+            if args.status_interval and \
+                    time.monotonic() - last >= args.status_interval:
+                last = time.monotonic()
+                print(json.dumps({'readview':
+                                  replica.healthz_section()}))
+                sys.stdout.flush()
+    finally:
+        replica.stop()
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,332 @@
+"""Read-path gate (ISSUE 20, docs/SERVING.md read path): patch-mode
+fan-out must actually be cheaper for thin clients than shipping change
+bytes, a materialized read replica must stay inside its staleness SLO
+under sustained writer churn and close a forced gap via resync, and a
+snapshot cold-open must be byte-identical to a full history replay.
+
+One REAL gateway server subprocess on a unix socket:
+
+  1. **patch-vs-change A/B** -- one popular doc, ``ROUNDS`` writer
+     flushes, one change-mode and one patch-mode subscriber draining
+     the same traffic.  Per frame, the change-mode thin client pays
+     the FULL backend (`Backend.apply_changes` + `apply_patch`) while
+     the patch-mode client only applies the server-computed patch;
+     gates:
+       * both clients' materialized end states byte-identical to the
+         server's serial ``get_patch`` oracle;
+       * patch-mode cumulative apply CPU strictly below change-mode
+         (the whole point of server-side patch shipping);
+       * wire bytes for both lanes recorded in the artifact (patch
+         frames carry materialized state, so bytes can go either way
+         -- the CPU win is the gate, the bytes are the honest cost).
+  2. **replica staleness SLO** -- a `ReadReplica` follows the popular
+     doc through churn (two phases, ``CHURN_ROUNDS`` flushes each);
+     mid-run, a FORCED GAP: the writer grows a doc the replica never
+     subscribed to, and ``resync_doc`` must fetch exactly that many
+     changes and land byte-identical to the upstream ``get_patch``.
+     After churn the replica must drain to zero lag inside
+     ``AMTPU_SMOKE_READPATH_DRAIN_S`` (default 30 s) and every sampled
+     staleness reading is recorded; reads served during churn come
+     from the replica's own listener (read-only: a write must be
+     refused).
+  3. **snapshot cold-open** -- the gateway serves the churned doc's v2
+     container; loading it into a fresh pool must be byte-identical
+     (``save`` round-trip) to replaying the full change history, and
+     a second fetch at the same frontier must hit the cache.
+  4. **kernel-path hygiene** -- ``fallback.oracle == 0`` at the end.
+
+Writes BENCH_READPATH_r20.json.
+
+Run: JAX_PLATFORMS=cpu python tools/readpath_check.py  (make readpath-check)
+"""
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from proc_util import spawn_server, stop_server  # noqa: E402
+
+ROUNDS = 20                   # arm 1 flushes
+OPS_PER_CHANGE = 6
+CHURN_ROUNDS = 15             # arm 2 flushes per phase
+GAP_CHANGES = 5               # forced-gap size
+ROOT_ID = '00000000-0000-0000-0000-000000000000'
+DOC = 'popular-doc'
+GAP_DOC = 'gap-doc'
+ARTIFACT = os.path.join(REPO, 'BENCH_READPATH_r20.json')
+
+
+def change(doc, seq, actor='writer'):
+    return {'actor': actor, 'seq': seq, 'deps': {},
+            'ops': [{'action': 'set', 'obj': ROOT_ID,
+                     'key': '%s-k%d' % (doc, (seq * 7 + i) % 9),
+                     'value': 'v%d.%d' % (seq, i)}
+                    for i in range(OPS_PER_CHANGE)]}
+
+
+def canon(obj):
+    return json.dumps(obj, sort_keys=True, default=str)
+
+
+def wire_len(frame):
+    """The frame's JSON-lines wire size (what the gateway encoder
+    ships) -- measured client-side off the decoded dict."""
+    return len((json.dumps(dict(frame)) + '\n').encode())
+
+
+def drain(client, kind, want, apply_fn, timeout=120):
+    """Drains `want` frames of `kind`, timing ONLY the apply_fn calls
+    (the thin client's CPU) and summing wire bytes."""
+    got, cpu_s, wire_b = 0, 0.0, 0
+    deadline = time.time() + timeout
+    while got < want:
+        ev = client.next_event(timeout=max(0.1, deadline - time.time()))
+        if ev is None:
+            break
+        if ev.get('event') != kind:
+            continue
+        wire_b += wire_len(ev)
+        t0 = time.perf_counter()
+        apply_fn(ev)
+        cpu_s += time.perf_counter() - t0
+        got += 1
+    assert got == want, '%s-mode client got %d/%d frames' \
+        % (kind, got, want)
+    return cpu_s, wire_b
+
+
+def arm_patch_vs_change(path, bench):
+    import automerge_tpu.backend as Backend
+    import automerge_tpu.frontend as Frontend
+    from automerge_tpu.frontend import apply_patch
+    from automerge_tpu.sidecar.client import SidecarClient
+
+    writer = SidecarClient(sock_path=path)
+    fat = SidecarClient(sock_path=path)
+    thin = SidecarClient(sock_path=path)
+    fat.subscribe(DOC, peer='fat')
+    thin_sub = thin.subscribe(DOC, peer='thin', mode='patch')
+    assert thin_sub['patch'] is None and thin_sub['clock'] == {}
+
+    for seq in range(1, ROUNDS + 1):
+        writer.apply_changes(DOC, [change(DOC, seq)])
+
+    # the change-mode thin client: a FULL backend per peer
+    fat_state = {'backend': Backend.init(),
+                 'doc': Frontend.init({'actorId': 'fat'})}
+
+    def fat_apply(ev):
+        fat_state['backend'], patch = Backend.apply_changes(
+            fat_state['backend'], ev['changes'])
+        fat_state['doc'] = apply_patch(fat_state['doc'], patch)
+
+    thin_state = {'doc': Frontend.init({'actorId': 'thin'})}
+
+    def thin_apply(ev):
+        base = Frontend.init({'actorId': 'thin'}) if ev.get('full') \
+            else thin_state['doc']
+        thin_state['doc'] = apply_patch(base, ev['patch'])
+
+    fat_cpu, fat_wire = drain(fat, 'change', ROUNDS, fat_apply)
+    thin_cpu, thin_wire = drain(thin, 'patch', ROUNDS, thin_apply)
+
+    oracle = writer.get_patch(DOC)
+    oracle_doc = apply_patch(Frontend.init({'actorId': 'o'}), oracle)
+    assert canon(dict(fat_state['doc'])) == canon(dict(oracle_doc)), \
+        'change-mode end state diverged from the get_patch oracle'
+    assert canon(dict(thin_state['doc'])) == canon(dict(oracle_doc)), \
+        'patch-mode end state diverged from the get_patch oracle'
+
+    bench['ab_rounds'] = ROUNDS
+    bench['ab_change_apply_cpu_ms'] = round(fat_cpu * 1000, 3)
+    bench['ab_patch_apply_cpu_ms'] = round(thin_cpu * 1000, 3)
+    bench['ab_change_wire_bytes'] = fat_wire
+    bench['ab_patch_wire_bytes'] = thin_wire
+    bench['ab_cpu_ratio'] = round(fat_cpu / max(thin_cpu, 1e-9), 2)
+    assert thin_cpu < fat_cpu, \
+        'patch mode did not win on thin-client CPU: patch %.2fms vs ' \
+        'change %.2fms' % (thin_cpu * 1000, fat_cpu * 1000)
+    for c in (writer, fat, thin):
+        c.close()
+    print('readpath-check: A/B OK (thin-client apply CPU %.2fms patch '
+          'vs %.2fms change = %.1fx win; wire %dB patch vs %dB change; '
+          'both end states == get_patch oracle)'
+          % (thin_cpu * 1000, fat_cpu * 1000, bench['ab_cpu_ratio'],
+             thin_wire, fat_wire))
+
+
+def arm_replica_slo(path, bench):
+    from automerge_tpu.errors import AutomergeError
+    from automerge_tpu.readview.replica import ReadReplica
+    from automerge_tpu.sidecar.client import SidecarClient
+    from automerge_tpu.utils.common import env_float
+    drain_s = env_float('AMTPU_SMOKE_READPATH_DRAIN_S', 30.0)
+
+    writer = SidecarClient(sock_path=path)
+    rd_path = os.path.join(tempfile.mkdtemp(), 'replica.sock')
+    rep = ReadReplica(path, rd_path, docs=[DOC],
+                      probe_s=0.2, slo_s=30.0).start()
+    reader = SidecarClient(sock_path=rd_path)
+    samples, reads = [], 0
+    stop = threading.Event()
+
+    def sample_loop():
+        while not stop.is_set():
+            st = rep.staleness().get(DOC)
+            if st is not None:
+                samples.append(st)
+            time.sleep(0.05)
+
+    sampler = threading.Thread(target=sample_loop, daemon=True)
+    sampler.start()
+    base = ROUNDS
+    try:
+        # churn phase 1: the replica tails the live stream
+        for seq in range(base + 1, base + CHURN_ROUNDS + 1):
+            writer.apply_changes(DOC, [change(DOC, seq)])
+            reader.get_patch(DOC)        # replica serves DURING churn
+            reads += 1
+        # forced gap: a doc the replica never subscribed to grows
+        for seq in range(1, GAP_CHANGES + 1):
+            writer.apply_changes(GAP_DOC, [change(GAP_DOC, seq)])
+        n = rep.resync_doc(GAP_DOC)
+        assert n == GAP_CHANGES, \
+            'resync fetched %d changes, wanted %d' % (n, GAP_CHANGES)
+        assert canon(reader.get_patch(GAP_DOC)) == \
+            canon(writer.get_patch(GAP_DOC)), \
+            'post-resync replica state diverged from upstream'
+        # churn phase 2: the stream keeps flowing after the resync
+        for seq in range(base + CHURN_ROUNDS + 1,
+                         base + 2 * CHURN_ROUNDS + 1):
+            writer.apply_changes(DOC, [change(DOC, seq)])
+            reader.get_patch(DOC)
+            reads += 1
+        # a replica is read-only: the write lane must refuse
+        refused = False
+        try:
+            reader.apply_changes(DOC, [change(DOC, 999, actor='evil')])
+        except AutomergeError:
+            refused = True
+        assert refused, 'replica accepted a write'
+        # drain: believed must reach auth inside the budget
+        target = writer.get_clock(DOC)['clock']
+        deadline = time.time() + drain_s
+        t0 = time.time()
+        while time.time() < deadline:
+            if reader.get_patch(DOC)['clock'] == target:
+                break
+            time.sleep(0.05)
+        drained_ms = (time.time() - t0) * 1000
+        assert reader.get_patch(DOC)['clock'] == target, \
+            'replica did not drain to the upstream frontier in %.0fs' \
+            % drain_s
+        assert canon(reader.get_patch(DOC)) == \
+            canon(writer.get_patch(DOC))
+    finally:
+        stop.set()
+        sampler.join(timeout=5)
+        reader.close()
+        writer.close()
+        rep.stop()
+    max_lag = max([s['lag'] for s in samples] or [0])
+    max_stale = max([s['stale_s'] for s in samples] or [0.0])
+    bench['replica_churn_flushes'] = 2 * CHURN_ROUNDS
+    bench['replica_reads_during_churn'] = reads
+    bench['replica_staleness_samples'] = len(samples)
+    bench['replica_max_lag_changes'] = max_lag
+    bench['replica_max_stale_s'] = round(max_stale, 3)
+    bench['replica_drain_ms'] = round(drained_ms, 1)
+    bench['replica_resync_changes'] = GAP_CHANGES
+    assert max_stale < drain_s, \
+        'measured staleness %.1fs blew the %.0fs budget' \
+        % (max_stale, drain_s)
+    print('readpath-check: replica OK (%d reads served during %d '
+          'churn flushes; max lag %d changes / %.2fs stale; forced '
+          'gap of %d closed via resync; drained to the upstream '
+          'frontier in %.0fms; write refused)'
+          % (reads, 2 * CHURN_ROUNDS, max_lag, max_stale,
+             GAP_CHANGES, drained_ms))
+
+
+def arm_snapshot_cold_open(path, bench):
+    from automerge_tpu.native import NativeDocPool
+    from automerge_tpu.sidecar.client import SidecarClient
+
+    client = SidecarClient(sock_path=path)
+    snap = client.snapshot(DOC)
+    t0 = time.perf_counter()
+    cold = NativeDocPool()
+    cold.load(DOC, snap.data)
+    cold_ms = (time.perf_counter() - t0) * 1000
+
+    # the oracle: replay the FULL change history into a fresh pool
+    history = client.get_missing_changes(DOC, {})
+    t0 = time.perf_counter()
+    replayed = NativeDocPool()
+    replayed.apply_changes(DOC, history)
+    replay_ms = (time.perf_counter() - t0) * 1000
+
+    assert cold.save(DOC) == replayed.save(DOC), \
+        'snapshot cold-open diverged from full history replay'
+    assert canon(cold.get_patch(DOC)) == canon(replayed.get_patch(DOC))
+
+    # same frontier -> the second fetch must be served from the cache
+    def snapshot_hits():
+        body = client.metrics()['body']
+        for line in body.splitlines():
+            if line.startswith('amtpu_runtime_counter') \
+                    and 'readview.snapshot_hits' in line:
+                return float(line.rsplit(None, 1)[1])
+        return 0.0
+
+    hits0 = snapshot_hits()
+    snap2 = client.snapshot(DOC)
+    assert snap2.data == snap.data and snap2.clock == snap.clock
+    hits1 = snapshot_hits()
+    assert hits1 > hits0, 'repeat snapshot at the same frontier ' \
+        'missed the cache (%s -> %s)' % (hits0, hits1)
+    client.close()
+    bench['snapshot_bytes'] = len(snap.data)
+    bench['snapshot_cold_open_ms'] = round(cold_ms, 3)
+    bench['snapshot_replay_ms'] = round(replay_ms, 3)
+    bench['snapshot_history_changes'] = len(history)
+    print('readpath-check: snapshot OK (%dB container, cold-open '
+          '%.1fms vs %.1fms full replay of %d changes, byte-identical '
+          'state; repeat fetch cache-hit)'
+          % (len(snap.data), cold_ms, replay_ms, len(history)))
+
+
+def main():
+    from automerge_tpu.sidecar.client import SidecarClient
+    bench = {'check': 'readpath', 'issue': 20,
+             'denominator': 'change-mode thin client running the '
+                            'full scalar backend per frame'}
+    path = os.path.join(tempfile.mkdtemp(), 'gw-readpath.sock')
+    proc = spawn_server(path, {'AMTPU_FLUSH_DEADLINE_MS': '5'})
+    try:
+        arm_patch_vs_change(path, bench)
+        arm_replica_slo(path, bench)
+        arm_snapshot_cold_open(path, bench)
+        probe = SidecarClient(sock_path=path)
+        h = probe.healthz()
+        assert h['scheduler']['fallback_oracle'] == 0, h['scheduler']
+        bench['fallback_oracle'] = 0
+        probe.close()
+    finally:
+        stop_server(proc)
+    with open(ARTIFACT, 'w') as f:
+        f.write(json.dumps(bench, sort_keys=True) + '\n')
+    print('readpath-check: artifact %s' % os.path.relpath(ARTIFACT,
+                                                          REPO))
+    print('READPATH-CHECK GREEN')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -19,7 +19,10 @@ an in-process :class:`RouterGateway`:
      the hot replica's top-K docs.  Gates: >= 1 migration committed;
      every (doc, seq) acked exactly once and in order across the
      moves (Overloaded answers are retryable, never lost); occupancy
-     skew strictly lower after the passes.
+     skew strictly lower after the passes; re-running the phase-1
+     zipf distribution over the REBALANCED placement lowers the
+     routed p99 (loud single-core skip recorded in the JSON,
+     mesh-check precedent).
   3. **SIGKILL mid-migration** -- the TARGET replica is SIGKILLed in
      the executor's ``on_after_out`` seam (docs already parked out to
      the durable handoff ColdStore), respawned, and ``migrate_in``
@@ -324,6 +327,36 @@ def main():
         print('route-check: rebalance OK (%d docs moved under load, '
               'acks exactly-once, skew %.3f -> %.3f)'
               % (moved, skew_before, skew_after))
+
+        # -- arm 2b: cost-driven placement lowers the routed tail ------
+        # same zipf distribution as phase 1 (which ran with every hot
+        # rank pinned to ONE replica), now over the rebalanced
+        # placement: the tail must come down because the hot docs'
+        # flushes no longer serialize on a single pool.  Meaningless
+        # without parallelism -- loud skip on one core, recorded in
+        # the JSON (mesh-check scaling-gate precedent).
+        seqs3 = zipf_seqs(docs, PHASE1_OPS)
+        base = {d: seqs[d] + seqs2[d] for d in docs}
+        streams3 = [(d, [change(d, s)
+                         for s in range(base[d] + 1,
+                                        base[d] + seqs3[d] + 1)])
+                    for d in docs]
+        acks3, lat3, errors3 = {}, [], []
+        run_writers(fleet.router_path, streams3, acks3, lat3, errors3)
+        p99_after = pctl(lat3, 0.99)
+        bench['placement_p99_before_ms'] = bench['routed_p99_ms']
+        bench['placement_p99_after_ms'] = round(p99_after, 3)
+        bench['placement_gate_skipped'] = \
+            single_core_skip('route-check', 'placement-p99', cores)
+        if not bench['placement_gate_skipped']:
+            assert p99_after < p99, \
+                'placement did not lower routed p99: %.1fms -> %.1fms' \
+                % (p99, p99_after)
+        print('route-check: placement OK (routed p99 %.1fms -> %.1fms '
+              'after moving the hot docs%s)'
+              % (p99, p99_after,
+                 '; gate skipped on 1 core'
+                 if bench['placement_gate_skipped'] else ''))
 
         # -- arm 3: SIGKILL the target mid-migration -------------------
         kill_doc = 'kill-doc'
